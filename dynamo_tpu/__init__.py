@@ -1,0 +1,25 @@
+"""dynamo_tpu — a TPU-native distributed LLM inference-serving framework.
+
+A ground-up rebuild of the capabilities of NVIDIA Dynamo (the reference at
+/root/reference) designed for TPU hardware: an in-process JAX/XLA engine with
+paged attention (Pallas) and continuous batching, a KV-cache-aware smart
+router, disaggregated prefill/decode workers with ICI/DCN KV-block handoff,
+and an asyncio distributed runtime (coordinator-based control plane, TCP
+response streaming) replacing the reference's etcd+NATS+NIXL stack.
+
+Layer map (bottom-up, mirroring SURVEY.md §1):
+
+  tokens      — token-block hashing (reference: lib/tokens)
+  runtime     — AsyncEngine, Context/cancellation, pipeline, distributed
+                runtime + transports (reference: lib/runtime)
+  llm         — OpenAI protocol, preprocessor, detokenizing backend, KV block
+                manager, KV-aware router, HTTP service (reference: lib/llm)
+  ops         — Pallas TPU kernels: paged attention, block copy
+                (reference: lib/llm/src/kernels/block_copy.cu + vLLM engine)
+  models      — JAX model implementations (Llama, MoE) — the "engine" the
+                reference delegates to vLLM/SGLang is in-process here
+  engine      — continuous-batching scheduler + executor on the JAX models
+  parallel    — mesh/sharding utilities, collectives layout (TP/DP/EP/SP)
+"""
+
+__version__ = "0.1.0"

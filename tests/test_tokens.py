@@ -1,0 +1,75 @@
+"""Unit tests for the token-block library (mirrors reference lib/tokens tests)."""
+
+import pytest
+
+from dynamo_tpu.tokens import (
+    TokenBlockSequence,
+    block_hashes,
+    compute_block_hash,
+    compute_seq_hash,
+    sequence_hashes,
+)
+
+
+def test_block_hash_deterministic():
+    a = compute_block_hash([1, 2, 3, 4])
+    b = compute_block_hash([1, 2, 3, 4])
+    assert a == b
+    assert a != compute_block_hash([1, 2, 3, 5])
+
+
+def test_seq_hash_chains():
+    h0 = compute_seq_hash(None, [1, 2, 3, 4])
+    h1 = compute_seq_hash(h0, [5, 6, 7, 8])
+    # chaining means same tokens under a different parent hash differently
+    assert h1 != compute_seq_hash(None, [5, 6, 7, 8])
+    # and salt perturbs the root
+    assert h0 != compute_seq_hash(None, [1, 2, 3, 4], salt=1)
+
+
+def test_fast_paths_match_object_path():
+    toks = list(range(37))
+    seq = TokenBlockSequence(toks, block_size=8)
+    assert [b.block_hash for b in seq.blocks] == block_hashes(toks, 8)
+    assert seq.sequence_hashes() == sequence_hashes(toks, 8)
+
+
+def test_shared_prefix_shares_hashes():
+    a = sequence_hashes(list(range(32)) + [100 + t for t in range(8)], 8)
+    b = sequence_hashes(list(range(32)) + [200 + t for t in range(8)], 8)
+    assert a[:4] == b[:4]
+    assert a[4] != b[4]
+
+
+def test_sequence_append_and_partial():
+    seq = TokenBlockSequence(block_size=4)
+    completed = []
+    for t in range(10):
+        blk = seq.append(t)
+        if blk is not None:
+            completed.append(blk)
+    assert len(completed) == 2
+    assert len(seq.blocks) == 2
+    assert seq.partial.tokens == [8, 9]
+    assert seq.total_tokens == 10
+    assert seq.tokens == list(range(10))
+    assert seq.blocks[0].position == 0
+    assert seq.blocks[1].parent_sequence_hash == seq.blocks[0].sequence_hash
+
+
+def test_truncate():
+    seq = TokenBlockSequence(range(20), block_size=4)
+    hashes = seq.sequence_hashes()
+    seq.truncate(10)
+    assert seq.total_tokens == 10
+    assert len(seq.blocks) == 2
+    assert seq.sequence_hashes() == hashes[:2]
+    with pytest.raises(ValueError):
+        seq.truncate(11)
+
+
+def test_extend_returns_completed():
+    seq = TokenBlockSequence(block_size=4)
+    done = seq.extend(range(9))
+    assert len(done) == 2
+    assert seq.partial.tokens == [8]
