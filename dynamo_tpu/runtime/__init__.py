@@ -1,0 +1,27 @@
+"""Runtime core: AsyncEngine abstraction, cancellation contexts, pipelines.
+
+Reference parity: lib/runtime/src/{engine.rs,pipeline.rs,lib.rs}.  The Rust
+reference builds on tokio; here the runtime is asyncio-native.  The key
+invariant carried over: every request travels with a Context that supports
+graceful stop (stop_generating) and hard kill, and cancellation propagates
+down a parent→child tree (reference: lib/runtime/src/engine.rs:47-104).
+"""
+
+from dynamo_tpu.runtime.engine import (
+    AsyncEngine,
+    Context,
+    EngineStream,
+    ResponseStream,
+)
+from dynamo_tpu.runtime.pipeline import Operator, build_pipeline
+from dynamo_tpu.runtime.echo import EchoEngine
+
+__all__ = [
+    "AsyncEngine",
+    "Context",
+    "EngineStream",
+    "ResponseStream",
+    "Operator",
+    "build_pipeline",
+    "EchoEngine",
+]

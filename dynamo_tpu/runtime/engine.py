@@ -1,0 +1,133 @@
+"""The AsyncEngine abstraction — THE core trait of the framework.
+
+An engine turns one request into a stream of responses.  Everything is an
+engine: the model executor, the preprocessor-wrapped pipeline, a remote
+endpoint client.  Composition of engines is how serving graphs are built.
+
+Reference parity:
+  * AsyncEngine trait            — lib/runtime/src/engine.rs:104
+  * AsyncEngineContext (stop/kill, is_stopped, stopped_or_killed)
+                                 — lib/runtime/src/engine.rs:47-101
+  * SingleIn<T> = Context<T>, ManyOut<U> = EngineStream<U>
+                                 — lib/runtime/src/pipeline.rs:41-68
+  * ResponseStream (stream + context handle)
+                                 — lib/runtime/src/engine.rs:116
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from abc import ABC, abstractmethod
+from typing import Any, AsyncIterator, Generic, Optional, TypeVar
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+__all__ = ["Context", "AsyncEngine", "ResponseStream", "EngineStream"]
+
+
+class Context(Generic[T]):
+    """A request envelope: payload + id + hierarchical cancellation.
+
+    ``stop_generating()`` asks the engine to finish gracefully (emit what it
+    has, mark the stream complete); ``kill()`` demands immediate abort.
+    Cancellation propagates to children (created via :meth:`child`), mirroring
+    the reference's CancellationToken tree.
+    """
+
+    __slots__ = ("data", "id", "_stop", "_kill", "_children", "annotations")
+
+    def __init__(self, data: T = None, id: Optional[str] = None):
+        self.data = data
+        self.id = id or uuid.uuid4().hex
+        self._stop = asyncio.Event()
+        self._kill = asyncio.Event()
+        self._children: list["Context"] = []
+        # free-form per-request annotations (formatted_prompt, token_ids, ...)
+        self.annotations: dict[str, Any] = {}
+
+    # -------------------------------------------------------------- transform
+    def map(self, data: U) -> "Context[U]":
+        """New payload, same identity and cancellation scope."""
+        ctx: Context[U] = Context.__new__(Context)
+        ctx.data = data
+        ctx.id = self.id
+        ctx._stop = self._stop
+        ctx._kill = self._kill
+        ctx._children = self._children
+        ctx.annotations = self.annotations
+        return ctx
+
+    def child(self, data: U = None) -> "Context[U]":
+        """A child scope: killed/stopped when the parent is, but may be
+        cancelled independently without affecting the parent."""
+        ctx: Context[U] = Context(data, id=self.id)
+        self._children.append(ctx)
+        if self._stop.is_set():
+            ctx._stop.set()
+        if self._kill.is_set():
+            ctx._kill.set()
+        return ctx
+
+    # ------------------------------------------------------------ cancellation
+    def stop_generating(self) -> None:
+        self._stop.set()
+        for c in self._children:
+            c.stop_generating()
+
+    def kill(self) -> None:
+        self._kill.set()
+        self._stop.set()
+        for c in self._children:
+            c.kill()
+
+    @property
+    def is_stopped(self) -> bool:
+        return self._stop.is_set()
+
+    @property
+    def is_killed(self) -> bool:
+        return self._kill.is_set()
+
+    async def stopped(self) -> None:
+        """Wait until stop or kill is requested."""
+        await self._stop.wait()
+
+    def __repr__(self) -> str:  # pragma: no cover
+        state = "killed" if self.is_killed else "stopped" if self.is_stopped else "live"
+        return f"Context(id={self.id[:8]}, {state})"
+
+
+EngineStream = AsyncIterator  # ManyOut<U> in the reference
+
+
+class AsyncEngine(ABC, Generic[T, U]):
+    """generate(Context[T]) -> async stream of U (ref engine.rs:104)."""
+
+    @abstractmethod
+    def generate(self, request: Context[T]) -> AsyncIterator[U]:
+        """Return an async iterator of responses.  Implementations must
+        respect ``request.is_stopped`` / ``request.is_killed``."""
+
+    async def generate_all(self, request: Context[T]) -> list[U]:
+        """Convenience: drain the stream (testing / non-streaming callers)."""
+        return [item async for item in self.generate(request)]
+
+
+class ResponseStream(Generic[U]):
+    """An async stream bundled with the context that controls it, so callers
+    downstream of a pipeline can still cancel (ref engine.rs:116)."""
+
+    def __init__(self, stream: AsyncIterator[U], context: Context):
+        self._stream = stream
+        self.context = context
+
+    def __aiter__(self) -> AsyncIterator[U]:
+        return self._stream.__aiter__()
+
+    def stop_generating(self) -> None:
+        self.context.stop_generating()
+
+    def kill(self) -> None:
+        self.context.kill()

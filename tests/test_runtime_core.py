@@ -1,0 +1,96 @@
+"""Runtime core tests: contexts, engines, pipelines (mirrors reference
+lib/runtime/tests/pipeline.rs — full pipelines in one process, mock engines)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import (
+    AsyncEngine,
+    Context,
+    EchoEngine,
+    Operator,
+    build_pipeline,
+)
+from dynamo_tpu.runtime.config import RuntimeConfig, env_is_truthy
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_echo_engine_streams():
+    out = run(EchoEngine().generate_all(Context([1, 2, 3])))
+    assert out == [1, 2, 3]
+
+
+def test_context_stop_halts_stream():
+    async def go():
+        ctx = Context(list(range(1000)))
+        got = []
+        async for item in EchoEngine(delay_s=0.001).generate(ctx):
+            got.append(item)
+            if len(got) == 3:
+                ctx.stop_generating()
+        return got
+
+    assert len(run(go())) == 3
+
+
+def test_context_map_shares_cancellation():
+    ctx = Context({"a": 1})
+    mapped = ctx.map([1, 2])
+    ctx.stop_generating()
+    assert mapped.is_stopped
+    assert mapped.id == ctx.id
+
+
+def test_child_context_tree():
+    parent = Context()
+    child = parent.child()
+    parent.kill()
+    assert child.is_killed
+    # child cancel does not affect parent
+    p2 = Context()
+    c2 = p2.child()
+    c2.stop_generating()
+    assert not p2.is_stopped
+
+
+class Doubler(Operator):
+    async def forward(self, request):
+        return request.map([x * 2 for x in request.data])
+
+    def backward(self, stream, request):
+        async def gen():
+            async for item in stream:
+                yield item + 1
+
+        return gen()
+
+
+def test_pipeline_forward_and_backward():
+    pipe = build_pipeline(EchoEngine(), Doubler(), Doubler())
+    out = run(pipe.generate_all(Context([1, 2])))
+    # forward: [1,2] -> [2,4] -> [4,8]; backward adds 1 twice
+    assert out == [6, 10]
+
+
+def test_config_env_overrides(monkeypatch):
+    monkeypatch.setenv("DYNTPU_NAMESPACE", "testns")
+    monkeypatch.setenv("DYNTPU_PORT", "7777")
+    monkeypatch.setenv("DYNTPU_IS_STATIC", "true")
+    cfg = RuntimeConfig.from_settings()
+    assert cfg.namespace == "testns"
+    assert cfg.port == 7777
+    assert cfg.is_static is True
+
+
+def test_env_truthiness(monkeypatch):
+    monkeypatch.setenv("X_FLAG", "yes")
+    assert env_is_truthy("X_FLAG")
+    monkeypatch.setenv("X_FLAG", "0")
+    assert not env_is_truthy("X_FLAG")
+    monkeypatch.setenv("X_FLAG", "bogus")
+    with pytest.raises(ValueError):
+        env_is_truthy("X_FLAG")
