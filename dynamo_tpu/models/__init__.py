@@ -1,0 +1,13 @@
+"""JAX model implementations — the in-process engine's compute path.
+
+The reference delegates forward passes to external engines (vLLM/SGLang,
+SURVEY.md §2.4); here the models are first-class: pure-JAX functions over a
+params pytree, written for XLA — lax.scan over homogeneous layers, static
+shapes, bfloat16 matmuls on the MXU, shardable over a device mesh via
+NamedSharding partition specs supplied alongside the params.
+"""
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import LlamaModel
+
+__all__ = ["ModelConfig", "LlamaModel"]

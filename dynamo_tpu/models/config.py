@@ -1,0 +1,90 @@
+"""Model architecture configuration (Llama family + MoE extensions).
+
+Loadable from a HuggingFace ``config.json`` so checkpoints drop in directly
+(reference analogue: ModelDeploymentCard builds from HF repo contents,
+lib/llm/src/model_card/create.rs).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclass
+class ModelConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None  # default hidden_size // num_heads
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    max_position_embeddings: int = 4096
+    tie_word_embeddings: bool = False
+    # MoE (Mixtral-style); num_experts == 0 → dense MLP
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # runtime
+    dtype: str = "bfloat16"
+
+    def __post_init__(self):
+        if self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_heads
+
+    @property
+    def jax_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    @classmethod
+    def tiny(cls, **kw) -> "ModelConfig":
+        """A toy config for tests (fast CPU compile, exercises GQA)."""
+        defaults = dict(
+            vocab_size=256,
+            hidden_size=64,
+            intermediate_size=128,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            max_position_embeddings=512,
+            dtype="float32",
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def from_hf_config(cls, path_or_dict, dtype: str = "bfloat16") -> "ModelConfig":
+        """Build from a HuggingFace config.json (file, dir, or dict)."""
+        if isinstance(path_or_dict, (str, Path)):
+            p = Path(path_or_dict)
+            if p.is_dir():
+                p = p / "config.json"
+            cfg = json.loads(p.read_text())
+        else:
+            cfg = dict(path_or_dict)
+        return cls(
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["hidden_size"],
+            intermediate_size=cfg["intermediate_size"],
+            num_layers=cfg["num_hidden_layers"],
+            num_heads=cfg["num_attention_heads"],
+            num_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
+            head_dim=cfg.get("head_dim"),
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            max_position_embeddings=cfg.get("max_position_embeddings", 4096),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            num_experts=cfg.get("num_local_experts", 0),
+            num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
+            dtype=dtype,
+        )

@@ -1,0 +1,237 @@
+"""Llama-family decoder in pure JAX, built for paged serving on TPU.
+
+Design (TPU-first, not a port):
+  * One unified forward pass serves prefill, chunked prefill and decode —
+    the S new tokens of each sequence scatter K/V into the paged cache then
+    run paged attention over their full context (ops/paged_attention.py).
+  * ``lax.scan`` over layers: per-layer weights are stacked on a leading L
+    axis so the whole stack compiles once — fast XLA compiles even at 80
+    layers, and the KV cache rides the scan as xs/ys.
+  * Static shapes everywhere; bf16 weights/activations on the MXU, f32
+    norms/softmax/logits.
+  * Tensor parallelism is declarative: :meth:`partition_specs` returns a
+    PartitionSpec pytree over mesh axes ("data", "model") and GSPMD inserts
+    the collectives (all-gather/psum over ICI) — no NCCL-style plumbing.
+  * MoE (Mixtral-style) uses dense one-hot dispatch: every expert computes
+    all tokens weighted by its gate probability.  Sharding experts over the
+    mesh's "expert"/"model" axis makes this the classic simple
+    expert-parallel layout (each device runs its experts, psum combines).
+
+The reference has no model code at all (engines are external, SURVEY.md
+§2.4); this module plus engine/ is the "native JAX/XLA engine" the rebuild
+adds (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.ops.paged_attention import paged_attention, write_kv_cache
+
+Params = Any  # pytree of jax.Array
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (norm * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """HF-Llama rotate-half RoPE.  x: [B,S,H,D], positions: [B,S]."""
+    d = x.shape[-1]
+    half = d // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) * 2.0 / d))
+    angles = positions.astype(jnp.float32)[:, :, None] * inv_freq[None, None, :]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B,S,1,half]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class LlamaModel:
+    """Functional model: params pytree + pure forward functions."""
+
+    def __init__(self, config: ModelConfig):
+        self.config = config
+
+    # ------------------------------------------------------------------ init
+    def init_params(self, rng: jax.Array) -> Params:
+        cfg = self.config
+        dt = cfg.jax_dtype
+        dm, hq, hk, dh, f = (
+            cfg.hidden_size,
+            cfg.num_heads,
+            cfg.num_kv_heads,
+            cfg.head_dim,
+            cfg.intermediate_size,
+        )
+        L = cfg.num_layers
+        keys = iter(jax.random.split(rng, 16))
+
+        def dense(key, shape, fan_in):
+            return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(dt)
+
+        layers: dict[str, jax.Array] = {
+            "attn_norm": jnp.ones((L, dm), dt),
+            "wq": dense(next(keys), (L, dm, hq * dh), dm),
+            "wk": dense(next(keys), (L, dm, hk * dh), dm),
+            "wv": dense(next(keys), (L, dm, hk * dh), dm),
+            "wo": dense(next(keys), (L, hq * dh, dm), hq * dh),
+            "mlp_norm": jnp.ones((L, dm), dt),
+        }
+        if cfg.is_moe:
+            e = cfg.num_experts
+            layers.update(
+                router=dense(next(keys), (L, dm, e), dm),
+                w_gate=dense(next(keys), (L, e, dm, f), dm),
+                w_up=dense(next(keys), (L, e, dm, f), dm),
+                w_down=dense(next(keys), (L, e, f, dm), f),
+            )
+        else:
+            layers.update(
+                w_gate=dense(next(keys), (L, dm, f), dm),
+                w_up=dense(next(keys), (L, dm, f), dm),
+                w_down=dense(next(keys), (L, f, dm), f),
+            )
+        params = {
+            "embed": dense(next(keys), (cfg.vocab_size, dm), dm),
+            "layers": layers,
+            "final_norm": jnp.ones((dm,), dt),
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = dense(next(keys), (dm, cfg.vocab_size), dm)
+        return params
+
+    # -------------------------------------------------------------- sharding
+    def partition_specs(self) -> Params:
+        """PartitionSpec pytree matching init_params — TP over axis "model".
+
+        GSPMD turns these annotations into ICI collectives; this is the whole
+        tensor-parallel implementation (cf. reference delegating TP to
+        vLLM/Ray, SURVEY.md §2.4 parallelism summary).
+        """
+        cfg = self.config
+        layers = {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "model"),
+            "wk": P(None, None, "model"),
+            "wv": P(None, None, "model"),
+            "wo": P(None, "model", None),
+            "mlp_norm": P(None, None),
+        }
+        if cfg.is_moe:
+            layers.update(
+                router=P(None, None, None),
+                w_gate=P(None, "model", None, None),
+                w_up=P(None, "model", None, None),
+                w_down=P(None, "model", None, None),
+            )
+        else:
+            layers.update(
+                w_gate=P(None, None, "model"),
+                w_up=P(None, None, "model"),
+                w_down=P(None, "model", None),
+            )
+        specs = {
+            "embed": P(None, None),
+            "layers": layers,
+            "final_norm": P(None),
+        }
+        if not cfg.tie_word_embeddings:
+            specs["lm_head"] = P(None, "model")
+        return specs
+
+    def cache_spec(self) -> P:
+        """KV cache [L,2,N,Bs,Hk,D]: shard the kv-head axis over "model"."""
+        return P(None, None, None, None, "model", None)
+
+    # --------------------------------------------------------------- kv cache
+    def init_kv_cache(self, num_blocks: int, block_size: int, dtype=None) -> jax.Array:
+        cfg = self.config
+        dt = dtype or cfg.jax_dtype
+        return jnp.zeros(
+            (cfg.num_layers, 2, num_blocks, block_size, cfg.num_kv_heads, cfg.head_dim),
+            dt,
+        )
+
+    # ---------------------------------------------------------------- forward
+    def forward(
+        self,
+        params: Params,
+        tokens: jax.Array,        # [B, S] int32
+        positions: jax.Array,     # [B, S] int32 (absolute; padding rows may be 0)
+        kv_cache: jax.Array,      # [L, 2, N, Bs, Hk, D]
+        block_tables: jax.Array,  # [B, M] int32
+        seq_lens: jax.Array,      # [B] int32 — context length incl. new tokens
+        slot_idx: jax.Array,      # [B, S] int32 — cache slot per new token, -1 pad
+    ) -> tuple[jax.Array, jax.Array]:
+        """Returns (hidden [B,S,Dm], updated kv_cache)."""
+        cfg = self.config
+        b, s = tokens.shape
+        dh, hq, hk = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+
+        hidden = jnp.take(params["embed"], tokens, axis=0)
+
+        def layer_step(h, layer_in):
+            lp, layer_cache = layer_in  # layer_cache: [2, N, Bs, Hk, D]
+            x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+            q = (x @ lp["wq"]).reshape(b, s, hq, dh)
+            k = (x @ lp["wk"]).reshape(b, s, hk, dh)
+            v = (x @ lp["wv"]).reshape(b, s, hk, dh)
+            q = apply_rope(q, positions, cfg.rope_theta)
+            k = apply_rope(k, positions, cfg.rope_theta)
+            k_cache, v_cache = write_kv_cache(
+                layer_cache[0], layer_cache[1], k, v, slot_idx
+            )
+            attn = paged_attention(
+                q, k_cache, v_cache, block_tables, seq_lens, positions
+            )
+            h = h + attn.reshape(b, s, hq * dh) @ lp["wo"]
+
+            x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+            if cfg.is_moe:
+                h = h + _moe_mlp(cfg, lp, x)
+            else:
+                h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+            return h, jnp.stack([k_cache, v_cache])
+
+        hidden, new_cache = jax.lax.scan(
+            layer_step, hidden, (params["layers"], kv_cache)
+        )
+        hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
+        return hidden, new_cache
+
+    def compute_logits(self, params: Params, hidden: jax.Array) -> jax.Array:
+        """hidden [..., Dm] -> logits [..., V] in f32."""
+        if self.config.tie_word_embeddings:
+            w = params["embed"].T
+        else:
+            w = params["lm_head"]
+        return (hidden.astype(jnp.float32) @ w.astype(jnp.float32))
+
+
+def _moe_mlp(cfg: ModelConfig, lp: dict, x: jax.Array) -> jax.Array:
+    """Dense-dispatch MoE: each expert computes all tokens, weighted by its
+    (top-k-normalised) router probability.  With experts sharded over the
+    mesh this is simple expert parallelism; a Pallas grouped-matmul dispatch
+    path is the planned optimisation."""
+    k = cfg.num_experts_per_tok
+    router_logits = (x @ lp["router"]).astype(jnp.float32)  # [B,S,E]
+    topv, topi = jax.lax.top_k(router_logits, k)
+    weights = jax.nn.softmax(topv, axis=-1)  # [B,S,k]
+    onehot = jax.nn.one_hot(topi, cfg.num_experts, dtype=jnp.float32)  # [B,S,k,E]
+    gate_probs = jnp.einsum("bske,bsk->bse", onehot, weights)  # [B,S,E]
+    # every expert runs all tokens: [B,S,E,F] intermediates
+    up = jnp.einsum("bsd,edf->bsef", x, lp["w_up"])
+    gate = jnp.einsum("bsd,edf->bsef", x, lp["w_gate"])
+    act = jax.nn.silu(gate) * up
+    out = jnp.einsum("bsef,efd->bsed", act, lp["w_down"])
+    return jnp.einsum("bsed,bse->bsd", out, gate_probs.astype(out.dtype))

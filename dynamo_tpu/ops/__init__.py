@@ -1,0 +1,16 @@
+"""TPU kernels and their portable JAX reference implementations.
+
+Everything the reference implements in CUDA/Triton lives here as a Pallas
+kernel plus a pure-JAX fallback (used on CPU in tests, and as the
+correctness oracle for the kernels):
+
+  paged_attention — the vLLM-engine equivalent attention over block tables
+                    (reference delegates this to vLLM; TPU version is ours)
+  block_copy      — batched gather/scatter of KV blocks between caches
+                    (reference: lib/llm/src/kernels/block_copy.cu)
+"""
+
+from dynamo_tpu.ops.paged_attention import paged_attention, write_kv_cache
+from dynamo_tpu.ops.block_copy import gather_blocks, scatter_blocks
+
+__all__ = ["paged_attention", "write_kv_cache", "gather_blocks", "scatter_blocks"]

@@ -1,0 +1,41 @@
+"""Batched KV-block gather/scatter — the block_copy.cu equivalent.
+
+The reference ships a CUDA kernel (lib/llm/src/kernels/block_copy.cu:41,
+entry points :167,246,309) that moves whole KV blocks between device and
+host pools for offload and disaggregated transfer.  On TPU the same job is
+a gather/scatter over the leading block axis of the cache — XLA compiles
+these to efficient HBM DMAs; the cross-host path stages through host RAM
+(``jax.device_get``/``device_put``) and the wire (see
+dynamo_tpu/llm/kv/transfer.py).
+
+Cache layout: [L, 2, N, Bs, Hk, D] (layers, k/v, blocks, block_size,
+kv_heads, head_dim) — one array for the whole model so a block id selects
+the block across every layer at once, exactly what transfer needs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["gather_blocks", "scatter_blocks"]
+
+
+@jax.jit
+def gather_blocks(cache: jax.Array, block_ids: jax.Array) -> jax.Array:
+    """Pull blocks out of a cache: [L,2,N,Bs,Hk,D] × [n] -> [L,2,n,Bs,Hk,D].
+
+    Used to extract a sequence's KV for offload / cross-worker transfer.
+    """
+    return jnp.take(cache, block_ids, axis=2)
+
+
+@jax.jit
+def scatter_blocks(
+    cache: jax.Array, block_ids: jax.Array, blocks: jax.Array
+) -> jax.Array:
+    """Write transferred blocks into a cache at ``block_ids``.
+
+    cache: [L,2,N,Bs,Hk,D]; blocks: [L,2,n,Bs,Hk,D]; block_ids: [n].
+    """
+    return cache.at[:, :, block_ids].set(blocks.astype(cache.dtype))

@@ -1,0 +1,90 @@
+"""Paged attention over block tables — the engine's core op.
+
+The KV cache is a pool of fixed-size blocks; each sequence owns an ordered
+list of block ids (its *block table*).  A single unified op serves prefill,
+chunked prefill and decode: the S new tokens of each sequence first scatter
+their K/V into the cache, then attend over the sequence's whole context
+(cached prefix + themselves) with causal masking by absolute position.
+
+This file holds the pure-JAX implementation: correct on any backend, used
+directly on CPU in tests, and as the oracle for the Pallas TPU kernel in
+``dynamo_tpu/ops/pallas/``.  On TPU the gather-based fallback is still a
+reasonable baseline: XLA fuses the block-table gather with the attention
+einsums, and all shapes are static (B, S, M buckets) so everything tiles
+onto the MXU.
+
+Reference parity: the reference has no such op in-repo (attention lives in
+vLLM); its CUDA surface is block_copy.cu.  This op is the heart of what the
+TPU rebuild owns natively (SURVEY.md §7 stage 4).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["write_kv_cache", "paged_attention"]
+
+
+def write_kv_cache(
+    k_cache: jax.Array,  # [N, Bs, Hk, D]  block pool
+    v_cache: jax.Array,  # [N, Bs, Hk, D]
+    k_new: jax.Array,    # [B, S, Hk, D]   fresh keys for the new tokens
+    v_new: jax.Array,    # [B, S, Hk, D]
+    slot_idx: jax.Array, # [B, S] int32    flat slot = block_id * Bs + offset; -1 = drop (padding)
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter new K/V rows into the paged cache.  Negative slots (padding
+    tokens) are dropped via scatter mode='drop'."""
+    n, bs, hk, d = k_cache.shape
+    flat_idx = slot_idx.reshape(-1)
+    # mode='drop' ignores out-of-range (negative) indices
+    k_flat = k_cache.reshape(n * bs, hk, d).at[flat_idx].set(
+        k_new.astype(k_cache.dtype).reshape(-1, hk, d), mode="drop"
+    )
+    v_flat = v_cache.reshape(n * bs, hk, d).at[flat_idx].set(
+        v_new.astype(v_cache.dtype).reshape(-1, hk, d), mode="drop"
+    )
+    return k_flat.reshape(n, bs, hk, d), v_flat.reshape(n, bs, hk, d)
+
+
+def paged_attention(
+    q: jax.Array,            # [B, S, H, D]
+    k_cache: jax.Array,      # [N, Bs, Hk, D]
+    v_cache: jax.Array,      # [N, Bs, Hk, D]
+    block_tables: jax.Array, # [B, M] int32 (entries past the sequence end may be any valid id)
+    seq_lens: jax.Array,     # [B] int32 — context length including the new tokens
+    positions: jax.Array,    # [B, S] int32 — absolute position of each query token
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Attention of S new tokens against their sequence's paged context.
+
+    Causal by absolute position: query at position p sees cache slots
+    0..p (the new tokens' K/V must already be in the cache — call
+    :func:`write_kv_cache` first).  Returns [B, S, H, D].
+    """
+    b, s, h, d = q.shape
+    _, bs, hk, _ = k_cache.shape
+    m = block_tables.shape[1]
+    t = m * bs
+    g = h // hk
+    if sm_scale is None:
+        sm_scale = 1.0 / (d**0.5)
+
+    # Gather each sequence's context: [B, M, Bs, Hk, D] -> [B, T, Hk, D]
+    k_ctx = k_cache[block_tables].reshape(b, t, hk, d)
+    v_ctx = v_cache[block_tables].reshape(b, t, hk, d)
+
+    qg = q.reshape(b, s, hk, g, d).astype(jnp.float32)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, k_ctx.astype(jnp.float32)) * sm_scale
+
+    # mask: slot j visible iff j <= position(query) and j < seq_len
+    slot = jnp.arange(t, dtype=jnp.int32)
+    lens = jnp.maximum(seq_lens, 1)  # keep padded rows numerically sane
+    visible = (slot[None, None, :] <= positions[:, :, None]) & (
+        slot[None, None, :] < lens[:, None, None]
+    )  # [B, S, T]
+    scores = jnp.where(visible[:, None, None, :, :], scores, -jnp.inf)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v_ctx.astype(jnp.float32))
+    return out.reshape(b, s, h, d).astype(q.dtype)

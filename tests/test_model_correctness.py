@@ -1,0 +1,123 @@
+"""Model correctness: our paged-attention JAX Llama vs transformers (torch CPU).
+
+The oracle strategy: build a tiny random HF LlamaForCausalLM, load its
+weights through our loader, and compare logits from (a) a full prefill and
+(b) an incremental prefill+decode through the paged KV cache.  This pins
+RoPE, GQA, RMSNorm, SiLU-MLP and the cache plumbing in one shot.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import LlamaModel
+from dynamo_tpu.models.loader import load_params_from_state_dict
+
+BLOCK = 8
+SEQ = 21
+MAX_BLOCKS = 8
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    hf_cfg = LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    model = LlamaForCausalLM(hf_cfg).eval()
+    return hf_cfg, model
+
+
+@pytest.fixture(scope="module")
+def ours(hf_model):
+    hf_cfg, model = hf_model
+    cfg = ModelConfig.from_hf_config(hf_cfg.to_dict(), dtype="float32")
+    params = load_params_from_state_dict(cfg, model.state_dict())
+    return cfg, LlamaModel(cfg), params
+
+
+def _hf_logits(hf_model, tokens):
+    import torch
+
+    _, model = hf_model
+    with torch.no_grad():
+        out = model(torch.tensor([tokens]))
+    return out.logits[0].float().numpy()
+
+
+def _run_ours(model, params, tokens, *, chunks):
+    """Run tokens through the paged path in the given chunk sizes."""
+    cfg = model.config
+    cache = model.init_kv_cache(MAX_BLOCKS, BLOCK)
+    block_table = jnp.arange(MAX_BLOCKS, dtype=jnp.int32)[None, :]
+    logits_out = []
+    pos = 0
+    for size in chunks:
+        chunk = tokens[pos : pos + size]
+        positions = jnp.arange(pos, pos + size, dtype=jnp.int32)[None, :]
+        slot_idx = positions  # identity block table → slot == position
+        hidden, cache = model.forward(
+            params,
+            jnp.asarray([chunk], dtype=jnp.int32),
+            positions,
+            cache,
+            block_table,
+            jnp.asarray([pos + size], dtype=jnp.int32),
+            slot_idx,
+        )
+        logits_out.append(np.asarray(model.compute_logits(params, hidden))[0])
+        pos += size
+    return np.concatenate(logits_out, axis=0)
+
+
+def test_full_prefill_matches_hf(hf_model, ours):
+    cfg, model, params = ours
+    tokens = list(np.random.RandomState(1).randint(0, 128, size=SEQ))
+    ref = _hf_logits(hf_model, tokens)
+    got = _run_ours(model, params, tokens, chunks=[SEQ])
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=5e-3)
+
+
+def test_chunked_prefill_and_decode_matches_hf(hf_model, ours):
+    cfg, model, params = ours
+    tokens = list(np.random.RandomState(2).randint(0, 128, size=SEQ))
+    ref = _hf_logits(hf_model, tokens)
+    # prefill in 2 chunks then decode token-by-token through the paged cache
+    got = _run_ours(model, params, tokens, chunks=[9, 7] + [1] * (SEQ - 16))
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=5e-3)
+
+
+def test_moe_forward_runs():
+    cfg = ModelConfig.tiny(num_experts=4, num_experts_per_tok=2)
+    model = LlamaModel(cfg)
+    import jax
+
+    params = model.init_params(jax.random.PRNGKey(0))
+    cache = model.init_kv_cache(4, BLOCK)
+    toks = jnp.asarray([[1, 2, 3]], dtype=jnp.int32)
+    positions = jnp.asarray([[0, 1, 2]], dtype=jnp.int32)
+    hidden, cache2 = model.forward(
+        params,
+        toks,
+        positions,
+        cache,
+        jnp.arange(4, dtype=jnp.int32)[None, :],
+        jnp.asarray([3], dtype=jnp.int32),
+        positions,
+    )
+    assert hidden.shape == (1, 3, cfg.hidden_size)
+    assert np.isfinite(np.asarray(hidden)).all()
