@@ -1,15 +1,27 @@
 """Test harness configuration.
 
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
-exercised without TPU hardware (the driver's dryrun does the same). Must be
-set before jax is imported anywhere.
+exercised without TPU hardware (the driver's dryrun does the same).
+
+This image injects a TPU PJRT plugin ("axon") via sitecustomize, which has
+already imported jax and registered its backend factory by the time conftest
+runs — so plain env vars are too late.  We flip the platform through
+jax.config and drop the axon factory before any backend initialises.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax._src.xla_bridge as _xb
+
+_xb._backend_factories.pop("axon", None)
