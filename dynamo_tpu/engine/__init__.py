@@ -1,0 +1,14 @@
+"""The in-process JAX engine: continuous batching over paged KV.
+
+The reference orchestrates external engines (vLLM/SGLang, SURVEY.md §2.4);
+here the engine is ours: a single jitted unified step (prefill & decode
+share one forward), static shapes (fixed decode batch, bucketed prefill
+lengths), a block manager with prefix reuse, and an asyncio front door that
+plugs into the runtime's AsyncEngine pipeline.
+"""
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.core import EngineCore
+from dynamo_tpu.engine.async_engine import AsyncLLMEngine
+
+__all__ = ["EngineConfig", "EngineCore", "AsyncLLMEngine"]

@@ -1,0 +1,113 @@
+"""AsyncLLMEngine — asyncio front door over the engine thread.
+
+Implements the runtime's AsyncEngine contract (generate(Context[BackendInput])
+→ stream of LLMEngineOutput) so the engine slots directly into pipelines,
+the HTTP service, and distributed endpoints.  The engine core runs on its
+own thread (JAX dispatch blocks); tokens cross back via
+loop.call_soon_threadsafe into per-request asyncio queues.
+
+Cancellation: a stopped/killed Context aborts the request in the core at
+the next step boundary (reference: AsyncEngineContext::stop_generating
+carried as ControlMessage::{Stop,Kill}, lib/runtime/src/engine.rs:76-84).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from typing import AsyncIterator
+
+from dynamo_tpu.engine.core import EngineCore
+from dynamo_tpu.engine.request import EngineRequest
+from dynamo_tpu.llm.protocols import BackendInput, LLMEngineOutput
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+log = logging.getLogger("dynamo_tpu.engine")
+
+__all__ = ["AsyncLLMEngine"]
+
+
+class AsyncLLMEngine(AsyncEngine):
+    def __init__(self, core: EngineCore):
+        self.core = core
+        self._wake = threading.Event()
+        self._shutdown = False
+        self._thread: threading.Thread | None = None
+
+    # --------------------------------------------------------------- lifecycle
+    def start(self) -> "AsyncLLMEngine":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="engine-core", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _run(self) -> None:
+        while not self._shutdown:
+            try:
+                did_work = self.core.step()
+            except Exception:
+                log.exception("engine step failed")
+                did_work = False
+            if not did_work:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+
+    # ---------------------------------------------------------------- generate
+    def generate(self, request: Context[BackendInput]) -> AsyncIterator[LLMEngineOutput]:
+        return self._generate(request)
+
+    async def _generate(self, request: Context[BackendInput]) -> AsyncIterator[LLMEngineOutput]:
+        inp = request.data
+        loop = asyncio.get_running_loop()
+        out_q: asyncio.Queue[LLMEngineOutput] = asyncio.Queue()
+
+        def emit(out: LLMEngineOutput) -> None:
+            loop.call_soon_threadsafe(out_q.put_nowait, out)
+
+        req = EngineRequest(
+            request_id=request.id,
+            prompt=list(inp.token_ids),
+            sampling=inp.sampling,
+            stops=inp.stops,
+            emit=emit,
+        )
+        self.core.submit(req)
+        self._wake.set()
+
+        cancel_task = asyncio.ensure_future(request.stopped())
+        try:
+            while True:
+                get_task = asyncio.ensure_future(out_q.get())
+                done, _ = await asyncio.wait(
+                    [get_task, cancel_task], return_when=asyncio.FIRST_COMPLETED
+                )
+                if get_task in done:
+                    out = get_task.result()
+                    yield out
+                    if out.finished:
+                        return
+                else:
+                    get_task.cancel()
+                    self.core.abort(req.request_id)
+                    self._wake.set()
+                    # drain until the core confirms cancellation
+                    while True:
+                        out = await out_q.get()
+                        yield out
+                        if out.finished:
+                            return
+        finally:
+            cancel_task.cancel()
+            if not request.is_stopped and req.finish_reason is None:
+                # consumer dropped the stream mid-generation
+                self.core.abort(req.request_id)
+                self._wake.set()

@@ -1,0 +1,50 @@
+"""Engine configuration: batching, cache sizing, bucketing, sharding."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+def default_buckets(max_len: int) -> list[int]:
+    """Powers of two up to max_len (prefill padding buckets)."""
+    out = []
+    b = 16
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return out
+
+
+@dataclass
+class EngineConfig:
+    # batching
+    max_batch_size: int = 8           # decode slots (static shape)
+    max_model_len: int = 2048
+    # paged cache
+    block_size: int = 16
+    num_blocks: int = 512             # cache blocks in HBM
+    cache_dtype: Optional[str] = None  # default: model dtype
+    enable_prefix_reuse: bool = True
+    # prefill
+    prefill_buckets: list[int] = field(default_factory=list)
+    # sharding: data/model axis sizes; 1,1 = single chip
+    mesh_shape: tuple[int, int] = (1, 1)
+    # rng
+    seed: int = 0
+
+    def __post_init__(self):
+        if not self.prefill_buckets:
+            self.prefill_buckets = default_buckets(self.max_model_len)
+        self.prefill_buckets = sorted(self.prefill_buckets)
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return -(-self.max_model_len // self.block_size)
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"sequence length {n} exceeds max_model_len {self.max_model_len}")

@@ -1,0 +1,369 @@
+"""EngineCore — the continuous-batching scheduler + executor.
+
+One jitted *unified step* runs both phases (the model's forward handles any
+[B, S] of new tokens against the paged cache):
+
+  prefill:  B=1, S=bucketed prompt remainder (prefix-cache hits skipped)
+  decode:   B=max_batch_size slots, S=1
+
+All shapes are static: the decode batch is a fixed array of slots (inactive
+rows masked via seq_len=0 / slot_idx=-1) and prefill lengths are padded to
+power-of-two buckets — so XLA compiles a handful of executables total and
+the hot loop never retraces.  The KV cache array is donated through the
+step so XLA updates it in place.
+
+Scheduling policy (reference analogue is inside vLLM; ours is explicit):
+admit waiting requests into free slots, run at most one prefill step per
+iteration (keeps decode ITL bounded), otherwise run one decode step for all
+running slots.  Prefix-cache hits shorten prefill via the block manager
+(lib/llm/src/kv/manager.rs:31 prepare_prefill_sequence analogue).
+
+Thread-safety: everything here runs on the engine thread; submit()/abort()
+are the only cross-thread entry points and only touch thread-safe queues.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.request import EngineRequest, RequestState
+from dynamo_tpu.engine.sampling import sample_tokens
+from dynamo_tpu.llm.kv.block_manager import KvBlockManager, NoFreeBlocks
+from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput
+from dynamo_tpu.models.llama import LlamaModel
+from dynamo_tpu.tokens import TokenBlockSequence
+
+log = logging.getLogger("dynamo_tpu.engine")
+
+__all__ = ["EngineCore"]
+
+
+class EngineCore:
+    def __init__(
+        self,
+        model: LlamaModel,
+        params,
+        config: EngineConfig,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        eos_token_ids: Optional[list[int]] = None,
+    ):
+        self.model = model
+        self.config = config
+        self.mesh = mesh
+        self.eos_token_ids = set(eos_token_ids or [])
+        self.block_manager = KvBlockManager(
+            config.num_blocks,
+            config.block_size,
+            enable_prefix_reuse=config.enable_prefix_reuse,
+        )
+
+        cache_dtype = config.cache_dtype or model.config.dtype
+        cache = model.init_kv_cache(config.num_blocks, config.block_size, cache_dtype)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            params = jax.device_put(
+                params,
+                jax.tree.map(
+                    lambda s: NamedSharding(mesh, s),
+                    model.partition_specs(),
+                    is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+                ),
+            )
+            cache = jax.device_put(cache, NamedSharding(mesh, model.cache_spec()))
+        self.params = params
+        self.cache = cache
+
+        self._rng = jax.random.PRNGKey(config.seed)
+        self._step_fn = jax.jit(self._step_impl, donate_argnums=(1,))
+
+        self.slots: list[Optional[EngineRequest]] = [None] * config.max_batch_size
+        self.waiting: "queue.SimpleQueue[EngineRequest]" = queue.SimpleQueue()
+        self._admitted: list[EngineRequest] = []  # waiting for a slot/blocks
+        self._by_id: dict[str, EngineRequest] = {}
+        self._abort_q: "queue.SimpleQueue[str]" = queue.SimpleQueue()
+        self._lock = threading.Lock()
+        # perf counters
+        self.steps = 0
+        self.prefill_steps = 0
+        self.decode_steps = 0
+        self.tokens_generated = 0
+
+    # ----------------------------------------------------------- step kernel
+    def _step_impl(
+        self, params, cache, tokens, positions, block_tables, seq_lens,
+        slot_idx, last_idx, rng, temp, top_k, top_p,
+    ):
+        hidden, cache = self.model.forward(
+            params, tokens, positions, cache, block_tables, seq_lens, slot_idx
+        )
+        b = tokens.shape[0]
+        last_h = hidden[jnp.arange(b), last_idx]  # [B, Dm]
+        logits = self.model.compute_logits(params, last_h)  # [B, V] f32
+        sampled = sample_tokens(logits, rng, temp, top_k, top_p)
+        return sampled, cache
+
+    def _run_step(self, tokens, positions, block_tables, seq_lens, slot_idx,
+                  last_idx, temp, top_k, top_p) -> np.ndarray:
+        self._rng, rng = jax.random.split(self._rng)
+        sampled, self.cache = self._step_fn(
+            self.params, self.cache,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(block_tables), jnp.asarray(seq_lens),
+            jnp.asarray(slot_idx), jnp.asarray(last_idx),
+            rng,
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+        )
+        self.steps += 1
+        return np.asarray(sampled)
+
+    # ------------------------------------------------------- cross-thread API
+    def submit(self, request: EngineRequest) -> None:
+        self.waiting.put(request)
+
+    def abort(self, request_id: str) -> None:
+        self._abort_q.put(request_id)
+
+    def has_work(self) -> bool:
+        return (
+            not self.waiting.empty()
+            or bool(self._admitted)
+            or any(s is not None for s in self.slots)
+        )
+
+    def metrics(self) -> dict:
+        """ForwardPassMetrics equivalent (ref kv_router/protocols.rs:30-47)."""
+        active = sum(1 for s in self.slots if s is not None)
+        return {
+            "request_active_slots": active,
+            "request_total_slots": self.config.max_batch_size,
+            "kv_active_blocks": self.block_manager.active_blocks,
+            "kv_total_blocks": self.block_manager.num_blocks,
+            "num_requests_waiting": self.waiting.qsize() + len(self._admitted),
+            "kv_usage_perc": self.block_manager.usage,
+            "tokens_generated": self.tokens_generated,
+        }
+
+    # -------------------------------------------------------------- main loop
+    def step(self) -> bool:
+        """Run one scheduling iteration.  Returns False when idle."""
+        self._process_aborts()
+        self._admit()
+        prefill = next(
+            (r for r in self.slots if r is not None and r.state is RequestState.PREFILL),
+            None,
+        )
+        if prefill is not None:
+            self._run_prefill(prefill)
+            return True
+        if any(r is not None and r.state is RequestState.RUNNING for r in self.slots):
+            self._run_decode()
+            return True
+        return False
+
+    def _process_aborts(self) -> None:
+        while True:
+            try:
+                rid = self._abort_q.get_nowait()
+            except queue.Empty:
+                break
+            req = self._by_id.get(rid)
+            if req is not None:
+                req.abort_requested = True
+
+    def _admit(self) -> None:
+        # drain the cross-thread queue
+        while True:
+            try:
+                req = self.waiting.get_nowait()
+            except queue.Empty:
+                break
+            self._admitted.append(req)
+        for req in list(self._admitted):
+            if req.abort_requested:
+                self._admitted.remove(req)
+                self._finish(req, FinishReason.CANCELLED)
+                continue
+            slot = next((i for i, s in enumerate(self.slots) if s is None), None)
+            if slot is None:
+                break
+            if req.prompt_len == 0:
+                self._admitted.remove(req)
+                self._finish(req, FinishReason.ERROR)
+                continue
+            if req.prompt_len >= self.config.max_model_len:
+                self._admitted.remove(req)
+                self._finish(req, FinishReason.LENGTH)
+                continue
+            req.seq = TokenBlockSequence(req.prompt, self.config.block_size)
+            try:
+                alloc = self.block_manager.allocate(
+                    req.seq.sequence_hashes(), req.prompt_len
+                )
+            except NoFreeBlocks:
+                break  # retry next step once blocks free up
+            req.block_ids = alloc.block_ids
+            req.cached_tokens = alloc.cached_tokens
+            req.computed_tokens = alloc.cached_tokens
+            req.slot = slot
+            req.state = RequestState.PREFILL
+            self.slots[slot] = req
+            self._by_id[req.request_id] = req
+            self._admitted.remove(req)
+
+    # ---------------------------------------------------------------- prefill
+    def _run_prefill(self, req: EngineRequest) -> None:
+        cfg = self.config
+        remaining = req.prompt_len - req.computed_tokens
+        s = cfg.bucket_for(remaining)
+        m = cfg.max_blocks_per_seq
+
+        tokens = np.zeros((1, s), np.int32)
+        positions = np.zeros((1, s), np.int32)
+        slot_idx = np.full((1, s), -1, np.int32)
+        tokens[0, :remaining] = req.prompt[req.computed_tokens :]
+        pos = np.arange(req.computed_tokens, req.prompt_len, dtype=np.int32)
+        positions[0, :remaining] = pos
+        bt = np.zeros((1, m), np.int32)
+        bt[0, : len(req.block_ids)] = req.block_ids
+        slot_idx[0, :remaining] = (
+            bt[0, pos // cfg.block_size] * cfg.block_size + pos % cfg.block_size
+        )
+        seq_lens = np.asarray([req.prompt_len], np.int32)
+        last_idx = np.asarray([remaining - 1], np.int32)
+
+        sampled = self._run_step(
+            tokens, positions, bt, seq_lens, slot_idx, last_idx,
+            np.asarray([req.sampling.temperature], np.float32),
+            np.asarray([req.sampling.top_k], np.int32),
+            np.asarray([req.sampling.top_p], np.float32),
+        )
+        self.prefill_steps += 1
+        req.computed_tokens = req.prompt_len
+        req.state = RequestState.RUNNING
+        # prompt blocks that are now fully computed become reusable
+        for blk in req.seq.blocks:
+            bid = req.block_ids[blk.position]
+            self.block_manager.commit(
+                bid, blk.sequence_hash, blk.parent_sequence_hash, list(blk.tokens)
+            )
+        self._append_token(req, int(sampled[0]), first=True)
+
+    # ----------------------------------------------------------------- decode
+    def _run_decode(self) -> None:
+        cfg = self.config
+        b, m = cfg.max_batch_size, cfg.max_blocks_per_seq
+        tokens = np.zeros((b, 1), np.int32)
+        positions = np.zeros((b, 1), np.int32)
+        slot_idx = np.full((b, 1), -1, np.int32)
+        bt = np.zeros((b, m), np.int32)
+        seq_lens = np.zeros(b, np.int32)
+        last_idx = np.zeros(b, np.int32)
+        temp = np.ones(b, np.float32)
+        top_k = np.zeros(b, np.int32)
+        top_p = np.ones(b, np.float32)
+
+        active: list[EngineRequest] = []
+        for i, req in enumerate(self.slots):
+            if req is None or req.state is not RequestState.RUNNING:
+                continue
+            p = req.seq.total_tokens - 1  # position of the not-yet-computed last token
+            needed = p // cfg.block_size + 1
+            if len(req.block_ids) < needed:
+                try:
+                    req.block_ids.extend(self.block_manager.allocate_raw(1))
+                except NoFreeBlocks:
+                    # no memory to grow this sequence — finish it at length
+                    self._finish_slot(req, FinishReason.LENGTH)
+                    continue
+            active.append(req)
+            tokens[i, 0] = req.seq.tokens[-1]
+            positions[i, 0] = p
+            bt[i, : len(req.block_ids)] = req.block_ids
+            slot_idx[i, 0] = (
+                req.block_ids[p // cfg.block_size] * cfg.block_size + p % cfg.block_size
+            )
+            seq_lens[i] = req.seq.total_tokens
+            temp[i] = req.sampling.temperature
+            top_k[i] = req.sampling.top_k
+            top_p[i] = req.sampling.top_p
+
+        if not active:
+            return
+        sampled = self._run_step(
+            tokens, positions, bt, seq_lens, slot_idx, last_idx, temp, top_k, top_p
+        )
+        self.decode_steps += 1
+        for req in active:
+            self._append_token(req, int(sampled[req.slot]))
+
+    # ------------------------------------------------------------- lifecycle
+    def _append_token(self, req: EngineRequest, token: int, first: bool = False) -> None:
+        """Record a sampled token, emit the delta, apply stop conditions.
+
+        The token's KV is *not* yet in the cache — it is computed by the next
+        decode step (standard one-step lag).  A block completed by the
+        previous token is committed here once its KV landed.
+        """
+        if req.abort_requested:
+            self._finish_slot(req, FinishReason.CANCELLED)
+            return
+        # the previous tail token's KV just landed (one-step lag); if that
+        # filled a block, the block is now fully resident — commit it
+        kv_resident = req.seq.total_tokens  # tokens with KV in cache, pre-append
+        if not first and kv_resident > 0 and kv_resident % self.config.block_size == 0:
+            blk = req.seq.blocks[kv_resident // self.config.block_size - 1]
+            if blk.position < len(req.block_ids):
+                self.block_manager.commit(
+                    req.block_ids[blk.position],
+                    blk.sequence_hash,
+                    blk.parent_sequence_hash,
+                    list(blk.tokens),
+                )
+        req.seq.append(token)
+        req.generated += 1
+        self.tokens_generated += 1
+
+        finish: Optional[FinishReason] = None
+        st = req.stops
+        if token in self.eos_token_ids and not st.ignore_eos and req.generated >= st.min_tokens:
+            finish = FinishReason.EOS
+        elif token in st.stop_token_ids and req.generated >= st.min_tokens:
+            finish = FinishReason.STOP
+        elif st.max_tokens is not None and req.generated >= st.max_tokens:
+            finish = FinishReason.LENGTH
+        elif req.seq.total_tokens >= self.config.max_model_len:
+            finish = FinishReason.LENGTH
+
+        out = LLMEngineOutput(
+            token_ids=[token], finish_reason=finish, cached_tokens=req.cached_tokens
+        )
+        req.emit(out)
+        if finish is not None:
+            self._finish_slot(req, finish, emitted=True)
+
+    def _finish_slot(self, req: EngineRequest, reason: FinishReason, emitted: bool = False) -> None:
+        if req.slot >= 0 and self.slots[req.slot] is req:
+            self.slots[req.slot] = None
+        self.block_manager.release(req.block_ids)
+        req.block_ids = []
+        self._by_id.pop(req.request_id, None)
+        req.state = RequestState.FINISHED
+        req.finish_reason = reason
+        if not emitted:
+            req.emit(LLMEngineOutput(token_ids=[], finish_reason=reason,
+                                     cached_tokens=req.cached_tokens))
+
+    def _finish(self, req: EngineRequest, reason: FinishReason) -> None:
+        """Finish a request that never got a slot."""
+        req.state = RequestState.FINISHED
+        req.finish_reason = reason
+        req.emit(LLMEngineOutput(token_ids=[], finish_reason=reason))

@@ -1,0 +1,50 @@
+"""Per-request engine state machine."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from dynamo_tpu.llm.protocols import (
+    FinishReason,
+    LLMEngineOutput,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.tokens import TokenBlockSequence
+
+
+class RequestState(enum.Enum):
+    WAITING = "waiting"    # queued, no slot yet
+    PREFILL = "prefill"    # slot assigned, prompt not fully computed
+    RUNNING = "running"    # decoding
+    FINISHED = "finished"
+
+
+@dataclass
+class EngineRequest:
+    request_id: str
+    prompt: list[int]
+    sampling: SamplingOptions = field(default_factory=SamplingOptions)
+    stops: StopConditions = field(default_factory=StopConditions)
+    # called from the engine thread with each LLMEngineOutput delta
+    emit: Callable[[LLMEngineOutput], None] = lambda out: None
+
+    state: RequestState = RequestState.WAITING
+    seq: Optional[TokenBlockSequence] = None  # prompt + generated tokens
+    block_ids: list[int] = field(default_factory=list)
+    cached_tokens: int = 0     # prefix-cache hit (KV already resident)
+    computed_tokens: int = 0   # prompt tokens whose KV is computed
+    generated: int = 0
+    slot: int = -1
+    finish_reason: Optional[FinishReason] = None
+    abort_requested: bool = False
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt)
+
+    @property
+    def total_tokens(self) -> int:
+        return self.seq.total_tokens if self.seq else self.prompt_len

@@ -1,0 +1,3 @@
+"""LLM serving library: protocols, preprocessing, KV management, routing,
+HTTP service — the lib/llm equivalent (SURVEY.md §2.2), minus the engine
+itself which lives in dynamo_tpu/engine (in-process JAX, not external)."""

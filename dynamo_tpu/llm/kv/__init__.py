@@ -1,0 +1,14 @@
+"""Worker-side paged-KV bookkeeping: block pool, prefix reuse, events,
+transfer.  Reference parity: lib/llm/src/kv/{manager,reuse,reserved}.rs and
+the KV event types in lib/llm/src/kv_router/protocols.rs."""
+
+from dynamo_tpu.llm.kv.events import KvCacheEvent, KvStoredEvent, KvRemovedEvent
+from dynamo_tpu.llm.kv.block_manager import KvBlockManager, BlockAllocation
+
+__all__ = [
+    "KvCacheEvent",
+    "KvStoredEvent",
+    "KvRemovedEvent",
+    "KvBlockManager",
+    "BlockAllocation",
+]

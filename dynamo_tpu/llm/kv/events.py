@@ -1,0 +1,64 @@
+"""KV cache events — how workers tell the router what their caches hold.
+
+Reference parity: KvCacheEvent{Stored{parent_hash, blocks}, Removed{hashes}}
+(lib/llm/src/kv_router/protocols.rs:60-120 region), published per worker on
+the event plane and consumed by the router's radix-tree indexer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+
+@dataclass
+class KvStoredEvent:
+    """Blocks became resident (and reusable) on a worker.
+
+    ``block_hashes`` are chained sequence hashes (dynamo_tpu.tokens), in
+    order; ``parent_hash`` is the sequence hash of the block preceding the
+    first one (None at sequence root).
+    """
+
+    block_hashes: list[int]
+    parent_hash: Optional[int] = None
+    token_blocks: list[list[int]] = field(default_factory=list)  # optional token payload
+
+    kind = "stored"
+
+
+@dataclass
+class KvRemovedEvent:
+    """Blocks were evicted from a worker's cache."""
+
+    block_hashes: list[int]
+
+    kind = "removed"
+
+
+KvCacheEvent = Union[KvStoredEvent, KvRemovedEvent]
+
+
+def event_to_wire(event_id: int, worker_id: int, ev: KvCacheEvent) -> dict:
+    """JSON-serialisable router event (ref RouterEvent, indexer.rs)."""
+    out = {"event_id": event_id, "worker_id": worker_id, "kind": ev.kind}
+    if isinstance(ev, KvStoredEvent):
+        out["parent_hash"] = ev.parent_hash
+        out["block_hashes"] = ev.block_hashes
+        if ev.token_blocks:
+            out["token_blocks"] = ev.token_blocks
+    else:
+        out["block_hashes"] = ev.block_hashes
+    return out
+
+
+def event_from_wire(d: dict) -> tuple[int, int, KvCacheEvent]:
+    if d["kind"] == "stored":
+        ev: KvCacheEvent = KvStoredEvent(
+            block_hashes=list(d["block_hashes"]),
+            parent_hash=d.get("parent_hash"),
+            token_blocks=[list(t) for t in d.get("token_blocks", [])],
+        )
+    else:
+        ev = KvRemovedEvent(block_hashes=list(d["block_hashes"]))
+    return d["event_id"], d["worker_id"], ev

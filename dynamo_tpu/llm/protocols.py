@@ -1,0 +1,83 @@
+"""Engine-agnostic internal request/response protocol.
+
+The preprocessor turns OpenAI-level requests into a BackendInput (token ids
++ sampling + stop conditions); engines emit LLMEngineOutput deltas; the
+backend detokenizes them into text deltas.
+
+Reference parity: lib/llm/src/protocols/common/llm_backend.rs:1-126
+(BackendInput, LLMEngineOutput, FinishReason) and protocols/common/
+(SamplingOptions, StopConditions).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+class FinishReason(str, enum.Enum):
+    EOS = "eos"          # hit an end-of-sequence token
+    STOP = "stop"        # hit a stop sequence / stop token
+    LENGTH = "length"    # max_tokens or model context limit
+    CANCELLED = "cancelled"
+    ERROR = "error"
+
+    def as_openai(self) -> str:
+        """Map to OpenAI finish_reason strings."""
+        if self in (FinishReason.EOS, FinishReason.STOP):
+            return "stop"
+        if self is FinishReason.LENGTH:
+            return "length"
+        return "stop" if self is FinishReason.CANCELLED else "error"
+
+
+@dataclass
+class SamplingOptions:
+    temperature: float = 1.0
+    top_k: int = 0          # 0 = disabled
+    top_p: float = 1.0
+    seed: Optional[int] = None
+    # reserved for parity with reference SamplingOptions; not yet applied
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+
+@dataclass
+class StopConditions:
+    max_tokens: Optional[int] = None
+    stop: list[str] = field(default_factory=list)          # stop strings (detok layer)
+    stop_token_ids: list[int] = field(default_factory=list)
+    ignore_eos: bool = False
+    min_tokens: int = 0
+
+
+@dataclass
+class BackendInput:
+    """What an engine consumes: tokens in, sampling+stop config."""
+
+    token_ids: list[int] = field(default_factory=list)
+    sampling: SamplingOptions = field(default_factory=SamplingOptions)
+    stops: StopConditions = field(default_factory=StopConditions)
+    model: str = ""
+    annotations: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class LLMEngineOutput:
+    """A streamed engine delta: newly generated token ids (usually one)."""
+
+    token_ids: list[int] = field(default_factory=list)
+    finish_reason: Optional[FinishReason] = None
+    # engine-side bookkeeping surfaced for metrics/tests
+    cached_tokens: int = 0      # prefix-cache hit length for this request
+    # filled by the detokenizing backend:
+    text: Optional[str] = None
+
+    @property
+    def finished(self) -> bool:
+        return self.finish_reason is not None

@@ -1,0 +1,85 @@
+"""KV block manager: pool, prefix reuse, refcount dedupe, LRU eviction, events."""
+
+import pytest
+
+from dynamo_tpu.llm.kv import KvBlockManager, KvRemovedEvent, KvStoredEvent
+from dynamo_tpu.llm.kv.block_manager import NoFreeBlocks
+from dynamo_tpu.tokens import sequence_hashes
+
+BS = 4
+
+
+def hashes(tokens):
+    return sequence_hashes(tokens, BS)
+
+
+def test_allocate_and_release():
+    mgr = KvBlockManager(8, BS)
+    toks = list(range(10))  # 2 full blocks + partial
+    alloc = mgr.allocate(hashes(toks), len(toks))
+    assert len(alloc.block_ids) == 3
+    assert alloc.cached_tokens == 0
+    assert mgr.active_blocks == 3
+    mgr.release(alloc.block_ids)
+    assert mgr.active_blocks == 0
+
+
+def test_prefix_reuse_and_dedupe():
+    events = []
+    mgr = KvBlockManager(8, BS, event_sink=events.append)
+    toks = list(range(12))
+    h = hashes(toks)
+    a = mgr.allocate(h, len(toks))
+    # commit the first two blocks (KV computed)
+    mgr.commit(a.block_ids[0], h[0], None)
+    mgr.commit(a.block_ids[1], h[1], h[0])
+    assert len(events) == 2 and all(isinstance(e, KvStoredEvent) for e in events)
+
+    # concurrent identical prompt dedupes onto the same blocks (still active)
+    b = mgr.allocate(h, len(toks))
+    assert b.block_ids[:2] == a.block_ids[:2]
+    assert b.cached_tokens == 8
+    # third block is fresh
+    assert b.block_ids[2] != a.block_ids[2]
+
+    mgr.release(a.block_ids)
+    # blocks still matchable after release (state preservation, ref reuse.rs:16)
+    c = mgr.allocate(h, len(toks))
+    assert c.block_ids[:2] == b.block_ids[:2]
+    assert c.cached_tokens == 8
+
+
+def test_last_token_never_cached():
+    mgr = KvBlockManager(8, BS)
+    toks = list(range(8))  # exactly 2 blocks
+    h = hashes(toks)
+    a = mgr.allocate(h, len(toks))
+    mgr.commit(a.block_ids[0], h[0], None)
+    mgr.commit(a.block_ids[1], h[1], h[0])
+    b = mgr.allocate(h, len(toks))
+    # only the first block may be matched: the engine must recompute >=1 token
+    assert b.cached_tokens == 4
+
+
+def test_lru_eviction_emits_removed():
+    events = []
+    mgr = KvBlockManager(2, BS, event_sink=events.append)
+    h1 = hashes([1, 2, 3, 4])
+    a = mgr.allocate(h1, 4 + 1)  # needs 2 blocks
+    mgr.commit(a.block_ids[0], h1[0], None)
+    mgr.release(a.block_ids)
+    # all blocks idle; new allocation must evict the registered one eventually
+    h2 = hashes([9, 9, 9, 9])
+    b = mgr.allocate(h2, 5)
+    assert len(b.block_ids) == 2
+    removed = [e for e in events if isinstance(e, KvRemovedEvent)]
+    assert removed and removed[0].block_hashes == [h1[0]]
+
+
+def test_pool_exhaustion():
+    mgr = KvBlockManager(2, BS)
+    mgr.allocate(hashes([1, 2, 3, 4]), 8)
+    with pytest.raises(NoFreeBlocks):
+        mgr.allocate(hashes([5, 6, 7, 8]), 8)
+    # failed allocation must not leak partial blocks
+    assert mgr.active_blocks == 2
