@@ -55,7 +55,8 @@ class AsyncLLMEngine(AsyncEngine):
             try:
                 did_work = self.core.step()
             except Exception:
-                log.exception("engine step failed")
+                log.exception("engine step failed; failing in-flight requests")
+                self.core.fail_all()
                 did_work = False
             if not did_work:
                 self._wake.wait(timeout=0.05)

@@ -139,6 +139,20 @@ class EngineCore:
             or any(s is not None for s in self.slots)
         )
 
+    def fail_all(self) -> None:
+        """Fail every in-flight and queued request (engine step blew up) so
+        callers get an error finish instead of a hung stream."""
+        for req in [r for r in self.slots if r is not None]:
+            self._finish_slot(req, FinishReason.ERROR)
+        for req in self._admitted:
+            self._finish(req, FinishReason.ERROR)
+        self._admitted.clear()
+        while True:
+            try:
+                self._finish(self.waiting.get_nowait(), FinishReason.ERROR)
+            except queue.Empty:
+                break
+
     def metrics(self) -> dict:
         """ForwardPassMetrics equivalent (ref kv_router/protocols.rs:30-47)."""
         active = sum(1 for s in self.slots if s is not None)

@@ -26,14 +26,15 @@ def sample_tokens(
 ) -> jax.Array:
     """Returns sampled token ids [B]."""
     b, v = logits.shape
-    vals, idx = jax.lax.top_k(logits, K_MAX)  # [B, K] descending
+    k_max = min(K_MAX, v)
+    vals, idx = jax.lax.top_k(logits, k_max)  # [B, K] descending
 
     greedy = temperature <= 0.0
     temp = jnp.where(greedy, 1.0, jnp.maximum(temperature, 1e-6))[:, None]
     scaled = vals / temp
 
-    rank = jnp.arange(K_MAX, dtype=jnp.int32)[None, :]
-    k = jnp.where(top_k <= 0, K_MAX, jnp.minimum(top_k, K_MAX))[:, None]
+    rank = jnp.arange(k_max, dtype=jnp.int32)[None, :]
+    k = jnp.where(top_k <= 0, k_max, jnp.minimum(top_k, k_max))[:, None]
     keep = rank < k
 
     # top-p over the kept candidates: keep the smallest prefix whose
@@ -43,7 +44,7 @@ def sample_tokens(
     keep = keep & ((cum - probs) < top_p[:, None])
 
     masked = jnp.where(keep, scaled, -jnp.inf)
-    gumbel = jax.random.gumbel(rng, (b, K_MAX), dtype=jnp.float32)
+    gumbel = jax.random.gumbel(rng, (b, k_max), dtype=jnp.float32)
     choice_sampled = jnp.argmax(masked + gumbel, axis=-1)
     choice = jnp.where(greedy, 0, choice_sampled)  # top_k output is sorted
     return jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]

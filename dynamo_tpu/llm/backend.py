@@ -1,0 +1,76 @@
+"""Backend — the detokenizing postprocessor operator.
+
+Wraps the engine: on the response path it incrementally detokenizes token
+deltas into text, holds back text that might be the start of a stop
+sequence (the "jail"), and maps finish reasons.
+
+Reference parity: lib/llm/src/backend.rs:63 (Backend operator with
+DecodeStream + hidden-stop-token jail).
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator
+
+from dynamo_tpu.llm.protocols import BackendInput, FinishReason, LLMEngineOutput
+from dynamo_tpu.llm.tokenizer import TokenizerWrapper
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.pipeline import Operator
+
+__all__ = ["Backend"]
+
+
+class Backend(Operator):
+    def __init__(self, tokenizer: TokenizerWrapper):
+        self.tokenizer = tokenizer
+
+    async def forward(self, request: Context[BackendInput]) -> Context[BackendInput]:
+        return request
+
+    def backward(
+        self, stream: AsyncIterator[LLMEngineOutput], request: Context[BackendInput]
+    ) -> AsyncIterator[LLMEngineOutput]:
+        return self._detokenize(stream, request)
+
+    async def _detokenize(
+        self, stream: AsyncIterator[LLMEngineOutput], request: Context[BackendInput]
+    ) -> AsyncIterator[LLMEngineOutput]:
+        decoder = self.tokenizer.decode_stream()
+        stop_strings = request.data.stops.stop
+        max_stop = max((len(s) for s in stop_strings), default=0)
+        held = ""  # jail: text that may be a stop-string prefix
+
+        async for out in stream:
+            text = ""
+            for tid in out.token_ids:
+                text += decoder.step(tid)
+            held += text
+
+            if stop_strings:
+                hit = None
+                for s in stop_strings:
+                    i = held.find(s)
+                    if i >= 0 and (hit is None or i < hit[0]):
+                        hit = (i, s)
+                if hit is not None:
+                    out.text = held[: hit[0]]
+                    out.finish_reason = FinishReason.STOP
+                    yield out
+                    request.stop_generating()
+                    return
+                # release everything that can no longer start a stop string
+                safe = len(held) - (max_stop - 1)
+                if out.finished:
+                    out.text = held
+                    held = ""
+                elif safe > 0:
+                    out.text = held[:safe]
+                    held = held[safe:]
+                else:
+                    out.text = ""
+            else:
+                out.text = held
+                held = ""
+            yield out
+            if out.finished:
+                return

@@ -1,0 +1,64 @@
+"""Engine stubs for tests and wiring rehearsals.
+
+Reference parity: lib/llm/src/engines.rs (EchoEngineCore/EchoEngineFull with
+DYN_TOKEN_ECHO_DELAY_MS) — every serving-stack feature must be testable with
+no model and no TPU (SURVEY.md §4 test strategy).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import AsyncIterator
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.protocols import BackendInput, FinishReason, LLMEngineOutput
+from dynamo_tpu.llm.tokenizer import TokenizerWrapper
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.pipeline import build_pipeline
+
+__all__ = ["EchoEngineCore", "build_serving_pipeline"]
+
+
+class EchoEngineCore(AsyncEngine):
+    """Echoes the prompt's token ids back, one per step (ref engines.rs:40)."""
+
+    def __init__(self, delay_s: float | None = None):
+        if delay_s is None:
+            delay_s = float(os.environ.get("DYNTPU_TOKEN_ECHO_DELAY_MS", "0")) / 1e3
+        self.delay_s = delay_s
+
+    def generate(self, request: Context[BackendInput]) -> AsyncIterator[LLMEngineOutput]:
+        return self._run(request)
+
+    async def _run(self, request: Context[BackendInput]) -> AsyncIterator[LLMEngineOutput]:
+        inp = request.data
+        max_tokens = inp.stops.max_tokens or len(inp.token_ids)
+        for i, tid in enumerate(inp.token_ids):
+            if request.is_stopped:
+                yield LLMEngineOutput(token_ids=[], finish_reason=FinishReason.CANCELLED)
+                return
+            if self.delay_s:
+                await asyncio.sleep(self.delay_s)
+            last = i + 1 >= max_tokens or i + 1 >= len(inp.token_ids)
+            yield LLMEngineOutput(
+                token_ids=[tid],
+                finish_reason=FinishReason.LENGTH if last else None,
+            )
+            if last:
+                return
+
+
+def build_serving_pipeline(
+    engine: AsyncEngine, card: ModelDeploymentCard, tokenizer: TokenizerWrapper | None = None
+) -> AsyncEngine:
+    """frontend-ready pipeline: ParsedRequest → preprocess → engine → detok.
+
+    Mirrors the reference's local pipeline assembly
+    (launch/dynamo-run/src/input/common.rs:78-96).
+    """
+    pre = OpenAIPreprocessor(card, tokenizer)
+    back = Backend(pre.tokenizer)
+    return build_pipeline(engine, pre, back)

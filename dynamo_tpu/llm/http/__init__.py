@@ -1,0 +1,5 @@
+"""OpenAI-compatible HTTP frontend (aiohttp) — reference lib/llm/src/http/."""
+
+from dynamo_tpu.llm.http.service import HttpService, ModelManager
+
+__all__ = ["HttpService", "ModelManager"]

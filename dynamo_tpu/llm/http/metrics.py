@@ -1,0 +1,62 @@
+"""HTTP service metrics in Prometheus text exposition format.
+
+Reference parity: lib/llm/src/http/service/metrics.rs:36-46 (request
+counters by model/endpoint/status, inflight gauge with RAII guard).
+No prometheus client dependency — the text format is trivial to emit.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Iterator
+
+PREFIX = "dynamo_tpu_http_service"
+
+
+class Metrics:
+    def __init__(self) -> None:
+        # (model, endpoint, status) -> count
+        self.requests: dict[tuple[str, str, str], int] = defaultdict(int)
+        # model -> inflight
+        self.inflight: dict[str, int] = defaultdict(int)
+        self.tokens_out: dict[str, int] = defaultdict(int)
+
+    def guard(self, model: str, endpoint: str) -> "InflightGuard":
+        return InflightGuard(self, model, endpoint)
+
+    def render(self) -> str:
+        lines = [
+            f"# TYPE {PREFIX}_requests_total counter",
+        ]
+        for (model, endpoint, status), n in sorted(self.requests.items()):
+            lines.append(
+                f'{PREFIX}_requests_total{{model="{model}",endpoint="{endpoint}",status="{status}"}} {n}'
+            )
+        lines.append(f"# TYPE {PREFIX}_inflight_requests gauge")
+        for model, n in sorted(self.inflight.items()):
+            lines.append(f'{PREFIX}_inflight_requests{{model="{model}"}} {n}')
+        lines.append(f"# TYPE {PREFIX}_output_tokens_total counter")
+        for model, n in sorted(self.tokens_out.items()):
+            lines.append(f'{PREFIX}_output_tokens_total{{model="{model}"}} {n}')
+        return "\n".join(lines) + "\n"
+
+
+class InflightGuard:
+    """Counts a request as inflight until closed; records final status."""
+
+    def __init__(self, metrics: Metrics, model: str, endpoint: str):
+        self._m = metrics
+        self.model = model
+        self.endpoint = endpoint
+        self._status = "error"
+        self._m.inflight[model] += 1
+
+    def ok(self) -> None:
+        self._status = "success"
+
+    def status(self, s: str) -> None:
+        self._status = s
+
+    def close(self) -> None:
+        self._m.inflight[self.model] -= 1
+        self._m.requests[(self.model, self.endpoint, self._status)] += 1

@@ -1,0 +1,242 @@
+"""The OpenAI-compatible HTTP service (aiohttp).
+
+Routes (reference lib/llm/src/http/service/openai.rs:132,218 and
+service_v2.rs):
+
+  POST /v1/chat/completions   — streaming (SSE) and unary
+  POST /v1/completions        — streaming (SSE) and unary
+  GET  /v1/models
+  GET  /metrics               — Prometheus text format
+  GET  /health, /live, /ready
+
+Models are served through a ModelManager registry; entries can be added and
+removed at runtime (the distributed frontend watches the control plane and
+registers remote models dynamically, ref http/service/discovery.rs:58).
+Client disconnects kill the request context so engines stop generating.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from dataclasses import dataclass
+from typing import AsyncIterator, Optional
+
+from aiohttp import web
+
+from dynamo_tpu.llm.http.metrics import Metrics
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.openai import (
+    SSE_DONE,
+    OpenAIError,
+    aggregate_stream,
+    chat_chunk,
+    completion_chunk,
+    new_id,
+    parse_request,
+    sse_encode,
+    usage_dict,
+)
+from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+log = logging.getLogger("dynamo_tpu.http")
+
+__all__ = ["ModelManager", "HttpService"]
+
+
+@dataclass
+class ModelEntry:
+    card: ModelDeploymentCard
+    engine: AsyncEngine  # full pipeline: Context[ParsedRequest] → LLMEngineOutput(text)
+
+
+class ModelManager:
+    """Registry of served models (ref http/service.rs:59 ModelManager)."""
+
+    def __init__(self) -> None:
+        self._models: dict[str, ModelEntry] = {}
+
+    def add_model(self, name: str, engine: AsyncEngine, card: Optional[ModelDeploymentCard] = None) -> None:
+        self._models[name] = ModelEntry(card or ModelDeploymentCard(name=name), engine)
+
+    def remove_model(self, name: str) -> None:
+        self._models.pop(name, None)
+
+    def get(self, name: str) -> ModelEntry:
+        entry = self._models.get(name)
+        if entry is None:
+            raise OpenAIError(f"model '{name}' not found", status=404, err_type="model_not_found")
+        return entry
+
+    def list_models(self) -> list[str]:
+        return sorted(self._models)
+
+
+class HttpService:
+    def __init__(self, manager: Optional[ModelManager] = None, host: str = "127.0.0.1", port: int = 8080):
+        self.manager = manager or ModelManager()
+        self.metrics = Metrics()
+        self.host = host
+        self.port = port
+        self._runner: Optional[web.AppRunner] = None
+        self.app = web.Application()
+        self.app.router.add_post("/v1/chat/completions", self._chat)
+        self.app.router.add_post("/v1/completions", self._completions)
+        self.app.router.add_get("/v1/models", self._models)
+        self.app.router.add_get("/metrics", self._metrics)
+        for p in ("/health", "/live", "/ready"):
+            self.app.router.add_get(p, self._health)
+
+    # ------------------------------------------------------------- lifecycle
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        # resolve ephemeral port
+        for s in self._runner.sites:
+            server = getattr(s, "_server", None)
+            if server and server.sockets:
+                self.port = server.sockets[0].getsockname()[1]
+        log.info("http service listening on %s:%s", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # --------------------------------------------------------------- handlers
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "models": self.manager.list_models()})
+
+    async def _models(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {"id": m, "object": "model", "owned_by": "dynamo_tpu"}
+                    for m in self.manager.list_models()
+                ],
+            }
+        )
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.metrics.render(), content_type="text/plain")
+
+    async def _chat(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve(request, chat=True)
+
+    async def _completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve(request, chat=False)
+
+    async def _serve(self, request: web.Request, chat: bool) -> web.StreamResponse:
+        endpoint = "chat_completions" if chat else "completions"
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            err = OpenAIError("invalid JSON body")
+            return web.json_response(err.body(), status=err.status)
+
+        guard = None
+        try:
+            parsed = parse_request(body, chat=chat)
+            entry = self.manager.get(parsed.model)
+            guard = self.metrics.guard(parsed.model, endpoint)
+            ctx = Context(parsed)
+            rid = new_id("chatcmpl" if chat else "cmpl")
+            stream = entry.engine.generate(ctx)
+            if parsed.stream:
+                return await self._stream_response(request, ctx, stream, rid, parsed, chat, guard)
+            return await self._unary_response(ctx, stream, rid, parsed, chat, guard)
+        except OpenAIError as e:
+            if guard:
+                guard.status("error")
+            return web.json_response(e.body(), status=e.status)
+        except Exception:
+            log.exception("request failed")
+            err = OpenAIError("internal error", status=500, err_type="internal_error")
+            return web.json_response(err.body(), status=err.status)
+        finally:
+            if guard:
+                guard.close()
+
+    # ------------------------------------------------------------- responders
+    def _chunks(
+        self, rid: str, parsed, chat: bool, out: LLMEngineOutput, n_out: int
+    ) -> list[dict]:
+        finish = out.finish_reason.as_openai() if out.finish_reason else None
+        chunks = []
+        if chat:
+            if out.text or finish:
+                chunks.append(
+                    chat_chunk(rid, parsed.model, content=out.text or "", finish_reason=finish)
+                )
+        else:
+            if out.text or finish:
+                chunks.append(
+                    completion_chunk(rid, parsed.model, out.text or "", finish_reason=finish)
+                )
+        return chunks
+
+    async def _stream_response(
+        self, request: web.Request, ctx: Context, stream: AsyncIterator[LLMEngineOutput],
+        rid: str, parsed, chat: bool, guard,
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            }
+        )
+        await resp.prepare(request)
+        n_out = 0
+        try:
+            if chat:
+                await resp.write(
+                    sse_encode(chat_chunk(rid, parsed.model, role="assistant", content=""))
+                )
+            async for out in stream:
+                n_out += len(out.token_ids)
+                for chunk in self._chunks(rid, parsed, chat, out, n_out):
+                    await resp.write(sse_encode(chunk))
+                if out.finished:
+                    break
+            usage = usage_dict(ctx.annotations.get("prompt_tokens", 0), n_out)
+            if chat:
+                await resp.write(sse_encode(chat_chunk(rid, parsed.model, usage=usage)))
+            await resp.write(SSE_DONE)
+            guard.ok()
+            self.metrics.tokens_out[parsed.model] += n_out
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away — stop the engine (ref: disconnect detection)
+            ctx.kill()
+            guard.status("disconnect")
+        await resp.write_eof()
+        return resp
+
+    async def _unary_response(
+        self, ctx: Context, stream: AsyncIterator[LLMEngineOutput],
+        rid: str, parsed, chat: bool, guard,
+    ) -> web.Response:
+        texts: list[str] = []
+        finish = FinishReason.STOP
+        n_out = 0
+        async for out in stream:
+            n_out += len(out.token_ids)
+            if out.text:
+                texts.append(out.text)
+            if out.finish_reason:
+                finish = out.finish_reason
+            if out.finished:
+                break
+        usage = usage_dict(ctx.annotations.get("prompt_tokens", 0), n_out)
+        chunks = (
+            [chat_chunk(rid, parsed.model, content="".join(texts), finish_reason=finish.as_openai(), usage=usage)]
+            if chat
+            else [completion_chunk(rid, parsed.model, "".join(texts), finish.as_openai(), usage=usage)]
+        )
+        guard.ok()
+        self.metrics.tokens_out[parsed.model] += n_out
+        return web.json_response(aggregate_stream(chunks, chat))

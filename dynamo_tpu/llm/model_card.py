@@ -1,0 +1,87 @@
+"""ModelDeploymentCard — the model manifest.
+
+Everything a frontend/router/worker needs to know about a served model
+without loading its weights: tokenizer, chat template, context length,
+special tokens, checksum.  Published to the control plane so remote
+components can preprocess for a model they don't host.
+
+Reference parity: lib/llm/src/model_card/model.rs:97-199 (ModelDeploymentCard,
+mdcsum checksum, load-from-HF-repo) and create.rs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+
+@dataclass
+class ModelDeploymentCard:
+    name: str
+    model_path: Optional[str] = None        # local HF dir (workers only)
+    tokenizer_path: Optional[str] = None    # tokenizer.json
+    context_length: int = 4096
+    eos_token_ids: list[int] = field(default_factory=list)
+    bos_token_id: Optional[int] = None
+    chat_template: Optional[str] = None     # jinja source
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def mdcsum(self) -> str:
+        """Stable checksum of the card (ref model.rs mdcsum)."""
+        payload = json.dumps(self.to_dict(), sort_keys=True).encode()
+        return hashlib.blake2s(payload, digest_size=8).hexdigest()
+
+    # ------------------------------------------------------------- serde
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "model_path": self.model_path,
+            "tokenizer_path": self.tokenizer_path,
+            "context_length": self.context_length,
+            "eos_token_ids": self.eos_token_ids,
+            "bos_token_id": self.bos_token_id,
+            "chat_template": self.chat_template,
+            "extra": self.extra,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ModelDeploymentCard":
+        return cls(**d)
+
+    # -------------------------------------------------------------- loading
+    @classmethod
+    def from_hf_dir(cls, model_dir: str | Path, name: Optional[str] = None) -> "ModelDeploymentCard":
+        """Build a card from a local HuggingFace model directory."""
+        d = Path(model_dir)
+        cfg = json.loads((d / "config.json").read_text()) if (d / "config.json").exists() else {}
+
+        eos = cfg.get("eos_token_id", [])
+        if isinstance(eos, int):
+            eos = [eos]
+        bos = cfg.get("bos_token_id")
+
+        chat_template = None
+        gen_cfg_path = d / "tokenizer_config.json"
+        if gen_cfg_path.exists():
+            tk_cfg = json.loads(gen_cfg_path.read_text())
+            chat_template = tk_cfg.get("chat_template")
+            if eos == [] and isinstance(tk_cfg.get("eos_token"), str):
+                pass  # token string → id resolution needs the tokenizer; left to caller
+        sep = d / "chat_template.jinja"
+        if chat_template is None and sep.exists():
+            chat_template = sep.read_text()
+
+        tok = d / "tokenizer.json"
+        return cls(
+            name=name or d.name,
+            model_path=str(d),
+            tokenizer_path=str(tok) if tok.exists() else None,
+            context_length=cfg.get("max_position_embeddings", 4096),
+            eos_token_ids=list(eos),
+            bos_token_id=bos,
+            chat_template=chat_template,
+        )

@@ -1,0 +1,233 @@
+"""OpenAI-compatible protocol: request parsing, response building, SSE.
+
+Covers /v1/chat/completions and /v1/completions (streaming and unary),
+including the reference's `nvext` extension fields (ignore_eos,
+annotations; lib/llm/src/protocols/openai/nvext.rs) which are accepted
+under both "nvext" and "ext" keys.
+
+Parsing is dict-based with explicit validation (no heavyweight schema
+dependency); the aggregator turns a streamed sequence of deltas back into
+a full response for non-streaming callers (reference: protocols/openai/
+chat_completions/aggregator.rs).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from dynamo_tpu.llm.protocols import BackendInput, SamplingOptions, StopConditions
+
+
+class OpenAIError(Exception):
+    def __init__(self, message: str, status: int = 400, err_type: str = "invalid_request_error"):
+        super().__init__(message)
+        self.status = status
+        self.err_type = err_type
+
+    def body(self) -> dict:
+        return {"error": {"message": str(self), "type": self.err_type, "code": self.status}}
+
+
+@dataclass
+class ParsedRequest:
+    """A validated OpenAI request, engine-ready except for tokenization."""
+
+    model: str
+    messages: Optional[list[dict]] = None   # chat mode
+    prompt: Optional[str] = None            # completions mode
+    prompt_token_ids: Optional[list[int]] = None
+    stream: bool = False
+    n: int = 1
+    sampling: SamplingOptions = field(default_factory=SamplingOptions)
+    stops: StopConditions = field(default_factory=StopConditions)
+    echo: bool = False
+    annotations: list[str] = field(default_factory=list)
+    raw: dict = field(default_factory=dict)
+
+    @property
+    def is_chat(self) -> bool:
+        return self.messages is not None
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise OpenAIError(msg)
+
+
+def parse_request(body: dict, chat: bool) -> ParsedRequest:
+    _require(isinstance(body, dict), "request body must be a JSON object")
+    model = body.get("model")
+    _require(isinstance(model, str) and model, "'model' is required")
+
+    req = ParsedRequest(model=model, raw=body, stream=bool(body.get("stream", False)))
+
+    if chat:
+        messages = body.get("messages")
+        _require(isinstance(messages, list) and messages, "'messages' must be a non-empty array")
+        for m in messages:
+            _require(isinstance(m, dict) and "role" in m, "each message needs a 'role'")
+        req.messages = messages
+    else:
+        prompt = body.get("prompt")
+        _require(prompt is not None, "'prompt' is required")
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            req.prompt_token_ids = prompt
+        elif isinstance(prompt, list):
+            _require(len(prompt) == 1, "batched prompts not yet supported")
+            req.prompt = prompt[0]
+        else:
+            _require(isinstance(prompt, str), "'prompt' must be a string or token array")
+            req.prompt = prompt
+        req.echo = bool(body.get("echo", False))
+
+    temperature = body.get("temperature")
+    top_p = body.get("top_p")
+    top_k = body.get("top_k")  # extension (vLLM-compatible)
+    seed = body.get("seed")
+    req.sampling = SamplingOptions(
+        temperature=1.0 if temperature is None else float(temperature),
+        top_p=1.0 if top_p is None else float(top_p),
+        top_k=0 if top_k is None else int(top_k),
+        seed=seed,
+    )
+
+    max_tokens = body.get("max_completion_tokens", body.get("max_tokens"))
+    stop = body.get("stop") or []
+    if isinstance(stop, str):
+        stop = [stop]
+    _require(isinstance(stop, list), "'stop' must be a string or array")
+    req.stops = StopConditions(
+        max_tokens=int(max_tokens) if max_tokens is not None else 16 if not chat else None,
+        stop=[s for s in stop if s],
+        min_tokens=int(body.get("min_tokens", 0)),
+    )
+
+    ext = body.get("nvext") or body.get("ext") or {}
+    if isinstance(ext, dict):
+        req.stops.ignore_eos = bool(ext.get("ignore_eos", body.get("ignore_eos", False)))
+        ann = ext.get("annotations", [])
+        if isinstance(ann, list):
+            req.annotations = ann
+
+    n = int(body.get("n", 1))
+    _require(n == 1, "'n' > 1 not yet supported")
+    return req
+
+
+# --------------------------------------------------------------------- builders
+
+def _now() -> int:
+    return int(time.time())
+
+
+def new_id(prefix: str) -> str:
+    return f"{prefix}-{uuid.uuid4().hex}"
+
+
+def chat_chunk(
+    rid: str, model: str, *, role: Optional[str] = None, content: Optional[str] = None,
+    finish_reason: Optional[str] = None, usage: Optional[dict] = None,
+) -> dict:
+    delta: dict[str, Any] = {}
+    if role is not None:
+        delta["role"] = role
+    if content:
+        delta["content"] = content
+    out = {
+        "id": rid,
+        "object": "chat.completion.chunk",
+        "created": _now(),
+        "model": model,
+        "choices": [{"index": 0, "delta": delta, "finish_reason": finish_reason}],
+    }
+    if usage is not None:
+        out["usage"] = usage
+    return out
+
+
+def chat_response(rid: str, model: str, content: str, finish_reason: str, usage: dict) -> dict:
+    return {
+        "id": rid,
+        "object": "chat.completion",
+        "created": _now(),
+        "model": model,
+        "choices": [
+            {
+                "index": 0,
+                "message": {"role": "assistant", "content": content},
+                "finish_reason": finish_reason,
+            }
+        ],
+        "usage": usage,
+    }
+
+
+def completion_chunk(
+    rid: str, model: str, text: str, finish_reason: Optional[str] = None,
+    usage: Optional[dict] = None,
+) -> dict:
+    out = {
+        "id": rid,
+        "object": "text_completion",
+        "created": _now(),
+        "model": model,
+        "choices": [{"index": 0, "text": text, "finish_reason": finish_reason}],
+    }
+    if usage is not None:
+        out["usage"] = usage
+    return out
+
+
+def completion_response(rid: str, model: str, text: str, finish_reason: str, usage: dict) -> dict:
+    return {
+        "id": rid,
+        "object": "text_completion",
+        "created": _now(),
+        "model": model,
+        "choices": [{"index": 0, "text": text, "finish_reason": finish_reason}],
+        "usage": usage,
+    }
+
+
+def usage_dict(prompt_tokens: int, completion_tokens: int) -> dict:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
+def sse_encode(data: dict | str) -> bytes:
+    if isinstance(data, dict):
+        data = json.dumps(data, separators=(",", ":"))
+    return f"data: {data}\n\n".encode()
+
+
+SSE_DONE = b"data: [DONE]\n\n"
+
+
+def aggregate_stream(chunks: list[dict], chat: bool) -> dict:
+    """Fold streamed chunks into a full response (ref aggregator.rs)."""
+    text = []
+    finish = "stop"
+    usage = None
+    rid = chunks[0]["id"] if chunks else new_id("cmpl")
+    model = chunks[0]["model"] if chunks else ""
+    for c in chunks:
+        ch = c["choices"][0]
+        if chat:
+            text.append(ch["delta"].get("content", "") or "")
+        else:
+            text.append(ch.get("text", "") or "")
+        if ch.get("finish_reason"):
+            finish = ch["finish_reason"]
+        if c.get("usage"):
+            usage = c["usage"]
+    usage = usage or usage_dict(0, 0)
+    if chat:
+        return chat_response(rid, model, "".join(text), finish, usage)
+    return completion_response(rid, model, "".join(text), finish, usage)

@@ -1,0 +1,106 @@
+"""OpenAIPreprocessor — OpenAI request → BackendInput (tokens + config).
+
+Renders the model's chat template (jinja), tokenizes with the model card's
+tokenizer, applies stop-condition and sampling defaults, and records
+annotations (formatted_prompt, token_ids) on the request context.
+
+Reference parity: lib/llm/src/preprocessor.rs:63-106 (OpenAIPreprocessor,
+minijinja prompt formatting, annotations) and preprocessor/prompt/.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.openai import OpenAIError, ParsedRequest
+from dynamo_tpu.llm.protocols import BackendInput
+from dynamo_tpu.llm.tokenizer import TokenizerWrapper
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.pipeline import Operator
+
+__all__ = ["OpenAIPreprocessor", "PromptFormatter"]
+
+# a minimal fallback template for models that ship none (role-tagged lines)
+DEFAULT_CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "<|{{ message['role'] }}|> {{ message['content'] }}\n"
+    "{% endfor %}"
+    "<|assistant|>"
+)
+
+
+class PromptFormatter:
+    """Jinja chat-template renderer (ref preprocessor/prompt/template/*)."""
+
+    def __init__(self, template: Optional[str], bos_token: str = "", eos_token: str = ""):
+        import jinja2
+
+        env = jinja2.Environment(trim_blocks=True, lstrip_blocks=True)
+        env.globals["raise_exception"] = self._raise
+        self._template = env.from_string(template or DEFAULT_CHAT_TEMPLATE)
+        self._bos = bos_token
+        self._eos = eos_token
+
+    @staticmethod
+    def _raise(msg: str):
+        raise OpenAIError(f"chat template error: {msg}")
+
+    def render(self, messages: list[dict], add_generation_prompt: bool = True) -> str:
+        return self._template.render(
+            messages=messages,
+            add_generation_prompt=add_generation_prompt,
+            bos_token=self._bos,
+            eos_token=self._eos,
+        )
+
+
+class OpenAIPreprocessor(Operator):
+    """Pipeline operator: Context[ParsedRequest] → Context[BackendInput]."""
+
+    def __init__(self, card: ModelDeploymentCard, tokenizer: Optional[TokenizerWrapper] = None):
+        self.card = card
+        if tokenizer is None:
+            if card.tokenizer_path is None:
+                raise ValueError(f"model card {card.name} has no tokenizer")
+            tokenizer = TokenizerWrapper.from_file(card.tokenizer_path)
+        self.tokenizer = tokenizer
+        self.formatter = PromptFormatter(card.chat_template)
+
+    async def forward(self, request: Context[ParsedRequest]) -> Context[BackendInput]:
+        parsed = request.data
+        if parsed.is_chat:
+            prompt = self.formatter.render(parsed.messages)
+            token_ids = self.tokenizer.encode(prompt)
+        elif parsed.prompt_token_ids is not None:
+            prompt = None
+            token_ids = list(parsed.prompt_token_ids)
+        else:
+            prompt = parsed.prompt
+            token_ids = self.tokenizer.encode(prompt)
+
+        if len(token_ids) >= self.card.context_length:
+            raise OpenAIError(
+                f"prompt ({len(token_ids)} tokens) exceeds model context length "
+                f"({self.card.context_length})",
+            )
+
+        stops = parsed.stops
+        # resolve stop strings that are single tokens into token-level stops
+        for s in stops.stop:
+            tid = self.tokenizer.token_to_id(s)
+            if tid is not None and tid not in stops.stop_token_ids:
+                stops.stop_token_ids.append(tid)
+
+        inp = BackendInput(
+            token_ids=token_ids,
+            sampling=parsed.sampling,
+            stops=stops,
+            model=parsed.model,
+        )
+        request.annotations["prompt_tokens"] = len(token_ids)
+        if "formatted_prompt" in parsed.annotations and prompt is not None:
+            request.annotations["formatted_prompt"] = prompt
+        if "token_ids" in parsed.annotations:
+            request.annotations["token_ids"] = token_ids
+        return request.map(inp)

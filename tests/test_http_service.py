@@ -1,0 +1,155 @@
+"""HTTP service tests: real aiohttp server + client against an echo engine
+(mirrors reference lib/llm/tests/http-service.rs: mock CounterEngine behind a
+real axum server with prometheus assertions)."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import ClientSession
+
+from dynamo_tpu.llm.engines import EchoEngineCore, build_serving_pipeline
+from dynamo_tpu.llm.http import HttpService, ModelManager
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.tokenizer import TokenizerWrapper
+
+WORDS = ["hello", "world", "foo", "bar", "baz", "stop", "the", "quick", "brown", "fox"]
+
+
+@pytest.fixture(scope="module")
+def tokenizer_file(tmp_path_factory):
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    vocab = {"<unk>": 0, "<s>": 1, "</s>": 2}
+    for w in WORDS:
+        vocab[w] = len(vocab)
+    # include role markup pieces so chat templates tokenize
+    for w in ["<|user|>", "<|assistant|>", "<|system|>"]:
+        vocab[w] = len(vocab)
+    tok = Tokenizer(models.WordLevel(vocab=vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.WhitespaceSplit()
+    path = tmp_path_factory.mktemp("tok") / "tokenizer.json"
+    tok.save(str(path))
+    return str(path)
+
+
+@pytest.fixture()
+def card(tokenizer_file):
+    return ModelDeploymentCard(
+        name="echo-model", tokenizer_path=tokenizer_file, context_length=128
+    )
+
+
+async def _start_service(card):
+    manager = ModelManager()
+    pipeline = build_serving_pipeline(EchoEngineCore(), card)
+    manager.add_model("echo-model", pipeline, card)
+    svc = HttpService(manager, port=0)
+    await svc.start()
+    return svc
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_completions_unary(card):
+    async def go():
+        svc = await _start_service(card)
+        try:
+            async with ClientSession() as s:
+                r = await s.post(
+                    f"http://127.0.0.1:{svc.port}/v1/completions",
+                    json={"model": "echo-model", "prompt": "hello world foo", "max_tokens": 16},
+                )
+                assert r.status == 200
+                body = await r.json()
+                assert body["object"] == "text_completion"
+                assert body["choices"][0]["text"].split() == ["hello", "world", "foo"]
+                assert body["usage"]["prompt_tokens"] == 3
+                assert body["usage"]["completion_tokens"] == 3
+        finally:
+            await svc.stop()
+
+    run(go())
+
+
+def test_chat_streaming_sse(card):
+    async def go():
+        svc = await _start_service(card)
+        try:
+            async with ClientSession() as s:
+                r = await s.post(
+                    f"http://127.0.0.1:{svc.port}/v1/chat/completions",
+                    json={
+                        "model": "echo-model",
+                        "messages": [{"role": "user", "content": "the quick brown fox"}],
+                        "stream": True,
+                    },
+                )
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/event-stream")
+                raw = (await r.read()).decode()
+            events = [l[6:] for l in raw.splitlines() if l.startswith("data: ")]
+            assert events[-1] == "[DONE]"
+            chunks = [json.loads(e) for e in events[:-1]]
+            assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+            text = "".join(c["choices"][0]["delta"].get("content", "") for c in chunks if c["choices"])
+            # echo of the default chat template render, incl. role markers
+            assert "the quick brown fox" in text
+            finishes = [c["choices"][0].get("finish_reason") for c in chunks if c["choices"]]
+            assert "length" in finishes
+            usage = [c for c in chunks if c.get("usage")]
+            assert usage and usage[-1]["usage"]["prompt_tokens"] > 0
+        finally:
+            await svc.stop()
+
+    run(go())
+
+
+def test_model_not_found_and_validation(card):
+    async def go():
+        svc = await _start_service(card)
+        try:
+            async with ClientSession() as s:
+                base = f"http://127.0.0.1:{svc.port}"
+                r = await s.post(f"{base}/v1/completions", json={"model": "nope", "prompt": "x"})
+                assert r.status == 404
+                r = await s.post(f"{base}/v1/chat/completions", json={"model": "echo-model"})
+                assert r.status == 400
+                r = await s.get(f"{base}/v1/models")
+                data = await r.json()
+                assert [m["id"] for m in data["data"]] == ["echo-model"]
+        finally:
+            await svc.stop()
+
+    run(go())
+
+
+def test_stop_strings_and_metrics(card):
+    async def go():
+        svc = await _start_service(card)
+        try:
+            async with ClientSession() as s:
+                base = f"http://127.0.0.1:{svc.port}"
+                r = await s.post(
+                    f"{base}/v1/completions",
+                    json={
+                        "model": "echo-model",
+                        "prompt": "hello world stop foo bar",
+                        "stop": ["stop"],
+                        "max_tokens": 16,
+                    },
+                )
+                body = await r.json()
+                text = body["choices"][0]["text"]
+                assert "stop" not in text and "foo" not in text
+                assert body["choices"][0]["finish_reason"] == "stop"
+
+                m = await (await s.get(f"{base}/metrics")).text()
+                assert 'requests_total{model="echo-model"' in m
+                assert 'status="success"' in m
+        finally:
+            await svc.stop()
+
+    run(go())
