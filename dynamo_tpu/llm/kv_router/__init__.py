@@ -1,0 +1,23 @@
+"""KV-cache-aware smart routing (reference lib/llm/src/kv_router/).
+
+Workers publish cache events (stored/removed block hashes) and load metrics;
+the router keeps a global index of which worker holds which prefix blocks
+and scores workers by overlap vs load for each incoming request.
+"""
+
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer, OverlapScores
+from dynamo_tpu.llm.kv_router.scheduler import (
+    DefaultWorkerSelector,
+    KvScheduler,
+    WorkerMetrics,
+)
+from dynamo_tpu.llm.kv_router.router import KvRouter
+
+__all__ = [
+    "KvIndexer",
+    "OverlapScores",
+    "KvScheduler",
+    "DefaultWorkerSelector",
+    "WorkerMetrics",
+    "KvRouter",
+]

@@ -1,0 +1,112 @@
+"""KvIndexer — the global prefix index: block hash → workers holding it.
+
+Reference parity: lib/llm/src/kv_router/indexer.rs:187-499 (RadixTree,
+find_matches, apply_event, KvIndexer).  The reference builds an explicit
+radix tree; here the chained sequence hashes (dynamo_tpu.tokens) make the
+trie redundant — a block hash already commits to its entire prefix, so a
+flat hash→workers map gives identical match semantics with O(1) lookups,
+plus per-worker hash sets for O(worker's blocks) teardown on failure.
+
+Like the reference (indexer.rs:36 doc), the index is single-writer: apply
+events from one task/thread; find_matches is read-only.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Sequence
+
+from dynamo_tpu.llm.kv.events import KvCacheEvent, KvRemovedEvent, KvStoredEvent
+
+log = logging.getLogger("dynamo_tpu.kv_router")
+
+__all__ = ["KvIndexer", "OverlapScores"]
+
+
+@dataclass
+class OverlapScores:
+    """worker_id → number of consecutive prefix blocks resident there
+    (ref indexer.rs OverlapScores)."""
+
+    scores: dict[int, int] = field(default_factory=dict)
+
+    def best(self) -> tuple[int, int] | None:
+        if not self.scores:
+            return None
+        wid = max(self.scores, key=lambda w: self.scores[w])
+        return wid, self.scores[wid]
+
+
+class KvIndexer:
+    def __init__(self) -> None:
+        # block sequence-hash → set of worker ids holding it
+        self._holders: dict[int, set[int]] = {}
+        # worker id → hashes it holds (for teardown)
+        self._worker_blocks: dict[int, set[int]] = {}
+        # per-worker last event id (gap/ordering diagnostics)
+        self._last_event_id: dict[int, int] = {}
+
+    # ---------------------------------------------------------------- queries
+    def find_matches(self, seq_hashes: Sequence[int]) -> OverlapScores:
+        """Longest-prefix match per worker over the request's block hashes."""
+        scores: dict[int, int] = {}
+        live: set[int] | None = None  # workers matching every block so far
+        for i, h in enumerate(seq_hashes):
+            holders = self._holders.get(h)
+            if not holders:
+                break
+            live = set(holders) if live is None else (live & holders)
+            if not live:
+                break
+            for w in live:  # workers that dropped out keep their shorter score
+                scores[w] = i + 1
+        return OverlapScores(scores)
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self._holders)
+
+    def workers(self) -> list[int]:
+        return sorted(self._worker_blocks)
+
+    # ----------------------------------------------------------------- events
+    def apply_event(self, worker_id: int, event: KvCacheEvent, event_id: int | None = None) -> None:
+        if event_id is not None:
+            last = self._last_event_id.get(worker_id)
+            if last is not None and event_id != last + 1:
+                log.debug(
+                    "worker %s event id gap: %s -> %s", worker_id, last, event_id
+                )
+            self._last_event_id[worker_id] = event_id
+
+        if isinstance(event, KvStoredEvent):
+            blocks = self._worker_blocks.setdefault(worker_id, set())
+            for h in event.block_hashes:
+                self._holders.setdefault(h, set()).add(worker_id)
+                blocks.add(h)
+        elif isinstance(event, KvRemovedEvent):
+            blocks = self._worker_blocks.get(worker_id, set())
+            for h in event.block_hashes:
+                holders = self._holders.get(h)
+                if holders:
+                    holders.discard(worker_id)
+                    if not holders:
+                        del self._holders[h]
+                blocks.discard(h)
+
+    def remove_worker(self, worker_id: int) -> None:
+        """Worker died/left: drop all its blocks (ref: client watcher delete
+        path, component/client.rs:145-154 → router stops picking it)."""
+        for h in self._worker_blocks.pop(worker_id, set()):
+            holders = self._holders.get(h)
+            if holders:
+                holders.discard(worker_id)
+                if not holders:
+                    del self._holders[h]
+        self._last_event_id.pop(worker_id, None)
+
+    def clear(self) -> None:
+        self._holders.clear()
+        self._worker_blocks.clear()
+        self._last_event_id.clear()

@@ -1,0 +1,63 @@
+"""KvRouter — the composed smart router.
+
+Given a tokenized request, hash its blocks, look up prefix overlap per
+worker in the indexer, and let the scheduler pick a worker.  Exposed both
+as a plain `schedule()` call and as an AsyncEngine that emits the decision
+(reference kv_router.rs:66-169 wraps it the same way so it can serve a
+`generate` endpoint; components/router/src/main.rs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import AsyncIterator, Optional, Sequence
+
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer
+from dynamo_tpu.llm.kv_router.scheduler import KvScheduler, WorkerSelector
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.tokens import sequence_hashes
+
+__all__ = ["KvRouter", "RoutingDecision"]
+
+
+@dataclass
+class RoutingDecision:
+    worker_id: int
+    overlap_blocks: int     # prefix blocks already on that worker
+    overlap_tokens: int
+
+
+class KvRouter(AsyncEngine):
+    def __init__(
+        self,
+        block_size: int = 16,
+        selector: Optional[WorkerSelector] = None,
+        indexer: Optional[KvIndexer] = None,
+        scheduler: Optional[KvScheduler] = None,
+    ):
+        self.block_size = block_size
+        self.indexer = indexer or KvIndexer()
+        self.scheduler = scheduler or KvScheduler(selector, block_size=block_size)
+
+    def schedule(self, token_ids: Sequence[int]) -> RoutingDecision:
+        hashes = sequence_hashes(token_ids, self.block_size)
+        overlaps = self.indexer.find_matches(hashes).scores
+        wid = self.scheduler.schedule(overlaps, len(token_ids))
+        blocks = overlaps.get(wid, 0)
+        return RoutingDecision(
+            worker_id=wid, overlap_blocks=blocks, overlap_tokens=blocks * self.block_size
+        )
+
+    def remove_worker(self, worker_id: int) -> None:
+        self.indexer.remove_worker(worker_id)
+        self.scheduler.remove_worker(worker_id)
+
+    # AsyncEngine surface: request payload = token id list → single decision
+    def generate(self, request: Context) -> AsyncIterator[RoutingDecision]:
+        return self._run(request)
+
+    async def _run(self, request: Context) -> AsyncIterator[RoutingDecision]:
+        token_ids = request.data
+        if hasattr(token_ids, "token_ids"):  # BackendInput passthrough
+            token_ids = token_ids.token_ids
+        yield self.schedule(token_ids)

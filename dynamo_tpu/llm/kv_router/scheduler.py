@@ -1,0 +1,144 @@
+"""KvScheduler — pick the decode worker for a request.
+
+Cost model carried over from the reference (kv_router/scheduler.rs:236-330,
+DefaultWorkerSelector):
+
+    logit = 2.0 * overlap − kv_usage − normalized_active_slots
+
+where overlap is the prefix-hit fraction of the request's blocks, kv_usage
+is the worker's cache occupancy [0,1], and normalized_active_slots its
+request-slot occupancy [0,1].  Highest logit wins; ties break randomly.
+The selector is pluggable (ref WorkerSelector trait, kv_router.rs:57).
+"""
+
+from __future__ import annotations
+
+import random
+import statistics
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Protocol
+
+__all__ = ["WorkerMetrics", "KvScheduler", "DefaultWorkerSelector", "KVHitRateEvent"]
+
+
+@dataclass
+class WorkerMetrics:
+    """A worker's published load (ref ForwardPassMetrics,
+    kv_router/protocols.rs:30-47)."""
+
+    worker_id: int
+    request_active_slots: int = 0
+    request_total_slots: int = 1
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 1
+    num_requests_waiting: int = 0
+    cache_hit_rate: float = 0.0
+    updated_at: float = field(default_factory=time.monotonic)
+
+    @property
+    def kv_usage(self) -> float:
+        return self.kv_active_blocks / max(self.kv_total_blocks, 1)
+
+    @property
+    def slot_usage(self) -> float:
+        return self.request_active_slots / max(self.request_total_slots, 1)
+
+
+@dataclass
+class KVHitRateEvent:
+    """Emitted per routing decision for the metrics plane
+    (ref kv_router/scheduler.rs:31)."""
+
+    worker_id: int
+    isl_blocks: int       # request length in blocks
+    overlap_blocks: int   # blocks already resident on the chosen worker
+
+
+class WorkerSelector(Protocol):
+    def select(
+        self,
+        workers: dict[int, WorkerMetrics],
+        overlaps: dict[int, int],
+        request_blocks: int,
+    ) -> Optional[int]: ...
+
+
+class DefaultWorkerSelector:
+    def __init__(self, rng: Optional[random.Random] = None):
+        self._rng = rng or random.Random()
+
+    def select(
+        self,
+        workers: dict[int, WorkerMetrics],
+        overlaps: dict[int, int],
+        request_blocks: int,
+    ) -> Optional[int]:
+        if not workers:
+            return None
+        best_logit = None
+        best: list[int] = []
+        for wid, m in workers.items():
+            overlap = overlaps.get(wid, 0) / max(request_blocks, 1)
+            logit = 2.0 * overlap - m.kv_usage - m.slot_usage
+            if best_logit is None or logit > best_logit + 1e-9:
+                best_logit, best = logit, [wid]
+            elif abs(logit - best_logit) <= 1e-9:
+                best.append(wid)
+        return self._rng.choice(best)
+
+
+class AllWorkersBusy(Exception):
+    """No worker has spare slots (ref scheduler.rs:146-160 waits on capacity)."""
+
+
+class KvScheduler:
+    """Combines worker metrics + overlap scores into routing decisions."""
+
+    def __init__(self, selector: Optional[WorkerSelector] = None, block_size: int = 16):
+        self.selector = selector or DefaultWorkerSelector()
+        self.block_size = block_size
+        self._workers: dict[int, WorkerMetrics] = {}
+        self._hit_events: list[KVHitRateEvent] = []
+
+    # ------------------------------------------------------------ worker set
+    def update_worker(self, metrics: WorkerMetrics) -> None:
+        self._workers[metrics.worker_id] = metrics
+
+    def remove_worker(self, worker_id: int) -> None:
+        self._workers.pop(worker_id, None)
+
+    def workers(self) -> dict[int, WorkerMetrics]:
+        return dict(self._workers)
+
+    # -------------------------------------------------------------- schedule
+    def schedule(self, overlaps: dict[int, int], request_tokens: int) -> int:
+        request_blocks = max(1, request_tokens // self.block_size)
+        wid = self.selector.select(self._workers, overlaps, request_blocks)
+        if wid is None:
+            raise AllWorkersBusy("no live workers")
+        self._hit_events.append(
+            KVHitRateEvent(wid, request_blocks, overlaps.get(wid, 0))
+        )
+        # optimistic local update so burst arrivals spread before the next
+        # metrics scrape lands
+        m = self._workers.get(wid)
+        if m is not None:
+            m.request_active_slots += 1
+        return wid
+
+    def drain_hit_events(self) -> list[KVHitRateEvent]:
+        out, self._hit_events = self._hit_events, []
+        return out
+
+    # --------------------------------------------------------------- summary
+    def load_summary(self) -> dict:
+        """load avg/std across workers (ref scoring.rs:22-52 ProcessedEndpoints)."""
+        if not self._workers:
+            return {"load_avg": 0.0, "load_std": 0.0, "workers": 0}
+        loads = [m.request_active_slots for m in self._workers.values()]
+        return {
+            "load_avg": statistics.fmean(loads),
+            "load_std": statistics.pstdev(loads) if len(loads) > 1 else 0.0,
+            "workers": len(loads),
+        }

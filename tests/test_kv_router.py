@@ -1,0 +1,122 @@
+"""KV-aware router: indexer matching, scheduler cost model, recorder replay
+(mirrors reference indexer.rs unit tests + replay fixtures strategy)."""
+
+import random
+
+import pytest
+
+from dynamo_tpu.llm.kv.events import KvRemovedEvent, KvStoredEvent
+from dynamo_tpu.llm.kv_router import (
+    DefaultWorkerSelector,
+    KvIndexer,
+    KvRouter,
+    KvScheduler,
+    WorkerMetrics,
+)
+from dynamo_tpu.llm.kv_router.recorder import KvRecorder, replay_into
+from dynamo_tpu.llm.kv_router.scheduler import AllWorkersBusy
+from dynamo_tpu.tokens import sequence_hashes
+
+BS = 4
+
+
+def store(indexer, worker, tokens, upto=None):
+    h = sequence_hashes(tokens, BS)[:upto]
+    for i, bh in enumerate(h):
+        indexer.apply_event(
+            worker, KvStoredEvent(block_hashes=[bh], parent_hash=h[i - 1] if i else None)
+        )
+    return h
+
+
+def test_indexer_prefix_matching():
+    idx = KvIndexer()
+    toks = list(range(16))
+    h = store(idx, 1, toks)          # worker 1 has all 4 blocks
+    store(idx, 2, toks, upto=2)       # worker 2 has first 2
+
+    scores = idx.find_matches(h).scores
+    assert scores == {1: 4, 2: 2}
+
+    # divergent suffix only matches the shared prefix
+    other = sequence_hashes(list(range(8)) + [99, 98, 97, 96, 1, 2, 3, 4], BS)
+    scores = idx.find_matches(other).scores
+    assert scores == {1: 2, 2: 2}
+
+    # unknown prompt matches nothing
+    assert idx.find_matches(sequence_hashes([7] * 16, BS)).scores == {}
+
+
+def test_indexer_removal_and_worker_teardown():
+    idx = KvIndexer()
+    toks = list(range(16))
+    h = store(idx, 1, toks)
+    store(idx, 2, toks)
+    idx.apply_event(1, KvRemovedEvent(block_hashes=[h[3]]))
+    assert idx.find_matches(h).scores == {1: 3, 2: 4}
+    idx.remove_worker(2)
+    assert idx.find_matches(h).scores == {1: 3}
+    assert idx.workers() == [1]
+
+
+def test_scheduler_prefers_overlap():
+    sched = KvScheduler(DefaultWorkerSelector(random.Random(0)), block_size=BS)
+    sched.update_worker(WorkerMetrics(1, request_active_slots=0, request_total_slots=8,
+                                      kv_active_blocks=0, kv_total_blocks=100))
+    sched.update_worker(WorkerMetrics(2, request_active_slots=0, request_total_slots=8,
+                                      kv_active_blocks=0, kv_total_blocks=100))
+    # equal load, worker 2 has 4/4 blocks cached
+    assert sched.schedule({2: 4}, request_tokens=16) == 2
+    ev = sched.drain_hit_events()
+    assert ev[0].worker_id == 2 and ev[0].overlap_blocks == 4
+
+
+def test_scheduler_load_beats_small_overlap():
+    sched = KvScheduler(DefaultWorkerSelector(random.Random(0)), block_size=BS)
+    # worker 1: tiny overlap but fully loaded; worker 2: idle, no overlap
+    sched.update_worker(WorkerMetrics(1, request_active_slots=8, request_total_slots=8,
+                                      kv_active_blocks=95, kv_total_blocks=100))
+    sched.update_worker(WorkerMetrics(2, request_active_slots=0, request_total_slots=8,
+                                      kv_active_blocks=0, kv_total_blocks=100))
+    # overlap 1/4 → 2*0.25=0.5 < 1.95 load penalty → worker 2 wins
+    assert sched.schedule({1: 1}, request_tokens=16) == 2
+
+
+def test_scheduler_no_workers():
+    sched = KvScheduler(block_size=BS)
+    with pytest.raises(AllWorkersBusy):
+        sched.schedule({}, 16)
+
+
+def test_router_end_to_end_and_failover():
+    router = KvRouter(block_size=BS, selector=DefaultWorkerSelector(random.Random(1)))
+    toks = list(range(20))
+    router.scheduler.update_worker(WorkerMetrics(1, request_total_slots=8, kv_total_blocks=100))
+    router.scheduler.update_worker(WorkerMetrics(2, request_total_slots=8, kv_total_blocks=100))
+    store(router.indexer, 1, toks)
+
+    d = router.schedule(toks)
+    assert d.worker_id == 1
+    assert d.overlap_blocks == 5
+    assert d.overlap_tokens == 20
+
+    # worker 1 dies → lease expiry path clears it everywhere
+    router.remove_worker(1)
+    d2 = router.schedule(toks)
+    assert d2.worker_id == 2
+    assert d2.overlap_blocks == 0
+
+
+def test_recorder_replay_roundtrip(tmp_path):
+    path = tmp_path / "events.jsonl"
+    toks = list(range(16))
+    h = sequence_hashes(toks, BS)
+    with KvRecorder(path) as rec:
+        for i, bh in enumerate(h):
+            rec.record(i, 7, KvStoredEvent(block_hashes=[bh],
+                                           parent_hash=h[i - 1] if i else None))
+        rec.record(len(h), 7, KvRemovedEvent(block_hashes=[h[-1]]))
+
+    idx = KvIndexer()
+    assert replay_into(path, idx) == 5
+    assert idx.find_matches(h).scores == {7: 3}
