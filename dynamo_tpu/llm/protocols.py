@@ -78,6 +78,11 @@ class LLMEngineOutput:
     # filled by the detokenizing backend:
     text: Optional[str] = None
 
+    def __post_init__(self):
+        # tolerate wire-decoded plain strings (runtime/serde.py)
+        if isinstance(self.finish_reason, str):
+            self.finish_reason = FinishReason(self.finish_reason)
+
     @property
     def finished(self) -> bool:
         return self.finish_reason is not None

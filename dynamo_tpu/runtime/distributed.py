@@ -1,0 +1,277 @@
+"""DistributedRuntime — the cluster handle.
+
+Namespace → Component → Endpoint naming, endpoint serving with lease-backed
+discovery, clients with watch-driven instance lists and routing modes.
+
+Reference parity: lib/runtime/src/distributed.rs:32 (DistributedRuntime),
+component.rs:107-295 (Component/Endpoint/Namespace, key scheme
+"{ns}/components/{comp}/{ep}:{lease}"), component/endpoint.rs:57-141
+(serve + discovery registration), component/client.rs:52-267 (Client,
+RouterMode random/round_robin/direct, wait_for_endpoints, watch-driven
+instance updates on lease expiry).
+
+The transports differ by design: discovery/lease/events ride the
+coordinator (transports/coordinator.py) and requests ride direct TCP
+(transports/tcp.py) — see transports/__init__.py for the mapping.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random as _random
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Callable, Optional
+
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient
+from dynamo_tpu.runtime.transports.tcp import EndpointTcpClient, EndpointTcpServer
+
+log = logging.getLogger("dynamo_tpu.runtime")
+
+__all__ = ["DistributedRuntime", "Namespace", "Component", "Endpoint", "Client", "Instance"]
+
+
+@dataclass(frozen=True)
+class Instance:
+    instance_id: int
+    host: str
+    port: int
+    subject: str
+    metadata: dict | None = None
+
+
+class DistributedRuntime:
+    def __init__(self, config: Optional[RuntimeConfig] = None):
+        self.config = config or RuntimeConfig()
+        self.coordinator: Optional[CoordinatorClient] = None
+        self._tcp_server: Optional[EndpointTcpServer] = None
+        self.primary_lease: Optional[int] = None
+
+    @classmethod
+    async def connect(cls, config: Optional[RuntimeConfig] = None) -> "DistributedRuntime":
+        rt = cls(config)
+        rt.coordinator = await CoordinatorClient(rt.config.coordinator_url).connect()
+        rt.primary_lease = await rt.coordinator.lease_create(rt.config.lease_ttl_s)
+        return rt
+
+    async def shutdown(self) -> None:
+        if self._tcp_server:
+            await self._tcp_server.stop()
+        if self.coordinator:
+            await self.coordinator.close()
+
+    @property
+    def instance_id(self) -> int:
+        """This process's cluster identity (its primary lease id)."""
+        return self.primary_lease or 0
+
+    async def tcp_server(self) -> EndpointTcpServer:
+        """Lazily started shared endpoint server (ref: lazy TCP server,
+        distributed.rs)."""
+        if self._tcp_server is None:
+            self._tcp_server = await EndpointTcpServer(
+                host=self.config.host, port=self.config.port
+            ).start()
+        return self._tcp_server
+
+    def namespace(self, name: str) -> "Namespace":
+        return Namespace(self, name)
+
+
+@dataclass
+class Namespace:
+    runtime: DistributedRuntime
+    name: str
+
+    def component(self, name: str) -> "Component":
+        return Component(self.runtime, self.name, name)
+
+    # namespace-scoped events (ref traits/events.rs)
+    async def publish(self, subject: str, payload: bytes | dict) -> int:
+        return await self.runtime.coordinator.publish(f"{self.name}.{subject}", payload)
+
+    async def subscribe(self, subject: str, cb: Callable[[str, bytes], None]) -> int:
+        return await self.runtime.coordinator.subscribe(f"{self.name}.{subject}", cb)
+
+
+@dataclass
+class Component:
+    runtime: DistributedRuntime
+    namespace: str
+    name: str
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self.runtime, self.namespace, self.name, name)
+
+    @property
+    def event_prefix(self) -> str:
+        return f"{self.namespace}.{self.name}"
+
+    async def publish(self, subject: str, payload: bytes | dict) -> int:
+        return await self.runtime.coordinator.publish(f"{self.event_prefix}.{subject}", payload)
+
+    async def subscribe(self, subject: str, cb: Callable[[str, bytes], None]) -> int:
+        return await self.runtime.coordinator.subscribe(f"{self.event_prefix}.{subject}", cb)
+
+
+class Endpoint:
+    def __init__(self, runtime: DistributedRuntime, namespace: str, component: str, name: str):
+        self.runtime = runtime
+        self.namespace = namespace
+        self.component = component
+        self.name = name
+
+    @property
+    def discovery_prefix(self) -> str:
+        return f"{self.namespace}/components/{self.component}/endpoints/{self.name}/"
+
+    def subject(self, instance_id: int) -> str:
+        # "{ns}_{comp}.{ep}-{lease:x}" in the reference (component.rs:262)
+        return f"{self.namespace}_{self.component}.{self.name}-{instance_id:x}"
+
+    @property
+    def url(self) -> str:
+        return f"dyn://{self.namespace}.{self.component}.{self.name}"
+
+    # ------------------------------------------------------------------ serve
+    async def serve(
+        self, engine: AsyncEngine, metadata: Optional[dict] = None,
+        lease_id: Optional[int] = None,
+    ) -> Instance:
+        """Register this engine as a live instance of the endpoint."""
+        rt = self.runtime
+        server = await rt.tcp_server()
+        instance_id = lease_id or rt.primary_lease
+        subject = self.subject(instance_id)
+        server.register(subject, engine)
+        info = {
+            "instance_id": instance_id,
+            "host": server.host,
+            "port": server.port,
+            "subject": subject,
+            "metadata": metadata or {},
+        }
+        key = f"{self.discovery_prefix}{instance_id:x}"
+        created = await rt.coordinator.kv_create(key, info, lease_id=instance_id)
+        if not created:
+            raise RuntimeError(f"endpoint instance already registered at {key}")
+        log.info("serving %s as instance %x on %s:%s", self.url, instance_id, info["host"], info["port"])
+        return Instance(instance_id, info["host"], info["port"], subject, metadata)
+
+    # ----------------------------------------------------------------- client
+    async def client(self) -> "Client":
+        c = Client(self)
+        await c.start()
+        return c
+
+
+class Client(AsyncEngine):
+    """Watch-driven endpoint client with routing modes (ref client.rs:52)."""
+
+    def __init__(self, endpoint: Endpoint):
+        self.endpoint = endpoint
+        self._instances: dict[int, Instance] = {}
+        self._conns: dict[int, EndpointTcpClient] = {}
+        self._rr = 0
+        self._watch_id: Optional[int] = None
+        self._changed = asyncio.Event()
+
+    async def start(self) -> None:
+        coord = self.endpoint.runtime.coordinator
+        self._watch_id, snapshot = await coord.watch(
+            self.endpoint.discovery_prefix, self._on_event
+        )
+        for key, value in snapshot.items():
+            self._add(value)
+
+    async def close(self) -> None:
+        if self._watch_id is not None:
+            try:
+                await self.endpoint.runtime.coordinator.unwatch(self._watch_id)
+            except (ConnectionError, RuntimeError):
+                pass
+        for conn in self._conns.values():
+            await conn.close()
+
+    # ------------------------------------------------------------- discovery
+    def _on_event(self, event: str, key: str, value: Any) -> None:
+        if event == "put":
+            self._add(value)
+        elif event == "delete":
+            iid = int(key.rsplit("/", 1)[-1], 16)
+            self._instances.pop(iid, None)
+            conn = self._conns.pop(iid, None)
+            if conn:
+                asyncio.ensure_future(conn.close())
+        self._changed.set()
+
+    def _add(self, info: dict) -> None:
+        inst = Instance(
+            instance_id=info["instance_id"],
+            host=info["host"],
+            port=info["port"],
+            subject=info["subject"],
+            metadata=info.get("metadata"),
+        )
+        self._instances[inst.instance_id] = inst
+
+    def instance_ids(self) -> list[int]:
+        return sorted(self._instances)
+
+    def instances(self) -> list[Instance]:
+        return [self._instances[i] for i in self.instance_ids()]
+
+    async def wait_for_instances(self, n: int = 1, timeout: float = 30.0) -> list[int]:
+        """Block until >= n instances are live (ref wait_for_endpoints)."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while len(self._instances) < n:
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"only {len(self._instances)}/{n} instances of {self.endpoint.url}"
+                )
+            self._changed.clear()
+            try:
+                await asyncio.wait_for(self._changed.wait(), remaining)
+            except asyncio.TimeoutError:
+                pass
+        return self.instance_ids()
+
+    # --------------------------------------------------------------- routing
+    def _conn(self, instance_id: int) -> EndpointTcpClient:
+        inst = self._instances.get(instance_id)
+        if inst is None:
+            raise KeyError(f"instance {instance_id:x} of {self.endpoint.url} not found")
+        conn = self._conns.get(instance_id)
+        if conn is None:
+            conn = EndpointTcpClient(inst.host, inst.port, inst.subject)
+            self._conns[instance_id] = conn
+        return conn
+
+    def pick_random(self) -> int:
+        ids = self.instance_ids()
+        if not ids:
+            raise RuntimeError(f"no instances of {self.endpoint.url}")
+        return _random.choice(ids)
+
+    def pick_round_robin(self) -> int:
+        ids = self.instance_ids()
+        if not ids:
+            raise RuntimeError(f"no instances of {self.endpoint.url}")
+        self._rr = (self._rr + 1) % len(ids)
+        return ids[self._rr]
+
+    def direct(self, request: Context, instance_id: int) -> AsyncIterator[Any]:
+        return self._conn(instance_id).generate(request)
+
+    def random(self, request: Context) -> AsyncIterator[Any]:
+        return self.direct(request, self.pick_random())
+
+    def round_robin(self, request: Context) -> AsyncIterator[Any]:
+        return self.direct(request, self.pick_round_robin())
+
+    # default AsyncEngine surface = random routing
+    def generate(self, request: Context) -> AsyncIterator[Any]:
+        return self.random(request)

@@ -1,0 +1,18 @@
+"""Transports: the wire layer of the distributed runtime.
+
+Reference parity map (lib/runtime/src/transports/):
+
+  etcd.rs + nats.rs  →  coordinator.py   one lightweight control-plane
+                                          service: KV+lease+watch (etcd
+                                          semantics), pub/sub subjects and
+                                          durable work queues (NATS core +
+                                          JetStream semantics)
+  pipeline/network/tcp/* + TwoPartCodec
+                     →  framing.py, tcp.py  direct duplex worker
+                                          connections: request frame out,
+                                          response stream back on the same
+                                          socket (collapses the reference's
+                                          NATS-request + dial-back TCP
+                                          response plane into one hop —
+                                          lower latency, fewer moving parts)
+"""

@@ -1,0 +1,534 @@
+"""The coordinator — control/event plane for the distributed runtime.
+
+One lightweight asyncio TCP service providing exactly the primitives the
+reference gets from etcd + NATS (SURVEY.md §5 "distributed communication
+backend", planes 1–3):
+
+  KV + leases + watches   — service discovery, liveness, dynamic config
+                            (etcd parity: transports/etcd.rs:40-255)
+  pub/sub subjects        — KV events, hit-rate events
+                            (NATS core parity: transports/nats.rs)
+  durable work queues     — remote prefill queue w/ ack+redelivery
+                            (JetStream parity: examples/llm/utils/nats_queue.py)
+
+Failure detection improves on the reference's TTL-only leases: a lease dies
+when its owning connection drops (instant) OR when its TTL lapses without
+keepalive (backstop) — so a crashed worker vanishes from discovery in
+milliseconds, mirroring the etcd lease-expiry → watcher-delete path
+(lib/runtime/src/transports/etcd/lease.rs:19-51, component/client.rs:145).
+
+Protocol: two-part frames (framing.py); header {op, id, ...}; replies echo
+{id}.  Server pushes carry op "watch_event" / "message" / nothing (queue
+deliveries are pull-based replies).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import logging
+import time
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from dynamo_tpu.runtime.transports.framing import read_frame, write_frame
+
+log = logging.getLogger("dynamo_tpu.coordinator")
+
+__all__ = ["CoordinatorServer", "CoordinatorClient"]
+
+
+def _match(pattern: str, subject: str) -> bool:
+    """Exact match, or prefix match when the pattern ends with '>'."""
+    if pattern.endswith(">"):
+        return subject.startswith(pattern[:-1])
+    return pattern == subject
+
+
+# ============================================================ server ==========
+
+
+@dataclass
+class _Lease:
+    lease_id: int
+    ttl: float
+    expires_at: float
+    keys: set[str] = field(default_factory=set)
+    conn_id: int = -1
+
+
+@dataclass
+class _QueueItem:
+    msg_id: int
+    payload: bytes
+    header: dict
+
+
+class CoordinatorServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._kv: dict[str, Any] = {}
+        self._kv_lease: dict[str, int] = {}
+        self._leases: dict[int, _Lease] = {}
+        self._ids = itertools.count(1)
+        # watches: watch_id -> (prefix, writer, conn_id)
+        self._watches: dict[int, tuple[str, asyncio.StreamWriter, int]] = {}
+        # subs: sub_id -> (pattern, writer, conn_id)
+        self._subs: dict[int, tuple[str, asyncio.StreamWriter, int]] = {}
+        self._queues: dict[str, deque[_QueueItem]] = defaultdict(deque)
+        self._queue_waiters: dict[str, deque[asyncio.Future]] = defaultdict(deque)
+        self._pending_acks: dict[tuple[str, int], _QueueItem] = {}
+        self._conn_ids = itertools.count(1)
+        self._conn_leases: dict[int, set[int]] = defaultdict(set)
+        self._expiry_task: Optional[asyncio.Task] = None
+        self._write_locks: dict[int, asyncio.Lock] = {}
+        self._conn_writers: dict[int, asyncio.StreamWriter] = {}
+
+    # ------------------------------------------------------------- lifecycle
+    async def start(self) -> "CoordinatorServer":
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._expiry_task = asyncio.ensure_future(self._expiry_loop())
+        log.info("coordinator listening on %s:%s", self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._expiry_task:
+            self._expiry_task.cancel()
+        if self._server:
+            self._server.close()
+            # sever live client connections so wait_closed() returns (py3.12
+            # waits on all connection handlers)
+            for w in list(self._conn_writers.values()):
+                w.close()
+            await self._server.wait_closed()
+
+    @property
+    def url(self) -> str:
+        return f"tcp://{self.host}:{self.port}"
+
+    async def _expiry_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.5)
+            now = time.monotonic()
+            for lease in [l for l in self._leases.values() if l.expires_at < now]:
+                log.info("lease %s expired", lease.lease_id)
+                self._revoke_lease(lease.lease_id)
+
+    # ------------------------------------------------------------ connection
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        conn_id = next(self._conn_ids)
+        self._write_locks[conn_id] = asyncio.Lock()
+        self._conn_writers[conn_id] = writer
+        try:
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    break
+                header, payload = frame
+                try:
+                    await self._dispatch(conn_id, writer, header, payload)
+                except Exception as e:  # protocol-level error back to caller
+                    log.exception("coordinator op failed: %s", header.get("op"))
+                    await self._send(conn_id, writer, {"id": header.get("id"), "error": str(e)})
+        finally:
+            # connection-drop cleanup: leases, watches, subs, pending queue acks
+            for lease_id in list(self._conn_leases.pop(conn_id, ())):
+                self._revoke_lease(lease_id)
+            for wid in [w for w, (_, _, c) in self._watches.items() if c == conn_id]:
+                del self._watches[wid]
+            for sid in [s for s, (_, _, c) in self._subs.items() if c == conn_id]:
+                del self._subs[sid]
+            for (queue, msg_id), item in list(self._pending_acks.items()):
+                if item.header.get("conn_id") == conn_id:
+                    del self._pending_acks[(queue, msg_id)]
+                    self._queue_deliver(queue, item)
+            self._write_locks.pop(conn_id, None)
+            self._conn_writers.pop(conn_id, None)
+            writer.close()
+
+    async def _send(self, conn_id: int, writer: asyncio.StreamWriter,
+                    header: dict, payload: bytes = b"") -> None:
+        lock = self._write_locks.get(conn_id)
+        if lock is None:
+            return
+        async with lock:
+            try:
+                write_frame(writer, header, payload)
+                await writer.drain()
+            except (ConnectionResetError, RuntimeError):
+                pass
+
+    # --------------------------------------------------------------- dispatch
+    async def _dispatch(self, conn_id: int, writer: asyncio.StreamWriter,
+                        h: dict, payload: bytes) -> None:
+        op = h.get("op")
+        rid = h.get("id")
+
+        if op == "kv_put" or op == "kv_create" or op == "kv_create_or_validate":
+            key, value = h["key"], h.get("value")
+            exists = key in self._kv
+            if op == "kv_create" and exists:
+                await self._send(conn_id, writer, {"id": rid, "ok": False, "exists": True})
+                return
+            if op == "kv_create_or_validate" and exists:
+                ok = self._kv[key] == value
+                await self._send(conn_id, writer, {"id": rid, "ok": ok, "exists": True})
+                return
+            self._kv[key] = value
+            lease_id = h.get("lease_id")
+            if lease_id:
+                lease = self._leases.get(lease_id)
+                if lease is None:
+                    del self._kv[key]
+                    await self._send(conn_id, writer, {"id": rid, "error": "no such lease"})
+                    return
+                lease.keys.add(key)
+                self._kv_lease[key] = lease_id
+            await self._notify_watchers("put", key, value)
+            await self._send(conn_id, writer, {"id": rid, "ok": True})
+
+        elif op == "kv_get":
+            key = h["key"]
+            await self._send(conn_id, writer,
+                             {"id": rid, "ok": key in self._kv, "value": self._kv.get(key)})
+
+        elif op == "kv_get_prefix":
+            prefix = h["prefix"]
+            items = {k: v for k, v in self._kv.items() if k.startswith(prefix)}
+            await self._send(conn_id, writer, {"id": rid, "ok": True, "items": items})
+
+        elif op == "kv_delete":
+            key = h["key"]
+            existed = self._delete_key(key)
+            await self._send(conn_id, writer, {"id": rid, "ok": existed})
+
+        elif op == "watch":
+            prefix = h["prefix"]
+            watch_id = next(self._ids)
+            self._watches[watch_id] = (prefix, writer, conn_id)
+            # initial snapshot as put events (etcd get+watch pattern)
+            snapshot = {k: v for k, v in self._kv.items() if k.startswith(prefix)}
+            await self._send(conn_id, writer,
+                             {"id": rid, "ok": True, "watch_id": watch_id, "snapshot": snapshot})
+
+        elif op == "unwatch":
+            self._watches.pop(h["watch_id"], None)
+            await self._send(conn_id, writer, {"id": rid, "ok": True})
+
+        elif op == "lease_create":
+            ttl = float(h.get("ttl", 10.0))
+            lease_id = next(self._ids)
+            self._leases[lease_id] = _Lease(
+                lease_id, ttl, time.monotonic() + ttl, conn_id=conn_id
+            )
+            self._conn_leases[conn_id].add(lease_id)
+            await self._send(conn_id, writer, {"id": rid, "ok": True, "lease_id": lease_id})
+
+        elif op == "lease_keepalive":
+            lease = self._leases.get(h["lease_id"])
+            if lease:
+                lease.expires_at = time.monotonic() + lease.ttl
+            await self._send(conn_id, writer, {"id": rid, "ok": lease is not None})
+
+        elif op == "lease_revoke":
+            self._revoke_lease(h["lease_id"])
+            await self._send(conn_id, writer, {"id": rid, "ok": True})
+
+        elif op == "subscribe":
+            sub_id = next(self._ids)
+            self._subs[sub_id] = (h["subject"], writer, conn_id)
+            await self._send(conn_id, writer, {"id": rid, "ok": True, "sub_id": sub_id})
+
+        elif op == "unsubscribe":
+            self._subs.pop(h["sub_id"], None)
+            await self._send(conn_id, writer, {"id": rid, "ok": True})
+
+        elif op == "publish":
+            subject = h["subject"]
+            n = 0
+            for sub_id, (pattern, w, cid) in list(self._subs.items()):
+                if _match(pattern, subject):
+                    await self._send(cid, w, {"op": "message", "sub_id": sub_id,
+                                              "subject": subject}, payload)
+                    n += 1
+            await self._send(conn_id, writer, {"id": rid, "ok": True, "delivered": n})
+
+        elif op == "queue_push":
+            item = _QueueItem(next(self._ids), payload, {"queue": h["queue"]})
+            self._queue_deliver(h["queue"], item)
+            await self._send(conn_id, writer, {"id": rid, "ok": True, "msg_id": item.msg_id})
+
+        elif op == "queue_pull":
+            # run as a task: a long pull must not stall this connection's
+            # dispatch loop (keepalives and other ops share the socket)
+            async def _pull(queue=h["queue"], timeout=h.get("timeout_ms", 0) / 1e3, rid=rid):
+                item = await self._queue_take(queue, timeout)
+                if item is None:
+                    await self._send(conn_id, writer, {"id": rid, "ok": False, "empty": True})
+                else:
+                    item.header["conn_id"] = conn_id
+                    self._pending_acks[(queue, item.msg_id)] = item
+                    await self._send(conn_id, writer,
+                                     {"id": rid, "ok": True, "msg_id": item.msg_id}, item.payload)
+
+            asyncio.ensure_future(_pull())
+
+        elif op == "queue_ack":
+            key = (h["queue"], h["msg_id"])
+            ok = self._pending_acks.pop(key, None) is not None
+            await self._send(conn_id, writer, {"id": rid, "ok": ok})
+
+        elif op == "queue_nack":
+            key = (h["queue"], h["msg_id"])
+            item = self._pending_acks.pop(key, None)
+            if item is not None:
+                self._queue_deliver(h["queue"], item)
+            await self._send(conn_id, writer, {"id": rid, "ok": item is not None})
+
+        elif op == "ping":
+            await self._send(conn_id, writer, {"id": rid, "ok": True})
+
+        else:
+            await self._send(conn_id, writer, {"id": rid, "error": f"unknown op {op!r}"})
+
+    # ----------------------------------------------------------------- helpers
+    def _delete_key(self, key: str) -> bool:
+        existed = self._kv.pop(key, None) is not None
+        lease_id = self._kv_lease.pop(key, None)
+        if lease_id and lease_id in self._leases:
+            self._leases[lease_id].keys.discard(key)
+        if existed:
+            asyncio.ensure_future(self._notify_watchers("delete", key, None))
+        return existed
+
+    def _revoke_lease(self, lease_id: int) -> None:
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return
+        self._conn_leases.get(lease.conn_id, set()).discard(lease_id)
+        for key in list(lease.keys):
+            self._kv.pop(key, None)
+            self._kv_lease.pop(key, None)
+            asyncio.ensure_future(self._notify_watchers("delete", key, None))
+
+    async def _notify_watchers(self, event: str, key: str, value: Any) -> None:
+        for watch_id, (prefix, writer, conn_id) in list(self._watches.items()):
+            if key.startswith(prefix):
+                await self._send(conn_id, writer, {
+                    "op": "watch_event", "watch_id": watch_id,
+                    "event": event, "key": key, "value": value,
+                })
+
+    def _queue_deliver(self, queue: str, item: _QueueItem) -> None:
+        waiters = self._queue_waiters[queue]
+        while waiters:
+            fut = waiters.popleft()
+            if not fut.done():
+                fut.set_result(item)
+                return
+        self._queues[queue].append(item)
+
+    async def _queue_take(self, queue: str, timeout: float) -> Optional[_QueueItem]:
+        q = self._queues[queue]
+        if q:
+            return q.popleft()
+        if timeout <= 0:
+            return None
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._queue_waiters[queue].append(fut)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            return None
+
+
+# ============================================================ client ==========
+
+
+class CoordinatorClient:
+    """Async client. Watches and subscriptions deliver via callbacks
+    (scheduled on the client's event loop)."""
+
+    def __init__(self, url: str):
+        # url: tcp://host:port
+        hostport = url.split("//", 1)[-1]
+        host, port = hostport.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._ids = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._watch_cbs: dict[int, Callable[[str, str, Any], None]] = {}
+        self._sub_cbs: dict[int, Callable[[str, bytes], None]] = {}
+        self._read_task: Optional[asyncio.Task] = None
+        self._keepalive_tasks: dict[int, asyncio.Task] = {}
+        self._write_lock = asyncio.Lock()
+        self.closed = asyncio.Event()
+
+    async def connect(self) -> "CoordinatorClient":
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._read_task = asyncio.ensure_future(self._read_loop())
+        return self
+
+    async def close(self) -> None:
+        for t in self._keepalive_tasks.values():
+            t.cancel()
+        if self._read_task:
+            self._read_task.cancel()
+        if self._writer:
+            self._writer.close()
+        self.closed.set()
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = await read_frame(self._reader)
+                if frame is None:
+                    break
+                header, payload = frame
+                op = header.get("op")
+                if op == "watch_event":
+                    cb = self._watch_cbs.get(header["watch_id"])
+                    if cb:
+                        cb(header["event"], header["key"], header.get("value"))
+                elif op == "message":
+                    cb = self._sub_cbs.get(header["sub_id"])
+                    if cb:
+                        cb(header["subject"], payload)
+                else:
+                    fut = self._pending.pop(header.get("id"), None)
+                    if fut and not fut.done():
+                        fut.set_result((header, payload))
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self.closed.set()
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("coordinator connection lost"))
+            self._pending.clear()
+
+    async def _call(self, header: dict, payload: bytes = b"") -> tuple[dict, bytes]:
+        rid = next(self._ids)
+        header["id"] = rid
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        async with self._write_lock:
+            write_frame(self._writer, header, payload)
+            await self._writer.drain()
+        resp, pl = await fut
+        if "error" in resp:
+            raise RuntimeError(f"coordinator error: {resp['error']}")
+        return resp, pl
+
+    # ----------------------------------------------------------------- KV API
+    async def kv_put(self, key: str, value: Any, lease_id: Optional[int] = None) -> None:
+        await self._call({"op": "kv_put", "key": key, "value": value, "lease_id": lease_id})
+
+    async def kv_create(self, key: str, value: Any, lease_id: Optional[int] = None) -> bool:
+        resp, _ = await self._call(
+            {"op": "kv_create", "key": key, "value": value, "lease_id": lease_id}
+        )
+        return bool(resp.get("ok"))
+
+    async def kv_create_or_validate(self, key: str, value: Any) -> bool:
+        resp, _ = await self._call({"op": "kv_create_or_validate", "key": key, "value": value})
+        return bool(resp.get("ok"))
+
+    async def kv_get(self, key: str) -> Optional[Any]:
+        resp, _ = await self._call({"op": "kv_get", "key": key})
+        return resp.get("value") if resp.get("ok") else None
+
+    async def kv_get_prefix(self, prefix: str) -> dict[str, Any]:
+        resp, _ = await self._call({"op": "kv_get_prefix", "prefix": prefix})
+        return resp.get("items", {})
+
+    async def kv_delete(self, key: str) -> bool:
+        resp, _ = await self._call({"op": "kv_delete", "key": key})
+        return bool(resp.get("ok"))
+
+    async def watch(
+        self, prefix: str, callback: Callable[[str, str, Any], None]
+    ) -> tuple[int, dict[str, Any]]:
+        """Watch a prefix; callback(event, key, value).  Returns
+        (watch_id, snapshot-at-watch-start)."""
+        resp, _ = await self._call({"op": "watch", "prefix": prefix})
+        watch_id = resp["watch_id"]
+        self._watch_cbs[watch_id] = callback
+        return watch_id, resp.get("snapshot", {})
+
+    async def unwatch(self, watch_id: int) -> None:
+        self._watch_cbs.pop(watch_id, None)
+        await self._call({"op": "unwatch", "watch_id": watch_id})
+
+    # -------------------------------------------------------------- lease API
+    async def lease_create(self, ttl: float = 10.0, auto_keepalive: bool = True) -> int:
+        resp, _ = await self._call({"op": "lease_create", "ttl": ttl})
+        lease_id = resp["lease_id"]
+        if auto_keepalive:
+            self._keepalive_tasks[lease_id] = asyncio.ensure_future(
+                self._keepalive_loop(lease_id, ttl)
+            )
+        return lease_id
+
+    async def _keepalive_loop(self, lease_id: int, ttl: float) -> None:
+        # half-TTL ticks (ref transports/etcd/lease.rs:51)
+        try:
+            while True:
+                await asyncio.sleep(ttl / 2)
+                await self._call({"op": "lease_keepalive", "lease_id": lease_id})
+        except (asyncio.CancelledError, ConnectionError, RuntimeError):
+            pass
+
+    async def lease_revoke(self, lease_id: int) -> None:
+        t = self._keepalive_tasks.pop(lease_id, None)
+        if t:
+            t.cancel()
+        await self._call({"op": "lease_revoke", "lease_id": lease_id})
+
+    # ------------------------------------------------------------- pub/sub API
+    async def subscribe(self, subject: str, callback: Callable[[str, bytes], None]) -> int:
+        resp, _ = await self._call({"op": "subscribe", "subject": subject})
+        sub_id = resp["sub_id"]
+        self._sub_cbs[sub_id] = callback
+        return sub_id
+
+    async def unsubscribe(self, sub_id: int) -> None:
+        self._sub_cbs.pop(sub_id, None)
+        await self._call({"op": "unsubscribe", "sub_id": sub_id})
+
+    async def publish(self, subject: str, payload: bytes | dict) -> int:
+        if isinstance(payload, dict):
+            payload = json.dumps(payload).encode()
+        resp, _ = await self._call({"op": "publish", "subject": subject}, payload)
+        return resp.get("delivered", 0)
+
+    # --------------------------------------------------------------- queue API
+    async def queue_push(self, queue: str, payload: bytes | dict) -> int:
+        if isinstance(payload, dict):
+            payload = json.dumps(payload).encode()
+        resp, _ = await self._call({"op": "queue_push", "queue": queue}, payload)
+        return resp["msg_id"]
+
+    async def queue_pull(self, queue: str, timeout_s: float = 0.0) -> Optional[tuple[int, bytes]]:
+        resp, payload = await self._call(
+            {"op": "queue_pull", "queue": queue, "timeout_ms": int(timeout_s * 1e3)}
+        )
+        if not resp.get("ok"):
+            return None
+        return resp["msg_id"], payload
+
+    async def queue_ack(self, queue: str, msg_id: int) -> None:
+        await self._call({"op": "queue_ack", "queue": queue, "msg_id": msg_id})
+
+    async def queue_nack(self, queue: str, msg_id: int) -> None:
+        await self._call({"op": "queue_nack", "queue": queue, "msg_id": msg_id})
+
+    async def ping(self) -> bool:
+        resp, _ = await self._call({"op": "ping"})
+        return bool(resp.get("ok"))

@@ -1,0 +1,56 @@
+"""Two-part length-prefixed framing: JSON header + binary payload.
+
+Frame layout: [u32 header_len][u32 payload_len][header JSON][payload bytes]
+(big-endian).  The header carries control/routing metadata; the payload is
+opaque bytes (JSON bodies, or raw tensor data for KV-block transfer, which
+must not pay a JSON/base64 tax).
+
+Reference parity: lib/runtime/src/pipeline/network/codec/two_part.rs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+from typing import Any, Optional
+
+_HDR = struct.Struct(">II")
+
+MAX_FRAME = 1 << 30  # 1 GiB guard
+
+__all__ = ["write_frame", "read_frame", "FrameError"]
+
+
+class FrameError(Exception):
+    pass
+
+
+def encode_frame(header: dict[str, Any], payload: bytes = b"") -> bytes:
+    hdr = json.dumps(header, separators=(",", ":")).encode()
+    return _HDR.pack(len(hdr), len(payload)) + hdr + payload
+
+
+def write_frame(writer: asyncio.StreamWriter, header: dict[str, Any], payload: bytes = b"") -> None:
+    writer.write(encode_frame(header, payload))
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Optional[tuple[dict, bytes]]:
+    """Read one frame; returns None on clean EOF."""
+    try:
+        prefix = await reader.readexactly(_HDR.size)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    hlen, plen = _HDR.unpack(prefix)
+    if hlen > MAX_FRAME or plen > MAX_FRAME:
+        raise FrameError(f"oversized frame: header={hlen} payload={plen}")
+    try:
+        hdr = await reader.readexactly(hlen)
+        payload = await reader.readexactly(plen) if plen else b""
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    try:
+        header = json.loads(hdr)
+    except json.JSONDecodeError as e:
+        raise FrameError(f"bad frame header: {e}") from e
+    return header, payload

@@ -1,0 +1,221 @@
+"""Direct TCP request plane: serve an AsyncEngine over a duplex socket.
+
+The reference sends requests over NATS and streams responses over a
+dial-back TCP connection (pipeline/network/egress/push.rs:88-180,
+tcp/server.rs:74).  Here both directions ride ONE connection, multiplexed
+by request id — one hop fewer per token, and cancellation (stop/kill
+control frames, ref ControlMessage network.rs:58) shares the socket.
+
+Frames (framing.py headers):
+  client → server:  {type:"request",  req_id} + payload(serde)
+                    {type:"stop"|"kill", req_id}
+  server → client:  {type:"item", req_id} + payload(serde)
+                    {type:"end",  req_id}
+                    {type:"error", req_id, error}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.runtime import serde
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.transports.framing import read_frame, write_frame
+
+log = logging.getLogger("dynamo_tpu.tcp")
+
+__all__ = ["EndpointTcpServer", "EndpointTcpClient"]
+
+_END = object()
+
+
+class EndpointTcpServer:
+    """Serves registered AsyncEngines over TCP; one server per process,
+    engines keyed by endpoint name (subject)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._engines: dict[str, AsyncEngine] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set[asyncio.StreamWriter] = set()
+
+    def register(self, subject: str, engine: AsyncEngine) -> None:
+        self._engines[subject] = engine
+
+    def unregister(self, subject: str) -> None:
+        self._engines.pop(subject, None)
+
+    async def start(self) -> "EndpointTcpServer":
+        if self._server is None:
+            self._server = await asyncio.start_server(self._handle, self.host, self.port)
+            self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            # sever live connections so wait_closed() (which waits on all
+            # handlers in py3.12) returns promptly
+            for w in list(self._conns):
+                w.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        self._conns.add(writer)
+        contexts: dict[int, Context] = {}
+        tasks: dict[int, asyncio.Task] = {}
+        wlock = asyncio.Lock()
+
+        async def send(header: dict, payload: bytes = b"") -> None:
+            async with wlock:
+                try:
+                    write_frame(writer, header, payload)
+                    await writer.drain()
+                except (ConnectionResetError, RuntimeError):
+                    pass
+
+        async def run_request(req_id: int, subject: str, data: Any) -> None:
+            engine = self._engines.get(subject)
+            if engine is None:
+                await send({"type": "error", "req_id": req_id,
+                            "error": f"no endpoint {subject!r}"})
+                return
+            ctx = Context(data)
+            contexts[req_id] = ctx
+            try:
+                async for item in engine.generate(ctx):
+                    await send({"type": "item", "req_id": req_id}, serde.dumps(item))
+                await send({"type": "end", "req_id": req_id})
+            except Exception as e:
+                log.exception("endpoint %s request failed", subject)
+                await send({"type": "error", "req_id": req_id, "error": str(e)})
+            finally:
+                contexts.pop(req_id, None)
+                tasks.pop(req_id, None)
+
+        try:
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    break
+                header, payload = frame
+                ftype = header.get("type")
+                req_id = header.get("req_id")
+                if ftype == "request":
+                    data = serde.loads(payload)
+                    tasks[req_id] = asyncio.ensure_future(
+                        run_request(req_id, header.get("subject", ""), data)
+                    )
+                elif ftype == "stop":
+                    ctx = contexts.get(req_id)
+                    if ctx:
+                        ctx.stop_generating()
+                elif ftype == "kill":
+                    ctx = contexts.get(req_id)
+                    if ctx:
+                        ctx.kill()
+        finally:
+            # peer gone: kill all in-flight requests from this connection
+            self._conns.discard(writer)
+            for ctx in contexts.values():
+                ctx.kill()
+            for t in tasks.values():
+                t.cancel()
+            writer.close()
+
+
+class EndpointTcpClient(AsyncEngine):
+    """Client-side AsyncEngine proxy for one remote endpoint."""
+
+    def __init__(self, host: str, port: int, subject: str):
+        self.host = host
+        self.port = port
+        self.subject = subject
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._ids = itertools.count(1)
+        self._streams: dict[int, asyncio.Queue] = {}
+        self._read_task: Optional[asyncio.Task] = None
+        self._wlock = asyncio.Lock()
+        self._connected = False
+
+    async def connect(self) -> "EndpointTcpClient":
+        if not self._connected:
+            self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+            self._read_task = asyncio.ensure_future(self._read_loop())
+            self._connected = True
+        return self
+
+    async def close(self) -> None:
+        if self._read_task:
+            self._read_task.cancel()
+        if self._writer:
+            self._writer.close()
+        self._connected = False
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = await read_frame(self._reader)
+                if frame is None:
+                    break
+                header, payload = frame
+                q = self._streams.get(header.get("req_id"))
+                if q is None:
+                    continue
+                ftype = header.get("type")
+                if ftype == "item":
+                    q.put_nowait(serde.loads(payload))
+                elif ftype == "end":
+                    q.put_nowait(_END)
+                elif ftype == "error":
+                    q.put_nowait(RuntimeError(header.get("error", "remote error")))
+        finally:
+            for q in self._streams.values():
+                q.put_nowait(ConnectionError("endpoint connection lost"))
+
+    async def _send(self, header: dict, payload: bytes = b"") -> None:
+        async with self._wlock:
+            write_frame(self._writer, header, payload)
+            await self._writer.drain()
+
+    def generate(self, request: Context) -> AsyncIterator[Any]:
+        return self._generate(request)
+
+    async def _generate(self, request: Context) -> AsyncIterator[Any]:
+        await self.connect()
+        req_id = next(self._ids)
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[req_id] = q
+        await self._send(
+            {"type": "request", "req_id": req_id, "subject": self.subject},
+            serde.dumps(request.data),
+        )
+        cancel_task = asyncio.ensure_future(request.stopped())
+        try:
+            while True:
+                get_task = asyncio.ensure_future(q.get())
+                done, _ = await asyncio.wait(
+                    [get_task, cancel_task], return_when=asyncio.FIRST_COMPLETED
+                )
+                if cancel_task in done and not get_task.done():
+                    get_task.cancel()
+                    await self._send(
+                        {"type": "kill" if request.is_killed else "stop", "req_id": req_id}
+                    )
+                    cancel_task = asyncio.ensure_future(asyncio.Event().wait())  # never again
+                    continue
+                item = get_task.result()
+                if item is _END:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            cancel_task.cancel()
+            self._streams.pop(req_id, None)

@@ -1,0 +1,182 @@
+"""Distributed runtime tests — full coordinator + TCP request plane in one
+process (the reference tests distributed features the same way: real
+etcd/NATS as local subprocesses + mock engines, SURVEY.md §4)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.llm.protocols import BackendInput  # registers via serde helper
+from dynamo_tpu.runtime import serde
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.echo import EchoEngine
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient, CoordinatorServer
+
+serde.register_llm_types()
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+async def _coordinator():
+    return await CoordinatorServer(port=0).start()
+
+
+# ------------------------------------------------------------- coordinator ----
+
+def test_kv_lease_watch():
+    async def go():
+        srv = await _coordinator()
+        try:
+            c1 = await CoordinatorClient(srv.url).connect()
+            c2 = await CoordinatorClient(srv.url).connect()
+
+            events = []
+            _, snap = await c2.watch("ns/", lambda e, k, v: events.append((e, k, v)))
+            assert snap == {}
+
+            lease = await c1.lease_create(ttl=5.0)
+            await c1.kv_put("ns/a", {"x": 1}, lease_id=lease)
+            assert await c2.kv_get("ns/a") == {"x": 1}
+            assert not await c1.kv_create("ns/a", {"x": 2})  # create-if-absent
+            await asyncio.sleep(0.05)
+            assert ("put", "ns/a", {"x": 1}) in events
+
+            # connection drop revokes the lease -> key vanishes, watcher told
+            await c1.close()
+            await asyncio.sleep(0.2)
+            assert await c2.kv_get("ns/a") is None
+            assert ("delete", "ns/a", None) in events
+            await c2.close()
+        finally:
+            await srv.stop()
+
+    run(go())
+
+
+def test_pubsub_and_queue():
+    async def go():
+        srv = await _coordinator()
+        try:
+            a = await CoordinatorClient(srv.url).connect()
+            b = await CoordinatorClient(srv.url).connect()
+
+            got = []
+            await b.subscribe("ns.kv_events.>", lambda subj, pl: got.append((subj, pl)))
+            n = await a.publish("ns.kv_events.w1", b"hello")
+            assert n == 1
+            await asyncio.sleep(0.05)
+            assert got == [("ns.kv_events.w1", b"hello")]
+
+            # work queue with ack + nack redelivery
+            await a.queue_push("prefill", b"job1")
+            msg = await b.queue_pull("prefill", timeout_s=1)
+            assert msg is not None and msg[1] == b"job1"
+            await b.queue_nack("prefill", msg[0])
+            msg2 = await b.queue_pull("prefill", timeout_s=1)
+            assert msg2 is not None and msg2[1] == b"job1"
+            await b.queue_ack("prefill", msg2[0])
+            assert await b.queue_pull("prefill") is None
+
+            await a.close()
+            await b.close()
+        finally:
+            await srv.stop()
+
+    run(go())
+
+
+# --------------------------------------------------------- endpoint serving ----
+
+async def _runtime(url) -> DistributedRuntime:
+    cfg = RuntimeConfig(coordinator_url=url, lease_ttl_s=2.0)
+    return await DistributedRuntime.connect(cfg)
+
+
+def test_endpoint_serve_discover_route():
+    async def go():
+        srv = await _coordinator()
+        try:
+            worker1 = await _runtime(srv.url)
+            worker2 = await _runtime(srv.url)
+            frontend = await _runtime(srv.url)
+
+            ep1 = worker1.namespace("dyn").component("backend").endpoint("generate")
+            ep2 = worker2.namespace("dyn").component("backend").endpoint("generate")
+            await ep1.serve(EchoEngine())
+            await ep2.serve(EchoEngine())
+
+            client = await frontend.namespace("dyn").component("backend").endpoint("generate").client()
+            ids = await client.wait_for_instances(2)
+            assert len(ids) == 2
+            assert ids == [worker1.instance_id, worker2.instance_id]
+
+            # random + round-robin + direct all produce the stream
+            out = [x async for x in client.generate(Context([1, 2, 3]))]
+            assert out == [1, 2, 3]
+            out = [x async for x in client.round_robin(Context(["a", "b"]))]
+            assert out == ["a", "b"]
+            out = [x async for x in client.direct(Context([9]), worker2.instance_id)]
+            assert out == [9]
+
+            # typed payloads cross the wire (serde round trip)
+            out = [x async for x in client.generate(Context([BackendInput(token_ids=[5])]))]
+            assert isinstance(out[0], BackendInput) and out[0].token_ids == [5]
+
+            # worker death: shutdown -> connection drop -> instance removed
+            await worker2.shutdown()
+            await asyncio.sleep(0.2)
+            assert client.instance_ids() == [worker1.instance_id]
+
+            await client.close()
+            await frontend.shutdown()
+            await worker1.shutdown()
+        finally:
+            await srv.stop()
+
+    run(go())
+
+
+class SlowEngine(AsyncEngine):
+    def generate(self, request):
+        return self._run(request)
+
+    async def _run(self, request):
+        for i in range(1000):
+            if request.is_stopped:
+                return
+            await asyncio.sleep(0.01)
+            yield i
+
+
+def test_remote_cancellation():
+    async def go():
+        srv = await _coordinator()
+        try:
+            worker = await _runtime(srv.url)
+            ep = worker.namespace("dyn").component("slow").endpoint("generate")
+            await ep.serve(SlowEngine())
+
+            frontend = await _runtime(srv.url)
+            client = await frontend.namespace("dyn").component("slow").endpoint("generate").client()
+            await client.wait_for_instances(1)
+
+            ctx = Context(None)
+            got = []
+            async for item in client.generate(ctx):
+                got.append(item)
+                if len(got) == 3:
+                    ctx.stop_generating()
+            # stop propagated to the remote context: stream ended early
+            assert 3 <= len(got) < 20
+
+            await client.close()
+            await frontend.shutdown()
+            await worker.shutdown()
+        finally:
+            await srv.stop()
+
+    run(go())
