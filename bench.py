@@ -1,0 +1,118 @@
+"""Single-chip serving benchmark.
+
+Measures steady-state decode throughput (output tok/s/chip) through the
+real engine path — continuous-batching EngineCore, paged KV cache, batched
+sampling — on a Llama-3.2-1B-class model (random bf16 weights; the decode
+hot loop is weight-value-independent).  Prints ONE JSON line:
+
+  {"metric": "decode_tok_s_per_chip", "value": N, "unit": "tok/s",
+   "vs_baseline": N / 2000}
+
+Baseline divisor = the north-star ≥2000 output tok/s/chip (BASELINE.json).
+Env knobs: DYNAMO_BENCH_BATCH, DYNAMO_BENCH_STEPS, DYNAMO_BENCH_MODEL
+(tiny|1b|8b).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.core import EngineCore
+from dynamo_tpu.engine.request import EngineRequest
+from dynamo_tpu.llm.protocols import SamplingOptions, StopConditions
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import LlamaModel
+
+MODELS = {
+    # fast CI / CPU smoke
+    "tiny": dict(vocab_size=2048, hidden_size=256, intermediate_size=512,
+                 num_layers=4, num_heads=8, num_kv_heads=4,
+                 max_position_embeddings=2048, rope_theta=500000.0),
+    # Llama-3.2-1B architecture
+    "1b": dict(vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+               num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
+               max_position_embeddings=8192, rope_theta=500000.0,
+               tie_word_embeddings=True),
+    # Llama-3-8B architecture
+    "8b": dict(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+               num_layers=32, num_heads=32, num_kv_heads=8,
+               max_position_embeddings=8192, rope_theta=500000.0),
+}
+
+
+def main() -> None:
+    platform = jax.devices()[0].platform
+    on_accel = platform != "cpu"
+    name = os.environ.get("DYNAMO_BENCH_MODEL", "1b" if on_accel else "tiny")
+    batch = int(os.environ.get("DYNAMO_BENCH_BATCH", "64" if on_accel else "8"))
+    steps = int(os.environ.get("DYNAMO_BENCH_STEPS", "300" if on_accel else "30"))
+    isl = int(os.environ.get("DYNAMO_BENCH_ISL", "128"))
+
+    cfg = ModelConfig(**MODELS[name], dtype="bfloat16" if on_accel else "float32")
+    max_len = 2048
+    block_size = 16
+    ecfg = EngineConfig(
+        max_batch_size=batch,
+        max_model_len=max_len,
+        block_size=block_size,
+        num_blocks=batch * (max_len // block_size) + 64,
+        enable_prefix_reuse=False,  # distinct prompts; measure raw decode
+    )
+    model = LlamaModel(cfg)
+    t0 = time.perf_counter()
+    params = model.init_params(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    engine = EngineCore(model, params, ecfg, eos_token_ids=[])
+    print(f"# model={name} platform={platform} batch={batch} "
+          f"init={time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    rng = np.random.default_rng(0)
+    for i in range(batch):
+        engine.submit(EngineRequest(
+            request_id=f"bench-{i}",
+            prompt=rng.integers(1, cfg.vocab_size - 1, size=isl).tolist(),
+            sampling=SamplingOptions(temperature=0.0),
+            stops=StopConditions(max_tokens=max_len - isl - 8, ignore_eos=True),
+        ))
+
+    # ramp: prefill everything + warm the decode executable
+    t0 = time.perf_counter()
+    while any(r is not None and r.state.value == "prefill" for r in engine.slots) \
+            or engine.has_work() and engine.decode_steps < 3:
+        if not engine.step():
+            break
+    ttft_ramp = time.perf_counter() - t0
+    print(f"# ramp (prefill x{engine.prefill_steps} + warmup): {ttft_ramp:.1f}s",
+          file=sys.stderr)
+
+    # steady-state decode window
+    tok0, t0 = engine.tokens_generated, time.perf_counter()
+    d0 = engine.decode_steps
+    while engine.decode_steps - d0 < steps and engine.has_work():
+        engine.step()
+    dt = time.perf_counter() - t0
+    toks = engine.tokens_generated - tok0
+    tok_s = toks / dt
+
+    # per-token decode latency (ITL) for the record
+    itl_ms = dt / max(engine.decode_steps - d0, 1) * 1000
+    print(f"# decode: {toks} tokens in {dt:.2f}s, ITL {itl_ms:.2f} ms/step",
+          file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "decode_tok_s_per_chip",
+        "value": round(tok_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / 2000.0, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -43,7 +43,24 @@ from dynamo_tpu.tokens import TokenBlockSequence
 
 log = logging.getLogger("dynamo_tpu.engine")
 
-__all__ = ["EngineCore"]
+__all__ = ["EngineCore", "unified_step"]
+
+
+def unified_step(
+    model, params, cache, tokens, positions, block_tables, seq_lens,
+    slot_idx, last_idx, rng, temp, top_k, top_p,
+):
+    """THE jitted serving step: forward over the paged cache, gather each
+    row's last hidden state, project to logits, sample.  Shared by the
+    engine hot loop and the driver's compile checks (__graft_entry__.py)."""
+    hidden, cache = model.forward(
+        params, tokens, positions, cache, block_tables, seq_lens, slot_idx
+    )
+    b = tokens.shape[0]
+    last_h = hidden[jnp.arange(b), last_idx]  # [B, Dm]
+    logits = model.compute_logits(params, last_h)  # [B, V] f32
+    sampled = sample_tokens(logits, rng, temp, top_k, top_p)
+    return sampled, cache
 
 
 class EngineCore:
@@ -98,18 +115,8 @@ class EngineCore:
         self.tokens_generated = 0
 
     # ----------------------------------------------------------- step kernel
-    def _step_impl(
-        self, params, cache, tokens, positions, block_tables, seq_lens,
-        slot_idx, last_idx, rng, temp, top_k, top_p,
-    ):
-        hidden, cache = self.model.forward(
-            params, tokens, positions, cache, block_tables, seq_lens, slot_idx
-        )
-        b = tokens.shape[0]
-        last_h = hidden[jnp.arange(b), last_idx]  # [B, Dm]
-        logits = self.model.compute_logits(params, last_h)  # [B, V] f32
-        sampled = sample_tokens(logits, rng, temp, top_k, top_p)
-        return sampled, cache
+    def _step_impl(self, params, cache, *args):
+        return unified_step(self.model, params, cache, *args)
 
     def _run_step(self, tokens, positions, block_tables, seq_lens, slot_idx,
                   last_idx, temp, top_k, top_p) -> np.ndarray:

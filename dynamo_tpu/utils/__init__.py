@@ -1,0 +1,5 @@
+"""Shared utilities (platform forcing, misc helpers)."""
+
+from dynamo_tpu.utils.platform import force_cpu_devices
+
+__all__ = ["force_cpu_devices"]

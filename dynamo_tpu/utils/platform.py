@@ -1,0 +1,39 @@
+"""Backend/platform forcing.
+
+This image's ``sitecustomize`` pre-imports jax and pins the platform to its
+TPU PJRT plugin ("axon") through ``jax.config`` — plain env vars are too
+late by the time user code runs.  This helper flips the platform back to an
+n-device virtual CPU mesh (tests, multi-chip dry runs) before any backend
+initialises.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu_devices(n: int) -> None:
+    """Force an n-device CPU platform.  Must run before jax initialises a
+    backend.  Raises the host-device-count flag if a smaller one is set."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    elif int(m.group(1)) < n:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={n}"
+        )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        import jax._src.xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass

@@ -2,26 +2,14 @@
 
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
 exercised without TPU hardware (the driver's dryrun does the same).
-
-This image injects a TPU PJRT plugin ("axon") via sitecustomize, which has
-already imported jax and registered its backend factory by the time conftest
-runs — so plain env vars are too late.  We flip the platform through
-jax.config and drop the axon factory before any backend initialises.
+See dynamo_tpu/utils/platform.py for why env vars alone are too late.
 """
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
+from dynamo_tpu.utils import force_cpu_devices
 
-jax.config.update("jax_platforms", "cpu")
-
-import jax._src.xla_bridge as _xb
-
-_xb._backend_factories.pop("axon", None)
+force_cpu_devices(8)
