@@ -6,7 +6,8 @@ Design (TPU-first, not a port):
     run paged attention over their full context (ops/paged_attention.py).
   * ``lax.scan`` over layers: per-layer weights are stacked on a leading L
     axis so the whole stack compiles once — fast XLA compiles even at 80
-    layers, and the KV cache rides the scan as xs/ys.
+    layers.  The KV cache is scan CARRY updated in place by scatter (never
+    sliced per layer), so decode traffic is O(tokens), not O(cache).
   * Static shapes everywhere; bf16 weights/activations on the MXU, f32
     norms/softmax/logits.
   * Tensor parallelism is declarative: :meth:`partition_specs` returns a
@@ -32,7 +33,10 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from dynamo_tpu.models.config import ModelConfig
-from dynamo_tpu.ops.paged_attention import paged_attention, write_kv_cache
+from dynamo_tpu.ops.paged_attention import (
+    paged_attention_layer,
+    write_kv_cache_layer,
+)
 
 Params = Any  # pytree of jax.Array
 
@@ -150,15 +154,30 @@ class LlamaModel:
         return specs
 
     def cache_spec(self) -> P:
-        """KV cache [L,2,N,Bs,Hk,D]: shard the kv-head axis over "model"."""
-        return P(None, None, None, None, "model", None)
+        """KV cache [L,2,N,Bs,Hk*D]: the trailing axis is kv-head-major, so
+        sharding it over "model" splits whole kv heads across the mesh."""
+        return P(None, None, None, None, "model")
 
     # --------------------------------------------------------------- kv cache
     def init_kv_cache(self, num_blocks: int, block_size: int, dtype=None) -> jax.Array:
+        """One array for the whole model: [L, 2, N, Bs, Hk*D].
+
+        A single multi-layer array (rather than per-layer leaves) is what
+        lets (a) the decode kernel index layers with a scalar instead of
+        slicing, (b) block transfer move a block id across all layers at
+        once (ops/block_copy.py), and (c) the engine donate one buffer.
+        The flat Hk*D minor axis is lane-aligned (512+ for real models).
+        """
         cfg = self.config
         dt = dtype or cfg.jax_dtype
         return jnp.zeros(
-            (cfg.num_layers, 2, num_blocks, block_size, cfg.num_kv_heads, cfg.head_dim),
+            (
+                cfg.num_layers,
+                2,
+                num_blocks,
+                block_size,
+                cfg.num_kv_heads * cfg.head_dim,
+            ),
             dt,
         )
 
@@ -168,7 +187,7 @@ class LlamaModel:
         params: Params,
         tokens: jax.Array,        # [B, S] int32
         positions: jax.Array,     # [B, S] int32 (absolute; padding rows may be 0)
-        kv_cache: jax.Array,      # [L, 2, N, Bs, Hk, D]
+        kv_cache: jax.Array,      # [L, 2, N, Bs, Hk*D]
         block_tables: jax.Array,  # [B, M] int32
         seq_lens: jax.Array,      # [B] int32 — context length incl. new tokens
         slot_idx: jax.Array,      # [B, S] int32 — cache slot per new token, -1 pad
@@ -180,19 +199,22 @@ class LlamaModel:
 
         hidden = jnp.take(params["embed"], tokens, axis=0)
 
-        def layer_step(h, layer_in):
-            lp, layer_cache = layer_in  # layer_cache: [2, N, Bs, Hk, D]
+        # The cache rides the scan as CARRY, updated by scatter: XLA keeps
+        # one buffer and updates it in place.  (Passing it as xs/ys instead
+        # copies the whole multi-GB cache through the loop every step —
+        # that copy, not attention, dominated decode ITL.)
+        def layer_step(carry, layer_in):
+            h, cache = carry
+            lp, li = layer_in
             x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
             q = (x @ lp["wq"]).reshape(b, s, hq, dh)
             k = (x @ lp["wk"]).reshape(b, s, hk, dh)
             v = (x @ lp["wv"]).reshape(b, s, hk, dh)
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
-            k_cache, v_cache = write_kv_cache(
-                layer_cache[0], layer_cache[1], k, v, slot_idx
-            )
-            attn = paged_attention(
-                q, k_cache, v_cache, block_tables, seq_lens, positions
+            cache = write_kv_cache_layer(cache, li, k, v, slot_idx)
+            attn = paged_attention_layer(
+                q, cache, li, block_tables, seq_lens, positions
             )
             h = h + attn.reshape(b, s, hq * dh) @ lp["wo"]
 
@@ -201,21 +223,29 @@ class LlamaModel:
                 h = h + _moe_mlp(cfg, lp, x)
             else:
                 h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
-            return h, jnp.stack([k_cache, v_cache])
+            return (h, cache), None
 
-        hidden, new_cache = jax.lax.scan(
-            layer_step, hidden, (params["layers"], kv_cache)
+        (hidden, new_cache), _ = jax.lax.scan(
+            layer_step,
+            (hidden, kv_cache),
+            (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
         )
         hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
         return hidden, new_cache
 
     def compute_logits(self, params: Params, hidden: jax.Array) -> jax.Array:
-        """hidden [..., Dm] -> logits [..., V] in f32."""
+        """hidden [..., Dm] -> logits [..., V] in f32.
+
+        The matmul runs in the weights' dtype with f32 accumulation — an
+        explicit f32 cast of the vocab matrix would materialise a copy of
+        the largest tensor in the model every step."""
         if self.config.tie_word_embeddings:
             w = params["embed"].T
         else:
             w = params["lm_head"]
-        return (hidden.astype(jnp.float32) @ w.astype(jnp.float32))
+        return jnp.matmul(
+            hidden.astype(w.dtype), w, preferred_element_type=jnp.float32
+        )
 
 
 def _moe_mlp(cfg: ModelConfig, lp: dict, x: jax.Array) -> jax.Array:

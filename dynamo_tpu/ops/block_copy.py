@@ -8,8 +8,8 @@ these to efficient HBM DMAs; the cross-host path stages through host RAM
 (``jax.device_get``/``device_put``) and the wire (see
 dynamo_tpu/llm/kv/transfer.py).
 
-Cache layout: [L, 2, N, Bs, Hk, D] (layers, k/v, blocks, block_size,
-kv_heads, head_dim) — one array for the whole model so a block id selects
+Cache layout: [L, 2, N, Bs, Hk*D] (layers, k/v, blocks, block_size,
+flat kv_heads*head_dim) — one array for the whole model so a block id selects
 the block across every layer at once, exactly what transfer needs.
 """
 
@@ -23,7 +23,7 @@ __all__ = ["gather_blocks", "scatter_blocks"]
 
 @jax.jit
 def gather_blocks(cache: jax.Array, block_ids: jax.Array) -> jax.Array:
-    """Pull blocks out of a cache: [L,2,N,Bs,Hk,D] × [n] -> [L,2,n,Bs,Hk,D].
+    """Pull blocks out of a cache: [L,2,N,Bs,HkD] × [n] -> [L,2,n,Bs,HkD].
 
     Used to extract a sequence's KV for offload / cross-worker transfer.
     """
@@ -36,6 +36,6 @@ def scatter_blocks(
 ) -> jax.Array:
     """Write transferred blocks into a cache at ``block_ids``.
 
-    cache: [L,2,N,Bs,Hk,D]; blocks: [L,2,n,Bs,Hk,D]; block_ids: [n].
+    cache: [L,2,N,Bs,HkD]; blocks: [L,2,n,Bs,HkD]; block_ids: [n].
     """
     return cache.at[:, :, block_ids].set(blocks.astype(cache.dtype))

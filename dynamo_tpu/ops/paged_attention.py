@@ -20,10 +20,86 @@ TPU rebuild owns natively (SURVEY.md §7 stage 4).
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
-__all__ = ["write_kv_cache", "paged_attention"]
+__all__ = [
+    "write_kv_cache",
+    "write_kv_cache_layer",
+    "paged_attention",
+    "paged_attention_layer",
+]
+
+
+def _pallas_decode_enabled() -> bool:
+    """Use the Pallas flash-decoding kernel for S=1 steps on TPU."""
+    if os.environ.get("DYNAMO_DISABLE_PALLAS"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def paged_attention_layer(
+    q: jax.Array,             # [B, S, H, D]
+    cache: jax.Array,         # [L, 2, N, Bs, Hk*D] — full multi-layer cache
+    layer: jax.Array,         # scalar int32
+    block_tables: jax.Array,  # [B, M] int32
+    seq_lens: jax.Array,      # [B] int32
+    positions: jax.Array,     # [B, S] int32
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Attention for layer ``layer`` against the full paged cache.
+
+    Decode steps (S=1) on TPU take the Pallas flash-decoding kernel, which
+    reads only the owned blocks straight from HBM (positions are seq_lens-1
+    by construction — the engine always queries the next token).  Other
+    shapes/backends materialise the layer slice and use the oracle below.
+    """
+    b, s, h, d = q.shape
+    _, _, n, bs, hkd = cache.shape
+    hk = hkd // d
+    if s == 1 and _pallas_decode_enabled():
+        from dynamo_tpu.ops.pallas.decode_attention import paged_decode_attention
+
+        out = paged_decode_attention(
+            q[:, 0], cache, layer, block_tables, seq_lens, sm_scale=sm_scale
+        )
+        return out[:, None]
+
+    layer_kv = jax.lax.dynamic_index_in_dim(cache, layer, axis=0, keepdims=False)
+    k_cache = layer_kv[0].reshape(n, bs, hk, d)
+    v_cache = layer_kv[1].reshape(n, bs, hk, d)
+    return paged_attention(
+        q, k_cache, v_cache, block_tables, seq_lens, positions, sm_scale
+    )
+
+
+def write_kv_cache_layer(
+    cache: jax.Array,    # [L, 2, N, Bs, Hk*D] — the WHOLE paged cache
+    layer: jax.Array,    # scalar int32 layer index
+    k_new: jax.Array,    # [B, S, Hk, D]
+    v_new: jax.Array,    # [B, S, Hk, D]
+    slot_idx: jax.Array, # [B, S] int32  flat slot = block_id * Bs + offset; -1 = drop
+) -> jax.Array:
+    """Scatter new K/V rows straight into the full multi-layer cache.
+
+    The cache is a scan carry: scattering into it (rather than slicing a
+    per-layer view) lets XLA update the buffer in place — the whole-cache
+    copy-through-the-loop this replaces dominated decode ITL on TPU.
+    """
+    l, two, n, bs, hkd = cache.shape
+    b, s, hk, d = k_new.shape
+    flat = cache.reshape(l * 2 * n * bs, hkd)
+    idx = slot_idx.reshape(-1)
+    valid = idx >= 0
+    k_idx = jnp.where(valid, (layer * 2 + 0) * n * bs + idx, -1)
+    v_idx = jnp.where(valid, (layer * 2 + 1) * n * bs + idx, -1)
+    rows_k = k_new.astype(cache.dtype).reshape(-1, hkd)
+    rows_v = v_new.astype(cache.dtype).reshape(-1, hkd)
+    flat = flat.at[k_idx].set(rows_k, mode="drop")
+    flat = flat.at[v_idx].set(rows_v, mode="drop")
+    return flat.reshape(cache.shape)
 
 
 def write_kv_cache(

@@ -1,0 +1,9 @@
+"""Pallas TPU kernels — the hand-tuned hot ops.
+
+Each kernel has a pure-JAX oracle in dynamo_tpu/ops/ that defines its
+semantics; tests compare against the oracle in interpret mode on CPU.
+"""
+
+from dynamo_tpu.ops.pallas.decode_attention import paged_decode_attention
+
+__all__ = ["paged_decode_attention"]

@@ -1,0 +1,202 @@
+"""Flash-decoding over paged KV blocks — the decode-step hot kernel.
+
+Why this exists: a naive paged-attention gathers each sequence's whole
+padded context out of the block pool before attending — at batch 64 / 2k
+context that is GBs of HBM traffic per step and dominates ITL.  This kernel
+instead streams ONLY the blocks each sequence actually owns, directly from
+the full multi-layer cache in HBM.
+
+Design (one grid step per sequence, work ∝ actual context length):
+
+  * Grid is (B,).  Inside the kernel a `fori_loop` with a *data-dependent*
+    bound (ceil(seq_len / chunk)) walks the sequence's chunks — padding
+    chunks are never visited, never DMA'd: a 100-token sequence in a
+    2048-token table costs 7 block fetches, not 128.  This also keeps the
+    Mosaic grid overhead at B steps instead of B × M/C tiny steps.
+  * K/V blocks are fetched with manual double-buffered `make_async_copy`
+    from the cache in HBM (`pltpu.ANY`), chunk i+1 in flight while chunk i
+    computes.  Block ids come from the scalar-prefetched block table in
+    SMEM; the layer is a scalar operand, so the per-layer K/V is never
+    sliced out (a slice would copy ~100s of MB per layer per step).
+  * GQA is handled by expanding q to a block-diagonal [H, Hk*D] layout
+    outside the kernel: scores and the PV product are then two plain MXU
+    matmuls per chunk with no per-head lane slicing.  The extra zeros cost
+    FLOPs the decode step has to spare (it is bandwidth-bound).
+  * Online softmax (flash) accumulation in VMEM scratch across chunks.
+
+Semantics match `paged_attention` with S=1: each query row attends over
+slots [0, seq_len) of its own block table.  Rows with seq_len == 0 yield 0.
+
+Reference parity: the reference's engines delegate decode attention to
+vLLM/TRT-LLM paged-attention CUDA kernels; this is the TPU-native
+equivalent the rebuild owns (SURVEY.md §7 stage 4, hard part #3).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["paged_decode_attention"]
+
+NEG_INF = -1e30
+
+
+def _kernel(
+    # scalar prefetch (SMEM)
+    seq_ref,     # [B] int32
+    bt_ref,      # [B, M] int32
+    layer_ref,   # [1] int32
+    # inputs
+    q_ref,       # [1, H, HkD] VMEM — block-diagonal expanded, pre-scaled f32
+    cache_ref,   # [L, 2, N, Bs, HkD] HBM (manual DMA)
+    # outputs
+    out_ref,     # [1, H, HkD] VMEM
+    # scratch
+    acc_ref,     # [H, HkD] f32
+    m_ref,       # [H, 128] f32
+    l_ref,       # [H, 128] f32
+    kbuf,        # [2, C, Bs, HkD] cache-dtype (double buffer)
+    vbuf,        # [2, C, Bs, HkD]
+    sems,        # [2, 2C] DMA semaphores
+    *,
+    c: int,
+):
+    b = pl.program_id(0)
+    bs, hkd = kbuf.shape[2], kbuf.shape[3]
+    h = q_ref.shape[1]
+    t = c * bs
+    seq_len = seq_ref[b]
+    lyr = layer_ref[0]
+    last_block = jnp.maximum(seq_len - 1, 0) // bs
+    num_chunks = pl.cdiv(seq_len, t)  # data-dependent loop bound
+
+    def block_dmas(ci, slot):
+        out = []
+        for i in range(c):  # static unroll: C copies per chunk
+            bid = bt_ref[b, jnp.minimum(ci * c + i, last_block)]
+            out.append(pltpu.make_async_copy(
+                cache_ref.at[lyr, 0, bid], kbuf.at[slot, i], sems.at[slot, i]
+            ))
+            out.append(pltpu.make_async_copy(
+                cache_ref.at[lyr, 1, bid], vbuf.at[slot, i], sems.at[slot, c + i]
+            ))
+        return out
+
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(num_chunks > 0)
+    def _prologue():
+        for dma in block_dmas(0, 0):
+            dma.start()
+
+    def body(ci, _):
+        slot = jax.lax.rem(ci, 2)
+
+        @pl.when(ci + 1 < num_chunks)
+        def _prefetch():
+            for dma in block_dmas(ci + 1, jax.lax.rem(ci + 1, 2)):
+                dma.start()
+
+        for dma in block_dmas(ci, slot):
+            dma.wait()
+
+        q = q_ref[0]  # [H, HkD]
+        k = kbuf[slot].reshape(t, hkd).astype(jnp.float32)
+        v = vbuf[slot].reshape(t, hkd).astype(jnp.float32)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [H, T]
+        pos = ci * t + jax.lax.broadcasted_iota(jnp.int32, (h, t), 1)
+        s = jnp.where(pos < seq_len, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        pv = jnp.dot(p, v, preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        return 0
+
+    jax.lax.fori_loop(0, num_chunks, body, 0)
+
+    denom = jnp.maximum(l_ref[:, :1], 1e-9)
+    out_ref[0] = (acc_ref[:] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("sm_scale", "blocks_per_chunk", "interpret"),
+)
+def paged_decode_attention(
+    q: jax.Array,             # [B, H, D]
+    cache: jax.Array,         # [L, 2, N, Bs, Hk*D] — full multi-layer cache
+    layer: jax.Array,         # scalar int32
+    block_tables: jax.Array,  # [B, M] int32
+    seq_lens: jax.Array,      # [B] int32
+    sm_scale: float | None = None,
+    blocks_per_chunk: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """One decode step of attention for B sequences.  Returns [B, H, D]."""
+    b, h, d = q.shape
+    l, _, n, bs, hkd = cache.shape
+    hk = hkd // d
+    m = block_tables.shape[1]
+    g = h // hk
+    if sm_scale is None:
+        sm_scale = 1.0 / (d**0.5)
+    c = min(blocks_per_chunk, m)
+
+    # Block-diagonal q expansion: row for head (k, g) lives in kv-head k's
+    # D-wide column slot; zeros elsewhere.  [B, H, D] -> [B, H, Hk*D] f32,
+    # columns ordered (kv_head, d) to match the cache's trailing axis.
+    qf = q.astype(jnp.float32) * sm_scale
+    eye = jnp.eye(hk, dtype=jnp.float32)
+    q_exp = jnp.einsum("bkgd,ke->bkged", qf.reshape(b, hk, g, d), eye)
+    q_exp = q_exp.reshape(b, h, hkd)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, h, hkd), lambda b_idx, *_: (b_idx, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # cache stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, h, hkd), lambda b_idx, *_: (b_idx, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, hkd), jnp.float32),
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((2, c, bs, hkd), cache.dtype),
+            pltpu.VMEM((2, c, bs, hkd), cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2 * c)),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, c=c),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, hkd), q.dtype),
+        interpret=interpret,
+    )(
+        seq_lens.astype(jnp.int32),
+        block_tables.astype(jnp.int32),
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        q_exp,
+        cache,
+    )
+
+    # Collapse the block-diagonal layout back to [B, H, D].
+    out = out.reshape(b, hk, g, hk, d)
+    out = jnp.einsum("bkged,ke->bkgd", out, jnp.eye(hk, dtype=out.dtype))
+    return out.reshape(b, h, d)
