@@ -62,11 +62,44 @@ class AsyncLLMEngine(AsyncEngine):
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
 
+    async def run_on_engine(self, fn):
+        """Run ``fn`` on the engine thread at a step boundary (cache/block
+        bookkeeping must stay single-writer); await its result."""
+        fut = self.core.run_on_step(fn)
+        self._wake.set()
+        return await asyncio.wrap_future(fut)
+
     # ---------------------------------------------------------------- generate
     def generate(self, request: Context[BackendInput]) -> AsyncIterator[LLMEngineOutput]:
         return self._generate(request)
 
-    async def _generate(self, request: Context[BackendInput]) -> AsyncIterator[LLMEngineOutput]:
+    def generate_ex(
+        self,
+        request: Context[BackendInput],
+        *,
+        remote_prefill: bool = False,
+        remote_decode: bool = False,
+        on_allocated=None,
+    ) -> AsyncIterator[LLMEngineOutput]:
+        """generate() with disaggregation knobs (ref RemotePrefillParams,
+        vllm patch remote_prefill.py): ``remote_prefill`` stalls the request
+        until a prefill worker delivers KV; ``remote_decode`` runs prefill
+        only and holds the blocks for transfer-out."""
+        return self._generate(
+            request,
+            remote_prefill=remote_prefill,
+            remote_decode=remote_decode,
+            on_allocated=on_allocated,
+        )
+
+    async def _generate(
+        self,
+        request: Context[BackendInput],
+        *,
+        remote_prefill: bool = False,
+        remote_decode: bool = False,
+        on_allocated=None,
+    ) -> AsyncIterator[LLMEngineOutput]:
         inp = request.data
         loop = asyncio.get_running_loop()
         out_q: asyncio.Queue[LLMEngineOutput] = asyncio.Queue()
@@ -80,6 +113,9 @@ class AsyncLLMEngine(AsyncEngine):
             sampling=inp.sampling,
             stops=inp.stops,
             emit=emit,
+            remote_prefill=remote_prefill,
+            remote_decode=remote_decode,
+            on_allocated=on_allocated,
         )
         self.core.submit(req)
         self._wake.set()

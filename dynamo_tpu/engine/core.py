@@ -24,10 +24,11 @@ are the only cross-thread entry points and only touch thread-safe queues.
 
 from __future__ import annotations
 
+import concurrent.futures
 import logging
 import queue
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +37,7 @@ import numpy as np
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.request import EngineRequest, RequestState
 from dynamo_tpu.engine.sampling import sample_tokens
+from dynamo_tpu.ops.block_copy import gather_blocks, scatter_blocks_inplace
 from dynamo_tpu.llm.kv.block_manager import KvBlockManager, NoFreeBlocks
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput
 from dynamo_tpu.models.llama import LlamaModel
@@ -108,6 +110,14 @@ class EngineCore:
         self._by_id: dict[str, EngineRequest] = {}
         self._abort_q: "queue.SimpleQueue[str]" = queue.SimpleQueue()
         self._lock = threading.Lock()
+        # ops enqueued by other threads, run on the engine thread at the next
+        # step boundary (KV scatter/gather, remote-prefill completion, ...)
+        self._ops: "queue.SimpleQueue[tuple[Callable, concurrent.futures.Future]]" = (
+            queue.SimpleQueue()
+        )
+        # prefill-side held blocks: finished remote-decode prefills whose
+        # blocks must survive until the transfer out completes
+        self._held: dict[str, list[int]] = {}
         # perf counters
         self.steps = 0
         self.prefill_steps = 0
@@ -139,10 +149,20 @@ class EngineCore:
     def abort(self, request_id: str) -> None:
         self._abort_q.put(request_id)
 
+    def run_on_step(self, fn: Callable) -> "concurrent.futures.Future":
+        """Enqueue ``fn`` to run on the engine thread at the next step
+        boundary; the returned future resolves with its result.  This is the
+        only safe way for other threads to touch the cache / block manager
+        (single-writer discipline, SURVEY.md §5 race detection)."""
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._ops.put((fn, fut))
+        return fut
+
     def has_work(self) -> bool:
         return (
             not self.waiting.empty()
             or bool(self._admitted)
+            or not self._ops.empty()
             or any(s is not None for s in self.slots)
         )
 
@@ -176,8 +196,17 @@ class EngineCore:
     # -------------------------------------------------------------- main loop
     def step(self) -> bool:
         """Run one scheduling iteration.  Returns False when idle."""
+        self._process_ops()
         self._process_aborts()
         self._admit()
+        # remote-prefill slots waiting on external KV: honour aborts, skip rest
+        for req in self.slots:
+            if (
+                req is not None
+                and req.state is RequestState.REMOTE_PREFILL
+                and req.abort_requested
+            ):
+                self._finish_slot(req, FinishReason.CANCELLED)
         prefill = next(
             (r for r in self.slots if r is not None and r.state is RequestState.PREFILL),
             None,
@@ -189,6 +218,19 @@ class EngineCore:
             self._run_decode()
             return True
         return False
+
+    def _process_ops(self) -> None:
+        while True:
+            try:
+                fn, fut = self._ops.get_nowait()
+            except queue.Empty:
+                break
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn())
+            except Exception as e:
+                fut.set_exception(e)
 
     def _process_aborts(self) -> None:
         while True:
@@ -235,10 +277,20 @@ class EngineCore:
             req.cached_tokens = alloc.cached_tokens
             req.computed_tokens = alloc.cached_tokens
             req.slot = slot
-            req.state = RequestState.PREFILL
+            req.state = (
+                RequestState.REMOTE_PREFILL if req.remote_prefill else RequestState.PREFILL
+            )
             self.slots[slot] = req
             self._by_id[req.request_id] = req
             self._admitted.remove(req)
+            if req.on_allocated is not None:
+                try:
+                    req.on_allocated(req)
+                except Exception:
+                    # a dying caller (closed event loop) must not take down
+                    # every other request via step() -> fail_all()
+                    log.exception("on_allocated callback failed for %s", req.request_id)
+                    req.abort_requested = True
 
     # ---------------------------------------------------------------- prefill
     def _run_prefill(self, req: EngineRequest) -> None:
@@ -276,6 +328,24 @@ class EngineCore:
             self.block_manager.commit(
                 bid, blk.sequence_hash, blk.parent_sequence_hash, list(blk.tokens)
             )
+        if req.remote_decode:
+            # prefill-only request: emit the first sampled token, hold the
+            # blocks for transfer-out, free the slot (ref prefill_worker.py:148
+            # runs generate(max_tokens=1, is_remote_decode=True))
+            self._held[req.request_id] = list(req.block_ids)
+            self.slots[req.slot] = None
+            self._by_id.pop(req.request_id, None)
+            req.state = RequestState.FINISHED
+            req.finish_reason = FinishReason.STOP
+            self.tokens_generated += 1
+            req.emit(
+                LLMEngineOutput(
+                    token_ids=[int(sampled[0])],
+                    finish_reason=FinishReason.STOP,
+                    cached_tokens=req.cached_tokens,
+                )
+            )
+            return
         self._append_token(req, int(sampled[0]), first=True)
 
     # ----------------------------------------------------------------- decode
@@ -388,3 +458,95 @@ class EngineCore:
         req.state = RequestState.FINISHED
         req.finish_reason = reason
         req.emit(LLMEngineOutput(token_ids=[], finish_reason=reason))
+
+    # ------------------------------------------------- disaggregation support
+    # All of these run on the engine thread (call via run_on_step from
+    # elsewhere).  They are the TPU-native replacement for the reference's
+    # NIXL block read/write (vllm patch nixl.py) — device-side gather/scatter
+    # with host staging for the DCN hop.
+
+    def held_blocks(self, request_id: str) -> list[int]:
+        """Block ids of a finished remote-decode prefill, still resident."""
+        return list(self._held.get(request_id, ()))
+
+    def release_held(self, request_id: str) -> None:
+        """Transfer-out done: drop the prefill-side block references."""
+        ids = self._held.pop(request_id, None)
+        if ids:
+            self.block_manager.release(ids)
+
+    def gather_blocks_np(self, block_ids: list[int]) -> np.ndarray:
+        """Stage blocks to host RAM: [L, 2, n, Bs, HkD] ndarray.  Under a
+        sharded mesh this all-gathers KV heads — which is exactly the
+        TP-resharding the reference needs a Triton kernel for
+        (kv_rearrange.py); here the host staging buffer is layout-neutral."""
+        out = gather_blocks(self.cache, jnp.asarray(block_ids, jnp.int32))
+        return np.asarray(jax.device_get(out))
+
+    def scatter_external(
+        self,
+        block_ids: list[int],
+        blocks: np.ndarray,
+        request_id: Optional[str] = None,
+    ) -> None:
+        """Write transferred blocks into this engine's cache (in place).
+
+        When ``request_id`` is given (remote-prefill ingest), the write is
+        validated against that request's live block ownership: if the
+        request was aborted meanwhile its blocks may already belong to
+        someone else, and a late write must be dropped, not applied.
+        """
+        if request_id is not None:
+            req = self._by_id.get(request_id)
+            if (
+                req is None
+                or req.state is not RequestState.REMOTE_PREFILL
+                or not set(block_ids) <= set(req.block_ids)
+            ):
+                log.warning(
+                    "dropping stale KV write for %s (request gone or blocks reassigned)",
+                    request_id,
+                )
+                return
+        arr = jnp.asarray(blocks)
+        if self.mesh is not None:
+            # shard the staged blocks like the pool so the donated scatter
+            # preserves the cache sharding (no step-fn recompiles) — this IS
+            # the TP-reshard on ingest (each shard keeps only its heads)
+            from jax.sharding import NamedSharding
+
+            arr = jax.device_put(arr, NamedSharding(self.mesh, self.model.cache_spec()))
+        self.cache = scatter_blocks_inplace(self.cache, block_ids, arr)
+
+    def complete_remote_prefill(
+        self, request_id: str, first_token: int, error: Optional[str] = None
+    ) -> None:
+        """Prefill-done notification: the request's KV is now resident in
+        this engine's cache; append the prefill-sampled first token and
+        enter decode.  (Ref: scheduler stall-until-notified, vllm patch
+        scheduler.py hunks + worker.py:212.)"""
+        req = self._by_id.get(request_id)
+        if req is None or req.state is not RequestState.REMOTE_PREFILL:
+            return  # cancelled/finished while prefill ran elsewhere
+        if error is not None:
+            self._finish_slot(req, FinishReason.ERROR)
+            return
+        req.computed_tokens = req.prompt_len
+        req.state = RequestState.RUNNING
+        for blk in req.seq.blocks:
+            bid = req.block_ids[blk.position]
+            self.block_manager.commit(
+                bid, blk.sequence_hash, blk.parent_sequence_hash, list(blk.tokens)
+            )
+        self._append_token(req, int(first_token), first=True)
+
+    def prefix_hit_tokens(self, seq_hashes: list[int], prompt_len: int) -> int:
+        """How many prompt tokens would hit the local prefix cache — the
+        disagg router's prefix_hit_length input.
+
+        Read-only dict probes (GIL-atomic), safe to call from any thread; a
+        concurrently-mutating engine can make the answer slightly stale,
+        which only perturbs the routing heuristic, never correctness."""
+        return len(
+            self.block_manager.match_prefix(seq_hashes, prompt_len)
+        ) * self.config.block_size

@@ -18,6 +18,7 @@ from dynamo_tpu.tokens import TokenBlockSequence
 class RequestState(enum.Enum):
     WAITING = "waiting"    # queued, no slot yet
     PREFILL = "prefill"    # slot assigned, prompt not fully computed
+    REMOTE_PREFILL = "remote_prefill"  # slot+blocks assigned; KV arrives from a prefill worker
     RUNNING = "running"    # decoding
     FINISHED = "finished"
 
@@ -30,6 +31,18 @@ class EngineRequest:
     stops: StopConditions = field(default_factory=StopConditions)
     # called from the engine thread with each LLMEngineOutput delta
     emit: Callable[[LLMEngineOutput], None] = lambda out: None
+
+    # --- disaggregation flags (ref vllm patch remote_prefill.py:
+    # RemotePrefillParams.is_remote_prefill / is_remote_decode) ---
+    # decode side: blocks are allocated up front and the request stalls in
+    # REMOTE_PREFILL until a prefill worker writes KV and notifies
+    remote_prefill: bool = False
+    # prefill side: stop after the prefill step + first sampled token, keep
+    # blocks held (not released) until the worker has transferred them out
+    remote_decode: bool = False
+    # called on the engine thread right after blocks are allocated (decode
+    # side uses this to learn the block ids to hand to the prefill worker)
+    on_allocated: Optional[Callable[["EngineRequest"], None]] = None
 
     state: RequestState = RequestState.WAITING
     seq: Optional[TokenBlockSequence] = None  # prompt + generated tokens

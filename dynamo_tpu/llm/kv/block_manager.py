@@ -80,6 +80,23 @@ class KvBlockManager:
         return self.active_blocks / self.num_blocks
 
     # ------------------------------------------------------------ allocation
+    def match_prefix(self, seq_hashes: list[int], total_tokens: int) -> list[int]:
+        """Longest cached-prefix match: block ids whose chained hashes match
+        ``seq_hashes``, capped so >=1 token remains to run through the model.
+        Read-only probe — shared by allocation and the disagg router's
+        prefix_hit_length input (ref kv/manager.rs:31 + disagg_router.rs:236).
+        """
+        if not self.enable_prefix_reuse:
+            return []
+        max_match = min(len(seq_hashes), (total_tokens - 1) // self.block_size)
+        matched: list[int] = []
+        for i in range(max_match):
+            bid = self._table.get(seq_hashes[i])
+            if bid is None:
+                break
+            matched.append(bid)
+        return matched
+
     def allocate(self, seq_hashes: list[int], total_tokens: int) -> BlockAllocation:
         """Allocate blocks to cover ``total_tokens``, reusing any cached
         prefix whose chained hashes match ``seq_hashes``.
@@ -88,18 +105,12 @@ class KvBlockManager:
         a position to compute logits from.
         """
         n_blocks = -(-total_tokens // self.block_size)  # ceil
-        # cap matches so >=1 token remains to run through the model
-        max_match = min(len(seq_hashes), (total_tokens - 1) // self.block_size)
         block_ids: list[int] = []
         cached = 0
-        if self.enable_prefix_reuse:
-            for i in range(max_match):
-                bid = self._table.get(seq_hashes[i])
-                if bid is None:
-                    break
-                self._acquire(bid)
-                block_ids.append(bid)
-                cached += self.block_size
+        for bid in self.match_prefix(seq_hashes, total_tokens):
+            self._acquire(bid)
+            block_ids.append(bid)
+            cached += self.block_size
         try:
             while len(block_ids) < n_blocks:
                 block_ids.append(self._alloc_fresh())

@@ -1,0 +1,218 @@
+"""KV-block transfer plane — the NIXL/RDMA replacement for TPU serving.
+
+The reference moves KV blocks between engines with NIXL (UCX/RDMA) plus a
+Triton kernel to re-arrange layouts across TP degrees (vllm patch:
+``vllm/distributed/device_communicators/nixl.py``, ``kv_rearrange.py``;
+SURVEY.md §2.9).  The TPU-native design replaces all of that with two paths:
+
+  * **same slice (ICI)** — blocks are `jax.Array`s; gather/scatter over the
+    block axis lets XLA route the copy over ICI when source and target
+    shardings live on the same mesh (ops/block_copy.py).
+  * **cross host (DCN)** — gather stages blocks to host RAM, this module
+    ships the bytes over TCP with two-part framing, and the receiver
+    scatters them into its pool.  Because the host staging buffer is a full
+    (unsharded) ndarray, producer and consumer may run *different* TP
+    degrees — resharding is free, where the reference needs a custom
+    Triton kernel (kv_rearrange.py).
+
+Wire protocol (two-part frames, framing.py):
+  {op: "write_blocks", block_ids, dtype, shape, request_id?} + raw bytes -> {ok}
+  {op: "read_blocks", block_ids}     -> {ok, dtype, shape} + raw bytes
+  {op: "notify", request_id, first_token, error?}            -> {ok}
+
+The ``write_blocks`` reply is sent only after the receiving engine applied
+the scatter at a step boundary — so ``notify`` ordered after it can never
+race the KV into a decode step (the reference gets this ordering from
+NIXL transfer-completion notifications).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Awaitable, Callable, Optional
+
+import numpy as np
+
+from dynamo_tpu.runtime.transports.framing import read_frame, write_frame
+
+log = logging.getLogger("dynamo_tpu.kv_transfer")
+
+__all__ = [
+    "pack_blocks",
+    "unpack_blocks",
+    "KvTransferServer",
+    "KvTransferClient",
+]
+
+
+def _np_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax dependency; covers bfloat16 / float8 variants
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def pack_blocks(arr: np.ndarray) -> tuple[dict, bytes]:
+    """ndarray -> (wire header fields, payload bytes)."""
+    arr = np.ascontiguousarray(arr)
+    return {"dtype": arr.dtype.name, "shape": list(arr.shape)}, arr.tobytes()
+
+
+def unpack_blocks(header: dict, payload: bytes) -> np.ndarray:
+    return np.frombuffer(payload, dtype=_np_dtype(header["dtype"])).reshape(
+        header["shape"]
+    )
+
+
+class KvTransferServer:
+    """Per-worker ingest endpoint for KV blocks + prefill notifications.
+
+    ``write_sink(block_ids, arr, request_id)`` must resolve once the blocks
+    are applied to the engine cache; ``read_source(block_ids)`` returns
+    staged blocks (for pull-mode transfer / offload);
+    ``notify_cb(request_id, first_token, error)`` delivers the prefill-done
+    signal.
+    """
+
+    def __init__(
+        self,
+        write_sink: Callable[[list[int], np.ndarray, Optional[str]], Awaitable[None]],
+        notify_cb: Callable[[str, int, Optional[str]], Awaitable[None]],
+        read_source: Optional[Callable[[list[int]], Awaitable[np.ndarray]]] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.write_sink = write_sink
+        self.notify_cb = notify_cb
+        self.read_source = read_source
+        self.host, self.port = host, port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> "KvTransferServer":
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    @property
+    def url(self) -> str:
+        return f"tcp://{self.host}:{self.port}"
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    break
+                h, payload = frame
+                op, rid = h.get("op"), h.get("id")
+                try:
+                    if op == "write_blocks":
+                        await self.write_sink(
+                            h["block_ids"],
+                            unpack_blocks(h, payload),
+                            h.get("request_id"),
+                        )
+                        write_frame(writer, {"id": rid, "ok": True})
+                    elif op == "read_blocks":
+                        if self.read_source is None:
+                            raise RuntimeError("read_blocks unsupported on this worker")
+                        meta, data = pack_blocks(await self.read_source(h["block_ids"]))
+                        write_frame(writer, {"id": rid, "ok": True, **meta}, data)
+                    elif op == "notify":
+                        await self.notify_cb(
+                            h["request_id"], h.get("first_token", -1), h.get("error")
+                        )
+                        write_frame(writer, {"id": rid, "ok": True})
+                    else:
+                        write_frame(writer, {"id": rid, "error": f"unknown op {op!r}"})
+                except Exception as e:
+                    log.exception("kv transfer op %s failed", op)
+                    write_frame(writer, {"id": rid, "error": str(e)})
+                await writer.drain()
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+
+class KvTransferClient:
+    """Dial a worker's transfer endpoint and push/pull blocks."""
+
+    def __init__(self, url: str):
+        hostport = url.split("//", 1)[-1]
+        host, port = hostport.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+        self._reader = self._writer = None
+        self._ids = itertools.count(1)
+        self._lock = asyncio.Lock()
+
+    @classmethod
+    async def connect(cls, url: str) -> "KvTransferClient":
+        self = cls(url)
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        return self
+
+    async def close(self) -> None:
+        if self._writer:
+            self._writer.close()
+
+    async def _call(self, header: dict, payload: bytes = b"") -> tuple[dict, bytes]:
+        async with self._lock:  # strict request/reply per connection
+            header["id"] = next(self._ids)
+            write_frame(self._writer, header, payload)
+            await self._writer.drain()
+            frame = await read_frame(self._reader)
+        if frame is None:
+            raise ConnectionError("kv transfer peer closed")
+        resp, data = frame
+        if "error" in resp:
+            raise RuntimeError(f"kv transfer error: {resp['error']}")
+        return resp, data
+
+    async def write_blocks(
+        self,
+        block_ids: list[int],
+        arr: np.ndarray,
+        request_id: Optional[str] = None,
+    ) -> None:
+        """Push blocks into the peer's cache at ``block_ids`` (NIXL WRITE).
+        ``request_id`` lets the receiver validate block ownership (a late
+        write for an aborted request is dropped, not applied)."""
+        meta, data = pack_blocks(arr)
+        await self._call(
+            {
+                "op": "write_blocks",
+                "block_ids": list(map(int, block_ids)),
+                "request_id": request_id,
+                **meta,
+            },
+            data,
+        )
+
+    async def read_blocks(self, block_ids: list[int]) -> np.ndarray:
+        """Pull blocks out of the peer's cache (NIXL READ)."""
+        resp, data = await self._call(
+            {"op": "read_blocks", "block_ids": list(map(int, block_ids))}
+        )
+        return unpack_blocks(resp, data)
+
+    async def notify(
+        self, request_id: str, first_token: int, error: Optional[str] = None
+    ) -> None:
+        await self._call(
+            {
+                "op": "notify",
+                "request_id": request_id,
+                "first_token": int(first_token),
+                "error": error,
+            }
+        )

@@ -1,0 +1,320 @@
+"""Disaggregated prefill/decode worker roles.
+
+Reference parity (examples/llm/components/worker.py, prefill_worker.py,
+utils/prefill_queue.py; SURVEY.md §3.3 "the money path"):
+
+  DecodeWorker.generate
+    ├─ conditional disagg decision            (worker.py:180-207)
+    ├─ local  → engine prefill+decode as one request
+    └─ remote → allocate KV blocks up front, enqueue RemotePrefillRequest
+                on the durable queue, stall until the prefill worker has
+                written KV into those blocks and notified (worker.py:164-173,
+                vllm patch scheduler stall)
+  PrefillWorker.run
+    └─ pull queue → prefill locally (remote_decode hold) → push blocks to
+       the decode worker's transfer endpoint → notify → release
+       (prefill_worker.py:119-177)
+
+The KV hop rides dynamo_tpu/llm/kv/transfer.py (ICI/DCN) instead of NIXL.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Optional
+
+from dynamo_tpu.engine.async_engine import AsyncLLMEngine
+from dynamo_tpu.llm.disagg_router import DisaggregatedRouter
+from dynamo_tpu.llm.kv.transfer import KvTransferClient, KvTransferServer
+from dynamo_tpu.llm.protocols import (
+    BackendInput,
+    FinishReason,
+    LLMEngineOutput,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.tokens import TokenBlockSequence
+
+log = logging.getLogger("dynamo_tpu.workers")
+
+__all__ = [
+    "RemotePrefillRequest",
+    "PrefillQueue",
+    "DecodeWorker",
+    "PrefillWorker",
+]
+
+
+@dataclass
+class RemotePrefillRequest:
+    """Work item on the prefill queue (ref vllm patch remote_prefill.py:
+    RemotePrefillRequest{engine_id, request_id, prompt_token_ids,
+    sampling_params, block_ids, computed_block_ids})."""
+
+    request_id: str
+    token_ids: list[int]
+    block_ids: list[int]       # decode-side blocks to fill
+    skip_blocks: int           # leading blocks already resident on decode side
+    transfer_url: str          # decode worker's KvTransferServer
+    sampling: SamplingOptions = field(default_factory=SamplingOptions)
+
+    def to_wire(self) -> bytes:
+        d = dataclasses.asdict(self)
+        return json.dumps(d).encode()
+
+    @classmethod
+    def from_wire(cls, data: bytes) -> "RemotePrefillRequest":
+        d = json.loads(data)
+        d["sampling"] = SamplingOptions(**d.get("sampling", {}))
+        return cls(**d)
+
+
+class PrefillQueue:
+    """Durable ack'd work queue for remote prefills — JetStream parity
+    (examples/llm/utils/nats_queue.py) on the coordinator queue plane."""
+
+    def __init__(self, coordinator, namespace: str = "default"):
+        self.coord = coordinator
+        self.name = f"{namespace}_prefill_queue"
+
+    async def push(self, req: RemotePrefillRequest) -> int:
+        return await self.coord.queue_push(self.name, req.to_wire())
+
+    async def pull(
+        self, timeout_s: float = 0.0
+    ) -> Optional[tuple[int, RemotePrefillRequest]]:
+        item = await self.coord.queue_pull(self.name, timeout_s)
+        if item is None:
+            return None
+        msg_id, payload = item
+        return msg_id, RemotePrefillRequest.from_wire(payload)
+
+    async def ack(self, msg_id: int) -> None:
+        await self.coord.queue_ack(self.name, msg_id)
+
+    async def nack(self, msg_id: int) -> None:
+        await self.coord.queue_nack(self.name, msg_id)
+
+    async def size(self) -> int:
+        return await self.coord.queue_len(self.name)
+
+
+class DecodeWorker(AsyncEngine):
+    """The decode-side engine wrapper: owns the conditional disagg decision
+    and the KV ingest endpoint.  Drop-in AsyncEngine, so it slots behind
+    endpoints / pipelines exactly like a plain engine."""
+
+    def __init__(
+        self,
+        engine: AsyncLLMEngine,
+        coordinator=None,
+        namespace: str = "default",
+        router: Optional[DisaggregatedRouter] = None,
+        transfer_host: str = "127.0.0.1",
+    ):
+        self.engine = engine
+        self.coord = coordinator
+        self.namespace = namespace
+        self.router = router or DisaggregatedRouter(namespace=namespace)
+        self.queue = PrefillQueue(coordinator, namespace) if coordinator else None
+        self._transfer: Optional[KvTransferServer] = None
+        self._transfer_host = transfer_host
+        self._cached_depth = 0
+        self._cached_depth_at = -1.0
+
+    # ------------------------------------------------------------- lifecycle
+    async def start(self) -> "DecodeWorker":
+        self._transfer = await KvTransferServer(
+            write_sink=self._apply_write,
+            notify_cb=self._on_notify,
+            read_source=self._read_blocks,
+            host=self._transfer_host,
+        ).start()
+        if self.coord is not None:
+            await self.router.watch(self.coord)
+        return self
+
+    async def stop(self) -> None:
+        if self._transfer:
+            await self._transfer.stop()
+
+    @property
+    def transfer_url(self) -> str:
+        return self._transfer.url
+
+    # ------------------------------------------------- transfer plane bridge
+    async def _apply_write(self, block_ids, arr, request_id=None) -> None:
+        core = self.engine.core
+        await self.engine.run_on_engine(
+            lambda: core.scatter_external(block_ids, arr, request_id)
+        )
+
+    async def _read_blocks(self, block_ids):
+        core = self.engine.core
+        return await self.engine.run_on_engine(lambda: core.gather_blocks_np(block_ids))
+
+    async def _on_notify(self, request_id, first_token, error) -> None:
+        core = self.engine.core
+        await self.engine.run_on_engine(
+            lambda: core.complete_remote_prefill(request_id, first_token, error)
+        )
+
+    # ---------------------------------------------------------------- routing
+    _QUEUE_DEPTH_TTL = 0.1  # seconds; routing heuristic tolerates staleness
+
+    def _prefix_hit(self, token_ids: list[int]) -> int:
+        # read-only dict probe against the block manager — GIL-safe from this
+        # thread, at worst slightly stale, and avoids waiting out an engine
+        # step boundary on the request's critical TTFT path
+        core = self.engine.core
+        seq = TokenBlockSequence(list(token_ids), core.config.block_size)
+        return core.prefix_hit_tokens(seq.sequence_hashes(), len(token_ids))
+
+    async def _queue_depth(self) -> int:
+        now = asyncio.get_running_loop().time()
+        if now - self._cached_depth_at > self._QUEUE_DEPTH_TTL:
+            self._cached_depth = await self.queue.size()
+            self._cached_depth_at = now
+        return self._cached_depth
+
+    async def _should_remote(self, token_ids: list[int]) -> bool:
+        if self.queue is None:
+            return False
+        hit = self._prefix_hit(token_ids)
+        qsize = await self._queue_depth()
+        return self.router.prefill_remote(len(token_ids), hit, qsize)
+
+    # --------------------------------------------------------------- generate
+    def generate(self, request: Context[BackendInput]) -> AsyncIterator[LLMEngineOutput]:
+        return self._generate(request)
+
+    async def _generate(self, request: Context[BackendInput]):
+        if not await self._should_remote(request.data.token_ids):
+            async for out in self.engine.generate(request):
+                yield out
+            return
+        async for out in self._generate_remote(request):
+            yield out
+
+    async def _generate_remote(self, request: Context[BackendInput]):
+        loop = asyncio.get_running_loop()
+        alloc_fut: asyncio.Future = loop.create_future()
+
+        def on_allocated(req) -> None:  # engine thread
+            ids, cached = list(req.block_ids), req.cached_tokens
+
+            def _set() -> None:
+                if not alloc_fut.done():
+                    alloc_fut.set_result((ids, cached))
+
+            loop.call_soon_threadsafe(_set)
+
+        agen = self.engine.generate_ex(
+            request, remote_prefill=True, on_allocated=on_allocated
+        )
+        first_task = asyncio.ensure_future(agen.__anext__())
+        try:
+            done, _ = await asyncio.wait(
+                {first_task, alloc_fut}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if alloc_fut in done:
+                block_ids, cached = alloc_fut.result()
+                bs = self.engine.core.config.block_size
+                await self.queue.push(
+                    RemotePrefillRequest(
+                        request_id=request.id,
+                        token_ids=list(request.data.token_ids),
+                        block_ids=block_ids,
+                        skip_blocks=cached // bs,
+                        transfer_url=self.transfer_url,
+                        sampling=request.data.sampling,
+                    )
+                )
+            # stream everything the engine emits (first token arrives once a
+            # prefill worker notifies)
+            while True:
+                out = await first_task
+                yield out
+                if out.finished:
+                    return
+                first_task = asyncio.ensure_future(agen.__anext__())
+        except StopAsyncIteration:
+            return
+        finally:
+            if not first_task.done():
+                first_task.cancel()
+            if not alloc_fut.done():
+                alloc_fut.cancel()
+            await agen.aclose()
+
+
+class PrefillWorker:
+    """Pulls remote-prefill work, computes KV locally, pushes the blocks to
+    the decode worker and notifies (ref prefill_worker.py:119-177)."""
+
+    def __init__(self, engine: AsyncLLMEngine, coordinator, namespace: str = "default"):
+        self.engine = engine
+        self.queue = PrefillQueue(coordinator, namespace)
+        self._stop = asyncio.Event()
+        self.handled = 0
+
+    def request_stop(self) -> None:
+        self._stop.set()
+
+    async def run(self) -> None:
+        """Main pull loop; returns after request_stop()."""
+        while not self._stop.is_set():
+            item = await self.queue.pull(timeout_s=0.2)
+            if item is None:
+                continue
+            msg_id, rpr = item
+            try:
+                await self.handle(rpr)
+                await self.queue.ack(msg_id)
+                self.handled += 1
+            except Exception:
+                log.exception("prefill of %s failed; nack for redelivery", rpr.request_id)
+                await self.queue.nack(msg_id)
+
+    async def handle(self, rpr: RemotePrefillRequest) -> None:
+        core = self.engine.core
+        ctx: Context[BackendInput] = Context(
+            BackendInput(
+                token_ids=list(rpr.token_ids),
+                sampling=rpr.sampling,
+                stops=StopConditions(max_tokens=1),
+            ),
+            id=rpr.request_id,
+        )
+        outs = [o async for o in self.engine.generate_ex(ctx, remote_decode=True)]
+        first_tokens = [t for o in outs for t in o.token_ids]
+        client = await KvTransferClient.connect(rpr.transfer_url)
+        try:
+            if not first_tokens or any(
+                o.finish_reason is FinishReason.ERROR for o in outs
+            ):
+                await client.notify(rpr.request_id, -1, error="prefill failed")
+                return
+            local_ids = core.held_blocks(rpr.request_id)
+            skip = rpr.skip_blocks
+            if len(local_ids) != len(rpr.block_ids):
+                await client.notify(rpr.request_id, -1, error="block count mismatch")
+                return
+            if skip < len(local_ids):
+                arr = await self.engine.run_on_engine(
+                    lambda: core.gather_blocks_np(local_ids[skip:])
+                )
+                await client.write_blocks(
+                    rpr.block_ids[skip:], arr, request_id=rpr.request_id
+                )
+            await client.notify(rpr.request_id, first_tokens[0])
+        finally:
+            await client.close()
+            await self.engine.run_on_engine(
+                lambda: core.release_held(rpr.request_id)
+            )

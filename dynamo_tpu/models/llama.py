@@ -233,6 +233,60 @@ class LlamaModel:
         hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
         return hidden, new_cache
 
+    def forward_seq_parallel(
+        self,
+        params: Params,
+        tokens: jax.Array,      # [B, S] int32, S sharded over mesh[sp_axis]
+        positions: jax.Array,   # [B, S] int32 global positions
+        mesh: jax.sharding.Mesh,
+        sp_axis: str = "sp",
+    ) -> tuple[jax.Array, jax.Array]:
+        """Long-context prefill with ring attention (context parallelism).
+
+        The sequence axis is sharded over ``mesh[sp_axis]``; each device
+        computes its chunk's Q/K/V and attention runs blockwise while KV
+        chunks rotate over ICI (ops/ring_attention.py) — prompts far beyond
+        one chip's HBM prefill exactly, a capability absent from the
+        reference (SURVEY.md §5 long-context).
+
+        Returns (hidden [B,S,Dm], kv [L,2,B,S,Hk*D]); the kv output is what
+        the engine scatters into paged-cache blocks after a long prefill,
+        and both keep the sequence sharding.
+        """
+        from dynamo_tpu.ops.ring_attention import ring_attention
+
+        cfg = self.config
+        b, s = tokens.shape
+        dh, hq, hk = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+
+        hidden = jnp.take(params["embed"], tokens, axis=0)
+
+        def layer_step(h, lp):
+            x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+            q = (x @ lp["wq"]).reshape(b, s, hq, dh)
+            k = (x @ lp["wk"]).reshape(b, s, hk, dh)
+            v = (x @ lp["wv"]).reshape(b, s, hk, dh)
+            q = apply_rope(q, positions, cfg.rope_theta)
+            k = apply_rope(k, positions, cfg.rope_theta)
+            attn = ring_attention(
+                q, k, v, positions, positions, mesh=mesh, axis=sp_axis
+            )
+            h = h + attn.reshape(b, s, hq * dh) @ lp["wo"]
+
+            x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+            if cfg.is_moe:
+                h = h + _moe_mlp(cfg, lp, x)
+            else:
+                h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+            kv = jnp.stack(
+                [k.reshape(b, s, hk * dh), v.reshape(b, s, hk * dh)], axis=0
+            )
+            return h, kv
+
+        hidden, kv = jax.lax.scan(layer_step, hidden, params["layers"])
+        hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
+        return hidden, kv  # kv: [L, 2, B, S, Hk*D]
+
     def compute_logits(self, params: Params, hidden: jax.Array) -> jax.Array:
         """hidden [..., Dm] -> logits [..., V] in f32.
 

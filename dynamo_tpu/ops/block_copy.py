@@ -18,7 +18,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["gather_blocks", "scatter_blocks"]
+__all__ = ["gather_blocks", "scatter_blocks", "scatter_blocks_inplace"]
 
 
 @jax.jit
@@ -39,3 +39,34 @@ def scatter_blocks(
     cache: [L,2,N,Bs,HkD]; blocks: [L,2,n,Bs,HkD]; block_ids: [n].
     """
     return cache.at[:, :, block_ids].set(blocks.astype(cache.dtype))
+
+
+_scatter_donated = jax.jit(
+    lambda cache, block_ids, blocks: cache.at[:, :, block_ids].set(
+        blocks.astype(cache.dtype)
+    ),
+    donate_argnums=(0,),
+)
+
+
+def scatter_blocks_inplace(cache, block_ids, blocks):
+    """Donating scatter for the serving path: the input cache buffer is
+    donated so XLA updates it in place instead of copying the whole pool.
+
+    The block count is padded to a power of two (duplicating the last id,
+    which rewrites identical data — idempotent) so XLA compiles O(log n)
+    executables rather than one per transfer size.
+    """
+    import numpy as np
+
+    n = len(block_ids)
+    padded = 1 << max(0, (n - 1).bit_length())
+    block_ids = np.asarray(block_ids, np.int32)
+    if padded != n:
+        block_ids = np.concatenate(
+            [block_ids, np.full(padded - n, block_ids[-1], np.int32)]
+        )
+        blocks = jnp.concatenate(
+            [blocks, jnp.repeat(blocks[:, :, -1:], padded - n, axis=2)], axis=2
+        )
+    return _scatter_donated(cache, jnp.asarray(block_ids), blocks)

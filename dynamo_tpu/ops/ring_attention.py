@@ -1,0 +1,119 @@
+"""Ring attention — context/sequence parallelism over an ICI mesh axis.
+
+Long-context capability the reference lacks entirely (SURVEY.md §5
+"long-context / sequence parallelism: absent from the reference") but a
+TPU-native engine needs: a prompt too long for one chip's HBM is sharded
+along the sequence axis of the mesh, and attention runs blockwise while
+K/V chunks rotate around the ring (jax.lax.ppermute over ICI), overlapping
+the collective with compute.  Online-softmax accumulation (the
+flash-attention recurrence) makes the result exact, not approximate.
+
+    device i holds Q_i forever; at ring step t it multiplies against
+    KV_{(i-t) mod n}, merging partial results with the running (m, l, o)
+    log-sum-exp state.  n steps visit every KV chunk once.
+
+Designed for use under ``jax.shard_map`` (wrapper below) so GSPMD sees the
+per-device program explicitly — no accidental all-gather of the sequence.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "ring_attention_inner"]
+
+_NEG_INF = -1e30
+
+
+def ring_attention_inner(
+    q: jax.Array,       # [B, Sq, Hq, D]  local query shard
+    k: jax.Array,       # [B, Sk, Hk, D]  local key shard
+    v: jax.Array,       # [B, Sk, Hk, D]  local value shard
+    q_pos: jax.Array,   # [B, Sq] int32   global positions of local queries
+    kv_pos: jax.Array,  # [B, Sk] int32   global positions of local keys
+    axis_name: str,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Per-device ring attention body (call under shard_map).
+
+    Returns [B, Sq, Hq, D] in q.dtype.  GQA handled by repeating kv heads.
+    Masking is position-based (q_pos >= kv_pos), so ragged/padded chunks
+    work: give padding keys a position larger than any query.
+    """
+    n = jax.lax.psum(1, axis_name)
+    b, sq, hq, d = q.shape
+    hk = k.shape[2]
+    rep = hq // hk
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    qf = q.astype(jnp.float32)
+
+    def step(carry, _):
+        o, m, l, k_c, v_c, kv_pos_c = carry
+        k_rep = jnp.repeat(k_c, rep, axis=2).astype(jnp.float32)
+        v_rep = jnp.repeat(v_c, rep, axis=2).astype(jnp.float32)
+        # [B, Hq, Sq, Sk]
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_rep) * scale
+        if causal:
+            mask = q_pos[:, None, :, None] >= kv_pos_c[:, None, None, :]
+            s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        # fully-masked rows: m_new is still _NEG_INF, so s - m_new == 0 and
+        # p would be 1 for every masked key — zero it (flash-attention guard)
+        p = jnp.where((m_new == _NEG_INF)[..., None], 0.0, p)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_rep)
+        # rotate the KV chunk to the next device; XLA overlaps this ICI
+        # ppermute with the next step's matmuls
+        k_c = jax.lax.ppermute(k_c, axis_name, perm)
+        v_c = jax.lax.ppermute(v_c, axis_name, perm)
+        kv_pos_c = jax.lax.ppermute(kv_pos_c, axis_name, perm)
+        return (o_new, m_new, l_new, k_c, v_c, kv_pos_c), None
+
+    o0 = jnp.zeros((b, hq, sq, d), jnp.float32)
+    m0 = jnp.full((b, hq, sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hq, sq), jnp.float32)
+    (o, _, l, _, _, _), _ = jax.lax.scan(
+        step, (o0, m0, l0, k, v, kv_pos), None, length=n
+    )
+    out = o / jnp.where(l == 0.0, 1.0, l)[..., None]  # fully-masked rows -> 0
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B, Sq, Hq, D]
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_pos: jax.Array,
+    kv_pos: jax.Array,
+    *,
+    mesh: jax.sharding.Mesh,
+    axis: str = "sp",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Sequence-parallel attention: inputs sharded on their seq axis over
+    ``mesh[axis]``; output keeps that sharding.  q/k/v: [B, S, H, D] global;
+    q_pos/kv_pos: [B, S] global positions."""
+    inner = functools.partial(
+        ring_attention_inner, axis_name=axis, causal=causal, sm_scale=sm_scale
+    )
+    seq = P(None, axis, None, None)
+    pos = P(None, axis)
+    return jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(seq, seq, seq, pos, pos),
+        out_specs=seq,
+        check_vma=False,
+    )(q, k, v, q_pos, kv_pos)

@@ -290,6 +290,12 @@ class CoordinatorServer:
                 self._queue_deliver(h["queue"], item)
             await self._send(conn_id, writer, {"id": rid, "ok": item is not None})
 
+        elif op == "queue_len":
+            n = len(self._queues[h["queue"]]) + sum(
+                1 for (q, _) in self._pending_acks if q == h["queue"]
+            )
+            await self._send(conn_id, writer, {"id": rid, "ok": True, "len": n})
+
         elif op == "ping":
             await self._send(conn_id, writer, {"id": rid, "ok": True})
 
@@ -522,6 +528,11 @@ class CoordinatorClient:
         if not resp.get("ok"):
             return None
         return resp["msg_id"], payload
+
+    async def queue_len(self, queue: str) -> int:
+        """Depth incl. unacked deliveries (disagg router backpressure input)."""
+        resp, _ = await self._call({"op": "queue_len", "queue": queue})
+        return int(resp.get("len", 0))
 
     async def queue_ack(self, queue: str, msg_id: int) -> None:
         await self._call({"op": "queue_ack", "queue": queue, "msg_id": msg_id})
