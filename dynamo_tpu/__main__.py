@@ -1,0 +1,3 @@
+from dynamo_tpu.cli import main
+
+main()

@@ -1,0 +1,358 @@
+"""dynamo-tpu CLI.
+
+Reference parity:
+  * ``run``    — launch/dynamo-run (lib.rs:84, opt.rs:23,91):
+                 ``run in=<http|text|stdin|batch:FILE|dyn://ep>
+                 out=<echo|tpu|dyn://ep>`` builds the local pipeline
+                 frontend → preprocessor → engine → detokenizer
+                 (input/common.rs:78-96) or serves/consumes endpoints.
+  * ``serve``  — deploy/dynamo/sdk `dynamo serve` (graph + YAML config,
+                 process supervisor).
+  * ``http``   — components/http standalone OpenAI frontend with dynamic
+                 model discovery from the coordinator (discovery.rs:58).
+  * ``models`` — launch/llmctl (add/list/remove ModelEntry records).
+
+Invoke as ``python -m dynamo_tpu <cmd> ...``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import sys
+import time
+from pathlib import Path
+from typing import Optional
+
+log = logging.getLogger("dynamo_tpu.cli")
+
+MODELS_PREFIX = "models/"  # under {namespace}/
+
+
+# ------------------------------------------------------------ engine build ----
+
+
+def _build_local_engine(args) -> tuple[object, object]:
+    """out=tpu|echo → (engine, card): the native JAX engine or the echo stub."""
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+    if args.model_path is None:
+        raise SystemExit(f"out={args.out} needs --model-path (weights + tokenizer)")
+    card = ModelDeploymentCard.from_hf_dir(args.model_path, name=args.model_name)
+
+    if args.out == "echo":
+        from dynamo_tpu.llm.engines import EchoEngineCore
+
+        return EchoEngineCore(), card
+
+    from dynamo_tpu.engine import AsyncLLMEngine, EngineConfig, EngineCore
+    from dynamo_tpu.models.llama import LlamaModel
+    from dynamo_tpu.models.loader import load_model_dir
+
+    model_cfg, params = load_model_dir(args.model_path, dtype=args.dtype)
+    model = LlamaModel(model_cfg)
+    cfg = EngineConfig(
+        max_batch_size=args.max_batch_size,
+        max_model_len=args.max_model_len,
+        block_size=args.block_size,
+        num_blocks=args.num_blocks,
+    )
+    core = EngineCore(
+        model, params, cfg, eos_token_ids=card.eos_token_ids or None
+    )
+    return AsyncLLMEngine(core).start(), card
+
+
+async def _build_out_engine(args, runtime=None):
+    """Resolve out= to a ParsedRequest-level engine (full local pipeline or
+    a remote endpoint client)."""
+    from dynamo_tpu.llm.engines import build_serving_pipeline
+
+    if args.out.startswith("dyn://"):
+        from dynamo_tpu.runtime.protocols import parse_endpoint_url
+
+        ns, comp, ep = parse_endpoint_url(args.out)
+        client = await runtime.namespace(ns).component(comp).endpoint(ep).client()
+        return client, None
+    engine, card = _build_local_engine(args)
+    return build_serving_pipeline(engine, card), card
+
+
+def _runtime_config(args):
+    from dynamo_tpu.runtime.config import RuntimeConfig
+
+    kw = {}
+    if args.coordinator:
+        kw["coordinator_url"] = args.coordinator
+    if args.namespace:
+        kw["namespace"] = args.namespace
+    return RuntimeConfig(**kw)
+
+
+# ------------------------------------------------------------------- run ------
+
+
+async def _cmd_run(args) -> None:
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime import serde
+
+    serde.register_llm_types()
+    needs_runtime = args.out.startswith("dyn://") or args.inp.startswith("dyn://")
+    runtime = await DistributedRuntime.connect(_runtime_config(args)) if needs_runtime else None
+
+    engine, card = await _build_out_engine(args, runtime)
+    model_name = args.model_name or (card.name if card else "model")
+
+    if args.inp.startswith("dyn://"):
+        # serve the engine AT this endpoint (worker mode, Input::Endpoint)
+        from dynamo_tpu.runtime.protocols import parse_endpoint_url
+
+        ns, comp, ep = parse_endpoint_url(args.inp)
+        await runtime.namespace(ns).component(comp).endpoint(ep).serve(engine)
+        log.info("serving %s at %s — ctrl-c to stop", model_name, args.inp)
+        await asyncio.Event().wait()
+
+    elif args.inp == "http":
+        from dynamo_tpu.llm.http.service import HttpService
+
+        svc = HttpService(host=args.host, port=args.http_port)
+        svc.manager.add_model(model_name, engine, card)
+        await svc.start()
+        log.info("OpenAI server on %s:%s — ctrl-c to stop", svc.host, svc.port)
+        await asyncio.Event().wait()
+
+    elif args.inp.startswith("text:"):
+        await _one_prompt(engine, model_name, args.inp[5:], args)
+
+    elif args.inp == "stdin":
+        for line in sys.stdin:
+            line = line.strip()
+            if line:
+                await _one_prompt(engine, model_name, line, args)
+
+    elif args.inp.startswith("batch:"):
+        await _batch(engine, model_name, Path(args.inp[6:]), args)
+
+    else:
+        raise SystemExit(f"unknown in={args.inp}")
+
+
+async def _one_prompt(engine, model_name: str, prompt: str, args) -> None:
+    from dynamo_tpu.llm.openai import parse_request
+    from dynamo_tpu.runtime.engine import Context
+
+    parsed = parse_request(
+        {"model": model_name, "prompt": prompt, "max_tokens": args.max_tokens},
+        chat=False,
+    )
+    async for out in engine.generate(Context(parsed)):
+        if out.text:
+            print(out.text, end="", flush=True)
+    print()
+
+
+async def _batch(engine, model_name: str, path: Path, args) -> None:
+    """Input::Batch benchmark mode (ref input/batch.rs): JSONL in
+    {"text": ...} → JSONL out with tokens + timing."""
+    from dynamo_tpu.llm.openai import parse_request
+    from dynamo_tpu.runtime.engine import Context
+
+    async def one(text: str) -> dict:
+        parsed = parse_request(
+            {"model": model_name, "prompt": text, "max_tokens": args.max_tokens},
+            chat=False,
+        )
+        t0 = time.perf_counter()
+        ttft, n_tokens, chunks = None, 0, []
+        async for out in engine.generate(Context(parsed)):
+            if ttft is None:
+                ttft = time.perf_counter() - t0
+            n_tokens += len(out.token_ids)
+            if out.text:
+                chunks.append(out.text)
+        dt = time.perf_counter() - t0
+        return {
+            "text": "".join(chunks),
+            "output_tokens": n_tokens,
+            "ttft_s": round(ttft or 0.0, 4),
+            "total_s": round(dt, 4),
+        }
+
+    lines = [json.loads(l) for l in path.read_text().splitlines() if l.strip()]
+    results = await asyncio.gather(*(one(l["text"]) for l in lines))
+    out_path = path.with_suffix(".out.jsonl")
+    with open(out_path, "w") as f:
+        for r in results:
+            f.write(json.dumps(r) + "\n")
+    total_tok = sum(r["output_tokens"] for r in results)
+    total_s = max(r["total_s"] for r in results) if results else 0.0
+    print(
+        json.dumps(
+            {
+                "requests": len(results),
+                "output_tokens": total_tok,
+                "tok_per_s": round(total_tok / total_s, 2) if total_s else 0.0,
+                "results": str(out_path),
+            }
+        )
+    )
+
+
+# ------------------------------------------------------------------ serve -----
+
+
+async def _cmd_serve(args) -> None:
+    from dynamo_tpu.sdk.config import ServiceConfig
+    from dynamo_tpu.sdk.serving import ServeSupervisor
+
+    config = ServiceConfig.from_yaml(args.config) if args.config else ServiceConfig()
+    sup = ServeSupervisor(args.graph, config, coordinator_url=args.coordinator)
+    await sup.start()
+    try:
+        await sup.watch()
+    finally:
+        await sup.stop()
+
+
+# ------------------------------------------------------------------- http -----
+
+
+async def _cmd_http(args) -> None:
+    """Standalone OpenAI frontend: discovers ModelEntry records on the
+    coordinator and builds a remote pipeline per model (ref
+    components/http/src/main.rs + http/service/discovery.rs:58)."""
+    from dynamo_tpu.llm.engines import build_serving_pipeline
+    from dynamo_tpu.llm.http.service import HttpService
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.runtime import serde
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.protocols import parse_endpoint_url
+
+    serde.register_llm_types()
+    runtime = await DistributedRuntime.connect(_runtime_config(args))
+    svc = HttpService(host=args.host, port=args.http_port)
+    ns = args.namespace or "dynamo"
+    clients: dict[str, object] = {}
+
+    async def add_model(name: str, entry: dict) -> None:
+        e_ns, comp, ep = parse_endpoint_url(entry["endpoint"])
+        client = await runtime.namespace(e_ns).component(comp).endpoint(ep).client()
+        clients[name] = client
+        card = (
+            ModelDeploymentCard.from_hf_dir(entry["model_path"], name=name)
+            if entry.get("model_path")
+            else ModelDeploymentCard.from_dict(entry.get("card", {"name": name}))
+        )
+        svc.manager.add_model(name, build_serving_pipeline(client, card), card)
+        log.info("model %s -> %s", name, entry["endpoint"])
+
+    def on_event(event: str, key: str, value) -> None:
+        name = key.rsplit("/", 1)[-1]
+        if event == "put":
+            asyncio.ensure_future(add_model(name, value))
+        elif event == "delete":
+            svc.manager.remove_model(name)
+            clients.pop(name, None)
+
+    _, snapshot = await runtime.coordinator.watch(f"{ns}/{MODELS_PREFIX}", on_event)
+    for key, value in snapshot.items():
+        await add_model(key.rsplit("/", 1)[-1], value)
+
+    await svc.start()
+    log.info("OpenAI frontend on %s:%s (namespace %s)", svc.host, svc.port, ns)
+    await asyncio.Event().wait()
+
+
+# ----------------------------------------------------------------- models -----
+
+
+async def _cmd_models(args) -> None:
+    """llmctl parity: manage ModelEntry records on the coordinator."""
+    from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient
+
+    ns = args.namespace or "dynamo"
+    coord = await CoordinatorClient(
+        args.coordinator or "tcp://127.0.0.1:6180"
+    ).connect()
+    try:
+        if args.action == "add":
+            entry = {"endpoint": args.endpoint, "model_path": args.model_path}
+            await coord.kv_put(f"{ns}/{MODELS_PREFIX}{args.name}", entry)
+            print(f"added {args.name} -> {args.endpoint}")
+        elif args.action == "remove":
+            ok = await coord.kv_delete(f"{ns}/{MODELS_PREFIX}{args.name}")
+            print(f"removed {args.name}" if ok else f"no such model {args.name}")
+        else:  # list
+            items = await coord.kv_get_prefix(f"{ns}/{MODELS_PREFIX}")
+            for key, value in sorted(items.items()):
+                print(f"{key.rsplit('/', 1)[-1]}\t{value.get('endpoint')}")
+    finally:
+        await coord.close()
+
+
+# ------------------------------------------------------------------ parser ----
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dynamo-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp):
+        sp.add_argument("--coordinator", default=None, help="tcp://host:port")
+        sp.add_argument("--namespace", default=None)
+
+    run = sub.add_parser("run", help="run a model or pipeline (dynamo-run parity)")
+    run.add_argument("inout", nargs="+", help="in=<...> out=<...>")
+    run.add_argument("--model-path", default=None)
+    run.add_argument("--model-name", default=None)
+    run.add_argument("--dtype", default="bfloat16")
+    run.add_argument("--max-batch-size", type=int, default=8)
+    run.add_argument("--max-model-len", type=int, default=4096)
+    run.add_argument("--block-size", type=int, default=16)
+    run.add_argument("--num-blocks", type=int, default=512)
+    run.add_argument("--max-tokens", type=int, default=128)
+    run.add_argument("--host", default="127.0.0.1")
+    run.add_argument("--http-port", type=int, default=8080)
+    common(run)
+
+    serve = sub.add_parser("serve", help="serve a graph of @service components")
+    serve.add_argument("graph", help="module.path:EntryService")
+    serve.add_argument("-f", "--config", default=None, help="YAML ServiceConfig")
+    common(serve)
+
+    http = sub.add_parser("http", help="standalone OpenAI frontend w/ discovery")
+    http.add_argument("--host", default="127.0.0.1")
+    http.add_argument("--http-port", type=int, default=8080)
+    common(http)
+
+    models = sub.add_parser("models", help="manage model registrations (llmctl)")
+    models.add_argument("action", choices=["add", "list", "remove"])
+    models.add_argument("name", nargs="?")
+    models.add_argument("endpoint", nargs="?", help="dyn://ns.component.endpoint")
+    models.add_argument("--model-path", default=None)
+    common(models)
+    return p
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    args = _parser().parse_args(argv)
+
+    if args.cmd == "run":
+        kv = dict(item.split("=", 1) for item in args.inout if "=" in item)
+        if "in" not in kv or "out" not in kv:
+            raise SystemExit("run needs in=<...> and out=<...>")
+        args.inp, args.out = kv["in"], kv["out"]
+        asyncio.run(_cmd_run(args))
+    elif args.cmd == "serve":
+        asyncio.run(_cmd_serve(args))
+    elif args.cmd == "http":
+        asyncio.run(_cmd_http(args))
+    elif args.cmd == "models":
+        asyncio.run(_cmd_models(args))
+
+
+if __name__ == "__main__":
+    main()

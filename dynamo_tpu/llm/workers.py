@@ -92,7 +92,14 @@ class PrefillQueue:
         if item is None:
             return None
         msg_id, payload = item
-        return msg_id, RemotePrefillRequest.from_wire(payload)
+        try:
+            return msg_id, RemotePrefillRequest.from_wire(payload)
+        except Exception:
+            # poison message: ack (drop) it or it redelivers forever,
+            # killing every worker that pulls it
+            log.exception("dropping undecodable prefill queue message %s", msg_id)
+            await self.ack(msg_id)
+            return None
 
     async def ack(self, msg_id: int) -> None:
         await self.coord.queue_ack(self.name, msg_id)
@@ -248,6 +255,12 @@ class DecodeWorker(AsyncEngine):
         finally:
             if not first_task.done():
                 first_task.cancel()
+                # let the cancellation reach the inner generator before
+                # aclose() — aclose() on a still-running generator raises
+                try:
+                    await first_task
+                except (asyncio.CancelledError, StopAsyncIteration, Exception):
+                    pass
             if not alloc_fut.done():
                 alloc_fut.cancel()
             await agen.aclose()
@@ -267,9 +280,16 @@ class PrefillWorker:
         self._stop.set()
 
     async def run(self) -> None:
-        """Main pull loop; returns after request_stop()."""
+        """Main pull loop; returns after request_stop().  Transport errors
+        back off and retry — the loop must outlive transient coordinator
+        hiccups or every remote prefill stalls forever."""
         while not self._stop.is_set():
-            item = await self.queue.pull(timeout_s=0.2)
+            try:
+                item = await self.queue.pull(timeout_s=0.2)
+            except Exception:
+                log.exception("prefill queue pull failed; retrying")
+                await asyncio.sleep(0.5)
+                continue
             if item is None:
                 continue
             msg_id, rpr = item
@@ -279,7 +299,10 @@ class PrefillWorker:
                 self.handled += 1
             except Exception:
                 log.exception("prefill of %s failed; nack for redelivery", rpr.request_id)
-                await self.queue.nack(msg_id)
+                try:
+                    await self.queue.nack(msg_id)
+                except Exception:
+                    log.exception("nack of %s failed", msg_id)
 
     async def handle(self, rpr: RemotePrefillRequest) -> None:
         core = self.engine.core

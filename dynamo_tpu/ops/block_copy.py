@@ -60,6 +60,8 @@ def scatter_blocks_inplace(cache, block_ids, blocks):
     import numpy as np
 
     n = len(block_ids)
+    if n == 0:
+        return cache
     padded = 1 << max(0, (n - 1).bit_length())
     block_ids = np.asarray(block_ids, np.int32)
     if padded != n:

@@ -54,16 +54,18 @@ def ring_attention_inner(
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    qf = q.astype(jnp.float32)
+    # grouped layout [B, Sq, Hk, rep, D]: the kv-head broadcast of GQA fuses
+    # into the matmuls instead of materialising rep× copies of each K/V chunk
+    qf = q.astype(jnp.float32).reshape(b, sq, hk, rep, d)
 
     def step(carry, _):
         o, m, l, k_c, v_c, kv_pos_c = carry
-        k_rep = jnp.repeat(k_c, rep, axis=2).astype(jnp.float32)
-        v_rep = jnp.repeat(v_c, rep, axis=2).astype(jnp.float32)
-        # [B, Hq, Sq, Sk]
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_rep) * scale
+        kf = k_c.astype(jnp.float32)
+        vf = v_c.astype(jnp.float32)
+        # [B, Hk, rep, Sq, Sk]
+        s = jnp.einsum("bqgrd,bkgd->bgrqk", qf, kf) * scale
         if causal:
-            mask = q_pos[:, None, :, None] >= kv_pos_c[:, None, None, :]
+            mask = q_pos[:, None, None, :, None] >= kv_pos_c[:, None, None, None, :]
             s = jnp.where(mask, s, _NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1))
         p = jnp.exp(s - m_new[..., None])
@@ -72,7 +74,7 @@ def ring_attention_inner(
         p = jnp.where((m_new == _NEG_INF)[..., None], 0.0, p)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=-1)
-        o_new = o * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_rep)
+        o_new = o * corr[..., None] + jnp.einsum("bgrqk,bkgd->bgrqd", p, vf)
         # rotate the KV chunk to the next device; XLA overlaps this ICI
         # ppermute with the next step's matmuls
         k_c = jax.lax.ppermute(k_c, axis_name, perm)
@@ -80,14 +82,15 @@ def ring_attention_inner(
         kv_pos_c = jax.lax.ppermute(kv_pos_c, axis_name, perm)
         return (o_new, m_new, l_new, k_c, v_c, kv_pos_c), None
 
-    o0 = jnp.zeros((b, hq, sq, d), jnp.float32)
-    m0 = jnp.full((b, hq, sq), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, hq, sq), jnp.float32)
+    o0 = jnp.zeros((b, hk, rep, sq, d), jnp.float32)
+    m0 = jnp.full((b, hk, rep, sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hk, rep, sq), jnp.float32)
     (o, _, l, _, _, _), _ = jax.lax.scan(
         step, (o0, m0, l0, k, v, kv_pos), None, length=n
     )
     out = o / jnp.where(l == 0.0, 1.0, l)[..., None]  # fully-masked rows -> 0
-    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B, Sq, Hq, D]
+    # [B, Hk, rep, Sq, D] -> [B, Sq, Hk*rep = Hq, D]
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, hq, d).astype(q.dtype)
 
 
 def ring_attention(

@@ -1,0 +1,29 @@
+"""Python SDK — declarative serving graphs.
+
+Reference parity: deploy/dynamo/sdk (BentoML-forked @service model,
+SURVEY.md §2.7): ``@service`` components with ``@dynamo_endpoint``s,
+``depends()`` cross-component clients, ``.link()`` graph edges, YAML
+ServiceConfig with Common inheritance, and a process supervisor
+(`dynamo-tpu serve`, sdk/serving.py) in place of circus.
+"""
+
+from dynamo_tpu.sdk.config import ServiceConfig
+from dynamo_tpu.sdk.service import (
+    DynamoService,
+    async_on_start,
+    depends,
+    dynamo_endpoint,
+    service,
+)
+from dynamo_tpu.sdk.serving import ServeHandle, serve_graph
+
+__all__ = [
+    "ServiceConfig",
+    "DynamoService",
+    "service",
+    "dynamo_endpoint",
+    "async_on_start",
+    "depends",
+    "serve_graph",
+    "ServeHandle",
+]

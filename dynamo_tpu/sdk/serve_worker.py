@@ -1,0 +1,51 @@
+"""Per-process worker entry (ref cli/serve_dynamo.py:57): connect to the
+coordinator, serve ONE service's endpoints, run until terminated.
+
+Usage (spawned by ServeSupervisor): python -m dynamo_tpu.sdk.serve_worker
+<module:Entry> <ServiceName>; env: DYNTPU_COORDINATOR, DYNTPU_SERVICE_CONFIG.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import importlib
+import logging
+import os
+import signal
+import sys
+
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.sdk.config import ServiceConfig
+from dynamo_tpu.sdk.service import DynamoService
+from dynamo_tpu.sdk.serving import serve_service
+
+log = logging.getLogger("dynamo_tpu.serve_worker")
+
+
+async def amain(graph: str, service_name: str) -> None:
+    mod_name, _, attr = graph.partition(":")
+    sys.path.insert(0, os.getcwd())
+    entry = getattr(importlib.import_module(mod_name), attr)
+    svc = next(s for s in entry.closure() if s.name == service_name)
+
+    cfg = RuntimeConfig(coordinator_url=os.environ["DYNTPU_COORDINATOR"])
+    runtime = await DistributedRuntime.connect(cfg)
+    await serve_service(svc, runtime, ServiceConfig.from_env())
+    log.info("%s serving (pid %s)", service_name, os.getpid())
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await runtime.shutdown()
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(amain(sys.argv[1], sys.argv[2]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,221 @@
+"""Serve a graph: in-process (tests, `run`) or supervised subprocesses.
+
+Reference parity: deploy/dynamo/sdk/cli/serving.py (circus arbiter spawning
+one process per component worker) + cli/serve_dynamo.py:57 (per-worker
+entry registering component endpoints in the DistributedRuntime) +
+cli/allocator.py (ResourceAllocator pinning GPUs via CUDA_VISIBLE_DEVICES —
+here TPU chips via JAX flags).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+from dataclasses import dataclass, field
+from typing import Optional
+
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.sdk.config import ServiceConfig
+from dynamo_tpu.sdk.service import (
+    Dependency,
+    DynamoService,
+    EndpointAdapter,
+    ServiceClient,
+)
+
+log = logging.getLogger("dynamo_tpu.serve")
+
+__all__ = ["serve_graph", "serve_service", "ServeHandle", "ServeSupervisor", "TpuAllocator"]
+
+
+# -------------------------------------------------------------- in-process ----
+
+
+@dataclass
+class ServeHandle:
+    """Running graph: per-service runtimes + instances; stop() tears down."""
+
+    runtimes: list[DistributedRuntime] = field(default_factory=list)
+    instances: dict[str, object] = field(default_factory=dict)  # inner objects by name
+    clients: list[ServiceClient] = field(default_factory=list)
+
+    async def stop(self) -> None:
+        for c in self.clients:
+            await c.close()
+        for rt in self.runtimes:
+            await rt.shutdown()
+
+
+async def serve_service(
+    svc: DynamoService,
+    runtime: DistributedRuntime,
+    config: Optional[ServiceConfig] = None,
+    handle: Optional[ServeHandle] = None,
+):
+    """Instantiate one service and register its endpoints (the
+    serve_dynamo.py:57 analogue).  Returns the inner instance."""
+    obj = svc.inner.__new__(svc.inner)
+    # wire dependencies before __init__ so constructors may touch them
+    for dep in svc.dependencies:
+        client = ServiceClient(runtime, dep.target)
+        obj.__dict__[f"_dep_{dep.attr}"] = client
+        if handle is not None:
+            handle.clients.append(client)
+    # per-service YAML/env args land on the instance before __init__
+    obj.service_config = (config or ServiceConfig.from_env()).for_service(svc.name)
+    obj.__init__()
+
+    for hook in svc.on_start_hooks:
+        await getattr(obj, hook)()
+
+    component = runtime.namespace(svc.namespace).component(svc.component)
+    for spec in svc.endpoints:
+        adapter = EndpointAdapter(getattr(obj, spec.method))
+        await component.endpoint(spec.name).serve(adapter)
+    return obj
+
+
+async def serve_graph(
+    entry: DynamoService,
+    config: Optional[ServiceConfig] = None,
+    runtime_config: Optional[RuntimeConfig] = None,
+) -> ServeHandle:
+    """Serve the entry's whole closure in this process (one runtime + lease
+    per service, like separate workers would hold) — the test seam the
+    reference gets from its sdk test pipeline (tests/test_e2e.py)."""
+    handle = ServeHandle()
+    services = entry.closure()
+    # dependencies first so their endpoints exist when dependents boot
+    for svc in reversed(services):
+        rt = await DistributedRuntime.connect(runtime_config)
+        handle.runtimes.append(rt)
+        obj = await serve_service(svc, rt, config, handle)
+        handle.instances[svc.name] = obj
+    return handle
+
+
+# ------------------------------------------------------------- tpu allocator ----
+
+
+class TpuAllocator:
+    """Assign TPU chips to worker processes (ResourceAllocator parity,
+    cli/allocator.py:136 — CUDA_VISIBLE_DEVICES becomes TPU chip pinning).
+
+    Pool from DYNTPU_TPU_CHIPS ("0,1,2,3"); a service asking
+    resources={"tpu": n} gets n chips exclusively, expressed to the child
+    via TPU_VISIBLE_CHIPS (honoured by libtpu) — CPU-only services get
+    JAX_PLATFORMS=cpu so they never grab the TPU runtime.
+    """
+
+    def __init__(self, chips: Optional[list[int]] = None):
+        if chips is None:
+            raw = os.environ.get("DYNTPU_TPU_CHIPS", "")
+            chips = [int(c) for c in raw.split(",") if c.strip()] if raw else []
+        self.free = list(chips)
+
+    def allocate(self, svc: DynamoService) -> dict[str, str]:
+        want = int(svc.resources.get("tpu", 0))
+        if want == 0:
+            return {"JAX_PLATFORMS": "cpu"}
+        if len(self.free) < want:
+            raise RuntimeError(
+                f"service {svc.name} wants {want} TPU chips, only {len(self.free)} free"
+            )
+        mine, self.free = self.free[:want], self.free[want:]
+        return {"TPU_VISIBLE_CHIPS": ",".join(map(str, mine))}
+
+
+# ------------------------------------------------------------- supervisor ----
+
+
+class ServeSupervisor:
+    """Spawn one OS process per service worker and keep them alive
+    (the circus-arbiter analogue, serving.py:243)."""
+
+    def __init__(
+        self,
+        graph: str,  # "package.module:EntryService"
+        config: Optional[ServiceConfig] = None,
+        coordinator_url: Optional[str] = None,
+        restart: bool = True,
+    ):
+        self.graph = graph
+        self.config = config or ServiceConfig()
+        self.coordinator_url = coordinator_url
+        self.restart = restart
+        self.procs: dict[str, subprocess.Popen] = {}
+        self._coordinator = None
+        self.allocator = TpuAllocator()
+
+    def _load_entry(self) -> DynamoService:
+        import importlib
+
+        mod_name, _, attr = self.graph.partition(":")
+        sys.path.insert(0, os.getcwd())
+        entry = getattr(importlib.import_module(mod_name), attr)
+        if not isinstance(entry, DynamoService):
+            raise TypeError(f"{self.graph} is not a @service")
+        return entry
+
+    async def start(self) -> None:
+        if self.coordinator_url is None:
+            from dynamo_tpu.runtime.transports.coordinator import CoordinatorServer
+
+            self._coordinator = await CoordinatorServer(port=0).start()
+            self.coordinator_url = self._coordinator.url
+        entry = self._load_entry()
+        for svc in reversed(entry.closure()):
+            env_extra = self.allocator.allocate(svc)
+            for worker_idx in range(svc.workers):
+                self._spawn(svc, worker_idx, env_extra)
+
+    def _spawn(self, svc: DynamoService, worker_idx: int, env_extra: dict) -> None:
+        env = dict(os.environ)
+        env.update(env_extra)
+        env.update(self.config.to_env())
+        env["DYNTPU_COORDINATOR"] = self.coordinator_url
+        key = f"{svc.name}:{worker_idx}"
+        self.procs[key] = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "dynamo_tpu.sdk.serve_worker",
+                self.graph,
+                svc.name,
+            ],
+            env=env,
+        )
+        log.info("spawned %s (pid %s)", key, self.procs[key].pid)
+
+    async def watch(self) -> None:
+        """Restart crashed workers until stop() (watcher loop parity)."""
+        entry = self._load_entry()
+        by_name = {s.name: s for s in entry.closure()}
+        while self.procs:
+            await asyncio.sleep(0.5)
+            for key, proc in list(self.procs.items()):
+                code = proc.poll()
+                if code is None:
+                    continue
+                name, _, idx = key.partition(":")
+                if self.restart and code != 0:
+                    log.warning("%s exited %s — restarting", key, code)
+                    self._spawn(by_name[name], int(idx), {})
+                else:
+                    del self.procs[key]
+
+    async def stop(self) -> None:
+        for proc in self.procs.values():
+            proc.terminate()
+        for proc in self.procs.values():
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self.procs.clear()
+        if self._coordinator:
+            await self._coordinator.stop()

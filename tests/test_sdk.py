@@ -1,0 +1,261 @@
+"""SDK tests: ServiceConfig merging, link-graph pruning, in-process graph
+serving with depends() injection, the supervisor's subprocess worker, and
+the llmctl-style model registry (reference seams: sdk tests
+test_config.py / test_link.py / test_e2e.py, SURVEY.md §2.7)."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.protocols import parse_endpoint_url
+from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient, CoordinatorServer
+from dynamo_tpu.sdk import (
+    ServiceConfig,
+    async_on_start,
+    depends,
+    dynamo_endpoint,
+    serve_graph,
+    service,
+)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# ------------------------------------------------------------------ config ----
+
+
+def test_service_config_common_inheritance():
+    cfg = ServiceConfig(
+        {
+            "Common": {"model": "llama", "block-size": 16, "unused": 1},
+            "Worker": {"common-configs": ["model", "block-size"], "tp": 4},
+            "Router": {"common-configs": ["model"], "model": "override"},
+        }
+    )
+    w = cfg.for_service("Worker")
+    assert w == {"model": "llama", "block-size": 16, "tp": 4}
+    # service-local value wins over Common
+    assert cfg.for_service("Router") == {"model": "override"}
+    # unknown service -> empty args
+    assert cfg.for_service("Nope") == {}
+
+
+def test_service_config_env_roundtrip(monkeypatch):
+    cfg = ServiceConfig({"A": {"x": 1}})
+    for k, v in cfg.to_env().items():
+        monkeypatch.setenv(k, v)
+    assert ServiceConfig.from_env().for_service("A") == {"x": 1}
+
+
+def test_service_config_merge():
+    cfg = ServiceConfig({"A": {"x": 1, "y": 2}})
+    merged = cfg.merged_with({"A": {"y": 3}, "B": {"z": 4}})
+    assert merged.for_service("A") == {"x": 1, "y": 3}
+    assert merged.for_service("B") == {"z": 4}
+
+
+# ------------------------------------------------------------- link pruning ----
+
+
+def _toy_services():
+    @service(dynamo={"namespace": "toy"})
+    class Backend:
+        @dynamo_endpoint
+        async def generate(self, req):
+            for tok in req["tokens"]:
+                yield {"tok": tok * 2}
+
+    @service(dynamo={"namespace": "toy"})
+    class Middle:
+        backend = depends(Backend)
+
+        def __init__(self):
+            self.scale = self.service_config.get("scale", 1)
+
+        @dynamo_endpoint
+        async def process(self, req):
+            async for item in self.backend.generate(req):
+                yield {"tok": item["tok"] * self.scale}
+
+    @service(dynamo={"namespace": "toy"})
+    class Unused:
+        @dynamo_endpoint
+        async def nothing(self, req):
+            yield req
+
+    @service(dynamo={"namespace": "toy"})
+    class Frontend:
+        middle = depends(Middle)
+
+        @async_on_start
+        async def boot(self):
+            self.booted = True
+
+        @dynamo_endpoint
+        async def entry(self, req):
+            async for item in self.middle.process(req):
+                yield item
+
+    return Frontend, Middle, Backend, Unused
+
+
+def test_link_closure_prunes_unlinked():
+    Frontend, Middle, Backend, Unused = _toy_services()
+    names = {s.name for s in Frontend.closure()}
+    # depends() edges pull in Middle and Backend; Unused is pruned
+    assert names == {"Frontend", "Middle", "Backend"}
+
+    # explicit .link chains extend the graph and return the tail
+    tail = Frontend.link(Unused)
+    assert tail is Unused
+    assert {s.name for s in Frontend.closure()} == {
+        "Frontend", "Middle", "Backend", "Unused",
+    }
+
+
+# ---------------------------------------------------------- in-process e2e ----
+
+
+def test_serve_graph_e2e():
+    Frontend, Middle, Backend, _ = _toy_services()
+
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        try:
+            handle = await serve_graph(
+                Frontend,
+                config=ServiceConfig({"Middle": {"scale": 10}}),
+                runtime_config=RuntimeConfig(coordinator_url=srv.url, lease_ttl_s=2.0),
+            )
+            # on_start hook ran
+            assert handle.instances["Frontend"].booted
+            # config reached the service
+            assert handle.instances["Middle"].scale == 10
+
+            # call the frontend endpoint through the runtime like a client
+            rt = handle.runtimes[0]
+            client = (
+                await rt.namespace("toy").component("frontend").endpoint("entry").client()
+            )
+            from dynamo_tpu.runtime.engine import Context
+
+            out = [x async for x in client.generate(Context({"tokens": [1, 2, 3]}))]
+            assert out == [{"tok": 20}, {"tok": 40}, {"tok": 60}]
+            await client.close()
+            await handle.stop()
+        finally:
+            await srv.stop()
+
+    run(go())
+
+
+# --------------------------------------------------------- subprocess worker ----
+
+
+GRAPH_MODULE = textwrap.dedent(
+    """
+    from dynamo_tpu.sdk import service, dynamo_endpoint
+
+    @service(dynamo={"namespace": "sub"}, resources={})
+    class Echo:
+        @dynamo_endpoint
+        async def generate(self, req):
+            for x in req:
+                yield x + self.service_config.get("bias", 0)
+    """
+)
+
+
+def test_serve_worker_subprocess(tmp_path):
+    """A real spawned worker process registers and serves (serve_dynamo.py
+    parity); the supervisor-side client streams through it."""
+    (tmp_path / "toy_graph.py").write_text(GRAPH_MODULE)
+
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        env = dict(os.environ)
+        env["DYNTPU_COORDINATOR"] = srv.url
+        env["DYNTPU_SERVICE_CONFIG"] = json.dumps({"Echo": {"bias": 100}})
+        env["PYTHONPATH"] = f"{tmp_path}:/root/repo:" + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.sdk.serve_worker", "toy_graph:Echo", "Echo"],
+            env=env,
+            cwd=tmp_path,
+        )
+        try:
+            from dynamo_tpu.runtime.distributed import DistributedRuntime
+            from dynamo_tpu.runtime.engine import Context
+
+            rt = await DistributedRuntime.connect(
+                RuntimeConfig(coordinator_url=srv.url, lease_ttl_s=2.0)
+            )
+            client = (
+                await rt.namespace("sub").component("echo").endpoint("generate").client()
+            )
+            await client.wait_for_instances(1, timeout=20)
+            out = [x async for x in client.generate(Context([1, 2]))]
+            assert out == [101, 102]
+            await client.close()
+            await rt.shutdown()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+            await srv.stop()
+
+    run(go())
+
+
+# -------------------------------------------------------------- cli helpers ----
+
+
+def test_parse_endpoint_url():
+    a = parse_endpoint_url("dyn://ns.comp.ep")
+    assert (a.namespace, a.component, a.name) == ("ns", "comp", "ep")
+    ns, comp, ep = parse_endpoint_url("comp.ep")  # shorthand + unpacking
+    assert (ns, comp, ep) == ("dynamo", "comp", "ep")
+    with pytest.raises(ValueError):
+        parse_endpoint_url("dyn://only-one")
+
+
+def test_models_registry_cli(capsys):
+    """llmctl parity: add / list / remove (the `models` subcommand's async
+    core, driven in one loop with the coordinator)."""
+    from types import SimpleNamespace
+
+    from dynamo_tpu.cli import _cmd_models
+
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        try:
+            def args(action, name=None, endpoint=None):
+                return SimpleNamespace(
+                    action=action, name=name, endpoint=endpoint,
+                    model_path=None, coordinator=srv.url, namespace="t",
+                )
+
+            await _cmd_models(args("add", "m1", "dyn://t.worker.generate"))
+            await _cmd_models(args("list"))
+            c = await CoordinatorClient(srv.url).connect()
+            assert await c.kv_get_prefix("t/models/") == {
+                "t/models/m1": {
+                    "endpoint": "dyn://t.worker.generate", "model_path": None,
+                }
+            }
+            await _cmd_models(args("remove", "m1"))
+            assert await c.kv_get_prefix("t/models/") == {}
+            await c.close()
+        finally:
+            await srv.stop()
+
+    run(go())
+    out = capsys.readouterr().out
+    assert "added m1" in out and "m1\tdyn://t.worker.generate" in out
