@@ -39,7 +39,21 @@ class OverlapScores:
 
 
 class KvIndexer:
-    def __init__(self) -> None:
+    def __init__(self, use_native: bool | None = None) -> None:
+        # Prefer the C++ index (native/src/kv_index.cpp) — same semantics,
+        # O(1) probes without Python set churn on the per-request hot path.
+        self._native = None
+        if use_native is not False:
+            try:
+                from dynamo_tpu import native
+
+                if native.available():
+                    self._native = native.NativeKvIndex()
+                elif use_native:
+                    raise RuntimeError("native KV index requested but unavailable")
+            except ImportError:  # toolchain absent → pure-Python fallback
+                if use_native:
+                    raise
         # block sequence-hash → set of worker ids holding it
         self._holders: dict[int, set[int]] = {}
         # worker id → hashes it holds (for teardown)
@@ -47,9 +61,15 @@ class KvIndexer:
         # per-worker last event id (gap/ordering diagnostics)
         self._last_event_id: dict[int, int] = {}
 
+    @property
+    def is_native(self) -> bool:
+        return self._native is not None
+
     # ---------------------------------------------------------------- queries
     def find_matches(self, seq_hashes: Sequence[int]) -> OverlapScores:
         """Longest-prefix match per worker over the request's block hashes."""
+        if self._native is not None:
+            return OverlapScores(self._native.find_matches(seq_hashes))
         scores: dict[int, int] = {}
         live: set[int] | None = None  # workers matching every block so far
         for i, h in enumerate(seq_hashes):
@@ -65,6 +85,8 @@ class KvIndexer:
 
     @property
     def num_blocks(self) -> int:
+        if self._native is not None:
+            return self._native.num_blocks
         return len(self._holders)
 
     def workers(self) -> list[int]:
@@ -79,6 +101,14 @@ class KvIndexer:
                     "worker %s event id gap: %s -> %s", worker_id, last, event_id
                 )
             self._last_event_id[worker_id] = event_id
+
+        if self._native is not None:
+            self._worker_blocks.setdefault(worker_id, set())  # workers() listing
+            if isinstance(event, KvStoredEvent):
+                self._native.store(worker_id, event.block_hashes)
+            elif isinstance(event, KvRemovedEvent):
+                self._native.remove(worker_id, event.block_hashes)
+            return
 
         if isinstance(event, KvStoredEvent):
             blocks = self._worker_blocks.setdefault(worker_id, set())
@@ -98,6 +128,11 @@ class KvIndexer:
     def remove_worker(self, worker_id: int) -> None:
         """Worker died/left: drop all its blocks (ref: client watcher delete
         path, component/client.rs:145-154 → router stops picking it)."""
+        if self._native is not None:
+            self._native.remove_worker(worker_id)
+            self._worker_blocks.pop(worker_id, None)
+            self._last_event_id.pop(worker_id, None)
+            return
         for h in self._worker_blocks.pop(worker_id, set()):
             holders = self._holders.get(h)
             if holders:
@@ -107,6 +142,8 @@ class KvIndexer:
         self._last_event_id.pop(worker_id, None)
 
     def clear(self) -> None:
+        if self._native is not None:
+            self._native.clear()
         self._holders.clear()
         self._worker_blocks.clear()
         self._last_event_id.clear()

@@ -170,6 +170,26 @@ class DynamoService:
         visit(self)
         return list(seen.values())
 
+    def boot_order(self) -> list["DynamoService"]:
+        """Closure in reverse-topological order (postorder DFS): every
+        service appears after its dependencies/links, so booting in list
+        order guarantees endpoints exist before their dependents start."""
+        seen: set[int] = set()
+        order: list[DynamoService] = []
+
+        def visit(svc: DynamoService) -> None:
+            if id(svc) in seen:
+                return
+            seen.add(id(svc))
+            for dep in svc.dependencies:
+                visit(dep.target)
+            for linked in svc._links:
+                visit(linked)
+            order.append(svc)
+
+        visit(self)
+        return order
+
 
 # ------------------------------------------------------- runtime adapters ----
 

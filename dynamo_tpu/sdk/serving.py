@@ -88,9 +88,8 @@ async def serve_graph(
     per service, like separate workers would hold) — the test seam the
     reference gets from its sdk test pipeline (tests/test_e2e.py)."""
     handle = ServeHandle()
-    services = entry.closure()
     # dependencies first so their endpoints exist when dependents boot
-    for svc in reversed(services):
+    for svc in entry.boot_order():
         rt = await DistributedRuntime.connect(runtime_config)
         handle.runtimes.append(rt)
         obj = await serve_service(svc, rt, config, handle)
@@ -118,6 +117,7 @@ class TpuAllocator:
         self.free = list(chips)
 
     def allocate(self, svc: DynamoService) -> dict[str, str]:
+        """Allocate one *worker's* chips (call once per worker process)."""
         want = int(svc.resources.get("tpu", 0))
         if want == 0:
             return {"JAX_PLATFORMS": "cpu"}
@@ -127,6 +127,12 @@ class TpuAllocator:
             )
         mine, self.free = self.free[:want], self.free[want:]
         return {"TPU_VISIBLE_CHIPS": ",".join(map(str, mine))}
+
+    def release(self, env_extra: dict[str, str]) -> None:
+        """Return a dead worker's chips to the pool."""
+        chips = env_extra.get("TPU_VISIBLE_CHIPS", "")
+        if chips:
+            self.free.extend(int(c) for c in chips.split(","))
 
 
 # ------------------------------------------------------------- supervisor ----
@@ -148,6 +154,7 @@ class ServeSupervisor:
         self.coordinator_url = coordinator_url
         self.restart = restart
         self.procs: dict[str, subprocess.Popen] = {}
+        self._envs: dict[str, dict[str, str]] = {}  # per-worker env_extra for respawn
         self._coordinator = None
         self.allocator = TpuAllocator()
 
@@ -168,10 +175,10 @@ class ServeSupervisor:
             self._coordinator = await CoordinatorServer(port=0).start()
             self.coordinator_url = self._coordinator.url
         entry = self._load_entry()
-        for svc in reversed(entry.closure()):
-            env_extra = self.allocator.allocate(svc)
+        for svc in entry.boot_order():
             for worker_idx in range(svc.workers):
-                self._spawn(svc, worker_idx, env_extra)
+                # each worker process gets its own exclusive chips
+                self._spawn(svc, worker_idx, self.allocator.allocate(svc))
 
     def _spawn(self, svc: DynamoService, worker_idx: int, env_extra: dict) -> None:
         env = dict(os.environ)
@@ -179,6 +186,7 @@ class ServeSupervisor:
         env.update(self.config.to_env())
         env["DYNTPU_COORDINATOR"] = self.coordinator_url
         key = f"{svc.name}:{worker_idx}"
+        self._envs[key] = dict(env_extra)
         self.procs[key] = subprocess.Popen(
             [
                 sys.executable,
@@ -204,9 +212,11 @@ class ServeSupervisor:
                 name, _, idx = key.partition(":")
                 if self.restart and code != 0:
                     log.warning("%s exited %s — restarting", key, code)
-                    self._spawn(by_name[name], int(idx), {})
+                    # respawn with the same chip pinning / platform guard
+                    self._spawn(by_name[name], int(idx), self._envs[key])
                 else:
                     del self.procs[key]
+                    self.allocator.release(self._envs.pop(key, {}))
 
     async def stop(self) -> None:
         for proc in self.procs.values():

@@ -1,0 +1,135 @@
+"""Native C++ library tests — parity with the pure-Python implementations.
+
+The native lib (native/) supplies the KV prefix index, batched block
+gather/scatter, and the C event-queue API.  These tests auto-build it via
+make; they are skipped only if no toolchain is available.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from dynamo_tpu import native
+from dynamo_tpu.llm.kv.events import KvRemovedEvent, KvStoredEvent
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+def test_native_index_basic():
+    ix = native.NativeKvIndex()
+    ix.store(1, [10, 20, 30])
+    ix.store(2, [10, 20])
+    assert ix.find_matches([10, 20, 30, 40]) == {1: 3, 2: 2}
+    assert ix.num_blocks == 3
+    ix.remove(1, [30])
+    assert ix.find_matches([10, 20, 30]) == {1: 2, 2: 2}
+    ix.remove_worker(2)
+    assert ix.find_matches([10, 20]) == {1: 2}
+    ix.clear()
+    assert ix.num_blocks == 0
+
+
+def test_native_index_matches_python_on_random_stream():
+    """Drive the same random event stream through both implementations."""
+    rng = random.Random(7)
+    py = KvIndexer(use_native=False)
+    nat = KvIndexer(use_native=True)
+    assert nat.is_native and not py.is_native
+
+    hashes = [rng.getrandbits(64) for _ in range(200)]
+    workers = [1, 2, 3, 7]
+    for step in range(500):
+        w = rng.choice(workers)
+        if rng.random() < 0.6:
+            start = rng.randrange(0, len(hashes) - 8)
+            ev = KvStoredEvent(block_hashes=hashes[start : start + rng.randrange(1, 8)])
+        else:
+            ev = KvRemovedEvent(
+                block_hashes=rng.sample(hashes, rng.randrange(1, 6))
+            )
+        py.apply_event(w, ev, event_id=step)
+        nat.apply_event(w, ev, event_id=step)
+        if step % 100 == 99:
+            dead = rng.choice(workers)
+            py.remove_worker(dead)
+            nat.remove_worker(dead)
+
+    assert py.num_blocks == nat.num_blocks
+    for _ in range(50):
+        start = rng.randrange(0, len(hashes) - 16)
+        query = hashes[start : start + 16]
+        assert py.find_matches(query).scores == nat.find_matches(query).scores
+
+
+def test_blocks_gather_scatter_roundtrip():
+    rng = np.random.default_rng(0)
+    pool = rng.standard_normal((64, 2, 4, 16, 8)).astype(np.float32)
+    ids = [5, 0, 63, 17, 17, 2]
+    got = native.blocks_gather(pool, ids)
+    np.testing.assert_array_equal(got, pool[ids])
+
+    dst = np.zeros_like(pool)
+    native.blocks_scatter(dst, ids, got)
+    np.testing.assert_array_equal(dst[ids], pool[ids])
+    untouched = sorted(set(range(64)) - set(ids))
+    assert not dst[untouched].any()
+
+
+def test_blocks_gather_large_parallel():
+    # Cross the 4 MiB parallel threshold to exercise the threaded path.
+    pool = np.arange(512 * 8192, dtype=np.float32).reshape(512, 8192)
+    ids = np.random.default_rng(1).permutation(512)[:300]
+    got = native.blocks_gather(pool, ids, threads=4)
+    np.testing.assert_array_equal(got, pool[ids])
+
+
+def test_event_queue_roundtrip_and_overflow():
+    q = native.NativeEventQueue(capacity=3)
+    assert q.publish(native.EVENT_STORED, 0, [1, 2, 3])
+    assert q.publish(native.EVENT_REMOVED, 0, [2])
+    assert q.publish(native.EVENT_STORED, 99, [7])
+    assert not q.publish(native.EVENT_STORED, 0, [8])  # full -> dropped
+    assert q.dropped == 1
+
+    evs = q.drain()
+    assert evs == [
+        (native.EVENT_STORED, 0, [1, 2, 3]),
+        (native.EVENT_REMOVED, 0, [2]),
+        (native.EVENT_STORED, 99, [7]),
+    ]
+    assert q.drain() == []
+    # drained -> capacity available again
+    assert q.publish(native.EVENT_STORED, 0, [9])
+
+
+def test_event_queue_oversized_event_dropped_not_wedged():
+    q = native.NativeEventQueue(capacity=8)
+    q.publish(native.EVENT_STORED, 0, list(range(10)))  # > hashes_cap below
+    q.publish(native.EVENT_STORED, 0, [1])
+    evs = q.drain(max_events=8, hashes_cap=4)
+    assert evs == [(native.EVENT_STORED, 0, [1])]  # oversized dropped, queue alive
+    assert q.dropped == 1
+
+
+def test_blocks_native_bounds_checked():
+    pool = np.zeros((4, 8), dtype=np.float32)
+    with pytest.raises(IndexError):
+        native.blocks_gather(pool, [0, 4])
+    with pytest.raises(IndexError):
+        native.blocks_scatter(pool, [-1], np.zeros((1, 8), dtype=np.float32))
+    with pytest.raises(ValueError):
+        native.blocks_scatter(pool, [0, 1], np.zeros((1, 8), dtype=np.float32))
+
+
+def test_kv_indexer_auto_uses_native():
+    ix = KvIndexer()
+    assert ix.is_native
+    ix.apply_event(4, KvStoredEvent(block_hashes=[11, 22]))
+    assert ix.find_matches([11, 22, 33]).scores == {4: 2}
+    assert ix.workers() == [4]

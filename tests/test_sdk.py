@@ -121,6 +121,34 @@ def test_link_closure_prunes_unlinked():
     }
 
 
+def test_boot_order_is_reverse_topological():
+    Frontend, Middle, Backend, Unused = _toy_services()
+    order = [s.name for s in Frontend.boot_order()]
+    # every service boots after everything it depends on / links to
+    assert order.index("Backend") < order.index("Middle") < order.index("Frontend")
+
+    # diamond: entry A depends on B and links C, C also depends on B.
+    # DFS-preorder-reversed would boot C before B; postorder must not.
+    from dynamo_tpu.sdk.service import depends, service
+
+    @service(dynamo={"namespace": "t"})
+    class B:
+        pass
+
+    @service(dynamo={"namespace": "t"})
+    class C:
+        b = depends(B)
+
+    @service(dynamo={"namespace": "t"})
+    class A:
+        b = depends(B)
+
+    A.link(C)
+    order = [s.name for s in A.boot_order()]
+    assert order.index("B") < order.index("C")
+    assert order.index("B") < order.index("A")
+
+
 # ---------------------------------------------------------- in-process e2e ----
 
 
