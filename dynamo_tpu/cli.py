@@ -67,7 +67,9 @@ def _build_local_engine(args) -> tuple[object, object]:
 
 async def _build_out_engine(args, runtime=None):
     """Resolve out= to a ParsedRequest-level engine (full local pipeline or
-    a remote endpoint client)."""
+    a remote endpoint client).  Returns (pipeline, card, raw_engine) — the
+    raw engine is what worker-side publishers hook into (the pipeline
+    wrapper hides .core)."""
     from dynamo_tpu.llm.engines import build_serving_pipeline
 
     if args.out.startswith("dyn://"):
@@ -75,9 +77,9 @@ async def _build_out_engine(args, runtime=None):
 
         ns, comp, ep = parse_endpoint_url(args.out)
         client = await runtime.namespace(ns).component(comp).endpoint(ep).client()
-        return client, None
+        return client, None, None
     engine, card = _build_local_engine(args)
-    return build_serving_pipeline(engine, card), card
+    return build_serving_pipeline(engine, card), card, engine
 
 
 def _runtime_config(args):
@@ -102,7 +104,7 @@ async def _cmd_run(args) -> None:
     needs_runtime = args.out.startswith("dyn://") or args.inp.startswith("dyn://")
     runtime = await DistributedRuntime.connect(_runtime_config(args)) if needs_runtime else None
 
-    engine, card = await _build_out_engine(args, runtime)
+    engine, card, raw_engine = await _build_out_engine(args, runtime)
     model_name = args.model_name or (card.name if card else "model")
 
     if args.inp.startswith("dyn://"):
@@ -111,6 +113,7 @@ async def _cmd_run(args) -> None:
 
         ns, comp, ep = parse_endpoint_url(args.inp)
         await runtime.namespace(ns).component(comp).endpoint(ep).serve(engine)
+        _attach_worker_publishers(runtime, raw_engine, ns)
         log.info("serving %s at %s — ctrl-c to stop", model_name, args.inp)
         await asyncio.Event().wait()
 
@@ -200,6 +203,23 @@ async def _batch(engine, model_name: str, path: Path, args) -> None:
     )
 
 
+def _attach_worker_publishers(runtime, engine, namespace: str) -> None:
+    """Real-engine worker: publish KV events + ForwardPassMetrics so the
+    smart router and metrics component see this worker (publisher.rs
+    parity).  No-op for engines without a core (echo, remote clients)."""
+    core = getattr(engine, "core", None)
+    if core is None and engine is not None and hasattr(engine, "_engine"):
+        core = getattr(engine._engine, "core", None)  # pipeline-wrapped engine
+    if core is None or not hasattr(core, "block_manager"):
+        return
+    from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, KvMetricsPublisher
+
+    wid = runtime.instance_id
+    events = KvEventPublisher(runtime.coordinator, wid, namespace).start()
+    core.block_manager.event_sink = events.sink
+    KvMetricsPublisher(runtime.coordinator, wid, core.metrics, namespace).start()
+
+
 # ------------------------------------------------------------------ serve -----
 
 
@@ -265,6 +285,48 @@ async def _cmd_http(args) -> None:
     await asyncio.Event().wait()
 
 
+# ---------------------------------------------------------------- metrics -----
+
+
+async def _cmd_metrics(args) -> None:
+    """Standalone metrics aggregation service (components/metrics parity):
+    Prometheus /metrics fed by worker ForwardPassMetrics + kv_hit_rate."""
+    from dynamo_tpu.components.metrics import MetricsService
+    from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient
+
+    coord = await CoordinatorClient(
+        args.coordinator or "tcp://127.0.0.1:6180"
+    ).connect()
+    svc = await MetricsService(
+        coord,
+        namespace=args.namespace or "dynamo",
+        host=args.host,
+        port=args.port,
+        push_url=args.push_url,
+    ).start()
+    log.info("metrics on http://%s:%s/metrics", svc.host, svc.port)
+    await asyncio.Event().wait()
+
+
+async def _cmd_mock_worker(args) -> None:
+    """GPU/TPU-free fake worker for exercising the router + metrics stack
+    (components/metrics/src/bin/mock_worker.rs parity)."""
+    from dynamo_tpu.components.mock_worker import MockWorker
+    from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient
+
+    coord = await CoordinatorClient(
+        args.coordinator or "tcp://127.0.0.1:6180"
+    ).connect()
+    workers = [
+        await MockWorker(
+            coord, worker_id=args.worker_id + i, namespace=args.namespace or "dynamo"
+        ).start()
+        for i in range(args.count)
+    ]
+    log.info("%d mock worker(s) publishing — ctrl-c to stop", len(workers))
+    await asyncio.Event().wait()
+
+
 # ----------------------------------------------------------------- models -----
 
 
@@ -327,6 +389,17 @@ def _parser() -> argparse.ArgumentParser:
     http.add_argument("--http-port", type=int, default=8080)
     common(http)
 
+    metrics = sub.add_parser("metrics", help="metrics aggregation service (Prometheus)")
+    metrics.add_argument("--host", default="127.0.0.1")
+    metrics.add_argument("--port", type=int, default=9091)
+    metrics.add_argument("--push-url", default=None, help="pushgateway URL (push mode)")
+    common(metrics)
+
+    mock = sub.add_parser("mock-worker", help="fake worker publishing metrics/KV events")
+    mock.add_argument("--worker-id", type=int, default=1)
+    mock.add_argument("--count", type=int, default=1)
+    common(mock)
+
     models = sub.add_parser("models", help="manage model registrations (llmctl)")
     models.add_argument("action", choices=["add", "list", "remove"])
     models.add_argument("name", nargs="?")
@@ -350,6 +423,10 @@ def main(argv: Optional[list[str]] = None) -> None:
         asyncio.run(_cmd_serve(args))
     elif args.cmd == "http":
         asyncio.run(_cmd_http(args))
+    elif args.cmd == "metrics":
+        asyncio.run(_cmd_metrics(args))
+    elif args.cmd == "mock-worker":
+        asyncio.run(_cmd_mock_worker(args))
     elif args.cmd == "models":
         asyncio.run(_cmd_models(args))
 

@@ -1,0 +1,6 @@
+"""Standalone deployable components (reference components/{http,router,metrics}).
+
+The http frontend and router live behind the CLI (`dynamo-tpu http`,
+`dynamo-tpu run in=dyn`); this package holds the metrics aggregation
+service and the GPU-free mock worker used to exercise it.
+"""

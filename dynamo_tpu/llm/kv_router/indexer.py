@@ -103,8 +103,9 @@ class KvIndexer:
             self._last_event_id[worker_id] = event_id
 
         if self._native is not None:
-            self._worker_blocks.setdefault(worker_id, set())  # workers() listing
             if isinstance(event, KvStoredEvent):
+                # workers() listing tracks Stored only (matches Python path)
+                self._worker_blocks.setdefault(worker_id, set())
                 self._native.store(worker_id, event.block_hashes)
             elif isinstance(event, KvRemovedEvent):
                 self._native.remove(worker_id, event.block_hashes)

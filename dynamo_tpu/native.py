@@ -183,13 +183,17 @@ def _check_ids(idx: np.ndarray, n_blocks: int) -> None:
 
 
 def blocks_gather(src: np.ndarray, ids: Sequence[int], threads: int = 0) -> np.ndarray:
-    """Gather src[ids] (axis 0) into a fresh contiguous array via native memcpy."""
+    """Gather src[ids] (axis 0) into a fresh contiguous array via native memcpy.
+
+    Same semantics regardless of backend: ids are bounds-checked (no
+    negative-index wrapping) and a non-contiguous pool falls back to numpy
+    fancy indexing rather than copying the whole pool to linearise it.
+    """
     lib = load()
-    src = np.ascontiguousarray(src)
     idx = np.ascontiguousarray(ids, dtype=np.int64)
-    if lib is None:
-        return np.ascontiguousarray(src[idx])
     _check_ids(idx, src.shape[0])
+    if lib is None or not src.flags.c_contiguous:
+        return np.ascontiguousarray(src[idx])
     out = np.empty((len(idx),) + src.shape[1:], dtype=src.dtype)
     block_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
     lib.dyn_blocks_gather(
@@ -200,16 +204,28 @@ def blocks_gather(src: np.ndarray, ids: Sequence[int], threads: int = 0) -> np.n
 
 
 def blocks_scatter(dst: np.ndarray, ids: Sequence[int], src: np.ndarray, threads: int = 0) -> None:
-    """Scatter src rows into dst[ids] (axis 0) in place via native memcpy."""
+    """Scatter src rows into dst[ids] (axis 0) in place via native memcpy.
+
+    Validation is identical on both backends (shape match, bounds-checked
+    ids).  Duplicate ids resolve last-write-wins like numpy — the native
+    threaded path would race on duplicates, so they are deduplicated first.
+    """
     lib = load()
     idx = np.ascontiguousarray(ids, dtype=np.int64)
-    if lib is None or not dst.flags.c_contiguous:
-        dst[idx] = src
-        return
-    src = np.ascontiguousarray(src, dtype=dst.dtype)
+    src = np.asarray(src)
     if src.shape != (len(idx),) + dst.shape[1:]:
         raise ValueError(f"scatter shape mismatch: src {src.shape} vs {(len(idx),) + dst.shape[1:]}")
     _check_ids(idx, dst.shape[0])
+    if lib is None or not dst.flags.c_contiguous:
+        dst[idx] = src
+        return
+    if len(np.unique(idx)) != len(idx):
+        # keep the LAST occurrence of each id (numpy scatter semantics)
+        last = {int(b): i for i, b in enumerate(idx)}
+        keep = np.fromiter(last.values(), dtype=np.int64)
+        idx = idx[keep]
+        src = src[keep]
+    src = np.ascontiguousarray(src, dtype=dst.dtype)
     block_bytes = dst.dtype.itemsize * int(np.prod(dst.shape[1:], dtype=np.int64))
     lib.dyn_blocks_scatter(
         dst.ctypes.data_as(_u8p), block_bytes,

@@ -117,6 +117,14 @@ def test_event_queue_oversized_event_dropped_not_wedged():
     assert q.dropped == 1
 
 
+def test_blocks_scatter_duplicate_ids_last_write_wins():
+    pool = np.zeros((4, 8), dtype=np.float32)
+    src = np.stack([np.full(8, 1.0), np.full(8, 2.0), np.full(8, 3.0)]).astype(np.float32)
+    native.blocks_scatter(pool, [2, 1, 2], src)
+    assert pool[2][0] == 3.0  # last occurrence wins, like numpy
+    assert pool[1][0] == 2.0
+
+
 def test_blocks_native_bounds_checked():
     pool = np.zeros((4, 8), dtype=np.float32)
     with pytest.raises(IndexError):
