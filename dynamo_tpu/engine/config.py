@@ -25,6 +25,7 @@ class EngineConfig:
     # paged cache
     block_size: int = 16
     num_blocks: int = 512             # cache blocks in HBM
+    num_host_blocks: int = 0          # host-RAM offload tier (0 = disabled)
     cache_dtype: Optional[str] = None  # default: model dtype
     enable_prefix_reuse: bool = True
     # prefill

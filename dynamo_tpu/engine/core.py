@@ -37,7 +37,7 @@ import numpy as np
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.request import EngineRequest, RequestState
 from dynamo_tpu.engine.sampling import sample_tokens
-from dynamo_tpu.ops.block_copy import gather_blocks, scatter_blocks_inplace
+from dynamo_tpu.ops.block_copy import gather_blocks_padded, scatter_blocks_inplace
 from dynamo_tpu.llm.kv.block_manager import KvBlockManager, NoFreeBlocks
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput
 from dynamo_tpu.models.llama import LlamaModel
@@ -83,6 +83,24 @@ class EngineCore:
             config.block_size,
             enable_prefix_reuse=config.enable_prefix_reuse,
         )
+        # host-RAM offload tier: device-evicted blocks stay restorable
+        # (ref kv/reuse.rs + layer.rs copy streams; SURVEY §5 checkpoint row)
+        self.host_pool = None
+        self._pending_offload: list[tuple[int, int]] = []  # (device bid, seq_hash)
+        if config.num_host_blocks > 0:
+            if not config.enable_prefix_reuse:
+                log.warning(
+                    "num_host_blocks=%d ignored: host offload needs "
+                    "enable_prefix_reuse=True (blocks are keyed by prefix hash)",
+                    config.num_host_blocks,
+                )
+            else:
+                from dynamo_tpu.llm.kv.host_pool import HostKvPool
+
+                self.host_pool = HostKvPool(config.num_host_blocks)
+                self.block_manager.offload_sink = (
+                    lambda bid, seq_hash, parent: self._pending_offload.append((bid, seq_hash))
+                )
 
         cache_dtype = config.cache_dtype or model.config.dtype
         cache = model.init_kv_cache(config.num_blocks, config.block_size, cache_dtype)
@@ -183,7 +201,7 @@ class EngineCore:
     def metrics(self) -> dict:
         """ForwardPassMetrics equivalent (ref kv_router/protocols.rs:30-47)."""
         active = sum(1 for s in self.slots if s is not None)
-        return {
+        out = {
             "request_active_slots": active,
             "request_total_slots": self.config.max_batch_size,
             "kv_active_blocks": self.block_manager.active_blocks,
@@ -192,10 +210,14 @@ class EngineCore:
             "kv_usage_perc": self.block_manager.usage,
             "tokens_generated": self.tokens_generated,
         }
+        if self.host_pool is not None:
+            out.update(self.host_pool.stats())
+        return out
 
     # -------------------------------------------------------------- main loop
     def step(self) -> bool:
         """Run one scheduling iteration.  Returns False when idle."""
+        self._drain_offload()  # evictions from the previous step's tail
         self._process_ops()
         self._process_aborts()
         self._admit()
@@ -275,7 +297,12 @@ class EngineCore:
                 break  # retry next step once blocks free up
             req.block_ids = alloc.block_ids
             req.cached_tokens = alloc.cached_tokens
-            req.computed_tokens = alloc.cached_tokens
+            if self.host_pool is not None:
+                # allocation may have evicted registered blocks — capture
+                # their content BEFORE restore writes into the same ids
+                self._drain_offload()
+                self._restore_from_host(req)
+            req.computed_tokens = req.cached_tokens
             req.slot = slot
             req.state = (
                 RequestState.REMOTE_PREFILL if req.remote_prefill else RequestState.PREFILL
@@ -389,6 +416,9 @@ class EngineCore:
 
         if not active:
             return
+        # growth allocations above may have evicted registered blocks that
+        # this very step writes into — offload them first
+        self._drain_offload()
         sampled = self._run_step(
             tokens, positions, bt, seq_lens, slot_idx, last_idx, temp, top_k, top_p
         )
@@ -475,12 +505,54 @@ class EngineCore:
         if ids:
             self.block_manager.release(ids)
 
+    # ------------------------------------------------------ host offload tier
+    def _drain_offload(self) -> None:
+        """Offload just-evicted device blocks to the host pool in one
+        batched HBM→host gather (the CopyStream analogue, kv/layer.rs:619).
+        Must run before anything overwrites the evicted block ids."""
+        if self.host_pool is None or not self._pending_offload:
+            return
+        pending, self._pending_offload = self._pending_offload, []
+        # re-evictions of host-resident content only need an LRU refresh —
+        # skip the HBM gather for them
+        self.host_pool.touch([h for _, h in pending if h in self.host_pool])
+        fresh = [(b, h) for b, h in pending if h not in self.host_pool]
+        if not fresh:
+            return
+        bids = [b for b, _ in fresh]
+        hashes = [h for _, h in fresh]
+        arr = self.gather_blocks_np(bids)        # [L, 2, n, Bs, HkD]
+        self.host_pool.store(hashes, np.moveaxis(arr, 2, 0))
+
+    def _restore_from_host(self, req: EngineRequest) -> None:
+        """Upload host-resident prefix blocks into the request's fresh
+        device blocks, register them, and extend the cached prefix —
+        turning a device cache miss into a host hit (TTFT win, ref
+        docs/architecture.md:87-93)."""
+        bs = self.config.block_size
+        dev = req.cached_tokens // bs
+        max_blocks = (req.prompt_len - 1) // bs  # >=1 token must remain
+        hit = self.host_pool.match_prefix(
+            [b.sequence_hash for b in req.seq.blocks[dev:max_blocks]]
+        )
+        if not hit:
+            return
+        blocks = self.host_pool.gather(hit)      # [n, L, 2, Bs, HkD]
+        target = req.block_ids[dev : dev + len(hit)]
+        self.scatter_external(target, np.moveaxis(blocks, 0, 2))
+        for i in range(len(hit)):
+            blk = req.seq.blocks[dev + i]
+            self.block_manager.commit(
+                target[i], blk.sequence_hash, blk.parent_sequence_hash, list(blk.tokens)
+            )
+        req.cached_tokens += len(hit) * bs
+
     def gather_blocks_np(self, block_ids: list[int]) -> np.ndarray:
         """Stage blocks to host RAM: [L, 2, n, Bs, HkD] ndarray.  Under a
         sharded mesh this all-gathers KV heads — which is exactly the
         TP-resharding the reference needs a Triton kernel for
         (kv_rearrange.py); here the host staging buffer is layout-neutral."""
-        out = gather_blocks(self.cache, jnp.asarray(block_ids, jnp.int32))
+        out = gather_blocks_padded(self.cache, block_ids)
         return np.asarray(jax.device_get(out))
 
     def scatter_external(

@@ -1,0 +1,157 @@
+"""Host-RAM KV offload tier: evicted device blocks stay reusable.
+
+Reference parity: the HBM→CPU KV offload tier (lib/llm/src/kv/reuse.rs
+state-preserving pool + kv/layer.rs:619 CopyStream device↔pinned-host copy
+orchestration; docs/architecture.md:87-93 claims +40% TTFT from it).
+
+TPU translation: the device side is XLA gather/scatter over the paged
+cache's block axis (dynamo_tpu/ops/block_copy.py); this module owns the
+host side — one big numpy pool (block-major, so a block is one contiguous
+row) moved with the native threaded memcpy (native/src/block_copy.cpp),
+plus the hash→block bookkeeping: LRU eviction, chained-sequence-hash
+prefix matching, content-addressed dedupe.
+
+Single-writer: called only from the engine loop (same discipline as
+KvBlockManager).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+from typing import Optional, Sequence
+
+import numpy as np
+
+from dynamo_tpu import native
+
+__all__ = ["HostKvPool"]
+
+
+class HostKvPool:
+    """Fixed-capacity host pool of KV blocks keyed by sequence hash.
+
+    The backing array is allocated lazily on the first ``store`` (the
+    engine knows a block's host-side shape only after the first gather).
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks <= 0:
+            raise ValueError("num_blocks must be positive")
+        self.num_blocks = num_blocks
+        self._arr: Optional[np.ndarray] = None  # [H, ...block shape...]
+        self._free: deque[int] = deque(range(num_blocks))
+        self._lru: "OrderedDict[int, None]" = OrderedDict()  # hid -> (order)
+        self._hash_of: list[Optional[int]] = [None] * num_blocks
+        self._table: dict[int, int] = {}  # seq_hash -> hid
+        # stats
+        self.stored_blocks = 0
+        self.restored_blocks = 0
+        self.evicted_blocks = 0
+
+    # ------------------------------------------------------------------ state
+    @property
+    def resident(self) -> int:
+        return len(self._table)
+
+    @property
+    def block_nbytes(self) -> int:
+        if self._arr is None:
+            return 0
+        return self._arr[0].nbytes
+
+    def __contains__(self, seq_hash: int) -> bool:
+        return seq_hash in self._table
+
+    # ------------------------------------------------------------------ store
+    def _ensure_arr(self, block_shape: tuple[int, ...], dtype) -> None:
+        if self._arr is None:
+            self._arr = np.empty((self.num_blocks,) + block_shape, dtype=dtype)
+        elif self._arr.shape[1:] != block_shape or self._arr.dtype != dtype:
+            raise ValueError(
+                f"block shape changed: pool {self._arr.shape[1:]}/{self._arr.dtype}"
+                f" vs incoming {block_shape}/{dtype}"
+            )
+
+    def _alloc(self) -> int:
+        if self._free:
+            return self._free.popleft()
+        hid, _ = self._lru.popitem(last=False)  # oldest
+        old = self._hash_of[hid]
+        if old is not None:
+            del self._table[old]
+            self._hash_of[hid] = None
+            self.evicted_blocks += 1
+        return hid
+
+    def store(self, seq_hashes: Sequence[int], blocks: np.ndarray) -> int:
+        """Offload blocks (block-major: blocks[i] belongs to seq_hashes[i]).
+
+        Already-resident hashes are refreshed in LRU order but not
+        re-copied.  Returns how many new blocks were written.
+        """
+        if len(seq_hashes) != len(blocks):
+            raise ValueError(f"{len(seq_hashes)} hashes vs {len(blocks)} blocks")
+        self._ensure_arr(blocks.shape[1:], blocks.dtype)
+        new_ids: list[int] = []
+        new_rows: list[int] = []
+        for i, h in enumerate(seq_hashes):
+            hid = self._table.get(h)
+            if hid is not None:
+                self._lru.move_to_end(hid)
+                continue
+            hid = self._alloc()
+            self._table[h] = hid
+            self._hash_of[hid] = h
+            self._lru[hid] = None
+            new_ids.append(hid)
+            new_rows.append(i)
+        if new_ids:
+            # fancy indexing already yields a fresh contiguous array
+            native.blocks_scatter(self._arr, new_ids, blocks[new_rows])
+            self.stored_blocks += len(new_ids)
+        return len(new_ids)
+
+    def touch(self, seq_hashes: Sequence[int]) -> None:
+        """Refresh LRU order for resident hashes (no copy)."""
+        for h in seq_hashes:
+            hid = self._table.get(h)
+            if hid is not None:
+                self._lru.move_to_end(hid)
+
+    # ------------------------------------------------------------------ fetch
+    def match_prefix(self, seq_hashes: Sequence[int]) -> list[int]:
+        """Longest resident prefix of ``seq_hashes`` (chained hashes commit
+        to their prefix, so element-wise probing is a true prefix match)."""
+        out: list[int] = []
+        for h in seq_hashes:
+            if h not in self._table:
+                break
+            out.append(h)
+        return out
+
+    def gather(self, seq_hashes: Sequence[int]) -> np.ndarray:
+        """Fetch resident blocks (block-major) for upload back to device."""
+        hids = []
+        for h in seq_hashes:
+            hid = self._table.get(h)
+            if hid is None:
+                raise KeyError(f"block {h:#x} not resident in host pool")
+            self._lru.move_to_end(hid)
+            hids.append(hid)
+        self.restored_blocks += len(hids)
+        return native.blocks_gather(self._arr, hids)
+
+    def clear(self) -> None:
+        self._table.clear()
+        self._lru.clear()
+        self._hash_of = [None] * self.num_blocks
+        self._free = deque(range(self.num_blocks))
+
+    def stats(self) -> dict:
+        return {
+            "host_blocks_resident": self.resident,
+            "host_blocks_total": self.num_blocks,
+            "host_blocks_stored": self.stored_blocks,
+            "host_blocks_restored": self.restored_blocks,
+            "host_blocks_evicted": self.evicted_blocks,
+        }

@@ -18,7 +18,12 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["gather_blocks", "scatter_blocks", "scatter_blocks_inplace"]
+__all__ = [
+    "gather_blocks",
+    "gather_blocks_padded",
+    "scatter_blocks",
+    "scatter_blocks_inplace",
+]
 
 
 @jax.jit
@@ -39,6 +44,21 @@ def scatter_blocks(
     cache: [L,2,N,Bs,HkD]; blocks: [L,2,n,Bs,HkD]; block_ids: [n].
     """
     return cache.at[:, :, block_ids].set(blocks.astype(cache.dtype))
+
+
+def gather_blocks_padded(cache: jax.Array, block_ids) -> jax.Array:
+    """gather_blocks with the id count padded to a power of two (duplicating
+    the last id, sliced off after) so arbitrary eviction/transfer batch
+    sizes reuse O(log n) compiled executables instead of one per size."""
+    import numpy as np
+
+    n = len(block_ids)
+    ids = np.asarray(block_ids, np.int32)
+    padded = 1 << max(0, (n - 1).bit_length())
+    if padded != n:
+        ids = np.concatenate([ids, np.full(padded - n, ids[-1], np.int32)])
+    out = gather_blocks(cache, jnp.asarray(ids))
+    return out[:, :, :n] if padded != n else out
 
 
 _scatter_donated = jax.jit(

@@ -1,0 +1,102 @@
+"""Host-RAM KV offload tier: pool bookkeeping + engine-level offload/restore.
+
+The engine test is the money path: fill the device cache, force eviction
+with other traffic, then replay the original prompt — its prefix must come
+back from the host pool (cached_tokens > 0) with bit-identical decoding.
+"""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, EngineCore
+from dynamo_tpu.engine.request import EngineRequest
+from dynamo_tpu.llm.kv.host_pool import HostKvPool
+from dynamo_tpu.llm.protocols import SamplingOptions, StopConditions
+from tests.test_engine import collect_greedy, setup  # noqa: F401  (fixture)
+
+
+# ------------------------------------------------------------- pool unit ----
+
+
+def _blocks(n, shape=(2, 4), seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n,) + shape).astype(np.float32)
+
+
+def test_pool_store_match_gather_roundtrip():
+    pool = HostKvPool(8)
+    data = _blocks(3)
+    assert pool.store([11, 22, 33], data) == 3
+    assert pool.match_prefix([11, 22, 33, 44]) == [11, 22, 33]
+    assert pool.match_prefix([22, 33]) == [22, 33]  # chained hashes → any subchain
+    np.testing.assert_array_equal(pool.gather([22, 33]), data[1:])
+    # re-store of resident hashes copies nothing new
+    assert pool.store([11, 22], _blocks(2, seed=9)) == 0
+    np.testing.assert_array_equal(pool.gather([11]), data[:1])
+
+
+def test_pool_lru_eviction():
+    pool = HostKvPool(4)
+    pool.store([1, 2, 3, 4], _blocks(4))
+    pool.gather([1])  # touch 1 → 2 becomes oldest
+    pool.store([5], _blocks(1, seed=1))
+    assert 2 not in pool
+    assert all(h in pool for h in (1, 3, 4, 5))
+    assert pool.evicted_blocks == 1
+
+
+def test_pool_rejects_shape_change():
+    pool = HostKvPool(4)
+    pool.store([1], _blocks(1))
+    with pytest.raises(ValueError):
+        pool.store([2], _blocks(1, shape=(3, 3)))
+
+
+# --------------------------------------------------------- engine offload ----
+
+
+def _offload_core(model, params):
+    cfg = EngineConfig(
+        max_batch_size=2,
+        max_model_len=64,
+        block_size=8,
+        num_blocks=8,            # tiny device pool → eviction pressure
+        num_host_blocks=32,
+        prefill_buckets=[16, 32, 64],
+    )
+    return EngineCore(model, params, cfg)
+
+
+def test_evicted_prefix_restored_from_host(setup):  # noqa: F811
+    hf, model, params = setup
+    rng = np.random.RandomState(7)
+    prompt = list(rng.randint(1, 128, size=24))  # 3 full blocks
+
+    core = _offload_core(model, params)
+    got1, _, _ = collect_greedy(core, prompt, 6, request_id="a")
+
+    # churn the tiny device pool until the original blocks are evicted
+    for i in range(4):
+        other = list(rng.randint(1, 128, size=24))
+        collect_greedy(core, other, 2, request_id=f"churn{i}")
+    assert core.host_pool.stored_blocks > 0, "eviction should have offloaded"
+
+    # replay: the prefix must be restored from host, and decode identically
+    got2, outs2, req2 = collect_greedy(core, prompt, 6, request_id="b")
+    assert req2.cached_tokens > 0, "host restore should shorten prefill"
+    assert core.host_pool.restored_blocks > 0
+    assert got2 == got1
+
+    stats = core.metrics()
+    assert stats["host_blocks_restored"] >= req2.cached_tokens // 8
+
+
+def test_offload_disabled_by_default(setup):  # noqa: F811
+    hf, model, params = setup
+    cfg = EngineConfig(max_batch_size=2, max_model_len=64, block_size=8, num_blocks=8,
+                       prefill_buckets=[16, 32, 64])
+    core = EngineCore(model, params, cfg)
+    assert core.host_pool is None
+    prompt = list(np.random.RandomState(3).randint(1, 128, size=16))
+    collect_greedy(core, prompt, 4)
+    assert "host_blocks_resident" not in core.metrics()
