@@ -14,6 +14,16 @@ from typing import Optional
 
 import jax.numpy as jnp
 
+# Llama-family architectures the unified decoder serves (reference parity:
+# vLLM's model zoo; these cover the reference's example deployments —
+# Llama/R1-Distill, Mistral, Mixtral MoE, Qwen).
+SUPPORTED_ARCHITECTURES = {
+    "LlamaForCausalLM",
+    "MistralForCausalLM",
+    "MixtralForCausalLM",
+    "Qwen2ForCausalLM",
+}
+
 
 @dataclass
 class ModelConfig:
@@ -28,6 +38,11 @@ class ModelConfig:
     rms_norm_eps: float = 1e-5
     max_position_embeddings: int = 4096
     tie_word_embeddings: bool = False
+    # Qwen2-style QKV projection bias (o_proj stays bias-free)
+    attention_bias: bool = False
+    # Mistral sliding-window size (metadata; full attention is a superset —
+    # exact up to window length, the common serving regime)
+    sliding_window: Optional[int] = None
     # MoE (Mixtral-style); num_experts == 0 → dense MLP
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -72,6 +87,13 @@ class ModelConfig:
             cfg = json.loads(p.read_text())
         else:
             cfg = dict(path_or_dict)
+        archs = cfg.get("architectures") or []
+        arch = archs[0] if archs else "LlamaForCausalLM"
+        if arch not in SUPPORTED_ARCHITECTURES:
+            raise ValueError(
+                f"unsupported architecture {arch!r}; supported: "
+                f"{sorted(SUPPORTED_ARCHITECTURES)}"
+            )
         return cls(
             vocab_size=cfg["vocab_size"],
             hidden_size=cfg["hidden_size"],
@@ -84,6 +106,10 @@ class ModelConfig:
             rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
             max_position_embeddings=cfg.get("max_position_embeddings", 4096),
             tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            # HF Qwen2 attention always carries QKV bias; Llama exposes an
+            # explicit attention_bias flag (default False)
+            attention_bias=cfg.get("attention_bias", arch == "Qwen2ForCausalLM"),
+            sliding_window=cfg.get("sliding_window"),
             num_experts=cfg.get("num_local_experts", 0),
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
             dtype=dtype,

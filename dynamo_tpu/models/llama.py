@@ -91,6 +91,12 @@ class LlamaModel:
             "wo": dense(next(keys), (L, hq * dh, dm), hq * dh),
             "mlp_norm": jnp.ones((L, dm), dt),
         }
+        if cfg.attention_bias:  # Qwen2-style QKV bias
+            layers.update(
+                bq=jnp.zeros((L, hq * dh), dt),
+                bk=jnp.zeros((L, hk * dh), dt),
+                bv=jnp.zeros((L, hk * dh), dt),
+            )
         if cfg.is_moe:
             e = cfg.num_experts
             layers.update(
@@ -131,6 +137,10 @@ class LlamaModel:
             "wo": P(None, "model", None),
             "mlp_norm": P(None, None),
         }
+        if cfg.attention_bias:
+            layers.update(
+                bq=P(None, "model"), bk=P(None, "model"), bv=P(None, "model")
+            )
         if cfg.is_moe:
             layers.update(
                 router=P(None, None, None),
@@ -207,9 +217,7 @@ class LlamaModel:
             h, cache = carry
             lp, li = layer_in
             x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
-            q = (x @ lp["wq"]).reshape(b, s, hq, dh)
-            k = (x @ lp["wk"]).reshape(b, s, hk, dh)
-            v = (x @ lp["wv"]).reshape(b, s, hk, dh)
+            q, k, v = _qkv_proj(cfg, lp, x, b, s)
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
             cache = write_kv_cache_layer(cache, li, k, v, slot_idx)
@@ -263,9 +271,7 @@ class LlamaModel:
 
         def layer_step(h, lp):
             x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
-            q = (x @ lp["wq"]).reshape(b, s, hq, dh)
-            k = (x @ lp["wk"]).reshape(b, s, hk, dh)
-            v = (x @ lp["wv"]).reshape(b, s, hk, dh)
+            q, k, v = _qkv_proj(cfg, lp, x, b, s)
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
             attn = ring_attention(
@@ -300,6 +306,21 @@ class LlamaModel:
         return jnp.matmul(
             hidden.astype(w.dtype), w, preferred_element_type=jnp.float32
         )
+
+
+def _qkv_proj(
+    cfg: ModelConfig, lp: dict, x: jax.Array, b: int, s: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """QKV projections (+ Qwen2-style bias when configured)."""
+    dh, hq, hk = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+    q, k, v = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
+    if cfg.attention_bias:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    return (
+        q.reshape(b, s, hq, dh),
+        k.reshape(b, s, hk, dh),
+        v.reshape(b, s, hk, dh),
+    )
 
 
 def _moe_mlp(cfg: ModelConfig, lp: dict, x: jax.Array) -> jax.Array:

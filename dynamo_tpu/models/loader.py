@@ -57,6 +57,12 @@ def load_params_from_state_dict(
             "model.layers.{i}.post_attention_layernorm.weight", transpose=False
         ),
     }
+    if cfg.attention_bias:
+        layers.update(
+            bq=stack("model.layers.{i}.self_attn.q_proj.bias", transpose=False),
+            bk=stack("model.layers.{i}.self_attn.k_proj.bias", transpose=False),
+            bv=stack("model.layers.{i}.self_attn.v_proj.bias", transpose=False),
+        )
     if cfg.is_moe:
         e = cfg.num_experts
 

@@ -101,6 +101,88 @@ def test_chunked_prefill_and_decode_matches_hf(hf_model, ours):
     np.testing.assert_allclose(got, ref, rtol=2e-2, atol=5e-3)
 
 
+def test_qwen2_with_bias_matches_hf():
+    """Qwen2 = Llama + QKV bias (+ typically tied embeddings)."""
+    torch = pytest.importorskip("torch")
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    torch.manual_seed(3)
+    hf_cfg = Qwen2Config(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        tie_word_embeddings=True,
+    )
+    hf = Qwen2ForCausalLM(hf_cfg).eval()
+    d = hf_cfg.to_dict()
+    d["architectures"] = ["Qwen2ForCausalLM"]
+    cfg = ModelConfig.from_hf_config(d, dtype="float32")
+    assert cfg.attention_bias and cfg.tie_word_embeddings
+    model = LlamaModel(cfg)
+    params = load_params_from_state_dict(cfg, hf.state_dict())
+
+    tokens = list(np.random.RandomState(4).randint(0, 128, size=SEQ))
+    import torch as _t
+
+    with _t.no_grad():
+        ref = hf(_t.tensor([tokens])).logits[0].float().numpy()
+    got = _run_ours(model, params, tokens, chunks=[9, 7] + [1] * (SEQ - 16))
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=5e-3)
+
+
+def test_mixtral_moe_matches_hf():
+    """Mixtral top-2 MoE through the paged path vs transformers."""
+    torch = pytest.importorskip("torch")
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    torch.manual_seed(5)
+    hf_cfg = MixtralConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        max_position_embeddings=256,
+        tie_word_embeddings=False,
+    )
+    hf = MixtralForCausalLM(hf_cfg).eval()
+    d = hf_cfg.to_dict()
+    d["architectures"] = ["MixtralForCausalLM"]
+    cfg = ModelConfig.from_hf_config(d, dtype="float32")
+    assert cfg.is_moe and cfg.num_experts == 4
+    model = LlamaModel(cfg)
+    params = load_params_from_state_dict(cfg, hf.state_dict())
+
+    tokens = list(np.random.RandomState(6).randint(0, 128, size=SEQ))
+    import torch as _t
+
+    with _t.no_grad():
+        ref = hf(_t.tensor([tokens])).logits[0].float().numpy()
+    got = _run_ours(model, params, tokens, chunks=[SEQ])
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=5e-3)
+
+
+def test_unsupported_architecture_rejected():
+    with pytest.raises(ValueError, match="unsupported architecture"):
+        ModelConfig.from_hf_config(
+            {
+                "architectures": ["GPTNeoXForCausalLM"],
+                "vocab_size": 128,
+                "hidden_size": 64,
+                "intermediate_size": 128,
+                "num_hidden_layers": 2,
+                "num_attention_heads": 4,
+            }
+        )
+
+
 def test_moe_forward_runs():
     cfg = ModelConfig.tiny(num_experts=4, num_experts_per_tok=2)
     model = LlamaModel(cfg)
