@@ -40,7 +40,12 @@ def _build_local_engine(args) -> tuple[object, object]:
 
     if args.model_path is None:
         raise SystemExit(f"out={args.out} needs --model-path (weights + tokenizer)")
-    card = ModelDeploymentCard.from_hf_dir(args.model_path, name=args.model_name)
+    is_gguf = args.model_path.endswith(".gguf")
+    card = (
+        ModelDeploymentCard.from_gguf(args.model_path, name=args.model_name)
+        if is_gguf
+        else ModelDeploymentCard.from_hf_dir(args.model_path, name=args.model_name)
+    )
 
     if args.out == "echo":
         from dynamo_tpu.llm.engines import EchoEngineCore
@@ -51,7 +56,12 @@ def _build_local_engine(args) -> tuple[object, object]:
     from dynamo_tpu.models.llama import LlamaModel
     from dynamo_tpu.models.loader import load_model_dir
 
-    model_cfg, params = load_model_dir(args.model_path, dtype=args.dtype)
+    if is_gguf:
+        from dynamo_tpu.llm.gguf import load_gguf_model
+
+        model_cfg, params = load_gguf_model(args.model_path, dtype=args.dtype)
+    else:
+        model_cfg, params = load_model_dir(args.model_path, dtype=args.dtype)
     model = LlamaModel(model_cfg)
     cfg = EngineConfig(
         max_batch_size=args.max_batch_size,

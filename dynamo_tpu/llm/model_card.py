@@ -85,3 +85,30 @@ class ModelDeploymentCard:
             bos_token_id=bos,
             chat_template=chat_template,
         )
+
+    @classmethod
+    def from_gguf(cls, gguf_path: str | Path, name: Optional[str] = None) -> "ModelDeploymentCard":
+        """Build a card from GGUF metadata, materialising the embedded
+        tokenizer as a tokenizer.json next to the checkpoint (reference:
+        gguf_metadata.rs + gguf_tokenizer.rs feed MDC creation)."""
+        from dynamo_tpu.llm.gguf import GGUFFile
+
+        p = Path(gguf_path)
+        gf = GGUFFile(p)
+        tok_path = p.with_suffix(".tokenizer.json")
+        if not tok_path.exists():
+            try:
+                gf.build_hf_tokenizer().save(str(tok_path))
+            except ValueError:
+                tok_path = None  # no embedded vocab
+        chat_template = gf.metadata.get("tokenizer.chat_template")
+        bos = gf.metadata.get("tokenizer.ggml.bos_token_id")
+        return cls(
+            name=name or gf.metadata.get("general.name", p.stem),
+            model_path=str(p),
+            tokenizer_path=str(tok_path) if tok_path else None,
+            context_length=int(gf.field("context_length", 4096)),
+            eos_token_ids=gf.eos_token_ids(),
+            bos_token_id=int(bos) if bos is not None else None,
+            chat_template=chat_template,
+        )

@@ -1,0 +1,180 @@
+"""GGUF: container roundtrip, quant dequant, tokenizer extraction, and the
+end-to-end oracle — a tiny HF Llama exported to GGUF (with llama.cpp's
+rope permutation) must load through load_gguf_model and reproduce HF logits.
+"""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.llm.gguf import (
+    GGML_F16,
+    GGML_Q8_0,
+    GGUFFile,
+    load_gguf_model,
+    permute_qk,
+    unpermute_qk,
+    write_gguf,
+)
+
+
+def test_container_roundtrip(tmp_path):
+    path = tmp_path / "t.gguf"
+    meta = {
+        "general.architecture": "llama",
+        "general.name": "tiny",
+        "llama.block_count": 2,
+        "llama.context_length": 256,
+        "llama.rope.freq_base": 10000.0,
+        "tokenizer.ggml.tokens": ["<unk>", "a", "b"],
+        "flag": True,
+    }
+    tensors = {
+        "x": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "y": np.ones((2, 5), dtype=np.float32),
+    }
+    write_gguf(path, meta, tensors, quantize={"y": GGML_F16})
+    gf = GGUFFile(path)
+    assert gf.metadata["general.name"] == "tiny"
+    assert gf.metadata["llama.block_count"] == 2
+    assert gf.metadata["flag"] is True
+    assert gf.metadata["tokenizer.ggml.tokens"] == ["<unk>", "a", "b"]
+    np.testing.assert_array_equal(gf.load_tensor("x"), tensors["x"])
+    np.testing.assert_allclose(gf.load_tensor("y"), tensors["y"], rtol=1e-3)
+
+
+def test_q8_0_dequant_accuracy(tmp_path):
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((8, 64)).astype(np.float32)
+    path = tmp_path / "q.gguf"
+    write_gguf(path, {"general.architecture": "llama"}, {"w": w},
+               quantize={"w": GGML_Q8_0})
+    got = GGUFFile(path).load_tensor("w")
+    # 8-bit block quant: ~1% relative error bound
+    assert np.abs(got - w).max() < np.abs(w).max() * 0.02
+
+
+def test_permute_roundtrip():
+    w = np.random.default_rng(1).standard_normal((8 * 16, 32)).astype(np.float32)
+    assert not np.array_equal(permute_qk(w, 8), w)
+    np.testing.assert_array_equal(unpermute_qk(permute_qk(w, 8), 8), w)
+
+
+def _export_hf_to_gguf(hf, hf_cfg, path, quantize_mlp=False):
+    """Mirror convert_hf_to_gguf.py: rename tensors, permute Q/K."""
+    sd = {k: v.detach().float().numpy() for k, v in hf.state_dict().items()}
+    nh, nkv = hf_cfg.num_attention_heads, hf_cfg.num_key_value_heads
+    tensors, quant = {}, {}
+    name_map = {
+        "model.embed_tokens.weight": "token_embd.weight",
+        "model.norm.weight": "output_norm.weight",
+        "lm_head.weight": "output.weight",
+    }
+    for hf_name, arr in sd.items():
+        if hf_name in name_map:
+            tensors[name_map[hf_name]] = arr
+            continue
+        if not hf_name.startswith("model.layers."):
+            continue
+        _, _, i, rest = hf_name.split(".", 3)
+        sub = {
+            "input_layernorm.weight": "attn_norm.weight",
+            "self_attn.q_proj.weight": "attn_q.weight",
+            "self_attn.k_proj.weight": "attn_k.weight",
+            "self_attn.v_proj.weight": "attn_v.weight",
+            "self_attn.o_proj.weight": "attn_output.weight",
+            "post_attention_layernorm.weight": "ffn_norm.weight",
+            "mlp.gate_proj.weight": "ffn_gate.weight",
+            "mlp.up_proj.weight": "ffn_up.weight",
+            "mlp.down_proj.weight": "ffn_down.weight",
+        }[rest]
+        if sub == "attn_q.weight":
+            arr = permute_qk(arr, nh)
+        elif sub == "attn_k.weight":
+            arr = permute_qk(arr, nkv)
+        name = f"blk.{i}.{sub}"
+        tensors[name] = arr
+        if quantize_mlp and sub.startswith("ffn_") and sub != "ffn_norm.weight":
+            quant[name] = GGML_Q8_0
+    meta = {
+        "general.architecture": "llama",
+        "general.name": "tiny-llama",
+        "llama.vocab_size": hf_cfg.vocab_size,
+        "llama.embedding_length": hf_cfg.hidden_size,
+        "llama.feed_forward_length": hf_cfg.intermediate_size,
+        "llama.block_count": hf_cfg.num_hidden_layers,
+        "llama.attention.head_count": nh,
+        "llama.attention.head_count_kv": nkv,
+        "llama.attention.layer_norm_rms_epsilon": hf_cfg.rms_norm_eps,
+        "llama.rope.freq_base": hf_cfg.rope_theta,
+        "llama.context_length": hf_cfg.max_position_embeddings,
+        "tokenizer.ggml.model": "gpt2",
+        "tokenizer.ggml.tokens": [f"t{i}" for i in range(hf_cfg.vocab_size)],
+        "tokenizer.ggml.eos_token_id": 2,
+    }
+    write_gguf(path, meta, tensors, quantize=quant)
+
+
+@pytest.fixture(scope="module")
+def tiny_hf():
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(11)
+    hf_cfg = LlamaConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, tie_word_embeddings=False,
+    )
+    return hf_cfg, LlamaForCausalLM(hf_cfg).eval()
+
+
+def test_gguf_model_matches_hf(tiny_hf, tmp_path):
+    import torch
+
+    hf_cfg, hf = tiny_hf
+    path = tmp_path / "model.gguf"
+    _export_hf_to_gguf(hf, hf_cfg, path)
+
+    cfg, params = load_gguf_model(path, dtype="float32")
+    assert cfg.num_layers == 2 and cfg.num_kv_heads == 2
+
+    from dynamo_tpu.models.llama import LlamaModel
+    from tests.test_model_correctness import _run_ours
+
+    tokens = list(np.random.RandomState(8).randint(0, 96, size=17))
+    with torch.no_grad():
+        ref = hf(torch.tensor([tokens])).logits[0].float().numpy()
+    got = _run_ours(LlamaModel(cfg), params, tokens, chunks=[17])
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=5e-3)
+
+
+def test_gguf_q8_model_close_to_hf(tiny_hf, tmp_path):
+    """MLP weights Q8_0-quantised: logits stay close (quant noise only)."""
+    import torch
+
+    hf_cfg, hf = tiny_hf
+    path = tmp_path / "model_q8.gguf"
+    _export_hf_to_gguf(hf, hf_cfg, path, quantize_mlp=True)
+    cfg, params = load_gguf_model(path, dtype="float32")
+
+    from dynamo_tpu.models.llama import LlamaModel
+    from tests.test_model_correctness import _run_ours
+
+    tokens = list(np.random.RandomState(9).randint(0, 96, size=12))
+    with torch.no_grad():
+        ref = hf(torch.tensor([tokens])).logits[0].float().numpy()
+    got = _run_ours(LlamaModel(cfg), params, tokens, chunks=[12])
+    np.testing.assert_allclose(got, ref, rtol=0.1, atol=0.15)
+
+
+def test_model_card_from_gguf(tiny_hf, tmp_path):
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+    hf_cfg, hf = tiny_hf
+    path = tmp_path / "card.gguf"
+    _export_hf_to_gguf(hf, hf_cfg, path)
+    card = ModelDeploymentCard.from_gguf(path)
+    assert card.name == "tiny-llama"
+    assert card.context_length == 256
+    assert card.eos_token_ids == [2]
+    assert card.tokenizer_path and card.tokenizer_path.endswith(".tokenizer.json")
