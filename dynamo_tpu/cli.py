@@ -295,6 +295,38 @@ async def _cmd_http(args) -> None:
     await asyncio.Event().wait()
 
 
+# ------------------------------------------------------------- coordinator ----
+
+
+async def _cmd_coordinator(args) -> None:
+    """Run the control/event/queue-plane coordinator (etcd+NATS stand-in)."""
+    from dynamo_tpu.runtime.transports.coordinator import CoordinatorServer
+
+    server = await CoordinatorServer(host=args.host, port=args.port).start()
+    log.info("coordinator on %s", server.url)
+    await asyncio.Event().wait()
+
+
+# ------------------------------------------------------------------ deploy ----
+
+
+async def _cmd_deploy(args) -> None:
+    """Render k8s manifests from a DynamoTpuDeployment spec (operator-lite,
+    ref deploy/dynamo/operator CRD controller)."""
+    from dynamo_tpu.deploy import DeploymentSpec
+    from dynamo_tpu.deploy.renderer import render_manifests, render_to_dir
+
+    spec = DeploymentSpec.from_yaml(Path(args.spec))
+    if args.out:
+        paths = render_to_dir(spec, args.out)
+        for p in paths:
+            print(p)
+    else:
+        import yaml as _yaml
+
+        print(_yaml.safe_dump_all(render_manifests(spec), sort_keys=False))
+
+
 # ---------------------------------------------------------------- metrics -----
 
 
@@ -399,6 +431,14 @@ def _parser() -> argparse.ArgumentParser:
     http.add_argument("--http-port", type=int, default=8080)
     common(http)
 
+    coord = sub.add_parser("coordinator", help="run the coordinator service")
+    coord.add_argument("--host", default="0.0.0.0")
+    coord.add_argument("--port", type=int, default=6180)
+
+    deploy = sub.add_parser("deploy", help="render k8s manifests from a deployment spec")
+    deploy.add_argument("spec", help="DynamoTpuDeployment YAML")
+    deploy.add_argument("-o", "--out", default=None, help="write one file per object")
+
     metrics = sub.add_parser("metrics", help="metrics aggregation service (Prometheus)")
     metrics.add_argument("--host", default="127.0.0.1")
     metrics.add_argument("--port", type=int, default=9091)
@@ -433,6 +473,10 @@ def main(argv: Optional[list[str]] = None) -> None:
         asyncio.run(_cmd_serve(args))
     elif args.cmd == "http":
         asyncio.run(_cmd_http(args))
+    elif args.cmd == "coordinator":
+        asyncio.run(_cmd_coordinator(args))
+    elif args.cmd == "deploy":
+        asyncio.run(_cmd_deploy(args))
     elif args.cmd == "metrics":
         asyncio.run(_cmd_metrics(args))
     elif args.cmd == "mock-worker":

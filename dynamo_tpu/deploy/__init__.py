@@ -1,0 +1,11 @@
+"""Deployment tooling: k8s manifest rendering for TPU serving graphs.
+
+Reference parity (lite): deploy/dynamo/operator (Go CRD controller turning
+DynamoDeployment specs into per-service Deployments/Services) — here a
+renderer that turns the same shape of spec into manifests directly, built
+for GKE TPU node pools instead of GPU operators.
+"""
+
+from dynamo_tpu.deploy.renderer import DeploymentSpec, render_manifests
+
+__all__ = ["DeploymentSpec", "render_manifests"]

@@ -1,0 +1,240 @@
+"""DynamoTpuDeployment spec → Kubernetes manifests.
+
+Reference parity: deploy/dynamo/operator/api/v1alpha1/dynamodeployment_types.go:31
+(DynamoDeployment CRD → per-service DynamoNimDeployment → Deployments,
+Services, ingress, autoscaling) and the helm charts under deploy/.
+
+The TPU translation: instead of `nvidia.com/gpu` resources and the GPU
+operator, workers request `google.com/tpu` chips on GKE TPU node pools
+(nodeSelector `cloud.google.com/gke-tpu-accelerator` + `-topology`), the
+coordinator replaces etcd+NATS as one lightweight Deployment, and
+multi-host slices map to one worker Deployment per slice with
+`hostNetwork` ICI reachability.
+
+Spec shape (YAML):
+
+    name: llama-disagg
+    namespace: default
+    image: dynamo-tpu:latest
+    coordinator: {}                      # optional overrides
+    frontend: {replicas: 1, port: 8080}
+    services:
+      decode:
+        command: ["dynamo-tpu", "run", "in=dyn://dynamo.decode.generate", "out=tpu"]
+        replicas: 2
+        tpu: {type: v5e, topology: "2x2", chips: 4}
+      prefill:
+        command: [...]
+        replicas: 4
+        tpu: {type: v5e, topology: "1x1", chips: 1}
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+import yaml
+
+__all__ = ["DeploymentSpec", "render_manifests", "render_to_dir"]
+
+_TPU_ACCEL_LABEL = "cloud.google.com/gke-tpu-accelerator"
+_TPU_TOPO_LABEL = "cloud.google.com/gke-tpu-topology"
+_TPU_RESOURCE = "google.com/tpu"
+
+_ACCELERATOR_NAMES = {
+    "v4": "tpu-v4-podslice",
+    "v5e": "tpu-v5-lite-podslice",
+    "v5p": "tpu-v5p-slice",
+    "v6e": "tpu-v6e-slice",
+}
+
+
+@dataclass
+class ServiceSpec:
+    name: str
+    command: list[str]
+    replicas: int = 1
+    tpu_type: Optional[str] = None      # v4 | v5e | v5p | v6e
+    tpu_topology: Optional[str] = None  # e.g. "2x2"
+    tpu_chips: int = 0
+    env: dict[str, str] = field(default_factory=dict)
+    port: Optional[int] = None
+
+
+@dataclass
+class DeploymentSpec:
+    name: str
+    image: str
+    namespace: str = "default"
+    services: list[ServiceSpec] = field(default_factory=list)
+    frontend_port: int = 8080
+    frontend_replicas: int = 1
+    coordinator_port: int = 6180
+    metrics_port: int = 9091
+
+    @classmethod
+    def from_yaml(cls, path_or_text: str | Path) -> "DeploymentSpec":
+        p = Path(path_or_text)
+        text = p.read_text() if p.exists() else str(path_or_text)
+        d = yaml.safe_load(text)
+        services = []
+        for name, s in (d.get("services") or {}).items():
+            tpu = s.get("tpu") or {}
+            services.append(
+                ServiceSpec(
+                    name=name,
+                    command=list(s["command"]),
+                    replicas=int(s.get("replicas", 1)),
+                    tpu_type=tpu.get("type"),
+                    tpu_topology=tpu.get("topology"),
+                    tpu_chips=int(tpu.get("chips", 0)),
+                    env={k: str(v) for k, v in (s.get("env") or {}).items()},
+                    port=s.get("port"),
+                )
+            )
+        fe = d.get("frontend") or {}
+        return cls(
+            name=d["name"],
+            image=d["image"],
+            namespace=d.get("namespace", "default"),
+            services=services,
+            frontend_port=int(fe.get("port", 8080)),
+            frontend_replicas=int(fe.get("replicas", 1)),
+            coordinator_port=int((d.get("coordinator") or {}).get("port", 6180)),
+            metrics_port=int((d.get("metrics") or {}).get("port", 9091)),
+        )
+
+
+def _labels(spec: DeploymentSpec, component: str) -> dict:
+    return {
+        "app.kubernetes.io/name": "dynamo-tpu",
+        "app.kubernetes.io/instance": spec.name,
+        "app.kubernetes.io/component": component,
+    }
+
+
+def _deployment(
+    spec: DeploymentSpec,
+    component: str,
+    command: list[str],
+    replicas: int,
+    env: dict[str, str],
+    port: Optional[int] = None,
+    svc: Optional[ServiceSpec] = None,
+) -> dict:
+    labels = _labels(spec, component)
+    container: dict[str, Any] = {
+        "name": component,
+        "image": spec.image,
+        "command": command,
+        "env": [{"name": k, "value": v} for k, v in env.items()],
+    }
+    if port:
+        container["ports"] = [{"containerPort": port}]
+    pod_spec: dict[str, Any] = {"containers": [container]}
+    if svc is not None and svc.tpu_chips > 0:
+        container["resources"] = {
+            "requests": {_TPU_RESOURCE: str(svc.tpu_chips)},
+            "limits": {_TPU_RESOURCE: str(svc.tpu_chips)},
+        }
+        selector: dict[str, str] = {}
+        if svc.tpu_type:
+            selector[_TPU_ACCEL_LABEL] = _ACCELERATOR_NAMES.get(
+                svc.tpu_type, svc.tpu_type
+            )
+        if svc.tpu_topology:
+            selector[_TPU_TOPO_LABEL] = svc.tpu_topology
+        if selector:
+            pod_spec["nodeSelector"] = selector
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": f"{spec.name}-{component}",
+            "namespace": spec.namespace,
+            "labels": labels,
+        },
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": pod_spec,
+            },
+        },
+    }
+
+
+def _service(spec: DeploymentSpec, component: str, port: int) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": f"{spec.name}-{component}",
+            "namespace": spec.namespace,
+            "labels": _labels(spec, component),
+        },
+        "spec": {
+            "selector": _labels(spec, component),
+            "ports": [{"port": port, "targetPort": port}],
+        },
+    }
+
+
+def render_manifests(spec: DeploymentSpec) -> list[dict]:
+    """All k8s objects for a deployment: coordinator, frontend, metrics,
+    and one Deployment per worker service."""
+    coord_url = f"tcp://{spec.name}-coordinator.{spec.namespace}.svc:{spec.coordinator_port}"
+    base_env = {"DYNTPU_COORDINATOR": coord_url}
+
+    out = [
+        _deployment(
+            spec, "coordinator",
+            ["dynamo-tpu", "coordinator", "--port", str(spec.coordinator_port)],
+            1, {}, port=spec.coordinator_port,
+        ),
+        _service(spec, "coordinator", spec.coordinator_port),
+        _deployment(
+            spec, "frontend",
+            ["dynamo-tpu", "http", "--host", "0.0.0.0",
+             "--http-port", str(spec.frontend_port),
+             "--coordinator", coord_url],
+            spec.frontend_replicas, base_env, port=spec.frontend_port,
+        ),
+        _service(spec, "frontend", spec.frontend_port),
+        _deployment(
+            spec, "metrics",
+            ["dynamo-tpu", "metrics", "--host", "0.0.0.0",
+             "--port", str(spec.metrics_port), "--coordinator", coord_url],
+            1, base_env, port=spec.metrics_port,
+        ),
+        _service(spec, "metrics", spec.metrics_port),
+    ]
+    for svc in spec.services:
+        env = dict(base_env)
+        env.update(svc.env)
+        cmd = list(svc.command)
+        if "--coordinator" not in cmd:
+            cmd += ["--coordinator", coord_url]
+        out.append(
+            _deployment(spec, svc.name, cmd, svc.replicas, env, port=svc.port, svc=svc)
+        )
+        if svc.port:
+            out.append(_service(spec, svc.name, svc.port))
+    return out
+
+
+def render_to_dir(spec: DeploymentSpec, out_dir: str | Path) -> list[Path]:
+    """Write one YAML file per object; returns the paths."""
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    paths = []
+    for obj in render_manifests(spec):
+        kind = obj["kind"].lower()
+        name = obj["metadata"]["name"]
+        p = out / f"{name}-{kind}.yaml"
+        p.write_text(yaml.safe_dump(obj, sort_keys=False))
+        paths.append(p)
+    return paths
