@@ -54,15 +54,22 @@ def main() -> None:
     batch = int(os.environ.get("DYNAMO_BENCH_BATCH", "64" if on_accel else "8"))
     steps = int(os.environ.get("DYNAMO_BENCH_STEPS", "300" if on_accel else "30"))
     isl = int(os.environ.get("DYNAMO_BENCH_ISL", "128"))
+    # tokens per decode dispatch: amortises dispatch overhead (dominant on
+    # remote-attached chips) over many on-device iterations
+    decode_steps = int(os.environ.get("DYNAMO_BENCH_DECODE_STEPS",
+                                      "64" if on_accel else "4"))
 
     cfg = ModelConfig(**MODELS[name], dtype="bfloat16" if on_accel else "float32")
-    max_len = 2048
-    block_size = 16
+    max_len = int(os.environ.get("DYNAMO_BENCH_MAX_LEN", "2048"))
+    # 32-token blocks halve the decode kernel's per-block DMA count
+    block_size = int(os.environ.get("DYNAMO_BENCH_BLOCK_SIZE",
+                                    "32" if on_accel else "16"))
     ecfg = EngineConfig(
         max_batch_size=batch,
         max_model_len=max_len,
         block_size=block_size,
         num_blocks=batch * (max_len // block_size) + 64,
+        decode_steps=decode_steps,
         enable_prefix_reuse=False,  # distinct prompts; measure raw decode
     )
     model = LlamaModel(cfg)

@@ -22,6 +22,10 @@ class EngineConfig:
     # batching
     max_batch_size: int = 8           # decode slots (static shape)
     max_model_len: int = 2048
+    # decode tokens generated per device dispatch (multi-step scheduling);
+    # >1 amortises dispatch overhead at the cost of stop-condition
+    # granularity (up to decode_steps-1 discarded samples per request)
+    decode_steps: int = 1
     # paged cache
     block_size: int = 16
     num_blocks: int = 512             # cache blocks in HBM

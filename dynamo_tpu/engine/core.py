@@ -45,7 +45,7 @@ from dynamo_tpu.tokens import TokenBlockSequence
 
 log = logging.getLogger("dynamo_tpu.engine")
 
-__all__ = ["EngineCore", "unified_step"]
+__all__ = ["EngineCore", "unified_step", "multi_decode_step"]
 
 
 def unified_step(
@@ -63,6 +63,47 @@ def unified_step(
     logits = model.compute_logits(params, last_h)  # [B, V] f32
     sampled = sample_tokens(logits, rng, temp, top_k, top_p)
     return sampled, cache
+
+
+def multi_decode_step(
+    model, params, cache, last_tokens, positions, block_tables, seq_lens,
+    limits, rng, temp, top_k, top_p, *, num_steps: int, block_size: int,
+):
+    """K decode iterations fully on device in one dispatch (multi-step
+    scheduling): forward → sample → feed the token back, K times under one
+    ``lax.scan``.  Amortises per-dispatch host/RPC overhead over K tokens —
+    on remote-attached TPU the dispatch round-trip, not compute, dominates
+    single-step ITL.
+
+    ``limits[i]`` is the max total tokens sequence i has block space for
+    (and may not exceed max_model_len): a position at/past its limit
+    writes no KV (slot -1 → dropped) and the host discards its samples.
+    Inactive rows have limits=0.  Returns (sampled [K, B], cache).
+    """
+    m = block_tables.shape[1]
+
+    def one(carry, rng_k):
+        cache, toks, pos, lens = carry
+        blk = jnp.minimum(pos // block_size, m - 1)
+        base = jnp.take_along_axis(block_tables, blk[:, None], axis=1)[:, 0]
+        slot = base * block_size + pos % block_size
+        slot = jnp.where(pos < limits, slot, -1)
+        hidden, cache = model.forward(
+            params, toks[:, None], pos[:, None], cache, block_tables, lens,
+            slot[:, None],
+        )
+        logits = model.compute_logits(params, hidden[:, 0])
+        sampled = sample_tokens(logits, rng_k, temp, top_k, top_p)
+        # clamp the context length at the limit: past it no KV was written,
+        # and an unclamped length would walk the block table out of bounds
+        return (cache, sampled, pos + 1, jnp.minimum(lens + 1, limits)), sampled
+
+    (cache, _, _, _), out = jax.lax.scan(
+        one,
+        (cache, last_tokens, positions, seq_lens),
+        jax.random.split(rng, num_steps),
+    )
+    return out, cache
 
 
 class EngineCore:
@@ -121,6 +162,7 @@ class EngineCore:
 
         self._rng = jax.random.PRNGKey(config.seed)
         self._step_fn = jax.jit(self._step_impl, donate_argnums=(1,))
+        self._multi_fn = jax.jit(self._multi_impl, donate_argnums=(1,))
 
         self.slots: list[Optional[EngineRequest]] = [None] * config.max_batch_size
         self.waiting: "queue.SimpleQueue[EngineRequest]" = queue.SimpleQueue()
@@ -146,6 +188,13 @@ class EngineCore:
     def _step_impl(self, params, cache, *args):
         return unified_step(self.model, params, cache, *args)
 
+    def _multi_impl(self, params, cache, *args):
+        return multi_decode_step(
+            self.model, params, cache, *args,
+            num_steps=max(1, self.config.decode_steps),
+            block_size=self.config.block_size,
+        )
+
     def _run_step(self, tokens, positions, block_tables, seq_lens, slot_idx,
                   last_idx, temp, top_k, top_p) -> np.ndarray:
         self._rng, rng = jax.random.split(self._rng)
@@ -155,6 +204,20 @@ class EngineCore:
             jnp.asarray(block_tables), jnp.asarray(seq_lens),
             jnp.asarray(slot_idx), jnp.asarray(last_idx),
             rng,
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+        )
+        self.steps += 1
+        return np.asarray(sampled)
+
+    def _run_multi_decode_step(self, tokens, positions, block_tables, seq_lens,
+                               limits, temp, top_k, top_p) -> np.ndarray:
+        """Dispatch one multi-step decode; returns sampled tokens [K, B]."""
+        self._rng, rng = jax.random.split(self._rng)
+        sampled, self.cache = self._multi_fn(
+            self.params, self.cache,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(block_tables), jnp.asarray(seq_lens),
+            jnp.asarray(limits), rng,
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
         )
         self.steps += 1
@@ -377,14 +440,19 @@ class EngineCore:
 
     # ----------------------------------------------------------------- decode
     def _run_decode(self) -> None:
+        """One decode dispatch = ``config.decode_steps`` tokens per active
+        sequence, generated entirely on device (multi-step scheduling).
+        Blocks for the whole burst are pre-allocated; a sequence that runs
+        out of block space stops writing KV at its ``limit`` and is
+        finished at LENGTH once its allowed samples are consumed."""
         cfg = self.config
         b, m = cfg.max_batch_size, cfg.max_blocks_per_seq
-        tokens = np.zeros((b, 1), np.int32)
-        positions = np.zeros((b, 1), np.int32)
-        slot_idx = np.full((b, 1), -1, np.int32)
+        k_steps = max(1, cfg.decode_steps)
+        tokens = np.zeros(b, np.int32)
+        positions = np.zeros(b, np.int32)
         bt = np.zeros((b, m), np.int32)
         seq_lens = np.zeros(b, np.int32)
-        last_idx = np.zeros(b, np.int32)
+        limits = np.zeros(b, np.int32)
         temp = np.ones(b, np.float32)
         top_k = np.zeros(b, np.int32)
         top_p = np.ones(b, np.float32)
@@ -394,22 +462,25 @@ class EngineCore:
             if req is None or req.state is not RequestState.RUNNING:
                 continue
             p = req.seq.total_tokens - 1  # position of the not-yet-computed last token
-            needed = p // cfg.block_size + 1
+            # cover the whole burst: positions p .. p+k-1, clamped to model len
+            want_tokens = min(p + k_steps, cfg.max_model_len)
+            needed = (want_tokens - 1) // cfg.block_size + 1
             if len(req.block_ids) < needed:
                 try:
-                    req.block_ids.extend(self.block_manager.allocate_raw(1))
+                    req.block_ids.extend(
+                        self.block_manager.allocate_raw(needed - len(req.block_ids))
+                    )
                 except NoFreeBlocks:
-                    # no memory to grow this sequence — finish it at length
-                    self._finish_slot(req, FinishReason.LENGTH)
-                    continue
+                    if len(req.block_ids) * cfg.block_size <= p:
+                        # not even the current token has a slot
+                        self._finish_slot(req, FinishReason.LENGTH)
+                        continue
             active.append(req)
-            tokens[i, 0] = req.seq.tokens[-1]
-            positions[i, 0] = p
+            tokens[i] = req.seq.tokens[-1]
+            positions[i] = p
             bt[i, : len(req.block_ids)] = req.block_ids
-            slot_idx[i, 0] = (
-                req.block_ids[p // cfg.block_size] * cfg.block_size + p % cfg.block_size
-            )
             seq_lens[i] = req.seq.total_tokens
+            limits[i] = min(len(req.block_ids) * cfg.block_size, cfg.max_model_len)
             temp[i] = req.sampling.temperature
             top_k[i] = req.sampling.top_k
             top_p[i] = req.sampling.top_p
@@ -417,14 +488,23 @@ class EngineCore:
         if not active:
             return
         # growth allocations above may have evicted registered blocks that
-        # this very step writes into — offload them first
+        # this very dispatch writes into — offload them first
         self._drain_offload()
-        sampled = self._run_step(
-            tokens, positions, bt, seq_lens, slot_idx, last_idx, temp, top_k, top_p
-        )
-        self.decode_steps += 1
+        sampled = self._run_multi_decode_step(
+            tokens, positions, bt, seq_lens, limits, temp, top_k, top_p
+        )  # [K, B]
+        self.decode_steps += sampled.shape[0]
         for req in active:
-            self._append_token(req, int(sampled[req.slot]))
+            slot = req.slot
+            # samples at/past the limit wrote no KV — not appendable
+            allowed = min(sampled.shape[0], int(limits[slot] - positions[slot]))
+            for k in range(allowed):
+                if req.state is not RequestState.RUNNING:
+                    break  # EOS/stop/max_tokens hit mid-burst
+                self._append_token(req, int(sampled[k, slot]))
+            if req.state is RequestState.RUNNING and allowed < sampled.shape[0]:
+                # block space exhausted before the burst ended
+                self._finish_slot(req, FinishReason.LENGTH)
 
     # ------------------------------------------------------------- lifecycle
     def _append_token(self, req: EngineRequest, token: int, first: bool = False) -> None:
@@ -521,8 +601,8 @@ class EngineCore:
             return
         bids = [b for b, _ in fresh]
         hashes = [h for _, h in fresh]
-        arr = self.gather_blocks_np(bids)        # [L, 2, n, Bs, HkD]
-        self.host_pool.store(hashes, np.moveaxis(arr, 2, 0))
+        arr = self.gather_blocks_np(bids)        # [L, n, 2, Bs, HkD]
+        self.host_pool.store(hashes, np.moveaxis(arr, 1, 0))
 
     def _restore_from_host(self, req: EngineRequest) -> None:
         """Upload host-resident prefix blocks into the request's fresh
@@ -539,7 +619,7 @@ class EngineCore:
             return
         blocks = self.host_pool.gather(hit)      # [n, L, 2, Bs, HkD]
         target = req.block_ids[dev : dev + len(hit)]
-        self.scatter_external(target, np.moveaxis(blocks, 0, 2))
+        self.scatter_external(target, np.moveaxis(blocks, 0, 1))
         for i in range(len(hit)):
             blk = req.seq.blocks[dev + i]
             self.block_manager.commit(
@@ -548,7 +628,7 @@ class EngineCore:
         req.cached_tokens += len(hit) * bs
 
     def gather_blocks_np(self, block_ids: list[int]) -> np.ndarray:
-        """Stage blocks to host RAM: [L, 2, n, Bs, HkD] ndarray.  Under a
+        """Stage blocks to host RAM: [L, n, 2, Bs, HkD] ndarray.  Under a
         sharded mesh this all-gathers KV heads — which is exactly the
         TP-resharding the reference needs a Triton kernel for
         (kv_rearrange.py); here the host staging buffer is layout-neutral."""

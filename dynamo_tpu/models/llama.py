@@ -164,27 +164,29 @@ class LlamaModel:
         return specs
 
     def cache_spec(self) -> P:
-        """KV cache [L,2,N,Bs,Hk*D]: the trailing axis is kv-head-major, so
+        """KV cache [L,N,2,Bs,Hk*D]: the trailing axis is kv-head-major, so
         sharding it over "model" splits whole kv heads across the mesh."""
         return P(None, None, None, None, "model")
 
     # --------------------------------------------------------------- kv cache
     def init_kv_cache(self, num_blocks: int, block_size: int, dtype=None) -> jax.Array:
-        """One array for the whole model: [L, 2, N, Bs, Hk*D].
+        """One array for the whole model: [L, N, 2, Bs, Hk*D].
 
         A single multi-layer array (rather than per-layer leaves) is what
         lets (a) the decode kernel index layers with a scalar instead of
         slicing, (b) block transfer move a block id across all layers at
         once (ops/block_copy.py), and (c) the engine donate one buffer.
-        The flat Hk*D minor axis is lane-aligned (512+ for real models).
+        K and V of a block are adjacent (k/v axis inside the block axis) so
+        the decode kernel's per-block fetch is ONE contiguous DMA.  The
+        flat Hk*D minor axis is lane-aligned (512+ for real models).
         """
         cfg = self.config
         dt = dtype or cfg.jax_dtype
         return jnp.zeros(
             (
                 cfg.num_layers,
-                2,
                 num_blocks,
+                2,
                 block_size,
                 cfg.num_kv_heads * cfg.head_dim,
             ),
@@ -197,7 +199,7 @@ class LlamaModel:
         params: Params,
         tokens: jax.Array,        # [B, S] int32
         positions: jax.Array,     # [B, S] int32 (absolute; padding rows may be 0)
-        kv_cache: jax.Array,      # [L, 2, N, Bs, Hk*D]
+        kv_cache: jax.Array,      # [L, N, 2, Bs, Hk*D]
         block_tables: jax.Array,  # [B, M] int32
         seq_lens: jax.Array,      # [B] int32 — context length incl. new tokens
         slot_idx: jax.Array,      # [B, S] int32 — cache slot per new token, -1 pad

@@ -8,9 +8,11 @@ these to efficient HBM DMAs; the cross-host path stages through host RAM
 (``jax.device_get``/``device_put``) and the wire (see
 dynamo_tpu/llm/kv/transfer.py).
 
-Cache layout: [L, 2, N, Bs, Hk*D] (layers, k/v, blocks, block_size,
+Cache layout: [L, N, 2, Bs, Hk*D] (layers, blocks, k/v, block_size,
 flat kv_heads*head_dim) — one array for the whole model so a block id selects
-the block across every layer at once, exactly what transfer needs.
+the block across every layer at once, exactly what transfer needs.  K and V
+of a block are adjacent (k/v axis INSIDE the block axis) so the decode
+kernel fetches both with a single DMA per block.
 """
 
 from __future__ import annotations
@@ -28,11 +30,11 @@ __all__ = [
 
 @jax.jit
 def gather_blocks(cache: jax.Array, block_ids: jax.Array) -> jax.Array:
-    """Pull blocks out of a cache: [L,2,N,Bs,HkD] × [n] -> [L,2,n,Bs,HkD].
+    """Pull blocks out of a cache: [L,N,2,Bs,HkD] × [n] -> [L,n,2,Bs,HkD].
 
     Used to extract a sequence's KV for offload / cross-worker transfer.
     """
-    return jnp.take(cache, block_ids, axis=2)
+    return jnp.take(cache, block_ids, axis=1)
 
 
 @jax.jit
@@ -41,9 +43,9 @@ def scatter_blocks(
 ) -> jax.Array:
     """Write transferred blocks into a cache at ``block_ids``.
 
-    cache: [L,2,N,Bs,HkD]; blocks: [L,2,n,Bs,HkD]; block_ids: [n].
+    cache: [L,N,2,Bs,HkD]; blocks: [L,n,2,Bs,HkD]; block_ids: [n].
     """
-    return cache.at[:, :, block_ids].set(blocks.astype(cache.dtype))
+    return cache.at[:, block_ids].set(blocks.astype(cache.dtype))
 
 
 def gather_blocks_padded(cache: jax.Array, block_ids) -> jax.Array:
@@ -58,11 +60,11 @@ def gather_blocks_padded(cache: jax.Array, block_ids) -> jax.Array:
     if padded != n:
         ids = np.concatenate([ids, np.full(padded - n, ids[-1], np.int32)])
     out = gather_blocks(cache, jnp.asarray(ids))
-    return out[:, :, :n] if padded != n else out
+    return out[:, :n] if padded != n else out
 
 
 _scatter_donated = jax.jit(
-    lambda cache, block_ids, blocks: cache.at[:, :, block_ids].set(
+    lambda cache, block_ids, blocks: cache.at[:, block_ids].set(
         blocks.astype(cache.dtype)
     ),
     donate_argnums=(0,),
@@ -89,6 +91,6 @@ def scatter_blocks_inplace(cache, block_ids, blocks):
             [block_ids, np.full(padded - n, block_ids[-1], np.int32)]
         )
         blocks = jnp.concatenate(
-            [blocks, jnp.repeat(blocks[:, :, -1:], padded - n, axis=2)], axis=2
+            [blocks, jnp.repeat(blocks[:, -1:], padded - n, axis=1)], axis=1
         )
     return _scatter_donated(cache, jnp.asarray(block_ids), blocks)

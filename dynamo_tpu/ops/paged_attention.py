@@ -42,7 +42,7 @@ def _pallas_decode_enabled() -> bool:
 
 def paged_attention_layer(
     q: jax.Array,             # [B, S, H, D]
-    cache: jax.Array,         # [L, 2, N, Bs, Hk*D] — full multi-layer cache
+    cache: jax.Array,         # [L, N, 2, Bs, Hk*D] — full multi-layer cache
     layer: jax.Array,         # scalar int32
     block_tables: jax.Array,  # [B, M] int32
     seq_lens: jax.Array,      # [B] int32
@@ -57,7 +57,7 @@ def paged_attention_layer(
     shapes/backends materialise the layer slice and use the oracle below.
     """
     b, s, h, d = q.shape
-    _, _, n, bs, hkd = cache.shape
+    _, n, _, bs, hkd = cache.shape
     hk = hkd // d
     if s == 1 and _pallas_decode_enabled():
         from dynamo_tpu.ops.pallas.decode_attention import paged_decode_attention
@@ -68,15 +68,15 @@ def paged_attention_layer(
         return out[:, None]
 
     layer_kv = jax.lax.dynamic_index_in_dim(cache, layer, axis=0, keepdims=False)
-    k_cache = layer_kv[0].reshape(n, bs, hk, d)
-    v_cache = layer_kv[1].reshape(n, bs, hk, d)
+    k_cache = layer_kv[:, 0].reshape(n, bs, hk, d)
+    v_cache = layer_kv[:, 1].reshape(n, bs, hk, d)
     return paged_attention(
         q, k_cache, v_cache, block_tables, seq_lens, positions, sm_scale
     )
 
 
 def write_kv_cache_layer(
-    cache: jax.Array,    # [L, 2, N, Bs, Hk*D] — the WHOLE paged cache
+    cache: jax.Array,    # [L, N, 2, Bs, Hk*D] — the WHOLE paged cache
     layer: jax.Array,    # scalar int32 layer index
     k_new: jax.Array,    # [B, S, Hk, D]
     v_new: jax.Array,    # [B, S, Hk, D]
@@ -88,13 +88,15 @@ def write_kv_cache_layer(
     per-layer view) lets XLA update the buffer in place — the whole-cache
     copy-through-the-loop this replaces dominated decode ITL on TPU.
     """
-    l, two, n, bs, hkd = cache.shape
+    l, n, two, bs, hkd = cache.shape
     b, s, hk, d = k_new.shape
-    flat = cache.reshape(l * 2 * n * bs, hkd)
+    flat = cache.reshape(l * n * 2 * bs, hkd)
     idx = slot_idx.reshape(-1)
     valid = idx >= 0
-    k_idx = jnp.where(valid, (layer * 2 + 0) * n * bs + idx, -1)
-    v_idx = jnp.where(valid, (layer * 2 + 1) * n * bs + idx, -1)
+    # row for (layer, block=idx//bs, kv, offset=idx%bs) in the flat view
+    base = layer * (n * 2 * bs) + (idx // bs) * (2 * bs) + idx % bs
+    k_idx = jnp.where(valid, base, -1)
+    v_idx = jnp.where(valid, base + bs, -1)
     rows_k = k_new.astype(cache.dtype).reshape(-1, hkd)
     rows_v = v_new.astype(cache.dtype).reshape(-1, hkd)
     flat = flat.at[k_idx].set(rows_k, mode="drop")

@@ -6,22 +6,28 @@ context that is GBs of HBM traffic per step and dominates ITL.  This kernel
 instead streams ONLY the blocks each sequence actually owns, directly from
 the full multi-layer cache in HBM.
 
-Design (one grid step per sequence, work ∝ actual context length):
+Design (one grid step per GROUP of G sequences, work ∝ actual context):
 
-  * Grid is (B,).  Inside the kernel a `fori_loop` with a *data-dependent*
-    bound (ceil(seq_len / chunk)) walks the sequence's chunks — padding
-    chunks are never visited, never DMA'd: a 100-token sequence in a
-    2048-token table costs 7 block fetches, not 128.  This also keeps the
-    Mosaic grid overhead at B steps instead of B × M/C tiny steps.
+  * Grid is (B/G,).  TPU grid steps run sequentially on the core, so the
+    per-step fixed cost (DMA issue, loop control, semaphore waits) is paid
+    B times if the grid is (B,).  Grouping G sequences per step issues all
+    their block DMAs together — G×C copies in flight per chunk — and
+    amortises the fixed cost G-fold.  At batch 64 this took the 1B-model
+    decode step from ~B sequential latency-bound walks to B/G.
+  * Inside the kernel a `fori_loop` with a *data-dependent* bound
+    (ceil(max(seq_len in group) / chunk)) walks the group's chunks —
+    chunks past a sequence's end fetch its last block again (clamped id,
+    masked compute), chunks past the GROUP's max are never visited.
   * K/V blocks are fetched with manual double-buffered `make_async_copy`
-    from the cache in HBM (`pltpu.ANY`), chunk i+1 in flight while chunk i
-    computes.  Block ids come from the scalar-prefetched block table in
-    SMEM; the layer is a scalar operand, so the per-layer K/V is never
-    sliced out (a slice would copy ~100s of MB per layer per step).
+    from the cache in HBM (`pl.ANY`), chunk i+1 in flight while chunk i
+    computes.  K and V of a block are adjacent in the cache layout
+    [L, N, 2, Bs, HkD], so each block is ONE contiguous DMA.  Block ids
+    come from the scalar-prefetched block table in SMEM; the layer is a
+    scalar operand, so per-layer K/V is never sliced out.
   * GQA is handled by expanding q to a block-diagonal [H, Hk*D] layout
-    outside the kernel: scores and the PV product are then two plain MXU
-    matmuls per chunk with no per-head lane slicing.  The extra zeros cost
-    FLOPs the decode step has to spare (it is bandwidth-bound).
+    outside the kernel: scores and the PV product are then plain MXU
+    matmuls with no per-head lane slicing.  The extra zeros cost FLOPs the
+    decode step has to spare (it is bandwidth/latency-bound).
   * Online softmax (flash) accumulation in VMEM scratch across chunks.
 
 Semantics match `paged_attention` with S=1: each query row attends over
@@ -52,39 +58,46 @@ def _kernel(
     bt_ref,      # [B, M] int32
     layer_ref,   # [1] int32
     # inputs
-    q_ref,       # [1, H, HkD] VMEM — block-diagonal expanded, pre-scaled f32
-    cache_ref,   # [L, 2, N, Bs, HkD] HBM (manual DMA)
+    q_ref,       # [G, H, HkD] VMEM — block-diagonal expanded, pre-scaled f32
+    cache_ref,   # [L, N, 2, Bs, HkD] HBM (manual DMA)
     # outputs
-    out_ref,     # [1, H, HkD] VMEM
+    out_ref,     # [G, H, HkD] VMEM
     # scratch
-    acc_ref,     # [H, HkD] f32
-    m_ref,       # [H, 128] f32
-    l_ref,       # [H, 128] f32
-    kbuf,        # [2, C, Bs, HkD] cache-dtype (double buffer)
-    vbuf,        # [2, C, Bs, HkD]
-    sems,        # [2, 2C] DMA semaphores
+    acc_ref,     # [G, H, HkD] f32
+    m_ref,       # [G, H, 128] f32
+    l_ref,       # [G, H, 128] f32
+    kvbuf,       # [2, G, C, 2, Bs, HkD] cache-dtype (double buffer)
+    sems,        # [2, G, C] DMA semaphores
     *,
     c: int,
+    g: int,
 ):
-    b = pl.program_id(0)
-    bs, hkd = kbuf.shape[2], kbuf.shape[3]
+    gi = pl.program_id(0)
+    bs, hkd = kvbuf.shape[4], kvbuf.shape[5]
     h = q_ref.shape[1]
     t = c * bs
-    seq_len = seq_ref[b]
     lyr = layer_ref[0]
-    last_block = jnp.maximum(seq_len - 1, 0) // bs
-    num_chunks = pl.cdiv(seq_len, t)  # data-dependent loop bound
+
+    # group-wide chunk bound: max seq_len among the G sequences
+    max_len = seq_ref[gi * g]
+    for j in range(1, g):
+        max_len = jnp.maximum(max_len, seq_ref[gi * g + j])
+    num_chunks = pl.cdiv(max_len, t)  # data-dependent loop bound
 
     def block_dmas(ci, slot):
         out = []
-        for i in range(c):  # static unroll: C copies per chunk
-            bid = bt_ref[b, jnp.minimum(ci * c + i, last_block)]
-            out.append(pltpu.make_async_copy(
-                cache_ref.at[lyr, 0, bid], kbuf.at[slot, i], sems.at[slot, i]
-            ))
-            out.append(pltpu.make_async_copy(
-                cache_ref.at[lyr, 1, bid], vbuf.at[slot, i], sems.at[slot, c + i]
-            ))
+        m = bt_ref.shape[1]
+        for j in range(g):          # static unroll over group
+            b = gi * g + j
+            # clamp to the table width: a caller-side seq_len beyond the
+            # table must not index SMEM out of bounds
+            last_block = jnp.minimum(jnp.maximum(seq_ref[b] - 1, 0) // bs, m - 1)
+            for i in range(c):      # static unroll: C copies per seq per chunk
+                bid = bt_ref[b, jnp.minimum(ci * c + i, last_block)]
+                # K and V are adjacent in the [.., 2, Bs, HkD] block: ONE DMA
+                out.append(pltpu.make_async_copy(
+                    cache_ref.at[lyr, bid], kvbuf.at[slot, j, i], sems.at[slot, j, i]
+                ))
         return out
 
     acc_ref[:] = jnp.zeros_like(acc_ref)
@@ -107,84 +120,96 @@ def _kernel(
         for dma in block_dmas(ci, slot):
             dma.wait()
 
-        q = q_ref[0]  # [H, HkD]
-        k = kbuf[slot].reshape(t, hkd).astype(jnp.float32)
-        v = vbuf[slot].reshape(t, hkd).astype(jnp.float32)
+        for j in range(g):  # static unroll: one flash update per sequence
+            seq_len = seq_ref[gi * g + j]
 
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [H, T]
-        pos = ci * t + jax.lax.broadcasted_iota(jnp.int32, (h, t), 1)
-        s = jnp.where(pos < seq_len, s, NEG_INF)
+            # skip chunks past THIS sequence's end (and zero-length rows:
+            # their acc/l stay 0 → output 0)
+            @pl.when(ci * t < seq_len)
+            def _update(j=j, seq_len=seq_len):
+                q = q_ref[j]  # [H, HkD]
+                k = kvbuf[slot, j, :, 0].reshape(t, hkd).astype(jnp.float32)
+                v = kvbuf[slot, j, :, 1].reshape(t, hkd).astype(jnp.float32)
 
-        m_prev = m_ref[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        pv = jnp.dot(p, v, preferred_element_type=jnp.float32)
-        acc_ref[:] = acc_ref[:] * alpha + pv
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+                )  # [H, T]
+                pos = ci * t + jax.lax.broadcasted_iota(jnp.int32, (h, t), 1)
+                s = jnp.where(pos < seq_len, s, NEG_INF)
+
+                m_prev = m_ref[j, :, :1]
+                m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+                alpha = jnp.exp(m_prev - m_new)
+                p = jnp.exp(s - m_new)
+                l_ref[j] = l_ref[j] * alpha + jnp.sum(p, axis=1, keepdims=True)
+                m_ref[j] = jnp.broadcast_to(m_new, m_ref.shape[1:])
+                pv = jnp.dot(p, v, preferred_element_type=jnp.float32)
+                acc_ref[j] = acc_ref[j] * alpha + pv
         return 0
 
     jax.lax.fori_loop(0, num_chunks, body, 0)
 
-    denom = jnp.maximum(l_ref[:, :1], 1e-9)
-    out_ref[0] = (acc_ref[:] / denom).astype(out_ref.dtype)
+    for j in range(g):
+        denom = jnp.maximum(l_ref[j, :, :1], 1e-9)
+        out_ref[j] = (acc_ref[j] / denom).astype(out_ref.dtype)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("sm_scale", "blocks_per_chunk", "interpret"),
+    static_argnames=("sm_scale", "blocks_per_chunk", "seqs_per_group", "interpret"),
 )
 def paged_decode_attention(
     q: jax.Array,             # [B, H, D]
-    cache: jax.Array,         # [L, 2, N, Bs, Hk*D] — full multi-layer cache
+    cache: jax.Array,         # [L, N, 2, Bs, Hk*D] — full multi-layer cache
     layer: jax.Array,         # scalar int32
     block_tables: jax.Array,  # [B, M] int32
     seq_lens: jax.Array,      # [B] int32
     sm_scale: float | None = None,
-    blocks_per_chunk: int = 8,
+    blocks_per_chunk: int = 4,
+    seqs_per_group: int = 8,
     interpret: bool = False,
 ) -> jax.Array:
     """One decode step of attention for B sequences.  Returns [B, H, D]."""
     b, h, d = q.shape
-    l, _, n, bs, hkd = cache.shape
+    l, n, _, bs, hkd = cache.shape
     hk = hkd // d
     m = block_tables.shape[1]
-    g = h // hk
+    g_heads = h // hk
     if sm_scale is None:
         sm_scale = 1.0 / (d**0.5)
     c = min(blocks_per_chunk, m)
+    g = seqs_per_group
+    while b % g:  # group size must divide the batch
+        g //= 2
+    g = max(g, 1)
 
-    # Block-diagonal q expansion: row for head (k, g) lives in kv-head k's
+    # Block-diagonal q expansion: row for head (k, gh) lives in kv-head k's
     # D-wide column slot; zeros elsewhere.  [B, H, D] -> [B, H, Hk*D] f32,
     # columns ordered (kv_head, d) to match the cache's trailing axis.
     qf = q.astype(jnp.float32) * sm_scale
     eye = jnp.eye(hk, dtype=jnp.float32)
-    q_exp = jnp.einsum("bkgd,ke->bkged", qf.reshape(b, hk, g, d), eye)
+    q_exp = jnp.einsum("bkgd,ke->bkged", qf.reshape(b, hk, g_heads, d), eye)
     q_exp = q_exp.reshape(b, h, hkd)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(b,),
+        grid=(b // g,),
         in_specs=[
-            pl.BlockSpec((1, h, hkd), lambda b_idx, *_: (b_idx, 0, 0)),
+            pl.BlockSpec((g, h, hkd), lambda i, *_: (i, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),  # cache stays in HBM
         ],
-        out_specs=pl.BlockSpec((1, h, hkd), lambda b_idx, *_: (b_idx, 0, 0)),
+        out_specs=pl.BlockSpec((g, h, hkd), lambda i, *_: (i, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((h, hkd), jnp.float32),
-            pltpu.VMEM((h, 128), jnp.float32),
-            pltpu.VMEM((h, 128), jnp.float32),
-            pltpu.VMEM((2, c, bs, hkd), cache.dtype),
-            pltpu.VMEM((2, c, bs, hkd), cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2 * c)),
+            pltpu.VMEM((g, h, hkd), jnp.float32),
+            pltpu.VMEM((g, h, 128), jnp.float32),
+            pltpu.VMEM((g, h, 128), jnp.float32),
+            pltpu.VMEM((2, g, c, 2, bs, hkd), cache.dtype),
+            pltpu.SemaphoreType.DMA((2, g, c)),
         ],
     )
 
     out = pl.pallas_call(
-        functools.partial(_kernel, c=c),
+        functools.partial(_kernel, c=c, g=g),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, hkd), q.dtype),
         interpret=interpret,
@@ -197,6 +222,6 @@ def paged_decode_attention(
     )
 
     # Collapse the block-diagonal layout back to [B, H, D].
-    out = out.reshape(b, hk, g, hk, d)
+    out = out.reshape(b, hk, g_heads, hk, d)
     out = jnp.einsum("bkged,ke->bkgd", out, jnp.eye(hk, dtype=out.dtype))
     return out.reshape(b, h, d)

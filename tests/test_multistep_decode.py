@@ -1,0 +1,120 @@
+"""Multi-step decode (K tokens per device dispatch): determinism vs the
+single-step path and vs HF; stop conditions mid-burst; block exhaustion."""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, EngineCore
+from dynamo_tpu.engine.request import EngineRequest
+from dynamo_tpu.llm.protocols import FinishReason, SamplingOptions, StopConditions
+from tests.test_engine import collect_greedy, hf_greedy, setup  # noqa: F401
+
+
+def _core(model, params, decode_steps, **kw):
+    cfg = EngineConfig(
+        max_batch_size=4, max_model_len=128, block_size=8, num_blocks=64,
+        prefill_buckets=[16, 32, 64, 128], decode_steps=decode_steps, **kw,
+    )
+    return EngineCore(model, params, cfg)
+
+
+def test_multistep_greedy_matches_hf(setup):  # noqa: F811
+    hf, model, params = setup
+    prompt = list(np.random.RandomState(21).randint(1, 128, size=13))
+    expect = hf_greedy(hf, prompt, 12)
+    for k in (2, 4, 5):
+        core = _core(model, params, decode_steps=k)
+        got, outs, _ = collect_greedy(core, prompt, 12, request_id=f"k{k}")
+        assert got == expect, f"decode_steps={k}"
+        assert outs[-1].finish_reason == FinishReason.LENGTH
+
+
+def test_multistep_batch_matches_single_step(setup):  # noqa: F811
+    hf, model, params = setup
+    rng = np.random.RandomState(22)
+    prompts = [list(rng.randint(1, 128, size=n)) for n in (9, 14, 23)]
+
+    def run(decode_steps):
+        core = _core(model, params, decode_steps=decode_steps)
+        outs = {i: [] for i in range(len(prompts))}
+        for i, p in enumerate(prompts):
+            core.submit(EngineRequest(
+                f"r{i}", list(p), SamplingOptions(temperature=0.0),
+                StopConditions(max_tokens=10), outs[i].append,
+            ))
+        for _ in range(200):
+            if not core.step():
+                break
+        return {i: [t for o in outs[i] for t in o.token_ids] for i in outs}
+
+    assert run(1) == run(4)
+
+
+def test_multistep_eos_mid_burst(setup):  # noqa: F811
+    hf, model, params = setup
+    prompt = list(np.random.RandomState(23).randint(1, 128, size=11))
+    # find what greedy emits, then make its 2nd token the EOS
+    core = _core(model, params, decode_steps=1)
+    ref, _, _ = collect_greedy(core, prompt, 6)
+    eos = ref[1]
+
+    cfg = EngineConfig(max_batch_size=4, max_model_len=128, block_size=8,
+                       num_blocks=64, prefill_buckets=[16, 32, 64, 128],
+                       decode_steps=4)
+    core = EngineCore(model, params, cfg, eos_token_ids=[eos])
+    outs = []
+    core.submit(EngineRequest(
+        "e", list(prompt), SamplingOptions(temperature=0.0),
+        StopConditions(max_tokens=20), outs.append,
+    ))
+    for _ in range(50):
+        if not core.step():
+            break
+    toks = [t for o in outs for t in o.token_ids]
+    # stops AT the EOS token even though the burst sampled past it
+    assert toks == ref[:2]
+    assert outs[-1].finish_reason == FinishReason.EOS
+    # slot freed; nothing left running
+    assert all(s is None for s in core.slots)
+
+
+def test_multistep_block_exhaustion_finishes_at_length(setup):  # noqa: F811
+    hf, model, params = setup
+    # 3 blocks of 8 → at most 24 tokens total per sequence (one seq only)
+    cfg = EngineConfig(max_batch_size=1, max_model_len=128, block_size=8,
+                       num_blocks=3, prefill_buckets=[16], decode_steps=4)
+    core = EngineCore(model, params, cfg)
+    outs = []
+    prompt = list(np.random.RandomState(24).randint(1, 128, size=10))
+    core.submit(EngineRequest(
+        "x", prompt, SamplingOptions(temperature=0.0),
+        StopConditions(max_tokens=100, ignore_eos=True), outs.append,
+    ))
+    for _ in range(60):
+        if not core.step():
+            break
+    toks = [t for o in outs for t in o.token_ids]
+    assert outs[-1].finish_reason == FinishReason.LENGTH
+    # 24 block-resident tokens + the final sampled token (whose KV is never needed)
+    # total tokens with KV ≤ 24, plus the final sampled token = 15 generated
+    assert len(toks) == 24 - 10 + 1
+    assert core.block_manager.free_blocks == 3  # everything released
+
+
+def test_multistep_respects_max_model_len(setup):  # noqa: F811
+    hf, model, params = setup
+    cfg = EngineConfig(max_batch_size=1, max_model_len=16, block_size=8,
+                       num_blocks=8, prefill_buckets=[16], decode_steps=4)
+    core = EngineCore(model, params, cfg)
+    outs = []
+    prompt = list(np.random.RandomState(25).randint(1, 128, size=10))
+    core.submit(EngineRequest(
+        "y", prompt, SamplingOptions(temperature=0.0),
+        StopConditions(max_tokens=100, ignore_eos=True), outs.append,
+    ))
+    for _ in range(30):
+        if not core.step():
+            break
+    toks = [t for o in outs for t in o.token_ids]
+    assert outs[-1].finish_reason == FinishReason.LENGTH
+    assert len(toks) == 16 - 10  # total tokens capped at max_model_len

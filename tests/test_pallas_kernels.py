@@ -13,18 +13,18 @@ from dynamo_tpu.ops.pallas.decode_attention import paged_decode_attention
 
 
 def _mk_cache(rng, n_layers, n, bs, hk, d, dtype=jnp.float32):
-    """Full multi-layer cache [L, 2, N, Bs, Hk*D] with random contents."""
+    """Full multi-layer cache [L, N, 2, Bs, Hk*D] with random contents."""
     return jnp.asarray(
-        rng.normal(size=(n_layers, 2, n, bs, hk * d)), dtype
+        rng.normal(size=(n_layers, n, 2, bs, hk * d)), dtype
     )
 
 
 def _oracle(q, cache, layer, bt, seq_lens):
-    l, _, n, bs, hkd = cache.shape
+    l, n, _, bs, hkd = cache.shape
     b, _, h, d = q.shape
     hk = hkd // d
-    kc = cache[layer, 0].reshape(n, bs, hk, d)
-    vc = cache[layer, 1].reshape(n, bs, hk, d)
+    kc = cache[layer, :, 0].reshape(n, bs, hk, d)
+    vc = cache[layer, :, 1].reshape(n, bs, hk, d)
     positions = (seq_lens - 1)[:, None].astype(jnp.int32)
     return paged_attention(q, kc, vc, bt, seq_lens, positions)[:, 0]
 

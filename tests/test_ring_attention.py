@@ -122,7 +122,8 @@ def test_seq_parallel_prefill_matches_paged(mesh):
     np.testing.assert_allclose(
         np.asarray(hidden_sp), np.asarray(hidden_paged), rtol=2e-4, atol=2e-4
     )
-    # kv_sp [L,2,1,S,HkD] vs cache blocks [L,2,n,Bs,HkD]
+    # kv_sp [L,2,1,S,HkD] vs cache blocks [L,n,2,Bs,HkD]
     got = np.asarray(kv_sp).reshape(cfg.num_layers, 2, n_blocks, bs, -1)
-    want = np.asarray(cache)[:, :, :n_blocks]
+    got = got.transpose(0, 2, 1, 3, 4)
+    want = np.asarray(cache)[:, :n_blocks]
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
