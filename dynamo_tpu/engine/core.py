@@ -50,13 +50,14 @@ __all__ = ["EngineCore", "unified_step", "multi_decode_step"]
 
 def unified_step(
     model, params, cache, tokens, positions, block_tables, seq_lens,
-    slot_idx, last_idx, rng, temp, top_k, top_p,
+    slot_idx, last_idx, rng, temp, top_k, top_p, prefix_blocks=None,
 ):
     """THE jitted serving step: forward over the paged cache, gather each
     row's last hidden state, project to logits, sample.  Shared by the
     engine hot loop and the driver's compile checks (__graft_entry__.py)."""
     hidden, cache = model.forward(
-        params, tokens, positions, cache, block_tables, seq_lens, slot_idx
+        params, tokens, positions, cache, block_tables, seq_lens, slot_idx,
+        prefix_blocks=prefix_blocks,
     )
     b = tokens.shape[0]
     last_h = hidden[jnp.arange(b), last_idx]  # [B, Dm]
@@ -161,7 +162,9 @@ class EngineCore:
         self.cache = cache
 
         self._rng = jax.random.PRNGKey(config.seed)
-        self._step_fn = jax.jit(self._step_impl, donate_argnums=(1,))
+        self._step_fn = jax.jit(
+            self._step_impl, donate_argnums=(1,), static_argnames=("prefix_blocks",)
+        )
         self._multi_fn = jax.jit(self._multi_impl, donate_argnums=(1,))
 
         self.slots: list[Optional[EngineRequest]] = [None] * config.max_batch_size
@@ -185,8 +188,9 @@ class EngineCore:
         self.tokens_generated = 0
 
     # ----------------------------------------------------------- step kernel
-    def _step_impl(self, params, cache, *args):
-        return unified_step(self.model, params, cache, *args)
+    def _step_impl(self, params, cache, *args, prefix_blocks=None):
+        return unified_step(self.model, params, cache, *args,
+                            prefix_blocks=prefix_blocks)
 
     def _multi_impl(self, params, cache, *args):
         return multi_decode_step(
@@ -196,7 +200,7 @@ class EngineCore:
         )
 
     def _run_step(self, tokens, positions, block_tables, seq_lens, slot_idx,
-                  last_idx, temp, top_k, top_p) -> np.ndarray:
+                  last_idx, temp, top_k, top_p, prefix_blocks=None) -> np.ndarray:
         self._rng, rng = jax.random.split(self._rng)
         sampled, self.cache = self._step_fn(
             self.params, self.cache,
@@ -205,6 +209,7 @@ class EngineCore:
             jnp.asarray(slot_idx), jnp.asarray(last_idx),
             rng,
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            prefix_blocks=prefix_blocks,
         )
         self.steps += 1
         return np.asarray(sampled)
@@ -403,11 +408,19 @@ class EngineCore:
         seq_lens = np.asarray([req.prompt_len], np.int32)
         last_idx = np.asarray([remaining - 1], np.int32)
 
+        # prefill fast path: cached-prefix blocks, bucketed to powers of two
+        # so the executable count stays O(log) (prefill_attention gathers
+        # only these instead of the whole padded table)
+        pb = req.computed_tokens // cfg.block_size
+        pb = 0 if pb == 0 else 1 << (pb - 1).bit_length()
+        pb = min(pb, m)
+
         sampled = self._run_step(
             tokens, positions, bt, seq_lens, slot_idx, last_idx,
             np.asarray([req.sampling.temperature], np.float32),
             np.asarray([req.sampling.top_k], np.int32),
             np.asarray([req.sampling.top_p], np.float32),
+            prefix_blocks=pb,
         )
         self.prefill_steps += 1
         req.computed_tokens = req.prompt_len

@@ -35,6 +35,7 @@ from jax.sharding import PartitionSpec as P
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops.paged_attention import (
     paged_attention_layer,
+    prefill_attention,
     write_kv_cache_layer,
 )
 
@@ -203,11 +204,21 @@ class LlamaModel:
         block_tables: jax.Array,  # [B, M] int32
         seq_lens: jax.Array,      # [B] int32 — context length incl. new tokens
         slot_idx: jax.Array,      # [B, S] int32 — cache slot per new token, -1 pad
+        prefix_blocks: int | None = None,  # STATIC — prefill fast path (see below)
     ) -> tuple[jax.Array, jax.Array]:
-        """Returns (hidden [B,S,Dm], updated kv_cache)."""
+        """Returns (hidden [B,S,Dm], updated kv_cache).
+
+        ``prefix_blocks`` (static int) activates the prefill fast path for
+        S>1: attention runs against this chunk's in-register K/V plus at
+        most ``prefix_blocks`` cached prefix blocks, instead of gathering
+        the whole padded block table.  Requires the S tokens of each row to
+        be contiguous from block-aligned position ``positions[:, 0]``
+        (exactly how the engine lays out prefill).  None = generic path.
+        """
         cfg = self.config
         b, s = tokens.shape
         dh, hq, hk = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+        fast_prefill = prefix_blocks is not None and s > 1
 
         hidden = jnp.take(params["embed"], tokens, axis=0)
 
@@ -223,9 +234,15 @@ class LlamaModel:
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
             cache = write_kv_cache_layer(cache, li, k, v, slot_idx)
-            attn = paged_attention_layer(
-                q, cache, li, block_tables, seq_lens, positions
-            )
+            if fast_prefill:
+                attn = prefill_attention(
+                    q, k, v, cache, li, block_tables, seq_lens,
+                    positions[:, 0], prefix_blocks,
+                )
+            else:
+                attn = paged_attention_layer(
+                    q, cache, li, block_tables, seq_lens, positions
+                )
             h = h + attn.reshape(b, s, hq * dh) @ lp["wo"]
 
             x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)

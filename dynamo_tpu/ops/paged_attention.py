@@ -30,6 +30,7 @@ __all__ = [
     "write_kv_cache_layer",
     "paged_attention",
     "paged_attention_layer",
+    "prefill_attention",
 ]
 
 
@@ -73,6 +74,69 @@ def paged_attention_layer(
     return paged_attention(
         q, k_cache, v_cache, block_tables, seq_lens, positions, sm_scale
     )
+
+
+def prefill_attention(
+    q: jax.Array,             # [B, S, H, D] — fresh queries (contiguous from `start`)
+    k_new: jax.Array,         # [B, S, Hk, D] — this chunk's keys (pre-cache-write values)
+    v_new: jax.Array,         # [B, S, Hk, D]
+    cache: jax.Array,         # [L, N, 2, Bs, Hk*D]
+    layer: jax.Array,         # scalar int32
+    block_tables: jax.Array,  # [B, M] int32
+    seq_lens: jax.Array,      # [B] int32 — context length incl. new tokens
+    start: jax.Array,         # [B] int32 — absolute position of q[:, 0] (block-aligned)
+    prefix_blocks: int,       # STATIC: cache blocks holding the cached prefix (bucketed)
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Prefill attention without gathering the sequence's whole block table.
+
+    The chunk's own K/V are right here in registers — only the *cached
+    prefix* (prefix-cache hits / earlier chunks) lives in the cache, and it
+    spans just ``prefix_blocks`` blocks (a compile-time bucket, usually 0 or
+    small).  The padded-table gather this replaces read M×Bs tokens per
+    layer regardless of context and dominated TTFT.
+
+    Fresh-fresh attention is causal by chunk index; fresh-prefix is full.
+    Padding tail rows (index ≥ seq_len−start) are masked out of everyone's
+    context.  Returns [B, S, H, D].
+    """
+    b, s, h, d = q.shape
+    hk = k_new.shape[2]
+    g = h // hk
+    if sm_scale is None:
+        sm_scale = 1.0 / (d**0.5)
+    qg = q.reshape(b, s, hk, g, d).astype(jnp.float32)
+    fresh = (seq_lens - start)[:, None, None]  # valid fresh tokens per row
+
+    sf = jnp.einsum("bskgd,btkd->bkgst", qg, k_new.astype(jnp.float32)) * sm_scale
+    i = jnp.arange(s, dtype=jnp.int32)
+    allow_f = (i[None, :, None] >= i[None, None, :]) & (i[None, None, :] < fresh)
+    sf = jnp.where(allow_f[:, None, None], sf, -jnp.inf)
+
+    if prefix_blocks == 0:
+        probs = jax.nn.softmax(sf, axis=-1)
+        out = jnp.einsum("bkgst,btkd->bskgd", probs, v_new.astype(jnp.float32))
+        return out.reshape(b, s, h, d).astype(q.dtype)
+
+    _, n, _, bs, hkd = cache.shape
+    layer_kv = jax.lax.dynamic_index_in_dim(cache, layer, axis=0, keepdims=False)
+    ctx = layer_kv[block_tables[:, :prefix_blocks]]  # [B, P, 2, Bs, HkD]
+    t = prefix_blocks * bs
+    kp = ctx[:, :, 0].reshape(b, t, hk, d)
+    vp = ctx[:, :, 1].reshape(b, t, hk, d)
+    sp = jnp.einsum("bskgd,btkd->bkgst", qg, kp.astype(jnp.float32)) * sm_scale
+    slot = jnp.arange(t, dtype=jnp.int32)
+    allow_p = slot[None, None, :] < start[:, None, None]
+    sp = jnp.where(allow_p[:, None, None], sp, -jnp.inf)
+
+    scores = jnp.concatenate([sp, sf], axis=-1)  # [B, Hk, G, S, T+S]
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgst,btkd->bskgd", probs[..., :t], vp.astype(jnp.float32)
+    ) + jnp.einsum(
+        "bkgst,btkd->bskgd", probs[..., t:], v_new.astype(jnp.float32)
+    )
+    return out.reshape(b, s, h, d).astype(q.dtype)
 
 
 def write_kv_cache_layer(
