@@ -1,0 +1,158 @@
+"""HTTP-level serving benchmark: concurrency sweep with TTFT/ITL/throughput.
+
+Reference parity: examples/llm/benchmarks/perf.sh + README (genai-perf
+concurrency sweep 1→256, ISL/OSL-controlled, ITL-matched throughput
+comparison).  Drives a live OpenAI endpoint with synthetic prompts of a
+fixed input length and measures, per concurrency level:
+
+  * output tok/s (aggregate)
+  * TTFT p50/p95 (ms)
+  * ITL mean (ms/token)
+
+Usage:
+  python benchmarks/serve_bench.py --url http://127.0.0.1:8080 \
+      --model llama --isl 3000 --osl 150 --concurrency 1,2,4,8,16
+
+With --spawn-echo it boots an in-process HttpService around the echo engine
+so the harness itself is testable without a TPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from aiohttp import ClientSession
+
+
+def _percentile(xs, p):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(round(p / 100 * (len(xs) - 1))))
+    return xs[i]
+
+
+async def one_request(session, url, model, prompt, osl):
+    t0 = time.perf_counter()
+    ttft = None
+    n_tokens = 0
+    async with session.post(
+        f"{url}/v1/completions",
+        json={"model": model, "prompt": prompt, "max_tokens": osl,
+              "temperature": 0.0, "stream": True, "ignore_eos": True},
+    ) as resp:
+        if resp.status != 200:
+            raise RuntimeError(f"HTTP {resp.status}: {await resp.text()}")
+        async for raw in resp.content:
+            line = raw.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            data = line[6:]
+            if data == "[DONE]":
+                break
+            chunk = json.loads(data)
+            got = sum(1 for c in chunk.get("choices", []) if c.get("text"))
+            if got and ttft is None:
+                ttft = time.perf_counter() - t0
+            n_tokens += got
+    total = time.perf_counter() - t0
+    return ttft or total, total, n_tokens
+
+
+async def sweep_level(url, model, prompt, osl, concurrency, requests_per_conc):
+    n_requests = concurrency * requests_per_conc
+    sem = asyncio.Semaphore(concurrency)
+    results = []
+
+    async with ClientSession() as session:
+        async def worker(i):
+            async with sem:
+                results.append(await one_request(session, url, model, prompt, osl))
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(worker(i) for i in range(n_requests)))
+        wall = time.perf_counter() - t0
+
+    ttfts = [r[0] * 1000 for r in results]
+    itls = [
+        (r[1] - r[0]) / max(r[2] - 1, 1) * 1000 for r in results if r[2] > 1
+    ]
+    total_tokens = sum(r[2] for r in results)
+    return {
+        "concurrency": concurrency,
+        "requests": n_requests,
+        "output_tok_s": round(total_tokens / wall, 1),
+        "ttft_p50_ms": round(_percentile(ttfts, 50), 1),
+        "ttft_p95_ms": round(_percentile(ttfts, 95), 1),
+        "itl_mean_ms": round(statistics.fmean(itls), 2) if itls else 0.0,
+    }
+
+
+async def run(args):
+    prompt = "benchmark " * max(1, args.isl // 2)  # ~isl whitespace tokens
+    rows = []
+    for conc in args.concurrency:
+        row = await sweep_level(
+            args.url, args.model, prompt, args.osl, conc, args.requests_per_conc
+        )
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    best = max(rows, key=lambda r: r["output_tok_s"])
+    print(json.dumps({"metric": "serve_output_tok_s", "value": best["output_tok_s"],
+                      "unit": "tok/s", "best_concurrency": best["concurrency"]}))
+    return rows
+
+
+async def run_with_echo(args):
+    """Self-contained mode for harness tests: echo engine behind HttpService."""
+    from tokenizers import Tokenizer, models as tok_models, pre_tokenizers
+    import os
+    import tempfile
+
+    from dynamo_tpu.llm.engines import EchoEngineCore, build_serving_pipeline
+    from dynamo_tpu.llm.http import HttpService, ModelManager
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+    vocab = {"<unk>": 0, "benchmark": 1}
+    tok = Tokenizer(tok_models.WordLevel(vocab=vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.WhitespaceSplit()
+    path = os.path.join(tempfile.mkdtemp(), "tok.json")
+    tok.save(path)
+    card = ModelDeploymentCard(name=args.model, tokenizer_path=path, context_length=8192)
+    manager = ModelManager()
+    manager.add_model(args.model, build_serving_pipeline(EchoEngineCore(), card), card)
+    svc = HttpService(manager, port=0)
+    await svc.start()
+    args.url = f"http://127.0.0.1:{svc.port}"
+    try:
+        return await run(args)
+    finally:
+        await svc.stop()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--url", default="http://127.0.0.1:8080")
+    p.add_argument("--model", default="model")
+    p.add_argument("--isl", type=int, default=3000)
+    p.add_argument("--osl", type=int, default=150)
+    p.add_argument("--concurrency", type=lambda s: [int(x) for x in s.split(",")],
+                   default=[1, 2, 4, 8, 16])
+    p.add_argument("--requests-per-conc", type=int, default=4)
+    p.add_argument("--spawn-echo", action="store_true",
+                   help="boot an in-process echo-engine server (harness test)")
+    args = p.parse_args(argv)
+    coro = run_with_echo(args) if args.spawn_echo else run(args)
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() else 1)

@@ -327,6 +327,18 @@ async def _cmd_deploy(args) -> None:
         print(_yaml.safe_dump_all(render_manifests(spec), sort_keys=False))
 
 
+# -------------------------------------------------------------- api store -----
+
+
+async def _cmd_api_store(args) -> None:
+    """Versioned graph registry with manifest rendering (api-store parity)."""
+    from dynamo_tpu.components.api_store import ApiStore
+
+    store = await ApiStore(db_path=args.db, host=args.host, port=args.port).start()
+    log.info("api-store on http://%s:%s (db %s)", store.host, store.port, args.db)
+    await asyncio.Event().wait()
+
+
 # ---------------------------------------------------------------- metrics -----
 
 
@@ -439,6 +451,11 @@ def _parser() -> argparse.ArgumentParser:
     deploy.add_argument("spec", help="DynamoTpuDeployment YAML")
     deploy.add_argument("-o", "--out", default=None, help="write one file per object")
 
+    store = sub.add_parser("api-store", help="versioned graph registry service")
+    store.add_argument("--db", default="graphs.db")
+    store.add_argument("--host", default="127.0.0.1")
+    store.add_argument("--port", type=int, default=7180)
+
     metrics = sub.add_parser("metrics", help="metrics aggregation service (Prometheus)")
     metrics.add_argument("--host", default="127.0.0.1")
     metrics.add_argument("--port", type=int, default=9091)
@@ -477,6 +494,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         asyncio.run(_cmd_coordinator(args))
     elif args.cmd == "deploy":
         asyncio.run(_cmd_deploy(args))
+    elif args.cmd == "api-store":
+        asyncio.run(_cmd_api_store(args))
     elif args.cmd == "metrics":
         asyncio.run(_cmd_metrics(args))
     elif args.cmd == "mock-worker":

@@ -1,0 +1,185 @@
+"""API store: versioned registry of packaged serving graphs.
+
+Reference parity: deploy/dynamo/api-store (FastAPI + Postgres store of
+packaged graphs/"bentos" that the k8s operator pulls deployment specs
+from).  Rebuilt lean: aiohttp + sqlite, storing DynamoTpuDeployment specs
+(deploy/renderer.py shape) with versions, and serving rendered k8s
+manifests straight from the store.
+
+REST surface:
+  POST   /api/v1/graphs                     {"name", "spec": <yaml|dict>, "labels"?}
+  GET    /api/v1/graphs                     list graphs (latest version each)
+  GET    /api/v1/graphs/{name}              all versions
+  GET    /api/v1/graphs/{name}/{ver}        one version (spec + metadata)
+  DELETE /api/v1/graphs/{name}/{ver}
+  GET    /api/v1/graphs/{name}/{ver}/manifests   rendered k8s objects (JSON list)
+
+Run via `dynamo-tpu api-store --db graphs.db --port 7180`.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import time
+from typing import Optional
+
+import yaml
+from aiohttp import web
+
+from dynamo_tpu.deploy.renderer import DeploymentSpec, render_manifests
+
+__all__ = ["ApiStore"]
+
+
+class ApiStore:
+    def __init__(self, db_path: str = ":memory:", host: str = "127.0.0.1", port: int = 7180):
+        self.db = sqlite3.connect(db_path)
+        self.db.execute(
+            """CREATE TABLE IF NOT EXISTS graphs (
+                 name TEXT NOT NULL,
+                 version INTEGER NOT NULL,
+                 spec TEXT NOT NULL,
+                 labels TEXT NOT NULL DEFAULT '{}',
+                 created_at REAL NOT NULL,
+                 PRIMARY KEY (name, version)
+               )"""
+        )
+        self.host = host
+        self.port = port
+        self._runner: Optional[web.AppRunner] = None
+
+    # ------------------------------------------------------------------ CRUD
+    def put_graph(self, name: str, spec: dict, labels: Optional[dict] = None) -> int:
+        # the spec must render — reject broken uploads at the door
+        render_manifests(self._to_spec(spec))
+        cur = self.db.execute(
+            "SELECT COALESCE(MAX(version), 0) FROM graphs WHERE name = ?", (name,)
+        )
+        version = cur.fetchone()[0] + 1
+        self.db.execute(
+            "INSERT INTO graphs (name, version, spec, labels, created_at) VALUES (?,?,?,?,?)",
+            (name, version, json.dumps(spec), json.dumps(labels or {}), time.time()),
+        )
+        self.db.commit()
+        return version
+
+    def list_graphs(self) -> list[dict]:
+        cur = self.db.execute(
+            """SELECT name, MAX(version), created_at FROM graphs
+               GROUP BY name ORDER BY name"""
+        )
+        return [
+            {"name": n, "latest_version": v, "created_at": t}
+            for n, v, t in cur.fetchall()
+        ]
+
+    def get_versions(self, name: str) -> list[dict]:
+        cur = self.db.execute(
+            "SELECT version, labels, created_at FROM graphs WHERE name = ? ORDER BY version",
+            (name,),
+        )
+        return [
+            {"version": v, "labels": json.loads(l), "created_at": t}
+            for v, l, t in cur.fetchall()
+        ]
+
+    def get_graph(self, name: str, version: Optional[int] = None) -> Optional[dict]:
+        if version is None:
+            cur = self.db.execute(
+                "SELECT version, spec, labels, created_at FROM graphs "
+                "WHERE name = ? ORDER BY version DESC LIMIT 1", (name,),
+            )
+        else:
+            cur = self.db.execute(
+                "SELECT version, spec, labels, created_at FROM graphs "
+                "WHERE name = ? AND version = ?", (name, version),
+            )
+        row = cur.fetchone()
+        if row is None:
+            return None
+        v, spec, labels, t = row
+        return {
+            "name": name, "version": v, "spec": json.loads(spec),
+            "labels": json.loads(labels), "created_at": t,
+        }
+
+    def delete_graph(self, name: str, version: int) -> bool:
+        cur = self.db.execute(
+            "DELETE FROM graphs WHERE name = ? AND version = ?", (name, version)
+        )
+        self.db.commit()
+        return cur.rowcount > 0
+
+    @staticmethod
+    def _to_spec(spec: dict) -> DeploymentSpec:
+        return DeploymentSpec.from_yaml(yaml.safe_dump(spec))
+
+    # ------------------------------------------------------------------ HTTP
+    async def _post_graph(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        spec = body.get("spec")
+        if isinstance(spec, str):
+            spec = yaml.safe_load(spec)
+        if not isinstance(spec, dict) or "name" not in body:
+            raise web.HTTPBadRequest(text="need {name, spec}")
+        try:
+            version = self.put_graph(body["name"], spec, body.get("labels"))
+        except (KeyError, ValueError, TypeError) as e:
+            raise web.HTTPUnprocessableEntity(text=f"spec does not render: {e}")
+        return web.json_response({"name": body["name"], "version": version}, status=201)
+
+    async def _list(self, request: web.Request) -> web.Response:
+        return web.json_response(self.list_graphs())
+
+    async def _versions(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        versions = self.get_versions(name)
+        if not versions:
+            raise web.HTTPNotFound
+        return web.json_response(versions)
+
+    async def _get(self, request: web.Request) -> web.Response:
+        g = self.get_graph(
+            request.match_info["name"], int(request.match_info["ver"])
+        )
+        if g is None:
+            raise web.HTTPNotFound
+        return web.json_response(g)
+
+    async def _delete(self, request: web.Request) -> web.Response:
+        ok = self.delete_graph(
+            request.match_info["name"], int(request.match_info["ver"])
+        )
+        if not ok:
+            raise web.HTTPNotFound
+        return web.json_response({"deleted": True})
+
+    async def _manifests(self, request: web.Request) -> web.Response:
+        g = self.get_graph(
+            request.match_info["name"], int(request.match_info["ver"])
+        )
+        if g is None:
+            raise web.HTTPNotFound
+        return web.json_response(render_manifests(self._to_spec(g["spec"])))
+
+    async def start(self) -> "ApiStore":
+        app = web.Application()
+        app.router.add_post("/api/v1/graphs", self._post_graph)
+        app.router.add_get("/api/v1/graphs", self._list)
+        app.router.add_get("/api/v1/graphs/{name}", self._versions)
+        app.router.add_get("/api/v1/graphs/{name}/{ver}", self._get)
+        app.router.add_delete("/api/v1/graphs/{name}/{ver}", self._delete)
+        app.router.add_get("/api/v1/graphs/{name}/{ver}/manifests", self._manifests)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = self._runner.addresses[0][1]
+        return self
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+            self._runner = None
+        self.db.close()

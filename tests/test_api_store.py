@@ -1,0 +1,65 @@
+"""API store: versioned graph registry + manifest rendering over HTTP."""
+
+import asyncio
+
+from aiohttp import ClientSession
+
+from dynamo_tpu.components.api_store import ApiStore
+
+SPEC = {
+    "name": "g1",
+    "image": "dynamo-tpu:latest",
+    "services": {
+        "decode": {
+            "command": ["dynamo-tpu", "run", "in=dyn://d.w.generate", "out=tpu"],
+            "tpu": {"type": "v5e", "topology": "2x2", "chips": 4},
+        }
+    },
+}
+
+
+def test_api_store_rest_roundtrip():
+    asyncio.new_event_loop().run_until_complete(_roundtrip())
+
+
+async def _roundtrip():
+    store = await ApiStore(db_path=":memory:", port=0).start()
+    base = f"http://127.0.0.1:{store.port}/api/v1"
+    try:
+        async with ClientSession() as s:
+            # upload twice → versions 1, 2
+            r = await s.post(f"{base}/graphs", json={"name": "demo", "spec": SPEC})
+            assert r.status == 201 and (await r.json())["version"] == 1
+            r = await s.post(f"{base}/graphs",
+                             json={"name": "demo", "spec": SPEC, "labels": {"env": "prod"}})
+            assert (await r.json())["version"] == 2
+
+            r = await s.get(f"{base}/graphs")
+            listing = await r.json()
+            assert listing == [{"name": "demo", "latest_version": 2,
+                                "created_at": listing[0]["created_at"]}]
+
+            r = await s.get(f"{base}/graphs/demo")
+            assert [v["version"] for v in await r.json()] == [1, 2]
+
+            r = await s.get(f"{base}/graphs/demo/2")
+            g = await r.json()
+            assert g["labels"] == {"env": "prod"}
+            assert g["spec"]["name"] == "g1"
+
+            # rendered manifests straight from the store
+            r = await s.get(f"{base}/graphs/demo/1/manifests")
+            objs = await r.json()
+            names = {o["metadata"]["name"] for o in objs}
+            assert "g1-decode" in names and "g1-coordinator" in names
+
+            # invalid spec rejected at upload
+            r = await s.post(f"{base}/graphs", json={"name": "bad", "spec": {"nope": 1}})
+            assert r.status == 422
+
+            r = await s.delete(f"{base}/graphs/demo/1")
+            assert (await r.json())["deleted"]
+            r = await s.get(f"{base}/graphs/demo/1")
+            assert r.status == 404
+    finally:
+        await store.stop()

@@ -1,0 +1,24 @@
+"""The serving benchmark harness runs end-to-end against the echo engine."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+
+def test_serve_bench_echo_mode():
+    repo = Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [sys.executable, "benchmarks/serve_bench.py", "--spawn-echo",
+         "--isl", "32", "--osl", "8", "--concurrency", "1,2",
+         "--requests-per-conc", "2"],
+        capture_output=True, text=True, timeout=240, cwd=str(repo),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [json.loads(l) for l in out.stdout.splitlines() if l.strip()]
+    summary = lines[-1]
+    assert summary["metric"] == "serve_output_tok_s"
+    assert summary["value"] > 0
+    levels = lines[:-1]
+    assert [l["concurrency"] for l in levels] == [1, 2]
+    assert all(l["ttft_p50_ms"] >= 0 for l in levels)
