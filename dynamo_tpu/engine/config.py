@@ -26,6 +26,14 @@ class EngineConfig:
     # >1 amortises dispatch overhead at the cost of stop-condition
     # granularity (up to decode_steps-1 discarded samples per request)
     decode_steps: int = 1
+    # chunked prefill: max prompt tokens computed per prefill dispatch
+    # (0 = whole remainder in one step).  Bounding the chunk keeps decode
+    # ITL flat while long prompts prefill — the scheduler alternates one
+    # prefill chunk with one decode burst when both have work (the
+    # reference gets this from vLLM's chunked-prefill scheduler; ours is
+    # native).  Rounded down to a block multiple so resumed chunks stay
+    # block-aligned for the prefill fast path.
+    prefill_chunk_tokens: int = 0
     # paged cache
     block_size: int = 16
     num_blocks: int = 512             # cache blocks in HBM
@@ -43,6 +51,13 @@ class EngineConfig:
         if not self.prefill_buckets:
             self.prefill_buckets = default_buckets(self.max_model_len)
         self.prefill_buckets = sorted(self.prefill_buckets)
+        if self.prefill_chunk_tokens:
+            # block-align the chunk so every resumed chunk starts on a block
+            # boundary (required by the prefill fast path)
+            self.prefill_chunk_tokens = max(
+                self.block_size,
+                self.prefill_chunk_tokens // self.block_size * self.block_size,
+            )
 
     @property
     def max_blocks_per_seq(self) -> int:

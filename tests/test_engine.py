@@ -200,3 +200,55 @@ def test_sampling_with_temperature_runs(setup):
     toks = [t for o in outs for t in o.token_ids]
     assert len(toks) == 10
     assert all(0 <= t < 128 for t in toks)
+
+
+def test_chunked_prefill_matches_unchunked(setup):
+    """Greedy output is identical whether the prompt prefills in one step
+    or in block-aligned chunks (chunked prefill, VERDICT r1 #2)."""
+    hf, model, params = setup
+    prompt = list(np.random.RandomState(7).randint(1, 128, size=50))
+    expect = hf_greedy(hf, prompt, 6)
+
+    core = make_core(model, params, prefill_chunk_tokens=16)
+    got, outs, _ = collect_greedy(core, prompt, 6)
+    assert got == expect
+    # 50 tokens / 16-token chunks -> 4 prefill dispatches (16+16+16+2)
+    assert core.prefill_steps == 4
+
+
+def test_chunked_prefill_interleaves_decode(setup):
+    """While a long prompt prefills in chunks, already-running requests
+    keep decoding between chunks — decode never stalls for the whole
+    prompt (bounded ITL)."""
+    hf, model, params = setup
+    rng = np.random.RandomState(8)
+    short = list(rng.randint(1, 128, size=5))
+    long = list(rng.randint(1, 128, size=64))
+    e_short = hf_greedy(hf, short, 12)
+    e_long = hf_greedy(hf, long, 4)
+
+    core = make_core(model, params, prefill_chunk_tokens=16)
+    outs_s, outs_l = [], []
+    core.submit(EngineRequest("s", short, SamplingOptions(temperature=0.0),
+                              StopConditions(max_tokens=12), outs_s.append))
+    # let the short request prefill and start decoding
+    core.step()
+    assert core.prefill_steps == 1
+    core.submit(EngineRequest("l", long, SamplingOptions(temperature=0.0),
+                              StopConditions(max_tokens=4), outs_l.append))
+
+    # record the phase of each scheduling iteration
+    phases = []
+    while core.step():
+        phases.append((core.prefill_steps, core.decode_steps))
+    assert [t for o in outs_s for t in o.token_ids] == e_short
+    assert [t for o in outs_l for t in o.token_ids] == e_long
+
+    # the long prompt took 4 chunks (64/16); decode steps advanced between
+    # consecutive prefill chunks (interleaving, not a prefill stall)
+    prefill_iters = [i for i, (p, d) in enumerate(phases)
+                     if p > (phases[i - 1][0] if i else 1)]
+    assert len(prefill_iters) == 4
+    for a, b in zip(prefill_iters, prefill_iters[1:]):
+        assert any(phases[i][1] > phases[a][1] for i in range(a + 1, b + 1)), \
+            f"no decode progress between prefill chunks at iters {a}..{b}"
