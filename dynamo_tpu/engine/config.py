@@ -40,6 +40,10 @@ class EngineConfig:
     num_host_blocks: int = 0          # host-RAM offload tier (0 = disabled)
     cache_dtype: Optional[str] = None  # default: model dtype
     enable_prefix_reuse: bool = True
+    # force exact lax.top_k candidate selection in the sampler (the default
+    # approx_max_k path is exact for greedy and ~0.95-recall for the deep
+    # tail; requests with top_k > 64 switch to exact automatically)
+    exact_sampling: bool = False
     # prefill
     prefill_buckets: list[int] = field(default_factory=list)
     # sharding: data/model axis sizes; 1,1 = single chip

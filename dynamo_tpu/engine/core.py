@@ -36,7 +36,7 @@ import numpy as np
 
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.request import EngineRequest, RequestState
-from dynamo_tpu.engine.sampling import sample_tokens
+from dynamo_tpu.engine.sampling import K_MAX, sample_full
 from dynamo_tpu.ops.block_copy import gather_blocks_padded, scatter_blocks_inplace
 from dynamo_tpu.llm.kv.block_manager import KvBlockManager, NoFreeBlocks
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput
@@ -51,10 +51,14 @@ __all__ = ["EngineCore", "unified_step", "multi_decode_step"]
 def unified_step(
     model, params, cache, tokens, positions, block_tables, seq_lens,
     slot_idx, last_idx, rng, temp, top_k, top_p, prefix_blocks=None,
+    k_cand=K_MAX, exact=False,
 ):
     """THE jitted serving step: forward over the paged cache, gather each
     row's last hidden state, project to logits, sample.  Shared by the
-    engine hot loop and the driver's compile checks (__graft_entry__.py)."""
+    engine hot loop and the driver's compile checks (__graft_entry__.py).
+
+    Returns ((sampled [B], logprob [B], cand_ids [B,C], cand_lps [B,C]),
+    cache) — candidate arrays feed OpenAI top_logprobs."""
     hidden, cache = model.forward(
         params, tokens, positions, cache, block_tables, seq_lens, slot_idx,
         prefix_blocks=prefix_blocks,
@@ -62,13 +66,16 @@ def unified_step(
     b = tokens.shape[0]
     last_h = hidden[jnp.arange(b), last_idx]  # [B, Dm]
     logits = model.compute_logits(params, last_h)  # [B, V] f32
-    sampled = sample_tokens(logits, rng, temp, top_k, top_p)
-    return sampled, cache
+    out = sample_full(logits, rng, temp, top_k, top_p, k_cand=k_cand, exact=exact)
+    return out, cache
 
 
 def multi_decode_step(
     model, params, cache, last_tokens, positions, block_tables, seq_lens,
-    limits, rng, temp, top_k, top_p, *, num_steps: int, block_size: int,
+    limits, rng, temp, top_k, top_p,
+    pen_tokens=None, pen_first=None, pen_cursor=None, freq_pen=None,
+    pres_pen=None, *, num_steps: int, block_size: int,
+    k_cand: int = K_MAX, exact: bool = False, use_penalties: bool = False,
 ):
     """K decode iterations fully on device in one dispatch (multi-step
     scheduling): forward → sample → feed the token back, K times under one
@@ -79,12 +86,24 @@ def multi_decode_step(
     ``limits[i]`` is the max total tokens sequence i has block space for
     (and may not exceed max_model_len): a position at/past its limit
     writes no KV (slot -1 → dropped) and the host discards its samples.
-    Inactive rows have limits=0.  Returns (sampled [K, B], cache).
+    Inactive rows have limits=0.
+
+    With ``use_penalties`` (static) the generated-token buffer
+    (``pen_tokens`` [B,T] -1-padded, ``pen_first`` first-occurrence mask,
+    ``pen_cursor`` [B] next write index) rides the scan carry: each newly
+    sampled token is appended on device so mid-burst repeats are penalised
+    without a host round-trip.
+
+    Returns ((sampled [K,B], logprob [K,B], cand_ids [K,B,C],
+    cand_lps [K,B,C]), cache).
     """
     m = block_tables.shape[1]
 
     def one(carry, rng_k):
-        cache, toks, pos, lens = carry
+        if use_penalties:
+            cache, toks, pos, lens, ptoks, pfirst, cur = carry
+        else:
+            cache, toks, pos, lens = carry
         blk = jnp.minimum(pos // block_size, m - 1)
         base = jnp.take_along_axis(block_tables, blk[:, None], axis=1)[:, 0]
         slot = base * block_size + pos % block_size
@@ -94,17 +113,35 @@ def multi_decode_step(
             slot[:, None],
         )
         logits = model.compute_logits(params, hidden[:, 0])
-        sampled = sample_tokens(logits, rng_k, temp, top_k, top_p)
+        sampled, lp, cids, clps = sample_full(
+            logits, rng_k, temp, top_k, top_p,
+            ptoks if use_penalties else None,
+            pfirst if use_penalties else None,
+            freq_pen if use_penalties else None,
+            pres_pen if use_penalties else None,
+            k_cand=k_cand, exact=exact,
+        )
         # clamp the context length at the limit: past it no KV was written,
         # and an unclamped length would walk the block table out of bounds
-        return (cache, sampled, pos + 1, jnp.minimum(lens + 1, limits)), sampled
+        new_lens = jnp.minimum(lens + 1, limits)
+        ys = (sampled, lp, cids, clps)
+        if use_penalties:
+            b = sampled.shape[0]
+            rows = jnp.arange(b, dtype=jnp.int32)
+            seen = jnp.any(ptoks == sampled[:, None], axis=-1)
+            t_cap = ptoks.shape[1]
+            at = jnp.minimum(cur, t_cap - 1)
+            ptoks = ptoks.at[rows, at].set(sampled)
+            pfirst = pfirst.at[rows, at].set(~seen)
+            cur = jnp.minimum(cur + 1, t_cap - 1)
+            return (cache, sampled, pos + 1, new_lens, ptoks, pfirst, cur), ys
+        return (cache, sampled, pos + 1, new_lens), ys
 
-    (cache, _, _, _), out = jax.lax.scan(
-        one,
-        (cache, last_tokens, positions, seq_lens),
-        jax.random.split(rng, num_steps),
-    )
-    return out, cache
+    init = (cache, last_tokens, positions, seq_lens)
+    if use_penalties:
+        init = init + (pen_tokens, pen_first, pen_cursor)
+    carry, out = jax.lax.scan(one, init, jax.random.split(rng, num_steps))
+    return out, carry[0]
 
 
 class EngineCore:
@@ -163,9 +200,13 @@ class EngineCore:
 
         self._rng = jax.random.PRNGKey(config.seed)
         self._step_fn = jax.jit(
-            self._step_impl, donate_argnums=(1,), static_argnames=("prefix_blocks",)
+            self._step_impl, donate_argnums=(1,),
+            static_argnames=("prefix_blocks", "k_cand", "exact"),
         )
-        self._multi_fn = jax.jit(self._multi_impl, donate_argnums=(1,))
+        self._multi_fn = jax.jit(
+            self._multi_impl, donate_argnums=(1,),
+            static_argnames=("k_cand", "exact", "use_penalties"),
+        )
 
         self.slots: list[Optional[EngineRequest]] = [None] * config.max_batch_size
         self.waiting: "queue.SimpleQueue[EngineRequest]" = queue.SimpleQueue()
@@ -189,45 +230,73 @@ class EngineCore:
         self._last_was_prefill = False
 
     # ----------------------------------------------------------- step kernel
-    def _step_impl(self, params, cache, *args, prefix_blocks=None):
+    def _step_impl(self, params, cache, *args, prefix_blocks=None,
+                   k_cand=K_MAX, exact=False):
         return unified_step(self.model, params, cache, *args,
-                            prefix_blocks=prefix_blocks)
+                            prefix_blocks=prefix_blocks, k_cand=k_cand,
+                            exact=exact)
 
-    def _multi_impl(self, params, cache, *args):
+    def _multi_impl(self, params, cache, *args, k_cand=K_MAX, exact=False,
+                    use_penalties=False):
         return multi_decode_step(
             self.model, params, cache, *args,
             num_steps=max(1, self.config.decode_steps),
             block_size=self.config.block_size,
+            k_cand=k_cand, exact=exact, use_penalties=use_penalties,
         )
 
+    def _sampling_mode(self, reqs) -> tuple[int, bool]:
+        """(k_cand, exact) for this dispatch: exact full top-k whenever a
+        request asks for top_k beyond the approx candidate set, so large
+        top_k never silently truncates.  k_cand is power-of-two bucketed
+        (executable count stays O(log)) and capped at 1024 — the deep tail
+        beyond that carries negligible probability mass."""
+        want = max((r.sampling.top_k for r in reqs), default=0)
+        exact = bool(self.config.exact_sampling)
+        k_cand = K_MAX
+        if want > K_MAX:
+            k_cand = min(1 << (want - 1).bit_length(), 1024)
+            exact = True
+        return k_cand, exact
+
     def _run_step(self, tokens, positions, block_tables, seq_lens, slot_idx,
-                  last_idx, temp, top_k, top_p, prefix_blocks=None) -> np.ndarray:
+                  last_idx, temp, top_k, top_p, prefix_blocks=None,
+                  k_cand=K_MAX, exact=False):
+        """Returns (sampled [B], logprob [B], cand_ids [B,C], cand_lps [B,C])."""
         self._rng, rng = jax.random.split(self._rng)
-        sampled, self.cache = self._step_fn(
+        out, self.cache = self._step_fn(
             self.params, self.cache,
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(block_tables), jnp.asarray(seq_lens),
             jnp.asarray(slot_idx), jnp.asarray(last_idx),
             rng,
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
-            prefix_blocks=prefix_blocks,
+            prefix_blocks=prefix_blocks, k_cand=k_cand, exact=exact,
         )
         self.steps += 1
-        return np.asarray(sampled)
+        return tuple(np.asarray(a) for a in out)
 
     def _run_multi_decode_step(self, tokens, positions, block_tables, seq_lens,
-                               limits, temp, top_k, top_p) -> np.ndarray:
-        """Dispatch one multi-step decode; returns sampled tokens [K, B]."""
+                               limits, temp, top_k, top_p, pen=None,
+                               k_cand=K_MAX, exact=False):
+        """Dispatch one multi-step decode; returns (sampled [K,B],
+        logprob [K,B], cand_ids [K,B,C], cand_lps [K,B,C])."""
         self._rng, rng = jax.random.split(self._rng)
-        sampled, self.cache = self._multi_fn(
-            self.params, self.cache,
+        args = [
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(block_tables), jnp.asarray(seq_lens),
             jnp.asarray(limits), rng,
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+        ]
+        use_pen = pen is not None
+        if use_pen:
+            args += [jnp.asarray(a) for a in pen]
+        out, self.cache = self._multi_fn(
+            self.params, self.cache, *args,
+            k_cand=k_cand, exact=exact, use_penalties=use_pen,
         )
         self.steps += 1
-        return np.asarray(sampled)
+        return tuple(np.asarray(a) for a in out)
 
     # ------------------------------------------------------- cross-thread API
     def submit(self, request: EngineRequest) -> None:
@@ -441,12 +510,13 @@ class EngineCore:
         pb = 0 if pb == 0 else 1 << (pb - 1).bit_length()
         pb = min(pb, m)
 
-        sampled = self._run_step(
+        k_cand, exact = self._sampling_mode([req])
+        sampled, lps, cids, clps = self._run_step(
             tokens, positions, bt, seq_lens, slot_idx, last_idx,
             np.asarray([req.sampling.temperature], np.float32),
             np.asarray([req.sampling.top_k], np.int32),
             np.asarray([req.sampling.top_p], np.float32),
-            prefix_blocks=pb,
+            prefix_blocks=pb, k_cand=k_cand, exact=exact,
         )
         self.prefill_steps += 1
         req.computed_tokens = end
@@ -478,7 +548,8 @@ class EngineCore:
                 )
             )
             return
-        self._append_token(req, int(sampled[0]), first=True)
+        self._append_token(req, int(sampled[0]), first=True,
+                           logprob=float(lps[0]), cand=(cids[0], clps[0]))
 
     # ----------------------------------------------------------------- decode
     def _run_decode(self) -> None:
@@ -532,24 +603,70 @@ class EngineCore:
         # growth allocations above may have evicted registered blocks that
         # this very dispatch writes into — offload them first
         self._drain_offload()
-        sampled = self._run_multi_decode_step(
-            tokens, positions, bt, seq_lens, limits, temp, top_k, top_p
-        )  # [K, B]
+        k_cand, exact = self._sampling_mode(active)
+        pen = self._penalty_buffers(active, k_steps)
+        sampled, lps, cids, clps = self._run_multi_decode_step(
+            tokens, positions, bt, seq_lens, limits, temp, top_k, top_p,
+            pen=pen, k_cand=k_cand, exact=exact,
+        )  # [K, B], [K, B], [K, B, C], [K, B, C]
         self.decode_steps += sampled.shape[0]
         for req in active:
             slot = req.slot
+            want_lp = req.sampling.logprobs or req.sampling.top_logprobs > 0
             # samples at/past the limit wrote no KV — not appendable
             allowed = min(sampled.shape[0], int(limits[slot] - positions[slot]))
             for k in range(allowed):
                 if req.state is not RequestState.RUNNING:
                     break  # EOS/stop/max_tokens hit mid-burst
-                self._append_token(req, int(sampled[k, slot]))
+                self._append_token(
+                    req, int(sampled[k, slot]),
+                    logprob=float(lps[k, slot]) if want_lp else None,
+                    cand=(cids[k, slot], clps[k, slot]) if want_lp else None,
+                )
             if req.state is RequestState.RUNNING and allowed < sampled.shape[0]:
                 # block space exhausted before the burst ended
                 self._finish_slot(req, FinishReason.LENGTH)
 
+    def _penalty_buffers(self, active, k_steps: int):
+        """Build the generated-token penalty buffers for this dispatch, or
+        None when no active request uses penalties (the common case pays
+        nothing — ``use_penalties`` is a static jit arg).
+
+        [B, T] token buffer (-1 pad) + first-occurrence mask + per-row
+        cursor; T is power-of-two bucketed over (max generated + burst) so
+        the executable count stays O(log max_model_len)."""
+        if not any(
+            r.sampling.frequency_penalty or r.sampling.presence_penalty
+            for r in active
+        ):
+            return None
+        b = self.config.max_batch_size
+        longest = max(r.seq.total_tokens - r.prompt_len for r in active)
+        t_cap = max(16, 1 << (longest + k_steps - 1).bit_length())
+        t_cap = min(t_cap, max(16, 1 << (self.config.max_model_len - 1).bit_length()))
+        ptoks = np.full((b, t_cap), -1, np.int32)
+        pfirst = np.zeros((b, t_cap), bool)
+        cursor = np.zeros(b, np.int32)
+        freq = np.zeros(b, np.float32)
+        pres = np.zeros(b, np.float32)
+        for r in active:
+            i = r.slot
+            gen = r.seq.tokens[r.prompt_len:]
+            n = min(len(gen), t_cap)
+            seen: set[int] = set()
+            for j, t in enumerate(gen[:n]):
+                ptoks[i, j] = t
+                if t not in seen:
+                    pfirst[i, j] = True
+                    seen.add(t)
+            cursor[i] = n
+            freq[i] = r.sampling.frequency_penalty
+            pres[i] = r.sampling.presence_penalty
+        return ptoks, pfirst, cursor, freq, pres
+
     # ------------------------------------------------------------- lifecycle
-    def _append_token(self, req: EngineRequest, token: int, first: bool = False) -> None:
+    def _append_token(self, req: EngineRequest, token: int, first: bool = False,
+                      logprob: Optional[float] = None, cand=None) -> None:
         """Record a sampled token, emit the delta, apply stop conditions.
 
         The token's KV is *not* yet in the cache — it is computed by the next
@@ -589,6 +706,14 @@ class EngineCore:
         out = LLMEngineOutput(
             token_ids=[token], finish_reason=finish, cached_tokens=req.cached_tokens
         )
+        if logprob is not None and (req.sampling.logprobs or req.sampling.top_logprobs):
+            out.logprobs = [logprob]
+            n = req.sampling.top_logprobs
+            if n > 0 and cand is not None:
+                ids, lps = cand
+                out.top_logprobs = [
+                    [(int(i), float(l)) for i, l in zip(ids[:n], lps[:n])]
+                ]
         req.emit(out)
         if finish is not None:
             self._finish_slot(req, finish, emitted=True)

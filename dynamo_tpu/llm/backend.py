@@ -32,6 +32,28 @@ class Backend(Operator):
     ) -> AsyncIterator[LLMEngineOutput]:
         return self._detokenize(stream, request)
 
+    def _logprob_content(self, out: LLMEngineOutput) -> list[dict]:
+        """Map engine logprob data (token ids) to OpenAI display form
+        (token strings + UTF-8 bytes), one entry per emitted token."""
+        entries = []
+        tops = out.top_logprobs or [None] * len(out.token_ids)
+        for tid, lp, top in zip(out.token_ids, out.logprobs, tops):
+            s = self.tokenizer.decode([tid], skip_special_tokens=False)
+            e = {"token": s, "logprob": lp, "bytes": list(s.encode())}
+            if top:
+                e["top_logprobs"] = [
+                    {
+                        "token": (ts := self.tokenizer.decode([int(i)], skip_special_tokens=False)),
+                        "logprob": float(l),
+                        "bytes": list(ts.encode()),
+                    }
+                    for i, l in top
+                ]
+            else:
+                e["top_logprobs"] = []
+            entries.append(e)
+        return entries
+
     async def _detokenize(
         self, stream: AsyncIterator[LLMEngineOutput], request: Context[BackendInput]
     ) -> AsyncIterator[LLMEngineOutput]:
@@ -45,6 +67,8 @@ class Backend(Operator):
             for tid in out.token_ids:
                 text += decoder.step(tid)
             held += text
+            if out.logprobs is not None:
+                out.logprob_content = self._logprob_content(out)
 
             if stop_strings:
                 hit = None

@@ -43,10 +43,17 @@ class EchoEngineCore(AsyncEngine):
             if self.delay_s:
                 await asyncio.sleep(self.delay_s)
             last = i + 1 >= max_tokens or i + 1 >= len(inp.token_ids)
-            yield LLMEngineOutput(
+            out = LLMEngineOutput(
                 token_ids=[tid],
                 finish_reason=FinishReason.LENGTH if last else None,
             )
+            if inp.sampling.logprobs or inp.sampling.top_logprobs:
+                # deterministic fake logprobs so the protocol surface is
+                # testable without a model (real values come from the engine)
+                out.logprobs = [-0.5]
+                if inp.sampling.top_logprobs > 0:
+                    out.top_logprobs = [[(tid, -0.5)]]
+            yield out
             if last:
                 return
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 from dataclasses import dataclass
 from typing import AsyncIterator, Optional
 
@@ -30,9 +31,12 @@ from dynamo_tpu.llm.model_card import ModelDeploymentCard
 from dynamo_tpu.llm.openai import (
     SSE_DONE,
     OpenAIError,
-    aggregate_stream,
     chat_chunk,
+    chat_logprobs_block,
+    chat_response,
     completion_chunk,
+    completion_logprobs_block,
+    completion_response,
     new_id,
     parse_request,
     sse_encode,
@@ -143,12 +147,14 @@ class HttpService:
             parsed = parse_request(body, chat=chat)
             entry = self.manager.get(parsed.model)
             guard = self.metrics.guard(parsed.model, endpoint)
-            ctx = Context(parsed)
             rid = new_id("chatcmpl" if chat else "cmpl")
-            stream = entry.engine.generate(ctx)
+            # n>1: fan out independent generations of the same prompt; the
+            # engine's prefix cache dedupes their prefill KV
+            ctxs = [Context(parsed) for _ in range(parsed.n)]
+            streams = [entry.engine.generate(c) for c in ctxs]
             if parsed.stream:
-                return await self._stream_response(request, ctx, stream, rid, parsed, chat, guard)
-            return await self._unary_response(ctx, stream, rid, parsed, chat, guard)
+                return await self._stream_response(request, ctxs, streams, rid, parsed, chat, guard)
+            return await self._unary_response(ctxs, streams, rid, parsed, chat, guard)
         except OpenAIError as e:
             if guard:
                 guard.status("error")
@@ -162,25 +168,33 @@ class HttpService:
                 guard.close()
 
     # ------------------------------------------------------------- responders
-    def _chunks(
-        self, rid: str, parsed, chat: bool, out: LLMEngineOutput, n_out: int
+    def _chunk(
+        self, rid: str, parsed, chat: bool, out: LLMEngineOutput, index: int,
+        text_off: int,
     ) -> list[dict]:
         finish = out.finish_reason.as_openai() if out.finish_reason else None
-        chunks = []
+        # logprob entries must flow even when the stop-string jail withholds
+        # text (the entry's token was still produced this delta)
+        if not (out.text or finish or out.logprob_content):
+            return []
+        lp_block = None
+        if out.logprob_content:
+            lp_block = (
+                chat_logprobs_block(out.logprob_content)
+                if chat
+                else completion_logprobs_block(out.logprob_content, text_off)
+            )
         if chat:
-            if out.text or finish:
-                chunks.append(
-                    chat_chunk(rid, parsed.model, content=out.text or "", finish_reason=finish)
-                )
-        else:
-            if out.text or finish:
-                chunks.append(
-                    completion_chunk(rid, parsed.model, out.text or "", finish_reason=finish)
-                )
-        return chunks
+            return [chat_chunk(rid, parsed.model, content=out.text or "",
+                               finish_reason=finish, index=index,
+                               logprobs=lp_block)]
+        return [completion_chunk(rid, parsed.model, out.text or "",
+                                 finish_reason=finish, index=index,
+                                 logprobs=lp_block)]
 
     async def _stream_response(
-        self, request: web.Request, ctx: Context, stream: AsyncIterator[LLMEngineOutput],
+        self, request: web.Request, ctxs: list[Context],
+        streams: list[AsyncIterator[LLMEngineOutput]],
         rid: str, parsed, chat: bool, guard,
     ) -> web.StreamResponse:
         resp = web.StreamResponse(
@@ -191,19 +205,44 @@ class HttpService:
             }
         )
         await resp.prepare(request)
+        n = len(streams)
         n_out = 0
+        text_off = [0] * n
+        merged: asyncio.Queue = asyncio.Queue()
+
+        async def pump(i: int, s: AsyncIterator[LLMEngineOutput]) -> None:
+            try:
+                async for out in s:
+                    await merged.put((i, out))
+                    if out.finished:
+                        break
+            except Exception as e:  # surface engine errors as a finish
+                log.exception("choice %d stream failed", i)
+                await merged.put(
+                    (i, LLMEngineOutput(finish_reason=FinishReason.ERROR))
+                )
+            finally:
+                await merged.put((i, None))
+
+        tasks = [asyncio.ensure_future(pump(i, s)) for i, s in enumerate(streams)]
         try:
             if chat:
-                await resp.write(
-                    sse_encode(chat_chunk(rid, parsed.model, role="assistant", content=""))
-                )
-            async for out in stream:
+                for i in range(n):
+                    await resp.write(sse_encode(
+                        chat_chunk(rid, parsed.model, role="assistant",
+                                   content="", index=i)
+                    ))
+            live = n
+            while live:
+                i, out = await merged.get()
+                if out is None:
+                    live -= 1
+                    continue
                 n_out += len(out.token_ids)
-                for chunk in self._chunks(rid, parsed, chat, out, n_out):
+                for chunk in self._chunk(rid, parsed, chat, out, i, text_off[i]):
                     await resp.write(sse_encode(chunk))
-                if out.finished:
-                    break
-            usage = usage_dict(ctx.annotations.get("prompt_tokens", 0), n_out)
+                text_off[i] += len(out.text or "")
+            usage = usage_dict(ctxs[0].annotations.get("prompt_tokens", 0), n_out)
             if chat:
                 await resp.write(sse_encode(chat_chunk(rid, parsed.model, usage=usage)))
             await resp.write(SSE_DONE)
@@ -211,32 +250,70 @@ class HttpService:
             self.metrics.tokens_out[parsed.model] += n_out
         except (ConnectionResetError, asyncio.CancelledError):
             # client went away — stop the engine (ref: disconnect detection)
-            ctx.kill()
+            for ctx in ctxs:
+                ctx.kill()
             guard.status("disconnect")
+        finally:
+            for t in tasks:
+                if not t.done():
+                    t.cancel()
         await resp.write_eof()
         return resp
 
     async def _unary_response(
-        self, ctx: Context, stream: AsyncIterator[LLMEngineOutput],
+        self, ctxs: list[Context], streams: list[AsyncIterator[LLMEngineOutput]],
         rid: str, parsed, chat: bool, guard,
     ) -> web.Response:
-        texts: list[str] = []
-        finish = FinishReason.STOP
-        n_out = 0
-        async for out in stream:
-            n_out += len(out.token_ids)
-            if out.text:
-                texts.append(out.text)
-            if out.finish_reason:
-                finish = out.finish_reason
-            if out.finished:
-                break
-        usage = usage_dict(ctx.annotations.get("prompt_tokens", 0), n_out)
-        chunks = (
-            [chat_chunk(rid, parsed.model, content="".join(texts), finish_reason=finish.as_openai(), usage=usage)]
-            if chat
-            else [completion_chunk(rid, parsed.model, "".join(texts), finish.as_openai(), usage=usage)]
-        )
+        n = len(streams)
+        texts: list[list[str]] = [[] for _ in range(n)]
+        lp_entries: list[list[dict]] = [[] for _ in range(n)]
+        finishes = [FinishReason.STOP] * n
+        counts = [0] * n
+
+        async def collect(i: int, s: AsyncIterator[LLMEngineOutput]) -> None:
+            async for out in s:
+                counts[i] += len(out.token_ids)
+                if out.text:
+                    texts[i].append(out.text)
+                if out.logprob_content:
+                    lp_entries[i].extend(out.logprob_content)
+                if out.finish_reason:
+                    finishes[i] = out.finish_reason
+                if out.finished:
+                    break
+
+        try:
+            await asyncio.gather(*(collect(i, s) for i, s in enumerate(streams)))
+        except asyncio.CancelledError:
+            # client dropped the connection mid-generation — free the slots
+            for ctx in ctxs:
+                ctx.kill()
+            guard.status("disconnect")
+            raise
+        n_out = sum(counts)
+        usage = usage_dict(ctxs[0].annotations.get("prompt_tokens", 0), n_out)
+        resp: Optional[dict] = None
+        for i in range(n):
+            text = "".join(texts[i])
+            lp_block = None
+            if lp_entries[i]:
+                lp_block = (
+                    chat_logprobs_block(lp_entries[i]) if chat
+                    else completion_logprobs_block(lp_entries[i])
+                )
+            piece = (
+                chat_response(rid, parsed.model, text,
+                              finishes[i].as_openai(), usage,
+                              index=i, logprobs=lp_block)
+                if chat else
+                completion_response(rid, parsed.model, text,
+                                    finishes[i].as_openai(), usage,
+                                    index=i, logprobs=lp_block)
+            )
+            if resp is None:
+                resp = piece
+            else:
+                resp["choices"].extend(piece["choices"])
         guard.ok()
         self.metrics.tokens_out[parsed.model] += n_out
-        return web.json_response(aggregate_stream(chunks, chat))
+        return web.json_response(resp)

@@ -88,11 +88,34 @@ def parse_request(body: dict, chat: bool) -> ParsedRequest:
     top_p = body.get("top_p")
     top_k = body.get("top_k")  # extension (vLLM-compatible)
     seed = body.get("seed")
+    freq_pen = float(body.get("frequency_penalty") or 0.0)
+    pres_pen = float(body.get("presence_penalty") or 0.0)
+    _require(-2.0 <= freq_pen <= 2.0, "'frequency_penalty' must be in [-2, 2]")
+    _require(-2.0 <= pres_pen <= 2.0, "'presence_penalty' must be in [-2, 2]")
+
+    # logprobs: chat = bool 'logprobs' + int 'top_logprobs' (0-20);
+    # completions = int-or-null 'logprobs' meaning top-N
+    if chat:
+        want_lp = bool(body.get("logprobs", False))
+        top_lp = int(body.get("top_logprobs") or 0)
+        _require(0 <= top_lp <= 20, "'top_logprobs' must be in [0, 20]")
+        _require(top_lp == 0 or want_lp,
+                 "'top_logprobs' requires 'logprobs': true")
+    else:
+        lp = body.get("logprobs")
+        want_lp = lp is not None and lp is not False
+        top_lp = int(lp) if isinstance(lp, int) and not isinstance(lp, bool) else 0
+        _require(0 <= top_lp <= 20, "'logprobs' must be in [0, 20]")
+
     req.sampling = SamplingOptions(
         temperature=1.0 if temperature is None else float(temperature),
         top_p=1.0 if top_p is None else float(top_p),
         top_k=0 if top_k is None else int(top_k),
         seed=seed,
+        frequency_penalty=freq_pen,
+        presence_penalty=pres_pen,
+        logprobs=want_lp,
+        top_logprobs=top_lp,
     )
 
     max_tokens = body.get("max_completion_tokens", body.get("max_tokens"))
@@ -114,7 +137,8 @@ def parse_request(body: dict, chat: bool) -> ParsedRequest:
             req.annotations = ann
 
     n = int(body.get("n", 1))
-    _require(n == 1, "'n' > 1 not yet supported")
+    _require(1 <= n <= 16, "'n' must be in [1, 16]")
+    req.n = n
     return req
 
 
@@ -131,65 +155,116 @@ def new_id(prefix: str) -> str:
 def chat_chunk(
     rid: str, model: str, *, role: Optional[str] = None, content: Optional[str] = None,
     finish_reason: Optional[str] = None, usage: Optional[dict] = None,
+    index: int = 0, logprobs: Optional[dict] = None,
 ) -> dict:
     delta: dict[str, Any] = {}
     if role is not None:
         delta["role"] = role
     if content:
         delta["content"] = content
+    choice: dict[str, Any] = {
+        "index": index, "delta": delta, "finish_reason": finish_reason,
+    }
+    if logprobs is not None:
+        choice["logprobs"] = logprobs
     out = {
         "id": rid,
         "object": "chat.completion.chunk",
         "created": _now(),
         "model": model,
-        "choices": [{"index": 0, "delta": delta, "finish_reason": finish_reason}],
+        "choices": [choice],
     }
     if usage is not None:
         out["usage"] = usage
     return out
 
 
-def chat_response(rid: str, model: str, content: str, finish_reason: str, usage: dict) -> dict:
+def chat_response(
+    rid: str, model: str, content: str, finish_reason: str, usage: dict,
+    *, index: int = 0, logprobs: Optional[dict] = None,
+) -> dict:
+    choice: dict[str, Any] = {
+        "index": index,
+        "message": {"role": "assistant", "content": content},
+        "finish_reason": finish_reason,
+    }
+    if logprobs is not None:
+        choice["logprobs"] = logprobs
     return {
         "id": rid,
         "object": "chat.completion",
         "created": _now(),
         "model": model,
-        "choices": [
-            {
-                "index": 0,
-                "message": {"role": "assistant", "content": content},
-                "finish_reason": finish_reason,
-            }
-        ],
+        "choices": [choice],
         "usage": usage,
     }
 
 
 def completion_chunk(
     rid: str, model: str, text: str, finish_reason: Optional[str] = None,
-    usage: Optional[dict] = None,
+    usage: Optional[dict] = None, *, index: int = 0,
+    logprobs: Optional[dict] = None,
 ) -> dict:
+    choice: dict[str, Any] = {
+        "index": index, "text": text, "finish_reason": finish_reason,
+    }
+    if logprobs is not None:
+        choice["logprobs"] = logprobs
     out = {
         "id": rid,
         "object": "text_completion",
         "created": _now(),
         "model": model,
-        "choices": [{"index": 0, "text": text, "finish_reason": finish_reason}],
+        "choices": [choice],
     }
     if usage is not None:
         out["usage"] = usage
     return out
 
 
-def completion_response(rid: str, model: str, text: str, finish_reason: str, usage: dict) -> dict:
+def completion_response(
+    rid: str, model: str, text: str, finish_reason: str, usage: dict,
+    *, index: int = 0, logprobs: Optional[dict] = None,
+) -> dict:
+    choice: dict[str, Any] = {
+        "index": index, "text": text, "finish_reason": finish_reason,
+    }
+    if logprobs is not None:
+        choice["logprobs"] = logprobs
     return {
         "id": rid,
         "object": "text_completion",
         "created": _now(),
         "model": model,
-        "choices": [{"index": 0, "text": text, "finish_reason": finish_reason}],
+        "choices": [choice],
         "usage": usage,
+    }
+
+
+def chat_logprobs_block(content: list[dict]) -> dict:
+    """Chat-format logprobs: {"content": [{token, logprob, bytes,
+    top_logprobs: [...]}]} — entries come from Backend detokenization."""
+    return {"content": content}
+
+
+def completion_logprobs_block(
+    content: list[dict], text_offset_base: int = 0
+) -> dict:
+    """Completions-format logprobs: parallel arrays (tokens, token_logprobs,
+    top_logprobs, text_offset) built from the same Backend entries."""
+    tokens, lps, tops, offsets = [], [], [], []
+    off = text_offset_base
+    for e in content:
+        tokens.append(e["token"])
+        lps.append(e["logprob"])
+        tops.append({t["token"]: t["logprob"] for t in e.get("top_logprobs", [])} or None)
+        offsets.append(off)
+        off += len(e["token"])
+    return {
+        "tokens": tokens,
+        "token_logprobs": lps,
+        "top_logprobs": tops,
+        "text_offset": offsets,
     }
 
 
@@ -208,26 +283,3 @@ def sse_encode(data: dict | str) -> bytes:
 
 
 SSE_DONE = b"data: [DONE]\n\n"
-
-
-def aggregate_stream(chunks: list[dict], chat: bool) -> dict:
-    """Fold streamed chunks into a full response (ref aggregator.rs)."""
-    text = []
-    finish = "stop"
-    usage = None
-    rid = chunks[0]["id"] if chunks else new_id("cmpl")
-    model = chunks[0]["model"] if chunks else ""
-    for c in chunks:
-        ch = c["choices"][0]
-        if chat:
-            text.append(ch["delta"].get("content", "") or "")
-        else:
-            text.append(ch.get("text", "") or "")
-        if ch.get("finish_reason"):
-            finish = ch["finish_reason"]
-        if c.get("usage"):
-            usage = c["usage"]
-    usage = usage or usage_dict(0, 0)
-    if chat:
-        return chat_response(rid, model, "".join(text), finish, usage)
-    return completion_response(rid, model, "".join(text), finish, usage)

@@ -38,9 +38,13 @@ class SamplingOptions:
     top_k: int = 0          # 0 = disabled
     top_p: float = 1.0
     seed: Optional[int] = None
-    # reserved for parity with reference SamplingOptions; not yet applied
+    # OpenAI penalties over generated tokens (engine/sampling.py applies
+    # them by scatter-add on device; vLLM-compatible semantics)
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
+    # logprob reporting: chosen-token logprob and top-N alternatives
+    logprobs: bool = False
+    top_logprobs: int = 0
 
     @property
     def greedy(self) -> bool:
@@ -77,6 +81,13 @@ class LLMEngineOutput:
     cached_tokens: int = 0      # prefix-cache hit length for this request
     # filled by the detokenizing backend:
     text: Optional[str] = None
+    # per-token logprob data (aligned with token_ids), when requested:
+    logprobs: Optional[list[float]] = None
+    # per-token top-N candidates as (token_id, logprob) pairs
+    top_logprobs: Optional[list[list[tuple]]] = None
+    # display-form logprobs (token strings + bytes), filled by the Backend:
+    # [{token, logprob, bytes, top_logprobs: [{token, logprob, bytes}]}]
+    logprob_content: Optional[list[dict]] = None
 
     def __post_init__(self):
         # tolerate wire-decoded plain strings (runtime/serde.py)
