@@ -34,6 +34,12 @@ class EngineConfig:
     # native).  Rounded down to a block multiple so resumed chunks stay
     # block-aligned for the prefill fast path.
     prefill_chunk_tokens: int = 0
+    # decode burst length while prefill work is pending (admitted/waiting
+    # requests or a mid-prefill slot).  Long bursts amortise dispatch
+    # overhead but make a freshly-arrived prompt wait a whole burst
+    # (decode_steps * ITL ≈ 760ms at 64 steps) before its first chunk —
+    # the dominant term in VERDICT r2's TTFT miss.  0 = min(8, decode_steps).
+    interactive_decode_steps: int = 0
     # paged cache
     block_size: int = 16
     num_blocks: int = 512             # cache blocks in HBM
@@ -55,6 +61,11 @@ class EngineConfig:
         if not self.prefill_buckets:
             self.prefill_buckets = default_buckets(self.max_model_len)
         self.prefill_buckets = sorted(self.prefill_buckets)
+        if self.interactive_decode_steps <= 0:
+            self.interactive_decode_steps = min(8, max(1, self.decode_steps))
+        self.interactive_decode_steps = min(
+            self.interactive_decode_steps, max(1, self.decode_steps)
+        )
         if self.prefill_chunk_tokens:
             # block-align the chunk so every resumed chunk starts on a block
             # boundary (required by the prefill fast path)

@@ -49,6 +49,14 @@ class EngineRequest:
     block_ids: list[int] = field(default_factory=list)
     cached_tokens: int = 0     # prefix-cache hit (KV already resident)
     computed_tokens: int = 0   # prompt tokens whose KV is computed
+    # prompt tokens [computed_tokens, wait_upto) live in blocks another
+    # request is prefilling right now (joined via the reserved-block
+    # registry): this request absorbs them as the owner commits instead of
+    # recomputing, and takes over if the owner aborts
+    wait_upto: int = 0
+    # (seq_hash, block_id) reservations THIS request owns; unresolved ones
+    # are dropped on finish so joiners can take over
+    reserved_pairs: list = field(default_factory=list)
     generated: int = 0
     slot: int = -1
     finish_reason: Optional[FinishReason] = None

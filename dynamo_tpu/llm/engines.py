@@ -19,7 +19,32 @@ from dynamo_tpu.llm.tokenizer import TokenizerWrapper
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 from dynamo_tpu.runtime.pipeline import build_pipeline
 
-__all__ = ["EchoEngineCore", "build_serving_pipeline"]
+__all__ = ["EchoEngineCore", "ScriptedEngine", "build_serving_pipeline"]
+
+
+class ScriptedEngine(AsyncEngine):
+    """Emits a fixed sequence of text deltas, ignoring the input — lets
+    protocol-surface tests (tool-call parsing, stop jail, SSE framing)
+    script exact model output without a model."""
+
+    def __init__(self, deltas: list[str]):
+        self.deltas = list(deltas)
+
+    def generate(self, request) -> AsyncIterator[LLMEngineOutput]:
+        return self._run(request)
+
+    async def _run(self, request) -> AsyncIterator[LLMEngineOutput]:
+        for i, d in enumerate(self.deltas):
+            if getattr(request, "is_stopped", False):
+                yield LLMEngineOutput(finish_reason=FinishReason.CANCELLED)
+                return
+            yield LLMEngineOutput(
+                token_ids=[i],
+                text=d,
+                finish_reason=(
+                    FinishReason.STOP if i + 1 == len(self.deltas) else None
+                ),
+            )
 
 
 class EchoEngineCore(AsyncEngine):

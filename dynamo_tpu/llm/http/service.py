@@ -43,11 +43,20 @@ from dynamo_tpu.llm.openai import (
     usage_dict,
 )
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput
+from dynamo_tpu.llm.tool_calls import ToolCallParser
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 
 log = logging.getLogger("dynamo_tpu.http")
 
 __all__ = ["ModelManager", "HttpService"]
+
+
+def _tool_parser(parsed) -> ToolCallParser:
+    """Parser honoring a named tool_choice (only that function's calls)."""
+    only = None
+    if isinstance(parsed.tool_choice, dict):
+        only = parsed.tool_choice.get("function", {}).get("name")
+    return ToolCallParser(only=only)
 
 
 @dataclass
@@ -149,7 +158,10 @@ class HttpService:
             guard = self.metrics.guard(parsed.model, endpoint)
             rid = new_id("chatcmpl" if chat else "cmpl")
             # n>1: fan out independent generations of the same prompt; the
-            # engine's prefix cache dedupes their prefill KV
+            # engine's reserved-block registry (kv/block_manager.py) makes
+            # them share ONE prefill — later admissions join the first
+            # request's in-flight blocks and wait on its commits
+            # (tests/test_inflight_dedupe.py covers the n=4 case)
             ctxs = [Context(parsed) for _ in range(parsed.n)]
             streams = [entry.engine.generate(c) for c in ctxs]
             if parsed.stream:
@@ -170,9 +182,11 @@ class HttpService:
     # ------------------------------------------------------------- responders
     def _chunk(
         self, rid: str, parsed, chat: bool, out: LLMEngineOutput, index: int,
-        text_off: int,
+        text_off: int, finish_override: Optional[str] = None,
     ) -> list[dict]:
-        finish = out.finish_reason.as_openai() if out.finish_reason else None
+        finish = finish_override or (
+            out.finish_reason.as_openai() if out.finish_reason else None
+        )
         # logprob entries must flow even when the stop-string jail withholds
         # text (the entry's token was still produced this delta)
         if not (out.text or finish or out.logprob_content):
@@ -225,6 +239,12 @@ class HttpService:
                 await merged.put((i, None))
 
         tasks = [asyncio.ensure_future(pump(i, s)) for i, s in enumerate(streams)]
+        # tool-call extraction per choice: stream content through the jail,
+        # emit parsed calls as one tool_calls delta at finish
+        parsers = [
+            _tool_parser(parsed) if chat and parsed.wants_tools else None
+            for _ in range(n)
+        ]
         try:
             if chat:
                 for i in range(n):
@@ -239,7 +259,23 @@ class HttpService:
                     live -= 1
                     continue
                 n_out += len(out.token_ids)
-                for chunk in self._chunk(rid, parsed, chat, out, i, text_off[i]):
+                finish_override = None
+                if parsers[i] is not None:
+                    visible = parsers[i].feed(out.text or "")
+                    if out.finish_reason is not None:
+                        leftover, calls = parsers[i].finish()
+                        if calls:
+                            out.text = visible
+                            finish_override = "tool_calls"
+                            await resp.write(sse_encode(chat_chunk(
+                                rid, parsed.model, tool_calls=calls, index=i
+                            )))
+                        else:
+                            out.text = visible + leftover
+                    else:
+                        out.text = visible
+                for chunk in self._chunk(rid, parsed, chat, out, i,
+                                         text_off[i], finish_override):
                     await resp.write(sse_encode(chunk))
                 text_off[i] += len(out.text or "")
             usage = usage_dict(ctxs[0].annotations.get("prompt_tokens", 0), n_out)
@@ -295,6 +331,15 @@ class HttpService:
         resp: Optional[dict] = None
         for i in range(n):
             text = "".join(texts[i])
+            calls = None
+            finish = finishes[i].as_openai()
+            if chat and parsed.wants_tools:
+                p = _tool_parser(parsed)
+                visible = p.feed(text)
+                leftover, calls = p.finish()
+                text = visible if calls else visible + leftover
+                if calls:
+                    finish = "tool_calls"
             lp_block = None
             if lp_entries[i]:
                 lp_block = (
@@ -302,9 +347,8 @@ class HttpService:
                     else completion_logprobs_block(lp_entries[i])
                 )
             piece = (
-                chat_response(rid, parsed.model, text,
-                              finishes[i].as_openai(), usage,
-                              index=i, logprobs=lp_block)
+                chat_response(rid, parsed.model, text, finish, usage,
+                              index=i, logprobs=lp_block, tool_calls=calls)
                 if chat else
                 completion_response(rid, parsed.model, text,
                                     finishes[i].as_openai(), usage,

@@ -38,6 +38,11 @@ class BlockAllocation:
 
     block_ids: list[int]
     cached_tokens: int  # prefix tokens whose KV is already resident
+    # tokens covered by ANOTHER request's in-flight (reserved, uncommitted)
+    # prefill blocks immediately after the cached prefix: this request
+    # references those blocks but must wait for the owner's commit instead
+    # of recomputing them (ref lib/llm/src/kv/reserved.rs:66 registry)
+    joined_tokens: int = 0
 
 
 @dataclass
@@ -45,6 +50,9 @@ class _Block:
     ref_count: int = 0
     seq_hash: Optional[int] = None
     parent_hash: Optional[int] = None
+    # content fully written (commit() ran for this block since its last
+    # allocation) — what in-flight joiners poll before absorbing the block
+    committed: bool = False
 
 
 class KvBlockManager:
@@ -70,6 +78,13 @@ class KvBlockManager:
         self._lru: OrderedDict[int, None] = OrderedDict()
         # seq_hash -> block_id for every content-registered block
         self._table: dict[int, int] = {}
+        # seq_hash -> block_id for blocks an in-flight prefill is WRITING:
+        # later allocations with the same chain join these blocks and wait
+        # on the owner's commit instead of prefilling duplicates (the
+        # reference's ReservedBlocks registry, kv/reserved.rs:66 +
+        # reuse.rs:16-50; this is what makes concurrent identical prompts —
+        # and n>1 fan-out — run ONE prefill)
+        self._reserved: dict[int, int] = {}
 
     # ----------------------------------------------------------------- stats
     @property
@@ -116,13 +131,26 @@ class KvBlockManager:
             self._acquire(bid)
             block_ids.append(bid)
             cached += self.block_size
+        # continue the chain through in-flight reservations: share the
+        # owner's blocks rather than computing duplicates
+        joined = 0
+        max_match = min(len(seq_hashes), (total_tokens - 1) // self.block_size)
+        while self.enable_prefix_reuse and len(block_ids) < max_match:
+            bid = self._reserved.get(seq_hashes[len(block_ids)])
+            if bid is None:
+                break
+            self._acquire(bid)
+            block_ids.append(bid)
+            joined += self.block_size
         try:
             while len(block_ids) < n_blocks:
                 block_ids.append(self._alloc_fresh())
         except NoFreeBlocks:
             self.release(block_ids)
             raise
-        return BlockAllocation(block_ids=block_ids, cached_tokens=cached)
+        return BlockAllocation(
+            block_ids=block_ids, cached_tokens=cached, joined_tokens=joined
+        )
 
     def allocate_raw(self, n: int) -> list[int]:
         """Allocate n fresh blocks (no prefix matching) — used by decode
@@ -146,6 +174,7 @@ class KvBlockManager:
             raise NoFreeBlocks
         blk = self._blocks[bid]
         blk.ref_count = 1
+        blk.committed = False
         return bid
 
     def _acquire(self, bid: int) -> None:
@@ -153,6 +182,33 @@ class KvBlockManager:
         if blk.ref_count == 0:
             self._lru.pop(bid, None)
         blk.ref_count += 1
+
+    # ------------------------------------------------ in-flight reservations
+    def reserve(self, seq_hash: int, block_id: int) -> bool:
+        """Claim responsibility for computing the block with this chain
+        hash.  Fails (False) when the content already exists or another
+        request is already computing it — the caller then joins/waits."""
+        if not self.enable_prefix_reuse:
+            return False
+        if seq_hash in self._table or seq_hash in self._reserved:
+            return False
+        self._reserved[seq_hash] = block_id
+        return True
+
+    def unreserve(self, seq_hash: int, block_id: int) -> None:
+        """Drop a reservation (owner aborted before committing).  No-op if
+        the reservation was already resolved by commit or is held by a
+        different block."""
+        if self._reserved.get(seq_hash) == block_id:
+            del self._reserved[seq_hash]
+
+    def is_reserved(self, seq_hash: int) -> bool:
+        return seq_hash in self._reserved
+
+    def block_committed(self, block_id: int) -> bool:
+        """Has this block's content been fully written since allocation?
+        (What a joiner polls before absorbing a shared in-flight block.)"""
+        return self._blocks[block_id].committed
 
     # ------------------------------------------------------------- lifecycle
     def commit(
@@ -171,6 +227,8 @@ class KvBlockManager:
         if not self.enable_prefix_reuse:
             return
         blk = self._blocks[block_id]
+        blk.committed = True
+        self.unreserve(seq_hash, block_id)
         if seq_hash in self._table:
             return
         blk.seq_hash = seq_hash

@@ -46,11 +46,18 @@ class ParsedRequest:
     stops: StopConditions = field(default_factory=StopConditions)
     echo: bool = False
     annotations: list[str] = field(default_factory=list)
+    # tool calling (chat mode): validated OpenAI tool schemas + choice
+    tools: Optional[list[dict]] = None
+    tool_choice: Any = None  # "none"|"auto"|"required"|{function ref}|None
     raw: dict = field(default_factory=dict)
 
     @property
     def is_chat(self) -> bool:
         return self.messages is not None
+
+    @property
+    def wants_tools(self) -> bool:
+        return bool(self.tools) and self.tool_choice != "none"
 
 
 def _require(cond: bool, msg: str) -> None:
@@ -70,7 +77,19 @@ def parse_request(body: dict, chat: bool) -> ParsedRequest:
         _require(isinstance(messages, list) and messages, "'messages' must be a non-empty array")
         for m in messages:
             _require(isinstance(m, dict) and "role" in m, "each message needs a 'role'")
+            if m["role"] == "tool":
+                _require("tool_call_id" in m, "tool messages need 'tool_call_id'")
         req.messages = messages
+        tools = body.get("tools")
+        if tools is not None:
+            from dynamo_tpu.llm.tool_calls import validate_tools
+
+            try:
+                validate_tools(tools, body.get("tool_choice"))
+            except ValueError as e:
+                raise OpenAIError(str(e))
+            req.tools = tools
+            req.tool_choice = body.get("tool_choice", "auto")
     else:
         prompt = body.get("prompt")
         _require(prompt is not None, "'prompt' is required")
@@ -156,12 +175,17 @@ def chat_chunk(
     rid: str, model: str, *, role: Optional[str] = None, content: Optional[str] = None,
     finish_reason: Optional[str] = None, usage: Optional[dict] = None,
     index: int = 0, logprobs: Optional[dict] = None,
+    tool_calls: Optional[list[dict]] = None,
 ) -> dict:
     delta: dict[str, Any] = {}
     if role is not None:
         delta["role"] = role
     if content:
         delta["content"] = content
+    if tool_calls:
+        delta["tool_calls"] = [
+            {"index": i, **c} for i, c in enumerate(tool_calls)
+        ]
     choice: dict[str, Any] = {
         "index": index, "delta": delta, "finish_reason": finish_reason,
     }
@@ -182,10 +206,15 @@ def chat_chunk(
 def chat_response(
     rid: str, model: str, content: str, finish_reason: str, usage: dict,
     *, index: int = 0, logprobs: Optional[dict] = None,
+    tool_calls: Optional[list[dict]] = None,
 ) -> dict:
+    message: dict[str, Any] = {"role": "assistant", "content": content}
+    if tool_calls:
+        message["content"] = content or None  # OpenAI: null content on calls
+        message["tool_calls"] = tool_calls
     choice: dict[str, Any] = {
         "index": index,
-        "message": {"role": "assistant", "content": content},
+        "message": message,
         "finish_reason": finish_reason,
     }
     if logprobs is not None:

@@ -35,10 +35,21 @@ class PromptFormatter:
 
     def __init__(self, template: Optional[str], bos_token: str = "", eos_token: str = ""):
         import jinja2
+        from jinja2 import meta
 
         env = jinja2.Environment(trim_blocks=True, lstrip_blocks=True)
         env.globals["raise_exception"] = self._raise
-        self._template = env.from_string(template or DEFAULT_CHAT_TEMPLATE)
+        src = template or DEFAULT_CHAT_TEMPLATE
+        self._template = env.from_string(src)
+        # does the template actually consume a `tools` variable?  (A
+        # substring probe misfires on templates merely mentioning the word;
+        # the AST check is exact.)
+        try:
+            self.supports_tools = "tools" in meta.find_undeclared_variables(
+                env.parse(src)
+            )
+        except Exception:
+            self.supports_tools = False
         self._bos = bos_token
         self._eos = eos_token
 
@@ -46,12 +57,18 @@ class PromptFormatter:
     def _raise(msg: str):
         raise OpenAIError(f"chat template error: {msg}")
 
-    def render(self, messages: list[dict], add_generation_prompt: bool = True) -> str:
+    def render(
+        self,
+        messages: list[dict],
+        add_generation_prompt: bool = True,
+        tools: Optional[list[dict]] = None,
+    ) -> str:
         return self._template.render(
             messages=messages,
             add_generation_prompt=add_generation_prompt,
             bos_token=self._bos,
             eos_token=self._eos,
+            tools=tools,
         )
 
 
@@ -70,7 +87,24 @@ class OpenAIPreprocessor(Operator):
     async def forward(self, request: Context[ParsedRequest]) -> Context[BackendInput]:
         parsed = request.data
         if parsed.is_chat:
-            prompt = self.formatter.render(parsed.messages)
+            messages = parsed.messages
+            tools = parsed.tools if parsed.wants_tools else None
+            if tools and not self.formatter.supports_tools:
+                # template has no native tools support: inject a hermes-
+                # format instruction block as a leading system message
+                # (ref lib/llm/src/preprocessor/tools.rs schema injection)
+                from dynamo_tpu.llm.tool_calls import render_tools_system
+
+                messages = [
+                    {
+                        "role": "system",
+                        "content": render_tools_system(
+                            tools, parsed.tool_choice
+                        ),
+                    }
+                ] + list(messages)
+                tools = None
+            prompt = self.formatter.render(messages, tools=tools)
             token_ids = self.tokenizer.encode(prompt)
         elif parsed.prompt_token_ids is not None:
             prompt = None

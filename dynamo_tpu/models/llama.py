@@ -33,6 +33,15 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.quant import (
+    QTensor,
+    dequantize,
+    matmul,
+    quantize_params,
+    random_qtensor,
+    stacked_channel_axes,
+    take_rows,
+)
 from dynamo_tpu.ops.paged_attention import (
     paged_attention_layer,
     prefill_attention,
@@ -68,7 +77,10 @@ class LlamaModel:
         self.config = config
 
     # ------------------------------------------------------------------ init
-    def init_params(self, rng: jax.Array) -> Params:
+    def init_params(self, rng: jax.Array, quantized: bool = False) -> Params:
+        """Random init.  ``quantized=True`` synthesizes int8 QTensor matmul
+        weights directly (never materializing the bf16 tensor — 8B bf16
+        would not fit the single chip the int8 path exists to fit)."""
         cfg = self.config
         dt = cfg.jax_dtype
         dm, hq, hk, dh, f = (
@@ -81,7 +93,10 @@ class LlamaModel:
         L = cfg.num_layers
         keys = iter(jax.random.split(rng, 16))
 
-        def dense(key, shape, fan_in):
+        def dense(key, shape, fan_in, channel_axes=None):
+            if quantized:
+                axes = channel_axes or stacked_channel_axes(len(shape))
+                return random_qtensor(key, shape, fan_in, axes)
             return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(dt)
 
         layers: dict[str, jax.Array] = {
@@ -100,8 +115,14 @@ class LlamaModel:
             )
         if cfg.is_moe:
             e = cfg.num_experts
+            # router stays dense even under quantization: it is tiny and
+            # its logits pick experts (accuracy-critical, no bandwidth win)
+            router_w = (
+                jax.random.normal(next(keys), (L, dm, e), jnp.float32)
+                / math.sqrt(dm)
+            ).astype(dt)
             layers.update(
-                router=dense(next(keys), (L, dm, e), dm),
+                router=router_w,
                 w_gate=dense(next(keys), (L, e, dm, f), dm),
                 w_up=dense(next(keys), (L, e, dm, f), dm),
                 w_down=dense(next(keys), (L, e, f, dm), f),
@@ -113,13 +134,18 @@ class LlamaModel:
                 w_down=dense(next(keys), (L, f, dm), f),
             )
         params = {
-            "embed": dense(next(keys), (cfg.vocab_size, dm), dm),
+            # per-row scales so the same tensor serves lookup + tied lm_head
+            "embed": dense(next(keys), (cfg.vocab_size, dm), dm, channel_axes=(0,)),
             "layers": layers,
             "final_norm": jnp.ones((dm,), dt),
         }
         if not cfg.tie_word_embeddings:
             params["lm_head"] = dense(next(keys), (dm, cfg.vocab_size), dm)
         return params
+
+    def quantize_params(self, params: Params) -> Params:
+        """bf16 params → int8 weight-only QTensor params (models/quant.py)."""
+        return quantize_params(params)
 
     # -------------------------------------------------------------- sharding
     def partition_specs(self) -> Params:
@@ -220,7 +246,7 @@ class LlamaModel:
         dh, hq, hk = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
         fast_prefill = prefix_blocks is not None and s > 1
 
-        hidden = jnp.take(params["embed"], tokens, axis=0)
+        hidden = take_rows(params["embed"], tokens, cfg.jax_dtype)
 
         # The cache rides the scan as CARRY, updated by scatter: XLA keeps
         # one buffer and updates it in place.  (Passing it as xs/ys instead
@@ -243,13 +269,16 @@ class LlamaModel:
                 attn = paged_attention_layer(
                     q, cache, li, block_tables, seq_lens, positions
                 )
-            h = h + attn.reshape(b, s, hq * dh) @ lp["wo"]
+            h = h + matmul(attn.reshape(b, s, hq * dh), lp["wo"])
 
             x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
             if cfg.is_moe:
                 h = h + _moe_mlp(cfg, lp, x)
             else:
-                h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+                h = h + matmul(
+                    jax.nn.silu(matmul(x, lp["w_gate"])) * matmul(x, lp["w_up"]),
+                    lp["w_down"],
+                )
             return (h, cache), None
 
         (hidden, new_cache), _ = jax.lax.scan(
@@ -286,7 +315,7 @@ class LlamaModel:
         b, s = tokens.shape
         dh, hq, hk = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
 
-        hidden = jnp.take(params["embed"], tokens, axis=0)
+        hidden = take_rows(params["embed"], tokens, cfg.jax_dtype)
 
         def layer_step(h, lp):
             x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
@@ -296,13 +325,16 @@ class LlamaModel:
             attn = ring_attention(
                 q, k, v, positions, positions, mesh=mesh, axis=sp_axis
             )
-            h = h + attn.reshape(b, s, hq * dh) @ lp["wo"]
+            h = h + matmul(attn.reshape(b, s, hq * dh), lp["wo"])
 
             x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
             if cfg.is_moe:
                 h = h + _moe_mlp(cfg, lp, x)
             else:
-                h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+                h = h + matmul(
+                    jax.nn.silu(matmul(x, lp["w_gate"])) * matmul(x, lp["w_up"]),
+                    lp["w_down"],
+                )
             kv = jnp.stack(
                 [k.reshape(b, s, hk * dh), v.reshape(b, s, hk * dh)], axis=0
             )
@@ -319,9 +351,13 @@ class LlamaModel:
         explicit f32 cast of the vocab matrix would materialise a copy of
         the largest tensor in the model every step."""
         if self.config.tie_word_embeddings:
-            w = params["embed"].T
+            w = params["embed"]
+            # embed's per-row scale transposes into lm_head's per-column
+            w = QTensor(w.q.T, w.scale.T) if isinstance(w, QTensor) else w.T
         else:
             w = params["lm_head"]
+        if isinstance(w, QTensor):
+            return matmul(hidden, w, preferred_element_type=jnp.float32)
         return jnp.matmul(
             hidden.astype(w.dtype), w, preferred_element_type=jnp.float32
         )
@@ -332,7 +368,7 @@ def _qkv_proj(
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """QKV projections (+ Qwen2-style bias when configured)."""
     dh, hq, hk = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
-    q, k, v = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
+    q, k, v = matmul(x, lp["wq"]), matmul(x, lp["wk"]), matmul(x, lp["wv"])
     if cfg.attention_bias:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     return (
@@ -353,9 +389,14 @@ def _moe_mlp(cfg: ModelConfig, lp: dict, x: jax.Array) -> jax.Array:
     weights = jax.nn.softmax(topv, axis=-1)  # [B,S,k]
     onehot = jax.nn.one_hot(topi, cfg.num_experts, dtype=jnp.float32)  # [B,S,k,E]
     gate_probs = jnp.einsum("bske,bsk->bse", onehot, weights)  # [B,S,E]
-    # every expert runs all tokens: [B,S,E,F] intermediates
-    up = jnp.einsum("bsd,edf->bsef", x, lp["w_up"])
-    gate = jnp.einsum("bsd,edf->bsef", x, lp["w_gate"])
+    # every expert runs all tokens: [B,S,E,F] intermediates.  Quantized
+    # experts dequant at the einsum operand (convert+mul fuse into the
+    # contraction's operand load; HBM reads stay int8)
+    w_up = dequantize(lp["w_up"], x.dtype)
+    w_gate = dequantize(lp["w_gate"], x.dtype)
+    w_down = dequantize(lp["w_down"], x.dtype)
+    up = jnp.einsum("bsd,edf->bsef", x, w_up)
+    gate = jnp.einsum("bsd,edf->bsef", x, w_gate)
     act = jax.nn.silu(gate) * up
-    out = jnp.einsum("bsef,efd->bsed", act, lp["w_down"])
+    out = jnp.einsum("bsef,efd->bsed", act, w_down)
     return jnp.einsum("bsed,bse->bsd", out, gate_probs.astype(out.dtype))
