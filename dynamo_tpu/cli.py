@@ -56,6 +56,24 @@ def _build_local_engine(args) -> tuple[object, object]:
     from dynamo_tpu.models.llama import LlamaModel
     from dynamo_tpu.models.loader import load_model_dir
 
+    # multi-host: join the jax.distributed mesh BEFORE any JAX array is
+    # created — loading/quantizing weights initializes the backend, and
+    # jax.distributed.initialize must run first for jax.devices() to be
+    # global (runtime/multihost.py)
+    from dynamo_tpu.runtime.multihost import (
+        MultiHostSpec,
+        bootstrap,
+        global_mesh,
+    )
+
+    nnodes = int(getattr(args, "nnodes", 1) or 1)
+    if nnodes > 1:
+        bootstrap(MultiHostSpec(
+            num_processes=nnodes,
+            process_id=int(getattr(args, "node_rank", 0) or 0),
+            coordinator_url=getattr(args, "coordinator", None),
+        ))
+
     if is_gguf:
         from dynamo_tpu.llm.gguf import load_gguf_model
 
@@ -63,6 +81,16 @@ def _build_local_engine(args) -> tuple[object, object]:
     else:
         model_cfg, params = load_model_dir(args.model_path, dtype=args.dtype)
     model = LlamaModel(model_cfg)
+    if getattr(args, "quantize", "none") == "int8":
+        # int8 weight-only serving (models/quant.py): ~2x HBM headroom
+        params = model.quantize_params(params)
+
+    mesh = None
+    tp = int(getattr(args, "tp", 1) or 1)
+    dp = int(getattr(args, "dp", 1) or 1)
+    if tp * dp > 1:
+        mesh = global_mesh((dp, tp), ("data", "model"))
+
     cfg = EngineConfig(
         max_batch_size=args.max_batch_size,
         max_model_len=args.max_model_len,
@@ -70,7 +98,7 @@ def _build_local_engine(args) -> tuple[object, object]:
         num_blocks=args.num_blocks,
     )
     core = EngineCore(
-        model, params, cfg, eos_token_ids=card.eos_token_ids or None
+        model, params, cfg, mesh=mesh, eos_token_ids=card.eos_token_ids or None
     )
     return AsyncLLMEngine(core).start(), card
 
@@ -425,6 +453,13 @@ def _parser() -> argparse.ArgumentParser:
     run.add_argument("--model-name", default=None)
     run.add_argument("--dtype", default="bfloat16")
     run.add_argument("--max-batch-size", type=int, default=8)
+    run.add_argument("--quantize", choices=["none", "int8"], default="none",
+                     help="int8 weight-only quantization (halves weight HBM)")
+    run.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
+    run.add_argument("--dp", type=int, default=1, help="data-parallel size")
+    run.add_argument("--nnodes", type=int, default=1,
+                     help="worker processes forming ONE mesh (multi-host)")
+    run.add_argument("--node-rank", type=int, default=0)
     run.add_argument("--max-model-len", type=int, default=4096)
     run.add_argument("--block-size", type=int, default=16)
     run.add_argument("--num-blocks", type=int, default=512)

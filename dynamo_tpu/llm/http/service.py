@@ -264,14 +264,13 @@ class HttpService:
                     visible = parsers[i].feed(out.text or "")
                     if out.finish_reason is not None:
                         leftover, calls = parsers[i].finish()
+                        # leftover = non-call prose (flushed either way)
+                        out.text = visible + leftover
                         if calls:
-                            out.text = visible
                             finish_override = "tool_calls"
                             await resp.write(sse_encode(chat_chunk(
                                 rid, parsed.model, tool_calls=calls, index=i
                             )))
-                        else:
-                            out.text = visible + leftover
                     else:
                         out.text = visible
                 for chunk in self._chunk(rid, parsed, chat, out, i,
@@ -337,7 +336,7 @@ class HttpService:
                 p = _tool_parser(parsed)
                 visible = p.feed(text)
                 leftover, calls = p.finish()
-                text = visible if calls else visible + leftover
+                text = visible + leftover
                 if calls:
                     finish = "tool_calls"
             lp_block = None

@@ -193,40 +193,50 @@ class ToolCallParser:
 
     # --------------------------------------------------------------- parsing
     def finish(self) -> tuple[str, list[dict]]:
-        """Parse whatever is withheld; returns (text_to_flush, tool_calls)."""
+        """Parse whatever is withheld; returns (text_to_flush, tool_calls).
+
+        Text outside the call markup (e.g. prose after the last
+        ``</tool_call>``) flushes as content alongside the calls.  When a
+        named tool_choice filters every parsed call out, the raw markup is
+        dropped — never leaked to the client as content."""
         text = self._pending
         self._pending = ""
-        calls = self._parse(text)
-        if self.only:
+        calls, remainder = self._parse(text)
+        if calls and self.only:
             calls = [c for c in calls if c["function"]["name"] == self.only]
+            return remainder, calls  # markup never leaks, even if all filtered
         if calls:
-            return "", calls
+            return remainder, calls
         return text, []
 
-    def _parse(self, text: str) -> list[dict]:
+    def _parse(self, text: str) -> tuple[list[dict], str]:
+        """Returns (calls, non-call remainder text)."""
         stripped = text.strip()
         if not stripped:
-            return []
+            return [], ""
         fmt = self.fmt
         if fmt in ("auto", "hermes") and HERMES_OPEN in stripped:
-            return self._parse_hermes(stripped)
+            return self._parse_hermes(text)
         if fmt in ("auto", "mistral") and stripped.startswith(MISTRAL_TAG):
-            return _parse_json_calls(stripped[len(MISTRAL_TAG):])
+            return _parse_json_calls(stripped[len(MISTRAL_TAG):]), ""
         if fmt in ("auto", "llama3_json"):
             if stripped.startswith(PYTHON_TAG):
                 stripped = stripped[len(PYTHON_TAG):].strip()
             if stripped[:1] in ("{", "["):
-                return _parse_json_calls(stripped)
-        return []
+                return _parse_json_calls(stripped), ""
+        return [], ""
 
     @staticmethod
-    def _parse_hermes(text: str) -> list[dict]:
+    def _parse_hermes(text: str) -> tuple[list[dict], str]:
         calls = []
+        outside: list[str] = []
         pos = 0
         while True:
             start = text.find(HERMES_OPEN, pos)
             if start < 0:
+                outside.append(text[pos:])
                 break
+            outside.append(text[pos:start])
             end = text.find(HERMES_CLOSE, start)
             body = text[start + len(HERMES_OPEN): end if end >= 0 else None]
             try:
@@ -238,4 +248,4 @@ class ToolCallParser:
             if end < 0:
                 break
             pos = end + len(HERMES_CLOSE)
-        return calls
+        return calls, "".join(outside).strip(" \n") if calls else ""

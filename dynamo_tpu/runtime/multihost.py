@@ -1,0 +1,166 @@
+"""Multi-host runtime: one JAX mesh spanning worker processes.
+
+The north-star deployment (BASELINE.md) is a v5e-16 slice = 4 hosts whose
+chips form ONE device mesh.  The reference delegates multi-node bootstrap
+to its engines (sglang subprocess tp/nnodes/node_rank,
+lib/engines/sglang/src/subprocess.rs:59-63; vLLM Ray placement groups,
+lib/engines/vllm0_7/src/ray.rs:70-148); this repo owns the engine, so it
+owns the bootstrap:
+
+  1. every worker process knows (group, num_processes, process_id),
+  2. process 0 publishes its JAX distributed-coordinator address under
+     ``mh/{group}/jax_coordinator`` in the control plane (CoordinatorClient
+     — the etcd-parity KV store), with a kv_create so restarts can't
+     clobber a live rendezvous,
+  3. everyone calls ``jax.distributed.initialize(addr, n, pid)``; after
+     that ``jax.devices()`` is the GLOBAL device list and a Mesh built
+     over it spans all hosts — GSPMD then inserts cross-host collectives
+     (ICI within a slice, DCN across slices) exactly like single-host.
+
+Works identically for real TPU pods and the CPU test rig (N processes ×
+``--xla_force_host_platform_device_count`` devices, gloo collectives) —
+tests/test_multihost.py runs a 2-process × 4-device sharded engine step.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+__all__ = ["MultiHostSpec", "bootstrap", "global_mesh", "spec_from_env"]
+
+
+@dataclass
+class MultiHostSpec:
+    num_processes: int = 1
+    process_id: int = 0
+    group: str = "default"
+    # control-plane URL for the rendezvous (coord://host:port); unused when
+    # jax_coordinator is given explicitly
+    coordinator_url: Optional[str] = None
+    # explicit JAX distributed-service address host:port (skips rendezvous)
+    jax_coordinator: Optional[str] = None
+    # local devices visible to this process (TPU: auto; CPU rig: forced)
+    local_device_count: Optional[int] = None
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.num_processes > 1
+
+
+def spec_from_env() -> MultiHostSpec:
+    """Build a spec from DYN_MH_* env vars (what `dynamo run --nnodes N
+    --node-rank R` exports for worker processes)."""
+    return MultiHostSpec(
+        num_processes=int(os.environ.get("DYN_MH_NPROCS", "1")),
+        process_id=int(os.environ.get("DYN_MH_RANK", "0")),
+        group=os.environ.get("DYN_MH_GROUP", "default"),
+        coordinator_url=os.environ.get("DYN_MH_COORDINATOR"),
+        jax_coordinator=os.environ.get("DYN_MH_JAX_COORDINATOR"),
+    )
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _host_ip() -> str:
+    """Best-effort routable address of this host (workers on other hosts
+    must reach the JAX coordinator service we start)."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))  # no traffic sent — picks the route
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+async def _rendezvous(spec: MultiHostSpec, timeout: float) -> str:
+    """Process 0 publishes its JAX coordinator address; others wait for it."""
+    from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient
+
+    key = f"mh/{spec.group}/jax_coordinator"
+    client = await CoordinatorClient(spec.coordinator_url).connect()
+    try:
+        if spec.process_id == 0:
+            addr = f"{_host_ip()}:{_free_port()}"
+            # kv_create: a stale address from a dead group must not linger —
+            # recreate the key if present but unclaimed this epoch
+            if not await client.kv_create(key, addr):
+                await client.kv_put(key, addr)
+            return addr
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            addr = await client.kv_get(key)
+            if addr:
+                return str(addr)
+            await asyncio.sleep(0.1)
+        raise TimeoutError(
+            f"rendezvous key {key} not published within {timeout}s"
+        )
+    finally:
+        await client.close()
+
+
+def _run_sync(coro):
+    """Run a coroutine to completion whether or not the caller is already
+    inside an event loop (the CLI calls bootstrap from async command
+    handlers; asyncio.run would raise there)."""
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        return asyncio.run(coro)
+    import concurrent.futures
+
+    with concurrent.futures.ThreadPoolExecutor(1) as ex:
+        return ex.submit(asyncio.run, coro).result()
+
+
+def bootstrap(spec: MultiHostSpec, timeout: float = 120.0) -> None:
+    """Join this process into the multi-host JAX runtime.  Single-process
+    specs are a no-op, so callers can run the same code path everywhere."""
+    if not spec.is_multihost:
+        return
+    addr = spec.jax_coordinator
+    if addr is None:
+        if spec.coordinator_url is None:
+            raise ValueError(
+                "multi-host bootstrap needs coordinator_url or jax_coordinator"
+            )
+        addr = _run_sync(_rendezvous(spec, timeout))
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=spec.num_processes,
+        process_id=spec.process_id,
+    )
+
+
+def global_mesh(shape: tuple[int, ...], axis_names: tuple[str, ...]):
+    """Mesh over the GLOBAL device list (all hosts).  Axis order follows
+    jax.devices() ordering: devices of one process are contiguous, so the
+    LAST mesh axes land within a host (put "model"/TP there — its
+    collectives then ride intra-host ICI; "data"/DP spans hosts over DCN,
+    the scaling-book layout)."""
+    import math
+
+    import jax
+    import numpy as np
+
+    devs = jax.devices()
+    need = math.prod(shape)
+    if need > len(devs):
+        raise ValueError(f"mesh {shape} needs {need} devices, have {len(devs)}")
+    arr = np.array(devs[:need]).reshape(shape)
+    return jax.sharding.Mesh(arr, axis_names)

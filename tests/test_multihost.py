@@ -1,0 +1,107 @@
+"""Multi-host runtime: coordinator rendezvous + a 2-process × 4-device
+sharded engine step (VERDICT r2 ask #3).
+
+The parent test hosts the control-plane CoordinatorServer; two worker
+processes rendezvous through it (process 0 publishes the JAX coordinator
+address), form ONE 8-device mesh via jax.distributed, and run the real
+EngineCore with TP=4 sharded params/cache.  Both ranks must emit identical
+greedy tokens — the cross-process collectives (gloo on the CPU rig, ICI on
+TPU pods) produced the same logits everywhere.
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from dynamo_tpu.runtime.multihost import MultiHostSpec, spec_from_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_mh_worker.py")
+
+
+class _CoordThread:
+    """CoordinatorServer on a private event loop thread."""
+
+    def __init__(self):
+        self.url = None
+        self._loop = asyncio.new_event_loop()
+        self._server = None
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        self._ready.wait(10)
+
+    def _run(self):
+        from dynamo_tpu.runtime.transports.coordinator import CoordinatorServer
+
+        asyncio.set_event_loop(self._loop)
+
+        async def go():
+            self._server = await CoordinatorServer().start()
+            self.url = self._server.url
+            self._ready.set()
+
+        self._loop.create_task(go())
+        self._loop.run_forever()
+
+    def stop(self):
+        async def halt():
+            await self._server.stop()
+            self._loop.stop()
+
+        asyncio.run_coroutine_threadsafe(halt(), self._loop)
+        self._thread.join(5)
+
+
+def _spawn(rank: int, n: int, url: str, extra_env=None) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # worker sets its own device count
+    env.update(
+        DYN_MH_NPROCS=str(n),
+        DYN_MH_RANK=str(rank),
+        DYN_MH_GROUP=f"test-{os.getpid()}",
+        DYN_MH_COORDINATOR=url,
+        **(extra_env or {}),
+    )
+    return subprocess.Popen(
+        [sys.executable, WORKER], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def test_spec_from_env(monkeypatch):
+    monkeypatch.setenv("DYN_MH_NPROCS", "4")
+    monkeypatch.setenv("DYN_MH_RANK", "2")
+    monkeypatch.setenv("DYN_MH_COORDINATOR", "tcp://10.0.0.1:4222")
+    spec = spec_from_env()
+    assert spec.num_processes == 4 and spec.process_id == 2
+    assert spec.is_multihost
+    assert not MultiHostSpec().is_multihost
+
+
+@pytest.mark.parametrize("quant", [False, True],
+                         ids=["bf16", "int8"])
+def test_two_process_sharded_engine(quant):
+    coord = _CoordThread()
+    try:
+        extra = {"DYN_MH_QUANT": "1"} if quant else None
+        procs = [_spawn(r, 2, coord.url, extra) for r in range(2)]
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, out[-2000:]
+        tokens = sorted(
+            line for out in outs for line in out.splitlines()
+            if line.startswith("TOKENS")
+        )
+        assert len(tokens) == 2, tokens
+        # identical greedy continuations on both ranks
+        assert tokens[0].split(" ", 2)[2] == tokens[1].split(" ", 2)[2]
+    finally:
+        coord.stop()

@@ -111,6 +111,22 @@ def test_named_tool_choice_filters_calls():
     assert [c["function"]["name"] for c in calls] == ["get_weather"]
 
 
+def test_named_choice_filtering_never_leaks_markup():
+    p = ToolCallParser(only="get_weather")
+    p.feed('<tool_call>{"name": "other", "arguments": {}}</tool_call>')
+    text, calls = p.finish()
+    assert calls == [] and "<tool_call>" not in text
+
+
+def test_prose_around_calls_is_preserved():
+    p = ToolCallParser()
+    p.feed('<tool_call>{"name": "a", "arguments": {}}</tool_call>'
+           ' I called the tool for you.')
+    text, calls = p.finish()
+    assert [c["function"]["name"] for c in calls] == ["a"]
+    assert text == "I called the tool for you."
+
+
 def test_template_tools_detection_is_ast_based():
     from dynamo_tpu.llm.preprocessor import PromptFormatter
 
