@@ -330,8 +330,10 @@ async def _cmd_coordinator(args) -> None:
     """Run the control/event/queue-plane coordinator (etcd+NATS stand-in)."""
     from dynamo_tpu.runtime.transports.coordinator import CoordinatorServer
 
-    server = await CoordinatorServer(host=args.host, port=args.port).start()
-    log.info("coordinator on %s", server.url)
+    server = await CoordinatorServer(
+        host=args.host, port=args.port, data_dir=args.data_dir
+    ).start()
+    log.info("coordinator on %s (durable=%s)", server.url, bool(args.data_dir))
     await asyncio.Event().wait()
 
 
@@ -481,6 +483,8 @@ def _parser() -> argparse.ArgumentParser:
     coord = sub.add_parser("coordinator", help="run the coordinator service")
     coord.add_argument("--host", default="0.0.0.0")
     coord.add_argument("--port", type=int, default=6180)
+    coord.add_argument("--data-dir", default=None,
+                       help="WAL directory: KV + queues survive restarts")
 
     deploy = sub.add_parser("deploy", help="render k8s manifests from a deployment spec")
     deploy.add_argument("spec", help="DynamoTpuDeployment YAML")

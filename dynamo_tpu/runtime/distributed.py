@@ -52,7 +52,11 @@ class DistributedRuntime:
     @classmethod
     async def connect(cls, config: Optional[RuntimeConfig] = None) -> "DistributedRuntime":
         rt = cls(config)
-        rt.coordinator = await CoordinatorClient(rt.config.coordinator_url).connect()
+        # reconnect=True: a coordinator restart re-registers this runtime's
+        # leases, discovery keys, watches and subs automatically
+        rt.coordinator = await CoordinatorClient(
+            rt.config.coordinator_url, reconnect=True
+        ).connect()
         rt.primary_lease = await rt.coordinator.lease_create(rt.config.lease_ttl_s)
         return rt
 

@@ -25,12 +25,15 @@ deliveries are pull-based replies).
 from __future__ import annotations
 
 import asyncio
+import base64
 import itertools
 import json
 import logging
+import os
 import time
 from collections import defaultdict, deque
 from dataclasses import dataclass, field
+from pathlib import Path
 from typing import Any, Callable, Optional
 
 from dynamo_tpu.runtime.transports.framing import read_frame, write_frame
@@ -67,9 +70,20 @@ class _QueueItem:
 
 
 class CoordinatorServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    """``data_dir`` enables durability: unleased KV and queue state are
+    appended to a write-ahead log and replayed on restart, so a coordinator
+    crash loses no queued remote prefill or registered config (ref: raft-
+    backed etcd, transports/etcd.rs:40-255, + JetStream file store,
+    examples/llm/utils/nats_queue.py:21-59).  Lease-bound keys are
+    deliberately ephemeral — their owners are gone after a restart; they
+    re-register through the reconnecting client."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 data_dir: Optional[str] = None):
         self.host = host
         self.port = port
+        self._data_dir = Path(data_dir) if data_dir else None
+        self._wal = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._kv: dict[str, Any] = {}
         self._kv_lease: dict[str, int] = {}
@@ -88,8 +102,74 @@ class CoordinatorServer:
         self._write_locks: dict[int, asyncio.Lock] = {}
         self._conn_writers: dict[int, asyncio.StreamWriter] = {}
 
+    # ------------------------------------------------------------ durability
+    def _log(self, rec: dict, sync: bool = False) -> None:
+        if self._wal is None:
+            return
+        self._wal.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._wal.flush()
+        if sync:
+            os.fsync(self._wal.fileno())
+
+    def _recover(self) -> None:
+        """Replay the WAL, then rewrite it compacted (current state only)."""
+        path = self._data_dir / "wal.jsonl"
+        self._data_dir.mkdir(parents=True, exist_ok=True)
+        queues: dict[str, dict[int, bytes]] = defaultdict(dict)
+        max_id = 0
+        if path.exists():
+            with path.open() as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        log.warning("truncated WAL record skipped")
+                        continue  # torn tail write — ignore
+                    t = rec.get("t")
+                    if t == "kv":
+                        self._kv[rec["key"]] = rec.get("value")
+                    elif t == "kvdel":
+                        self._kv.pop(rec["key"], None)
+                    elif t == "qpush":
+                        queues[rec["q"]][rec["mid"]] = base64.b64decode(rec["p"])
+                        max_id = max(max_id, rec["mid"])
+                    elif t == "qack":
+                        queues[rec["q"]].pop(rec["mid"], None)
+        for q, items in queues.items():
+            for mid, payload in sorted(items.items()):
+                self._queues[q].append(_QueueItem(mid, payload, {"queue": q}))
+        self._ids = itertools.count(max_id + 1)
+        # compact: snapshot current state, drop the acked/deleted history
+        tmp = path.with_suffix(".tmp")
+        with tmp.open("w") as f:
+            for key, value in self._kv.items():
+                f.write(json.dumps({"t": "kv", "key": key, "value": value},
+                                   separators=(",", ":")) + "\n")
+            for q, dq in self._queues.items():
+                for item in dq:
+                    f.write(json.dumps(
+                        {"t": "qpush", "q": q, "mid": item.msg_id,
+                         "p": base64.b64encode(item.payload).decode()},
+                        separators=(",", ":")) + "\n")
+            # the rewrite must be as durable as the fsynced records it
+            # replaces — flush+fsync file, then fsync the dir after rename
+            f.flush()
+            os.fsync(f.fileno())
+        tmp.replace(path)
+        dir_fd = os.open(self._data_dir, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+        self._wal = path.open("a")
+
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> "CoordinatorServer":
+        if self._data_dir is not None:
+            self._recover()
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
         self._expiry_task = asyncio.ensure_future(self._expiry_loop())
@@ -106,6 +186,9 @@ class CoordinatorServer:
             for w in list(self._conn_writers.values()):
                 w.close()
             await self._server.wait_closed()
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
 
     @property
     def url(self) -> str:
@@ -179,6 +262,11 @@ class CoordinatorServer:
                 ok = self._kv[key] == value
                 await self._send(conn_id, writer, {"id": rid, "ok": ok, "exists": True})
                 return
+            # an overwrite changes the key's lease binding: detach from any
+            # previous lease so the old owner's expiry can't delete it
+            old_lease = self._kv_lease.pop(key, None)
+            if old_lease and old_lease in self._leases:
+                self._leases[old_lease].keys.discard(key)
             self._kv[key] = value
             lease_id = h.get("lease_id")
             if lease_id:
@@ -189,6 +277,13 @@ class CoordinatorServer:
                     return
                 lease.keys.add(key)
                 self._kv_lease[key] = lease_id
+                if not old_lease:
+                    # a previously-durable value must not resurrect on
+                    # restart now that the key is lease-bound (ephemeral)
+                    self._log({"t": "kvdel", "key": key})
+            else:
+                # only unleased KV is durable; leased state dies with owners
+                self._log({"t": "kv", "key": key, "value": value})
             await self._notify_watchers("put", key, value)
             await self._send(conn_id, writer, {"id": rid, "ok": True})
 
@@ -260,6 +355,8 @@ class CoordinatorServer:
 
         elif op == "queue_push":
             item = _QueueItem(next(self._ids), payload, {"queue": h["queue"]})
+            self._log({"t": "qpush", "q": h["queue"], "mid": item.msg_id,
+                       "p": base64.b64encode(payload).decode()}, sync=True)
             self._queue_deliver(h["queue"], item)
             await self._send(conn_id, writer, {"id": rid, "ok": True, "msg_id": item.msg_id})
 
@@ -281,6 +378,9 @@ class CoordinatorServer:
         elif op == "queue_ack":
             key = (h["queue"], h["msg_id"])
             ok = self._pending_acks.pop(key, None) is not None
+            if ok:
+                self._log({"t": "qack", "q": h["queue"], "mid": h["msg_id"]},
+                          sync=True)
             await self._send(conn_id, writer, {"id": rid, "ok": ok})
 
         elif op == "queue_nack":
@@ -309,6 +409,8 @@ class CoordinatorServer:
         if lease_id and lease_id in self._leases:
             self._leases[lease_id].keys.discard(key)
         if existed:
+            if not lease_id:
+                self._log({"t": "kvdel", "key": key})
             asyncio.ensure_future(self._notify_watchers("delete", key, None))
         return existed
 
@@ -320,6 +422,8 @@ class CoordinatorServer:
         for key in list(lease.keys):
             self._kv.pop(key, None)
             self._kv_lease.pop(key, None)
+            # a pre-lease durable value must not resurrect on restart
+            self._log({"t": "kvdel", "key": key})
             asyncio.ensure_future(self._notify_watchers("delete", key, None))
 
     async def _notify_watchers(self, event: str, key: str, value: Any) -> None:
@@ -358,36 +462,67 @@ class CoordinatorServer:
 
 class CoordinatorClient:
     """Async client. Watches and subscriptions deliver via callbacks
-    (scheduled on the client's event loop)."""
+    (scheduled on the client's event loop).
 
-    def __init__(self, url: str):
+    ``reconnect=True`` makes the client survive a coordinator restart: on
+    connection loss it redials with backoff and RE-REGISTERS its watches,
+    subscriptions, leases, and lease-bound keys (fresh server-side ids,
+    stable client-side handles) — so worker discovery heals without any
+    caller code.  In-flight calls at the moment of disconnect still raise
+    ConnectionError; callers retry (the workers' pull loops already do)."""
+
+    def __init__(self, url: str, reconnect: bool = False):
         # url: tcp://host:port
         hostport = url.split("//", 1)[-1]
         host, port = hostport.rsplit(":", 1)
         self.host, self.port = host, int(port)
+        self.reconnect = reconnect
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._ids = itertools.count(1)
         self._pending: dict[int, asyncio.Future] = {}
-        self._watch_cbs: dict[int, Callable[[str, str, Any], None]] = {}
-        self._sub_cbs: dict[int, Callable[[str, bytes], None]] = {}
         self._read_task: Optional[asyncio.Task] = None
         self._keepalive_tasks: dict[int, asyncio.Task] = {}
         self._write_lock = asyncio.Lock()
         self.closed = asyncio.Event()
+        self._closing = False
+        # Callbacks are keyed by stable client-side HANDLES (the first
+        # server id ever issued); live server ids map back to handles via
+        # the *_by_srv tables, rebuilt wholesale on reconnect — so a
+        # restarted server reusing id numbers can never misdirect or drop
+        # a callback.
+        self._watch_cbs: dict[int, Callable[[str, str, Any], None]] = {}
+        self._watch_reg: dict[int, str] = {}          # handle -> prefix
+        self._watch_by_srv: dict[int, int] = {}       # live watch_id -> handle
+        self._watch_keys: dict[int, set] = {}         # handle -> known keys
+        self._sub_cbs: dict[int, Callable[[str, bytes], None]] = {}
+        self._sub_reg: dict[int, str] = {}            # handle -> subject
+        self._sub_by_srv: dict[int, int] = {}         # live sub_id -> handle
+        self._lease_srv: dict[int, int] = {}          # handle -> live lease_id
+        self._lease_reg: dict[int, float] = {}        # handle -> ttl
+        self._leased_kv: dict[str, tuple[Any, int]] = {}  # key -> (value, lease handle)
+        self._reconnect_task: Optional[asyncio.Task] = None
+        self._reconnecting = False
+        self._connected = asyncio.Event()
+        self._epoch = 0  # bumped on every disconnect; guards stale writes
 
     async def connect(self) -> "CoordinatorClient":
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._connected.set()
         self._read_task = asyncio.ensure_future(self._read_loop())
         return self
 
     async def close(self) -> None:
+        self._closing = True
         for t in self._keepalive_tasks.values():
             t.cancel()
+        if self._reconnect_task:
+            self._reconnect_task.cancel()
         if self._read_task:
             self._read_task.cancel()
         if self._writer:
             self._writer.close()
+        self._connected.clear()
         self.closed.set()
 
     async def _read_loop(self) -> None:
@@ -399,11 +534,19 @@ class CoordinatorClient:
                 header, payload = frame
                 op = header.get("op")
                 if op == "watch_event":
-                    cb = self._watch_cbs.get(header["watch_id"])
+                    handle = self._watch_by_srv.get(header["watch_id"])
+                    cb = self._watch_cbs.get(handle)
                     if cb:
-                        cb(header["event"], header["key"], header.get("value"))
+                        key = header["key"]
+                        known = self._watch_keys.setdefault(handle, set())
+                        if header["event"] == "put":
+                            known.add(key)
+                        else:
+                            known.discard(key)
+                        cb(header["event"], key, header.get("value"))
                 elif op == "message":
-                    cb = self._sub_cbs.get(header["sub_id"])
+                    handle = self._sub_by_srv.get(header["sub_id"])
+                    cb = self._sub_cbs.get(handle)
                     if cb:
                         cb(header["subject"], payload)
                 else:
@@ -413,18 +556,103 @@ class CoordinatorClient:
         except asyncio.CancelledError:
             pass
         finally:
-            self.closed.set()
+            # mark disconnected FIRST so no new _call can slip a future in
+            # after the sweep below (it would hang forever)
+            self._epoch += 1
+            self._connected.clear()
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError("coordinator connection lost"))
             self._pending.clear()
+            if self.reconnect and not self._closing and not self._reconnecting:
+                self._reconnect_task = asyncio.ensure_future(self._reconnect_loop())
+            elif not self._reconnecting:
+                self.closed.set()
+
+    async def _reconnect_loop(self) -> None:
+        """Sole owner of redial + re-registration.  A connection that dies
+        again mid-re-registration is retried HERE (the dying read loop sees
+        _reconnecting and does not spawn a second loop)."""
+        self._reconnecting = True
+        delay = 0.1
+        try:
+            while not self._closing:
+                try:
+                    self._reader, self._writer = await asyncio.open_connection(
+                        self.host, self.port
+                    )
+                except OSError:
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 1.6, 3.0)
+                    continue
+                self._connected.set()
+                self._read_task = asyncio.ensure_future(self._read_loop())
+                try:
+                    await self._reregister()
+                    log.info("coordinator client reconnected to %s:%s",
+                             self.host, self.port)
+                    return
+                except Exception:
+                    log.exception("re-registration failed; redialing")
+                    self._connected.clear()
+                    try:
+                        self._writer.close()
+                    except Exception:
+                        pass
+                    await asyncio.sleep(delay)
+        finally:
+            self._reconnecting = False
+            if self._closing or not self._connected.is_set():
+                self.closed.set()
+
+    async def _reregister(self) -> None:
+        """Re-establish server-side state under the fresh connection."""
+        self._watch_by_srv.clear()
+        self._sub_by_srv.clear()
+        for handle, prefix in list(self._watch_reg.items()):
+            resp, _ = await self._call({"op": "watch", "prefix": prefix})
+            self._watch_by_srv[resp["watch_id"]] = handle
+            cb = self._watch_cbs.get(handle)
+            snapshot = resp.get("snapshot", {})
+            if cb:
+                # synthesize deletes for keys that vanished while we were
+                # down (e.g. a worker that crashed during the outage), then
+                # replay the snapshot as puts
+                known = self._watch_keys.setdefault(handle, set())
+                for k in sorted(known - set(snapshot)):
+                    cb("delete", k, None)
+                for k, v in snapshot.items():
+                    cb("put", k, v)
+            self._watch_keys[handle] = set(snapshot)
+        for handle, subject in list(self._sub_reg.items()):
+            resp, _ = await self._call({"op": "subscribe", "subject": subject})
+            self._sub_by_srv[resp["sub_id"]] = handle
+        for handle, ttl in list(self._lease_reg.items()):
+            resp, _ = await self._call({"op": "lease_create", "ttl": ttl})
+            self._lease_srv[handle] = resp["lease_id"]
+        for key, (value, lease_handle) in list(self._leased_kv.items()):
+            live = self._lease_srv.get(lease_handle)
+            if live is None:
+                continue  # lease was revoked — never resurrect the key
+            await self._call({
+                "op": "kv_put", "key": key, "value": value, "lease_id": live,
+            })
 
     async def _call(self, header: dict, payload: bytes = b"") -> tuple[dict, bytes]:
+        if not self._connected.is_set():
+            # fail fast during the disconnect window: a write to the stale
+            # half-closed socket would buffer silently and the future would
+            # hang forever (the new connection never sees this request id)
+            raise ConnectionError("coordinator disconnected")
+        epoch = self._epoch
         rid = next(self._ids)
         header["id"] = rid
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
         async with self._write_lock:
+            if epoch != self._epoch or not self._connected.is_set():
+                self._pending.pop(rid, None)
+                raise ConnectionError("coordinator disconnected")
             write_frame(self._writer, header, payload)
             await self._writer.drain()
         resp, pl = await fut
@@ -434,13 +662,20 @@ class CoordinatorClient:
 
     # ----------------------------------------------------------------- KV API
     async def kv_put(self, key: str, value: Any, lease_id: Optional[int] = None) -> None:
-        await self._call({"op": "kv_put", "key": key, "value": value, "lease_id": lease_id})
+        await self._call({"op": "kv_put", "key": key, "value": value,
+                          "lease_id": self._lease_srv.get(lease_id, lease_id)})
+        if lease_id and self.reconnect:
+            self._leased_kv[key] = (value, lease_id)
 
     async def kv_create(self, key: str, value: Any, lease_id: Optional[int] = None) -> bool:
         resp, _ = await self._call(
-            {"op": "kv_create", "key": key, "value": value, "lease_id": lease_id}
+            {"op": "kv_create", "key": key, "value": value,
+             "lease_id": self._lease_srv.get(lease_id, lease_id)}
         )
-        return bool(resp.get("ok"))
+        ok = bool(resp.get("ok"))
+        if ok and lease_id and self.reconnect:
+            self._leased_kv[key] = (value, lease_id)
+        return ok
 
     async def kv_create_or_validate(self, key: str, value: Any) -> bool:
         resp, _ = await self._call({"op": "kv_create_or_validate", "key": key, "value": value})
@@ -455,6 +690,7 @@ class CoordinatorClient:
         return resp.get("items", {})
 
     async def kv_delete(self, key: str) -> bool:
+        self._leased_kv.pop(key, None)
         resp, _ = await self._call({"op": "kv_delete", "key": key})
         return bool(resp.get("ok"))
 
@@ -464,49 +700,81 @@ class CoordinatorClient:
         """Watch a prefix; callback(event, key, value).  Returns
         (watch_id, snapshot-at-watch-start)."""
         resp, _ = await self._call({"op": "watch", "prefix": prefix})
-        watch_id = resp["watch_id"]
-        self._watch_cbs[watch_id] = callback
-        return watch_id, resp.get("snapshot", {})
+        handle = resp["watch_id"]  # stable client handle = first server id
+        self._watch_cbs[handle] = callback
+        self._watch_by_srv[handle] = handle
+        self._watch_reg[handle] = prefix
+        snapshot = resp.get("snapshot", {})
+        self._watch_keys[handle] = set(snapshot)
+        return handle, snapshot
 
     async def unwatch(self, watch_id: int) -> None:
+        self._watch_reg.pop(watch_id, None)
         self._watch_cbs.pop(watch_id, None)
-        await self._call({"op": "unwatch", "watch_id": watch_id})
+        self._watch_keys.pop(watch_id, None)
+        live = next(
+            (s for s, h in self._watch_by_srv.items() if h == watch_id), watch_id
+        )
+        self._watch_by_srv.pop(live, None)
+        await self._call({"op": "unwatch", "watch_id": live})
 
     # -------------------------------------------------------------- lease API
     async def lease_create(self, ttl: float = 10.0, auto_keepalive: bool = True) -> int:
         resp, _ = await self._call({"op": "lease_create", "ttl": ttl})
         lease_id = resp["lease_id"]
+        if self.reconnect:
+            self._lease_srv[lease_id] = lease_id
+            self._lease_reg[lease_id] = ttl
         if auto_keepalive:
             self._keepalive_tasks[lease_id] = asyncio.ensure_future(
                 self._keepalive_loop(lease_id, ttl)
             )
         return lease_id
 
-    async def _keepalive_loop(self, lease_id: int, ttl: float) -> None:
-        # half-TTL ticks (ref transports/etcd/lease.rs:51)
-        try:
-            while True:
+    async def _keepalive_loop(self, handle: int, ttl: float) -> None:
+        # half-TTL ticks (ref transports/etcd/lease.rs:51); resolve the
+        # handle each tick — reconnection swaps the server-side lease id
+        while True:
+            try:
                 await asyncio.sleep(ttl / 2)
-                await self._call({"op": "lease_keepalive", "lease_id": lease_id})
-        except (asyncio.CancelledError, ConnectionError, RuntimeError):
-            pass
+                await self._call({
+                    "op": "lease_keepalive",
+                    "lease_id": self._lease_srv.get(handle, handle),
+                })
+            except asyncio.CancelledError:
+                return
+            except (ConnectionError, RuntimeError, OSError):
+                if not self.reconnect or self._closing:
+                    return  # without reconnect, a lost lease stays lost
 
     async def lease_revoke(self, lease_id: int) -> None:
         t = self._keepalive_tasks.pop(lease_id, None)
         if t:
             t.cancel()
-        await self._call({"op": "lease_revoke", "lease_id": lease_id})
+        self._lease_reg.pop(lease_id, None)
+        # revoked keys must not resurrect through post-reconnect re-puts
+        for key in [k for k, (_, lh) in self._leased_kv.items() if lh == lease_id]:
+            del self._leased_kv[key]
+        live = self._lease_srv.pop(lease_id, lease_id)
+        await self._call({"op": "lease_revoke", "lease_id": live})
 
     # ------------------------------------------------------------- pub/sub API
     async def subscribe(self, subject: str, callback: Callable[[str, bytes], None]) -> int:
         resp, _ = await self._call({"op": "subscribe", "subject": subject})
-        sub_id = resp["sub_id"]
-        self._sub_cbs[sub_id] = callback
-        return sub_id
+        handle = resp["sub_id"]
+        self._sub_cbs[handle] = callback
+        self._sub_by_srv[handle] = handle
+        self._sub_reg[handle] = subject
+        return handle
 
     async def unsubscribe(self, sub_id: int) -> None:
+        self._sub_reg.pop(sub_id, None)
         self._sub_cbs.pop(sub_id, None)
-        await self._call({"op": "unsubscribe", "sub_id": sub_id})
+        live = next(
+            (s for s, h in self._sub_by_srv.items() if h == sub_id), sub_id
+        )
+        self._sub_by_srv.pop(live, None)
+        await self._call({"op": "unsubscribe", "sub_id": live})
 
     async def publish(self, subject: str, payload: bytes | dict) -> int:
         if isinstance(payload, dict):
