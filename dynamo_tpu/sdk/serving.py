@@ -44,6 +44,14 @@ class ServeHandle:
     clients: list[ServiceClient] = field(default_factory=list)
 
     async def stop(self) -> None:
+        # instance-level shutdown hooks first (HTTP servers, pull loops)
+        for inst in self.instances.values():
+            hook = getattr(inst, "shutdown", None)
+            if hook is not None:
+                try:
+                    await hook()
+                except Exception:
+                    log.exception("instance shutdown failed")
         for c in self.clients:
             await c.close()
         for rt in self.runtimes:
@@ -65,8 +73,11 @@ async def serve_service(
         obj.__dict__[f"_dep_{dep.attr}"] = client
         if handle is not None:
             handle.clients.append(client)
-    # per-service YAML/env args land on the instance before __init__
+    # per-service YAML/env args land on the instance before __init__, and
+    # the runtime itself so components can build ad-hoc ServiceClients /
+    # reach the coordinator (prefill queue, KV events)
     obj.service_config = (config or ServiceConfig.from_env()).for_service(svc.name)
+    obj.dynamo_runtime = runtime
     obj.__init__()
 
     for hook in svc.on_start_hooks:

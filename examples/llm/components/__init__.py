@@ -1,0 +1,7 @@
+from .frontend import Frontend
+from .kv_router import Router
+from .prefill_worker import PrefillWorker
+from .processor import Processor
+from .worker import TpuWorker
+
+__all__ = ["Frontend", "Processor", "Router", "TpuWorker", "PrefillWorker"]

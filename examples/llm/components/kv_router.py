@@ -1,0 +1,55 @@
+"""Router — KV-aware worker selection for the *_router example graphs.
+
+Wraps the KvRouter (chained-hash prefix index + cost-model scheduler) and
+keeps it live off the coordinator's KV-event / metrics subjects.  The
+Processor consults `route` and then direct-dials the chosen TpuWorker
+instance.  Reference analogue: examples/llm/components/kv_router.py +
+components/router/src/main.rs.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from dynamo_tpu.sdk import async_on_start, dynamo_endpoint, service
+
+from .worker import NAMESPACE
+
+log = logging.getLogger("examples.kv_router")
+
+
+@service(dynamo={"namespace": NAMESPACE})
+class Router:
+    def __init__(self):
+        self._cfg = dict(self.service_config)
+        self.router = None
+
+    @async_on_start
+    async def boot(self):
+        from dynamo_tpu.llm.kv_router.metrics_aggregator import KvRouterSubscriber
+        from dynamo_tpu.llm.kv_router.router import KvRouter
+
+        self.router = KvRouter(block_size=int(self._cfg.get("block-size", 16)))
+        self.subscriber = await KvRouterSubscriber(
+            self.router, self.dynamo_runtime.coordinator, NAMESPACE
+        ).start()
+
+    async def shutdown(self):
+        if getattr(self, "subscriber", None) is not None:
+            await self.subscriber.stop()
+
+    @dynamo_endpoint
+    async def route(self, req: dict):
+        from dynamo_tpu.llm.kv_router.scheduler import AllWorkersBusy
+
+        try:
+            decision = self.router.schedule(req["token_ids"])
+        except AllWorkersBusy:
+            # no metrics yet (cold start) — caller falls back to round-robin
+            yield {"worker_id": None}
+            return
+        yield {
+            "worker_id": decision.worker_id,
+            "overlap_blocks": decision.overlap_blocks,
+            "overlap_tokens": decision.overlap_tokens,
+        }

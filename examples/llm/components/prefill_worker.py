@@ -1,0 +1,48 @@
+"""PrefillWorker — disagg prefill side of the example graphs.
+
+Pulls remote-prefill work from the coordinator queue, computes KV, pushes
+blocks to the decode worker's transfer endpoint (device-to-device when
+colocated, TCP over DCN otherwise) and notifies.  Reference analogue:
+examples/llm/components/prefill_worker.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from dynamo_tpu.sdk import async_on_start, dynamo_endpoint, service
+
+from .worker import NAMESPACE, build_engine
+
+log = logging.getLogger("examples.prefill_worker")
+
+
+@service(dynamo={"namespace": NAMESPACE}, resources={"tpu": 1})
+class PrefillWorker:
+    def __init__(self):
+        self._cfg = dict(self.service_config)
+        self._task = None
+        self.worker = None
+
+    @async_on_start
+    async def boot(self):
+        from dynamo_tpu.llm.workers import PrefillWorker as EnginePrefillWorker
+
+        engine, _card = build_engine(self._cfg)
+        rt = self.dynamo_runtime
+        self.worker = EnginePrefillWorker(engine, rt.coordinator, NAMESPACE)
+        self._task = asyncio.ensure_future(self.worker.run())
+
+    async def shutdown(self):
+        if self.worker is not None:
+            self.worker.request_stop()
+        if self._task is not None:
+            try:
+                await asyncio.wait_for(self._task, timeout=2)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                self._task.cancel()
+
+    @dynamo_endpoint
+    async def status(self, req: dict):
+        yield {"handled": self.worker.handled if self.worker else 0}

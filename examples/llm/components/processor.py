@@ -1,0 +1,80 @@
+"""Processor — request preprocessing + worker routing for the example
+graphs (reference analogue: examples/llm/components/processor.py).
+
+Takes an OpenAI-ish request dict ({prompt_token_ids | prompt, sampling,
+stops}), tokenizes when a tokenizer is configured, picks a worker (KV-aware
+via the Router component when ``router: kv``, else the client's built-in
+round-robin), and streams the worker's deltas back.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from dynamo_tpu.sdk import async_on_start, dynamo_endpoint, service
+from dynamo_tpu.sdk.service import ServiceClient
+
+from .worker import NAMESPACE, TpuWorker
+
+log = logging.getLogger("examples.processor")
+
+
+@service(dynamo={"namespace": NAMESPACE})
+class Processor:
+    def __init__(self):
+        self._cfg = dict(self.service_config)
+        self.tokenizer = None
+        self.router_client = None
+
+    @async_on_start
+    async def boot(self):
+        rt = self.dynamo_runtime
+        self.worker_client = ServiceClient(rt, TpuWorker)
+        if self._cfg.get("router") == "kv":
+            from .kv_router import Router
+
+            self.router_client = ServiceClient(rt, Router)
+        tok = self._cfg.get("tokenizer")
+        if tok:
+            from dynamo_tpu.llm.tokenizer import TokenizerWrapper
+
+            self.tokenizer = TokenizerWrapper.from_file(tok)
+
+    async def _pick_instance(self, token_ids):
+        if self.router_client is None:
+            return None
+        try:
+            async for d in self.router_client.route({"token_ids": token_ids}):
+                return d.get("worker_id")
+        except Exception:
+            log.exception("router unavailable; falling back to round-robin")
+        return None
+
+    @dynamo_endpoint
+    async def process(self, req: dict):
+        token_ids = req.get("prompt_token_ids")
+        if token_ids is None:
+            if self.tokenizer is None:
+                raise ValueError(
+                    "text prompt needs a configured tokenizer; send "
+                    "prompt_token_ids instead"
+                )
+            token_ids = self.tokenizer.encode(req["prompt"])
+        payload = {
+            "token_ids": list(map(int, token_ids)),
+            "sampling": req.get("sampling", {}),
+            "stops": req.get("stops", {}),
+            "model": req.get("model", ""),
+        }
+        instance = await self._pick_instance(payload["token_ids"])
+        stream = (
+            self.worker_client.generate.direct(payload, instance)
+            if instance is not None
+            else self.worker_client.generate(payload)
+        )
+        async for out in stream:
+            if self.tokenizer is not None and out.get("token_ids") and "text" not in out:
+                out["text"] = self.tokenizer.decode(out["token_ids"])
+            yield out
+            if out.get("finish_reason"):
+                return

@@ -1,0 +1,156 @@
+"""TpuWorker — the serving engine component of the example graphs.
+
+Reference analogue: examples/llm/components/worker.py (VllmWorker) +
+prefill_worker.py; here the engine is the native JAX EngineCore.  Config
+(ServiceConfig YAML, see ../configs/):
+
+  engine: echo | tiny | tpu     (tiny = random-weights EngineCore, used by
+                                 serve-level tests; tpu needs model-path)
+  model-path: HF dir or .gguf   quantize: none | int8
+  max-batch-size / max-model-len / block-size / num-blocks
+  tp / dp                       (sharded engine over a device mesh)
+  remote-prefill: true          (disagg decode side: conditional remote
+                                 prefill via the coordinator queue)
+  max-local-prefill-length      (disagg router threshold)
+"""
+
+from __future__ import annotations
+
+import logging
+from types import SimpleNamespace
+
+from dynamo_tpu.llm.protocols import (
+    BackendInput,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.sdk import async_on_start, dynamo_endpoint, service
+
+log = logging.getLogger("examples.worker")
+
+NAMESPACE = "dynamo"
+
+
+def build_engine(cfg: dict):
+    """(engine, card) from a service config dict (shared by TpuWorker and
+    PrefillWorker so both sides of a disagg pair agree on the model)."""
+    kind = cfg.get("engine", "tpu" if cfg.get("model-path") else "echo")
+    if kind == "echo":
+        from dynamo_tpu.llm.engines import EchoEngineCore
+
+        return EchoEngineCore(), None
+    if kind == "tiny":
+        import jax
+
+        from dynamo_tpu.engine import AsyncLLMEngine, EngineConfig, EngineCore
+        from dynamo_tpu.models.config import ModelConfig
+        from dynamo_tpu.models.llama import LlamaModel
+
+        mcfg = ModelConfig.tiny()
+        model = LlamaModel(mcfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        if cfg.get("quantize") == "int8":
+            params = model.quantize_params(params)
+        ecfg = EngineConfig(
+            max_batch_size=int(cfg.get("max-batch-size", 4)),
+            max_model_len=int(cfg.get("max-model-len", 256)),
+            block_size=int(cfg.get("block-size", 16)),
+            num_blocks=int(cfg.get("num-blocks", 64)),
+        )
+        return AsyncLLMEngine(EngineCore(model, params, ecfg)).start(), None
+    # full path: reuse the CLI's builder (loading, quantize, mesh, multihost)
+    from dynamo_tpu.cli import _build_local_engine
+
+    args = SimpleNamespace(
+        out="tpu",
+        model_path=cfg.get("model-path"),
+        model_name=cfg.get("model-name"),
+        dtype=cfg.get("dtype", "bfloat16"),
+        max_batch_size=int(cfg.get("max-batch-size", 8)),
+        max_model_len=int(cfg.get("max-model-len", 4096)),
+        block_size=int(cfg.get("block-size", 16)),
+        num_blocks=int(cfg.get("num-blocks", 512)),
+        quantize=cfg.get("quantize", "none"),
+        tp=int(cfg.get("tp", 1)),
+        dp=int(cfg.get("dp", 1)),
+        nnodes=int(cfg.get("nnodes", 1)),
+        node_rank=int(cfg.get("node-rank", 0)),
+        coordinator=cfg.get("coordinator"),
+    )
+    return _build_local_engine(args)
+
+
+def backend_input(req: dict) -> BackendInput:
+    return BackendInput(
+        token_ids=list(req["token_ids"]),
+        sampling=SamplingOptions(**req.get("sampling", {})),
+        stops=StopConditions(**req.get("stops", {})),
+        model=req.get("model", ""),
+    )
+
+
+def wire_output(out) -> dict:
+    d = {"token_ids": list(out.token_ids)}
+    if out.text:
+        d["text"] = out.text
+    if out.finish_reason is not None:
+        d["finish_reason"] = out.finish_reason.value
+    if out.cached_tokens:
+        d["cached_tokens"] = out.cached_tokens
+    return d
+
+
+@service(dynamo={"namespace": NAMESPACE}, resources={"tpu": 1})
+class TpuWorker:
+    """Engine worker: serves `generate` over BackendInput-shaped dicts.
+    With ``remote-prefill: true`` it wraps the engine in a DecodeWorker so
+    long prompts prefill remotely via the coordinator queue (disagg)."""
+
+    def __init__(self):
+        self._cfg = dict(self.service_config)
+        self.engine = None
+
+    @async_on_start
+    async def boot(self):
+        cfg = self._cfg
+        self.engine, self.card = build_engine(cfg)
+        rt = getattr(self, "dynamo_runtime", None)
+        if cfg.get("remote-prefill") and rt is not None:
+            from dynamo_tpu.llm.disagg_router import (
+                DisaggregatedRouter,
+                DisaggRouterConf,
+            )
+            from dynamo_tpu.llm.workers import DecodeWorker
+
+            conf = DisaggRouterConf(
+                max_local_prefill_length=int(
+                    cfg.get("max-local-prefill-length", 0)
+                ),
+            )
+            self.engine = await DecodeWorker(
+                self.engine,
+                coordinator=rt.coordinator,
+                namespace=NAMESPACE,
+                router=DisaggregatedRouter(conf, namespace=NAMESPACE),
+            ).start()
+        if rt is not None:
+            from dynamo_tpu.cli import _attach_worker_publishers
+
+            _attach_worker_publishers(rt, self.engine, NAMESPACE)
+
+    async def shutdown(self):
+        eng = self.engine
+        if hasattr(eng, "stop"):  # DecodeWorker: close transfer endpoint
+            await eng.stop()
+            eng = eng.engine
+        if hasattr(eng, "shutdown"):  # AsyncLLMEngine thread
+            eng.shutdown()
+
+    @dynamo_endpoint
+    async def generate(self, req: dict):
+        ctx = Context(backend_input(req))
+        async for out in self.engine.generate(ctx):
+            yield wire_output(out)
+            if out.finished:
+                return

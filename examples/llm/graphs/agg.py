@@ -1,0 +1,9 @@
+"""Aggregated serving: one worker does prefill + decode.
+Run: dynamo serve examples.llm.graphs.agg:Frontend -f examples/llm/configs/agg.yaml
+(Reference analogue: examples/llm/graphs/agg.py)"""
+
+from examples.llm.components.frontend import Frontend
+from examples.llm.components.processor import Processor
+from examples.llm.components.worker import TpuWorker
+
+Frontend.link(Processor).link(TpuWorker)

@@ -1,0 +1,10 @@
+"""Aggregated serving with KV-aware routing across worker replicas.
+Run: dynamo serve examples.llm.graphs.agg_router:Frontend -f examples/llm/configs/agg_router.yaml
+(Reference analogue: examples/llm/graphs/agg_router.py)"""
+
+from examples.llm.components.frontend import Frontend
+from examples.llm.components.kv_router import Router
+from examples.llm.components.processor import Processor
+from examples.llm.components.worker import TpuWorker
+
+Frontend.link(Processor).link(Router).link(TpuWorker)

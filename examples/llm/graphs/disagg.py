@@ -1,0 +1,10 @@
+"""Disaggregated serving: decode worker + remote prefill worker.
+Run: dynamo serve examples.llm.graphs.disagg:Frontend -f examples/llm/configs/disagg.yaml
+(Reference analogue: examples/llm/graphs/disagg.py)"""
+
+from examples.llm.components.frontend import Frontend
+from examples.llm.components.prefill_worker import PrefillWorker
+from examples.llm.components.processor import Processor
+from examples.llm.components.worker import TpuWorker
+
+Frontend.link(Processor).link(TpuWorker).link(PrefillWorker)

@@ -1,0 +1,11 @@
+"""Disaggregated serving + KV-aware routing.
+Run: dynamo serve examples.llm.graphs.disagg_router:Frontend -f examples/llm/configs/disagg_router.yaml
+(Reference analogue: examples/llm/graphs/disagg_router.py)"""
+
+from examples.llm.components.frontend import Frontend
+from examples.llm.components.kv_router import Router
+from examples.llm.components.prefill_worker import PrefillWorker
+from examples.llm.components.processor import Processor
+from examples.llm.components.worker import TpuWorker
+
+Frontend.link(Processor).link(Router).link(TpuWorker).link(PrefillWorker)

@@ -194,7 +194,15 @@ async def _drain(engine_like, prompt, n):
     return toks
 
 
-def test_disagg_e2e_matches_local(setup):
+@pytest.fixture()
+def force_tcp(monkeypatch):
+    """Pin the transfer plane to the wire path: these tests cover TCP/DCN
+    framing; colocated engines would otherwise take the in-process ICI
+    shortcut (covered separately by test_colocated_*)."""
+    monkeypatch.setenv("DYN_KV_TRANSFER_FORCE_TCP", "1")
+
+
+def test_disagg_e2e_matches_local(setup, force_tcp):
     """Remote-prefill decode must produce exactly the local greedy tokens,
     including on a second request that hits the decode-side prefix cache
     (skip_blocks > 0 path)."""
@@ -264,7 +272,7 @@ def test_disagg_e2e_matches_local(setup):
     run(go())
 
 
-def test_disagg_sharded_decode_matches_local(setup):
+def test_disagg_sharded_decode_matches_local(setup, force_tcp):
     """Full disagg stack (coordinator + router + transfer) with a
     TP-SHARDED decode engine: the transfer-in scatter must reshard staged
     host blocks onto the mesh (each shard keeps its kv heads) and decode
@@ -300,6 +308,86 @@ def test_disagg_sharded_decode_matches_local(setup):
             got = await _drain(worker, prompt, 8)
             assert got == expected
             assert prefill.handled == 1
+
+            prefill.request_stop()
+            await prefill_task
+            await worker.stop()
+            await c_dec.close()
+            await c_pre.close()
+        finally:
+            decode_engine.shutdown()
+            prefill_engine.shutdown()
+            reference_engine.shutdown()
+            await srv.stop()
+
+    run(go())
+
+
+def test_colocated_handoff_skips_host_staging(setup, monkeypatch):
+    """Colocated prefill/decode (same process) must move KV blocks
+    device-to-device: no host gather, no wire serialization, and the
+    scatter input stays a jax.Array (VERDICT r2 ask #8).  TCP remains the
+    fallback for foreign URLs."""
+    import jax
+
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.llm.kv import transfer as tr
+
+    model, params = setup
+    rng = np.random.default_rng(13)
+    prompt = rng.integers(1, 128, size=30).tolist()
+
+    staged = {"np_gathers": 0, "packs": 0, "scatter_types": []}
+    real_gather_np = EngineCore.gather_blocks_np
+    real_scatter = EngineCore.scatter_external
+    real_pack = tr.pack_blocks
+
+    def spy_gather_np(self, bids):
+        staged["np_gathers"] += 1
+        return real_gather_np(self, bids)
+
+    def spy_scatter(self, bids, blocks, request_id=None):
+        staged["scatter_types"].append(type(blocks).__name__)
+        return real_scatter(self, bids, blocks, request_id)
+
+    def spy_pack(arr):
+        staged["packs"] += 1
+        return real_pack(arr)
+
+    monkeypatch.setattr(EngineCore, "gather_blocks_np", spy_gather_np)
+    monkeypatch.setattr(EngineCore, "scatter_external", spy_scatter)
+    monkeypatch.setattr(tr, "pack_blocks", spy_pack)
+
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        decode_engine = make_engine(model, params)
+        prefill_engine = make_engine(model, params)
+        reference_engine = make_engine(model, params)
+        try:
+            c_dec = await CoordinatorClient(srv.url).connect()
+            c_pre = await CoordinatorClient(srv.url).connect()
+            worker = DecodeWorker(
+                decode_engine, coordinator=c_dec, namespace="ici",
+                router=DisaggregatedRouter(
+                    DisaggRouterConf(max_local_prefill_length=0), namespace="ici"
+                ),
+            )
+            await worker.start()
+            prefill = PrefillWorker(prefill_engine, c_pre, "ici")
+            prefill_task = asyncio.ensure_future(prefill.run())
+
+            expected = await _drain(reference_engine, prompt, 8)
+            got = await _drain(worker, prompt, 8)
+            assert got == expected
+            assert prefill.handled == 1
+
+            # the handoff went device-to-device:
+            assert staged["np_gathers"] == 0, "host staging on colocated path"
+            assert staged["packs"] == 0, "wire serialization on colocated path"
+            assert staged["scatter_types"], "scatter never ran"
+            assert all(
+                t != "ndarray" for t in staged["scatter_types"]
+            ), f"scatter fed host arrays: {staged['scatter_types']}"
 
             prefill.request_stop()
             await prefill_task

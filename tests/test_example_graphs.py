@@ -1,0 +1,129 @@
+"""Serve-level tests for the example graph library (VERDICT r2 ask #10).
+
+Each graph boots through serve_graph (real runtime + coordinator +
+endpoints) with the tiny random-weights engine and serves a completion
+through the real HTTP frontend.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import ClientSession
+
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.transports.coordinator import CoordinatorServer
+from dynamo_tpu.sdk import ServiceConfig, serve_graph
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+BASE_CFG = {
+    "Frontend": {"served_model_name": "tiny", "port": 0},
+    "TpuWorker": {"engine": "tiny", "max-batch-size": 4,
+                  "max-model-len": 128, "block-size": 16, "num-blocks": 64},
+    "PrefillWorker": {"engine": "tiny", "max-batch-size": 4,
+                      "max-model-len": 128, "block-size": 16,
+                      "num-blocks": 64},
+    "Router": {"block-size": 16},
+}
+
+
+async def _post_completion(port: int, n_tokens: int = 6):
+    async with ClientSession() as s:
+        r = await s.post(
+            f"http://127.0.0.1:{port}/v1/completions",
+            json={"model": "tiny",
+                  "prompt": list(range(1, 20)),
+                  "max_tokens": n_tokens,
+                  "temperature": 0.0,
+                  "ignore_eos": True},
+        )
+        assert r.status == 200, await r.text()
+        return await r.json()
+
+
+async def _serve_and_hit(entry_modpath: str, extra_cfg=None, n_requests=1):
+    import importlib
+
+    mod_name, attr = entry_modpath.split(":")
+    entry = getattr(importlib.import_module(mod_name), attr)
+    srv = await CoordinatorServer(port=0).start()
+    cfg = {k: dict(v) for k, v in BASE_CFG.items()}
+    for k, v in (extra_cfg or {}).items():
+        cfg.setdefault(k, {}).update(v)
+    handle = await serve_graph(
+        entry,
+        config=ServiceConfig(cfg),
+        runtime_config=RuntimeConfig(coordinator_url=srv.url),
+    )
+    try:
+        frontend = handle.instances["Frontend"]
+        bodies = []
+        for _ in range(n_requests):
+            bodies.append(await _post_completion(frontend.port))
+        return handle, bodies
+    finally:
+        await handle.stop()
+        await srv.stop()
+
+
+def test_agg_graph_serves():
+    async def go():
+        handle, bodies = await _serve_and_hit("examples.llm.graphs.agg:Frontend")
+        body = bodies[0]
+        assert body["choices"][0]["finish_reason"] in ("length", "stop")
+        assert body["usage"]["completion_tokens"] == 6
+
+    run(go())
+
+
+def test_agg_router_graph_serves():
+    async def go():
+        handle, bodies = await _serve_and_hit(
+            "examples.llm.graphs.agg_router:Frontend",
+            extra_cfg={"Processor": {"router": "kv"}},
+            n_requests=3,
+        )
+        for body in bodies:
+            assert body["usage"]["completion_tokens"] == 6
+        # the Router service actually booted and is live
+        assert "Router" in handle.instances
+
+    run(go())
+
+
+def test_disagg_graph_serves():
+    async def go():
+        handle, bodies = await _serve_and_hit(
+            "examples.llm.graphs.disagg:Frontend",
+            extra_cfg={
+                "TpuWorker": {"remote-prefill": True,
+                              "max-local-prefill-length": 0},
+            },
+        )
+        assert bodies[0]["usage"]["completion_tokens"] == 6
+        # the prompt actually went through the remote prefill worker
+        prefill = handle.instances["PrefillWorker"]
+        assert prefill.worker.handled == 1
+
+    run(go())
+
+
+def test_disagg_router_graph_serves():
+    async def go():
+        handle, bodies = await _serve_and_hit(
+            "examples.llm.graphs.disagg_router:Frontend",
+            extra_cfg={
+                "Processor": {"router": "kv"},
+                "TpuWorker": {"remote-prefill": True,
+                              "max-local-prefill-length": 0},
+            },
+        )
+        assert bodies[0]["usage"]["completion_tokens"] == 6
+        assert handle.instances["PrefillWorker"].worker.handled == 1
+        assert "Router" in handle.instances
+
+    run(go())

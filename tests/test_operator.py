@@ -1,0 +1,124 @@
+"""Operator-lite reconcile loop (VERDICT r2 ask #10): create / scale /
+delete a DynamoTpuDeployment and assert the cluster levels to desired.
+Ref: deploy/dynamo/operator reconcilers,
+api/v1alpha1/dynamodeployment_types.go:31.
+"""
+
+import asyncio
+import copy
+
+import pytest
+
+from dynamo_tpu.deploy.operator import MemoryCluster, Operator, obj_key
+from dynamo_tpu.deploy.renderer import DeploymentSpec
+
+SPEC_YAML = """
+name: llama-disagg
+namespace: serving
+image: dynamo-tpu:latest
+frontend: {replicas: 1, port: 8080}
+services:
+  decode:
+    command: [dynamo-tpu, run, "in=dyn://dynamo.decode.generate", "out=tpu"]
+    replicas: 1
+    tpu: {type: v5e, topology: "2x2", chips: 4}
+  prefill:
+    command: [dynamo-tpu, run, "in=dyn://dynamo.prefill.generate", "out=tpu"]
+    replicas: 4
+    tpu: {type: v5e, topology: "1x1", chips: 1}
+"""
+
+
+def _deployments(cluster):
+    return {
+        k: o for k, o in cluster.objects.items() if k[0] == "Deployment"
+    }
+
+
+def test_create_scale_delete_reconcile():
+    cluster = MemoryCluster()
+    op = Operator(cluster)
+    spec = DeploymentSpec.from_yaml(SPEC_YAML)
+
+    # ---- create
+    op.set_spec(spec)
+    s = op.reconcile_once()
+    assert s["created"] > 0 and s["deleted"] == 0
+    deps = _deployments(cluster)
+    names = {k[2] for k in deps}
+    assert any("decode" in n for n in names)
+    assert any("prefill" in n for n in names)
+    prefill_key = next(k for k in deps if "prefill" in k[2])
+    assert deps[prefill_key]["spec"]["replicas"] == 4
+    # level: second pass is a no-op
+    s2 = op.reconcile_once()
+    assert s2 == {"created": 0, "updated": 0, "deleted": 0,
+                  "unchanged": s["created"]}
+
+    # ---- scale
+    scaled = copy.deepcopy(spec)
+    scaled.services[1].replicas = 8
+    assert scaled.services[1].name == "prefill"
+    op.set_spec(scaled)
+    s3 = op.reconcile_once()
+    assert s3["updated"] == 1 and s3["created"] == 0 and s3["deleted"] == 0
+    assert _deployments(cluster)[prefill_key]["spec"]["replicas"] == 8
+
+    # ---- delete
+    total_owned = len(cluster.list_owned(op.owner))
+    op.delete_spec(spec.name)
+    s4 = op.reconcile_once()
+    assert s4["deleted"] == total_owned
+    assert cluster.list_owned(op.owner) == []
+
+
+def test_drift_repair_and_foreign_objects_untouched():
+    cluster = MemoryCluster()
+    # a foreign object the operator must never touch
+    foreign = {"kind": "Deployment",
+               "metadata": {"name": "unrelated", "namespace": "serving"}}
+    cluster.apply(foreign)
+    op = Operator(cluster)
+    op.set_spec(DeploymentSpec.from_yaml(SPEC_YAML))
+    op.reconcile_once()
+    owned = len(cluster.list_owned(op.owner))
+    assert owned > 0
+
+    # drift: someone deletes an owned object out-of-band → next pass heals
+    key = next(k for k in cluster.objects if "decode" in k[2])
+    cluster.objects.pop(key)
+    s = op.reconcile_once()
+    assert s["created"] == 1
+    assert key in cluster.objects
+    # the foreign object survived every pass
+    assert obj_key(foreign) in cluster.objects
+
+
+def test_load_dir_watch_standin(tmp_path):
+    (tmp_path / "a.yaml").write_text(SPEC_YAML)
+    cluster = MemoryCluster()
+    op = Operator(cluster)
+    op.load_dir(tmp_path)
+    op.reconcile_once()
+    assert cluster.list_owned(op.owner)
+    # file vanishes → spec deleted → objects pruned
+    (tmp_path / "a.yaml").unlink()
+    op.load_dir(tmp_path)
+    op.reconcile_once()
+    assert cluster.list_owned(op.owner) == []
+
+
+def test_async_loop_reconciles_on_set_spec():
+    async def go():
+        cluster = MemoryCluster()
+        op = Operator(cluster, interval_s=30.0).start()  # long tick: event-driven
+        await asyncio.sleep(0.05)
+        op.set_spec(DeploymentSpec.from_yaml(SPEC_YAML))
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            if cluster.list_owned(op.owner):
+                break
+        assert cluster.list_owned(op.owner)
+        await op.stop()
+
+    asyncio.new_event_loop().run_until_complete(go())
