@@ -259,7 +259,11 @@ class LlamaModel:
             q, k, v = _qkv_proj(cfg, lp, x, b, s)
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
-            cache = write_kv_cache_layer(cache, li, k, v, slot_idx)
+            # fast_prefill implies the engine's block-aligned contiguous
+            # chunk layout — unlocks the block-granular cache write
+            cache = write_kv_cache_layer(
+                cache, li, k, v, slot_idx, block_aligned=fast_prefill
+            )
             if fast_prefill:
                 attn = prefill_attention(
                     q, k, v, cache, li, block_tables, seq_lens,

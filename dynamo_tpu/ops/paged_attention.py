@@ -41,6 +41,15 @@ def _pallas_decode_enabled() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def _pallas_prefill_enabled() -> bool:
+    """Use the Pallas flash-prefill kernel for S>1 steps on TPU."""
+    if os.environ.get("DYNAMO_DISABLE_PALLAS"):
+        return False
+    if os.environ.get("DYNAMO_DISABLE_PALLAS_PREFILL"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
 def paged_attention_layer(
     q: jax.Array,             # [B, S, H, D]
     cache: jax.Array,         # [L, N, 2, Bs, Hk*D] — full multi-layer cache
@@ -105,6 +114,18 @@ def prefill_attention(
     g = h // hk
     if sm_scale is None:
         sm_scale = 1.0 / (d**0.5)
+    if s > 1 and _pallas_prefill_enabled():
+        # flash path: online softmax, scores never leave VMEM; the cached
+        # prefix streams from HBM by its TRUE length (start), so the
+        # static prefix_blocks bucket doesn't even force recompiles here
+        from dynamo_tpu.ops.pallas.prefill_attention import (
+            paged_prefill_attention,
+        )
+
+        return paged_prefill_attention(
+            q, k_new, v_new, cache, layer, block_tables, seq_lens, start,
+            sm_scale=sm_scale,
+        )
     qg = q.reshape(b, s, hk, g, d).astype(jnp.float32)
     fresh = (seq_lens - start)[:, None, None]  # valid fresh tokens per row
 
@@ -145,22 +166,61 @@ def write_kv_cache_layer(
     k_new: jax.Array,    # [B, S, Hk, D]
     v_new: jax.Array,    # [B, S, Hk, D]
     slot_idx: jax.Array, # [B, S] int32  flat slot = block_id * Bs + offset; -1 = drop
+    block_aligned: bool = False,  # STATIC: rows are Bs-groups, each group
+                                  # contiguous from a block-leading slot
 ) -> jax.Array:
     """Scatter new K/V rows straight into the full multi-layer cache.
 
     The cache is a scan carry: scattering into it (rather than slicing a
     per-layer view) lets XLA update the buffer in place — the whole-cache
     copy-through-the-loop this replaces dominated decode ITL on TPU.
+
+    With ``block_aligned=True`` (the engine's prefill layout guarantees
+    it: chunks start block-aligned and rows are contiguous) the scatter
+    collapses to block-granular read-modify-writes: S/Bs big rows instead
+    of S small ones (a 2048-token prefill writes 64 block rows per layer,
+    not 2048 row scatters — XLA lowers many-small-row scatter to a slow
+    sequential loop, which dominated TTFT).  Rows with slot -1 inside a
+    partially-valid group keep the EXISTING cache content (the gather+
+    select below), honoring the '-1 = drop' contract bit-for-bit.
+    Alignment is a caller contract, not data-inspected — callers that
+    cannot guarantee it use the default row path.
     """
     l, n, two, bs, hkd = cache.shape
     b, s, hk, d = k_new.shape
-    flat = cache.reshape(l * n * 2 * bs, hkd)
+    if block_aligned and s > 1 and s % bs == 0:
+        nb = s // bs
+        size = l * n * 2  # one-past-the-end: truly dropped by mode="drop"
+        first = slot_idx[:, ::bs]                     # [B, nb] block-leading slot
+        bid = jnp.where(first >= 0, first // bs, -1)  # [B, nb]
+        flat = cache.reshape(size, bs, hkd)
+        base = layer * (n * 2) + bid * 2              # K row of (layer, bid)
+        # NOTE: the drop sentinel must be OUT OF BOUNDS (size), never -1 —
+        # scatter wraps negative indices like numpy, so -1 would silently
+        # corrupt the LAST cache row with padding K/V
+        base = jnp.where(bid >= 0, base, size).reshape(-1)
+        valid = (slot_idx >= 0).reshape(b * nb, bs, 1)
+        rows_k = k_new.astype(cache.dtype).reshape(b * nb, bs, hkd)
+        rows_v = v_new.astype(cache.dtype).reshape(b * nb, bs, hkd)
+        # read-modify-write: padding rows inside a partial block preserve
+        # the existing cache bytes instead of clobbering them with K/V of
+        # padding tokens
+        cur_k = flat[jnp.minimum(base, size - 1)]
+        cur_v = flat[jnp.minimum(base + 1, size - 1)]
+        flat = flat.at[base].set(jnp.where(valid, rows_k, cur_k), mode="drop")
+        flat = flat.at[jnp.where(base < size, base + 1, size)].set(
+            jnp.where(valid, rows_v, cur_v), mode="drop"
+        )
+        return flat.reshape(cache.shape)
+    size = l * n * 2 * bs
+    flat = cache.reshape(size, hkd)
     idx = slot_idx.reshape(-1)
     valid = idx >= 0
     # row for (layer, block=idx//bs, kv, offset=idx%bs) in the flat view
     base = layer * (n * 2 * bs) + (idx // bs) * (2 * bs) + idx % bs
-    k_idx = jnp.where(valid, base, -1)
-    v_idx = jnp.where(valid, base + bs, -1)
+    # OOB sentinel, NOT -1: scatter wraps negative indices (see above)
+    k_idx = jnp.where(valid, base, size)
+    v_idx = jnp.where(valid, base + bs, size)
     rows_k = k_new.astype(cache.dtype).reshape(-1, hkd)
     rows_v = v_new.astype(cache.dtype).reshape(-1, hkd)
     flat = flat.at[k_idx].set(rows_k, mode="drop")
@@ -176,10 +236,12 @@ def write_kv_cache(
     slot_idx: jax.Array, # [B, S] int32    flat slot = block_id * Bs + offset; -1 = drop (padding)
 ) -> tuple[jax.Array, jax.Array]:
     """Scatter new K/V rows into the paged cache.  Negative slots (padding
-    tokens) are dropped via scatter mode='drop'."""
+    tokens) are remapped to an out-of-bounds sentinel and dropped —
+    scatter WRAPS negative indices like numpy, so -1 itself would write
+    the pool's last slot."""
     n, bs, hk, d = k_cache.shape
     flat_idx = slot_idx.reshape(-1)
-    # mode='drop' ignores out-of-range (negative) indices
+    flat_idx = jnp.where(flat_idx >= 0, flat_idx, n * bs)
     k_flat = k_cache.reshape(n * bs, hk, d).at[flat_idx].set(
         k_new.astype(k_cache.dtype).reshape(-1, hk, d), mode="drop"
     )

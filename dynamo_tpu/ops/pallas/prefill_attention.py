@@ -1,0 +1,240 @@
+"""Flash prefill over the paged cache — the TTFT hot kernel.
+
+The pure-JAX prefill path materialises the full [Hk, G, S, S+P] f32 score
+tensor per layer (537MB at S=2048 on a 1B model) and round-trips it
+through HBM for the softmax.  This kernel runs the classic flash pattern
+instead: the query rows stream in TQ-sized chunks, keys/values arrive as
+(a) the chunk's own fresh K/V resident in VMEM and (b) the cached-prefix
+blocks double-buffer-DMA'd straight from the paged cache in HBM (same
+machinery as the decode kernel), with online-softmax accumulation — scores
+never touch HBM.
+
+Semantics match ops.paged_attention.prefill_attention:
+  * queries are S contiguous tokens starting at block-aligned ``start[b]``,
+  * fresh-fresh attention is causal by chunk index,
+  * fresh-prefix attention is full over slots [0, start),
+  * query padding rows (index >= seq_len - start) yield 0.
+
+Grid: (B, S/TQ).  GQA is handled per kv-head: q rows fold the G query
+heads into the row axis ([TQ, G*D] -> [TQ*G, D]), so scores and PV are
+plain MXU matmuls.  SURVEY.md §7 hard part 3; VERDICT r2 ask #4.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["paged_prefill_attention"]
+
+NEG_INF = -1e30
+
+
+def _kernel(
+    # scalar prefetch (SMEM)
+    seq_ref,     # [B] int32 — context length incl. fresh tokens
+    start_ref,   # [B] int32 — absolute position of q[:, 0]
+    bt_ref,      # [B, M] int32
+    layer_ref,   # [1] int32
+    # inputs
+    q_ref,       # [1, TQ, Hk, G*D] VMEM — this grid step's query rows
+    k_ref,       # [1, S, Hk*D] VMEM — whole fresh K (chunk-resident)
+    v_ref,       # [1, S, Hk*D] VMEM
+    cache_ref,   # [L, N, 2, Bs, Hk*D] HBM (manual DMA)
+    # outputs
+    out_ref,     # [1, TQ, Hk, G*D] VMEM
+    # scratch
+    acc_ref,     # [Hk, TQ*G, D] f32
+    m_ref,       # [Hk, TQ*G, 128] f32
+    l_ref,       # [Hk, TQ*G, 128] f32
+    kvbuf,       # [2, C, 2, Bs, Hk*D] cache-dtype (double buffer)
+    sems,        # [2, C] DMA semaphores
+    *,
+    c: int,
+    tq: int,
+    hk: int,
+    g: int,
+    d: int,
+    sm_scale: float,
+):
+    bi = pl.program_id(0)
+    ri = pl.program_id(1)
+    bs = kvbuf.shape[3]
+    t = c * bs
+    lyr = layer_ref[0]
+    prefix = start_ref[bi]                  # cached-prefix token count
+    fresh = seq_ref[bi] - prefix            # valid fresh tokens
+    n_pref = pl.cdiv(prefix, t)             # data-dependent chunk bound
+
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (tq * g, 1), 0) // g  # query row
+
+    def flash_update(h, s_scores, v_cols):
+        """Online-softmax fold of one [TQ*G, TKV] score tile (masked)."""
+        m_prev = m_ref[h, :, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s_scores, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s_scores - m_new)
+        l_ref[h] = l_ref[h] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[h] = jnp.broadcast_to(m_new, m_ref.shape[1:])
+        pv = jnp.dot(p, v_cols, preferred_element_type=jnp.float32)
+        acc_ref[h] = acc_ref[h] * alpha + pv
+
+    def q_head(h):
+        # [TQ, G*D] -> [TQ*G, D], pre-scaled f32
+        return q_ref[0, :, h, :].reshape(tq * g, d).astype(jnp.float32) * sm_scale
+
+    # ---------------------------------------------------- prefix phase (DMA)
+    def block_dmas(ci, slot):
+        m_table = bt_ref.shape[1]
+        out = []
+        for i in range(c):  # static unroll: C block copies per chunk
+            bid = bt_ref[bi, jnp.minimum(ci * c + i, m_table - 1)]
+            out.append(pltpu.make_async_copy(
+                cache_ref.at[lyr, bid], kvbuf.at[slot, i], sems.at[slot, i]
+            ))
+        return out
+
+    @pl.when(n_pref > 0)
+    def _prologue():
+        for dma in block_dmas(0, 0):
+            dma.start()
+
+    def pref_body(ci, _):
+        slot = jax.lax.rem(ci, 2)
+
+        @pl.when(ci + 1 < n_pref)
+        def _prefetch():
+            for dma in block_dmas(ci + 1, jax.lax.rem(ci + 1, 2)):
+                dma.start()
+
+        for dma in block_dmas(ci, slot):
+            dma.wait()
+
+        kc = kvbuf[slot, :, 0].reshape(t, hk * d).astype(jnp.float32)
+        vc = kvbuf[slot, :, 1].reshape(t, hk * d).astype(jnp.float32)
+        col = ci * t + jax.lax.broadcasted_iota(jnp.int32, (1, t), 1)
+        allow = col < prefix                              # [1, T]
+        for h in range(hk):  # static unroll over kv heads
+            s_ = jax.lax.dot_general(
+                q_head(h), kc[:, h * d:(h + 1) * d],
+                (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            )  # [TQ*G, T]
+            s_ = jnp.where(allow, s_, NEG_INF)
+            flash_update(h, s_, vc[:, h * d:(h + 1) * d])
+        return 0
+
+    jax.lax.fori_loop(0, n_pref, pref_body, 0)
+
+    # ------------------------------------------------- fresh phase (causal)
+    def fresh_body(cj, _):
+        col0 = cj * tq
+        kc = k_ref[0, pl.ds(col0, tq)].astype(jnp.float32)   # [TQ, Hk*D]
+        vc = v_ref[0, pl.ds(col0, tq)].astype(jnp.float32)
+        col = col0 + jax.lax.broadcasted_iota(jnp.int32, (1, tq), 1)
+        # causal by fresh index + clip padding columns
+        allow = (col <= ri * tq + rows) & (col < fresh)      # [TQ*G, TQ]
+        for h in range(hk):
+            s_ = jax.lax.dot_general(
+                q_head(h), kc[:, h * d:(h + 1) * d],
+                (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            )
+            s_ = jnp.where(allow, s_, NEG_INF)
+            flash_update(h, s_, vc[:, h * d:(h + 1) * d])
+        return 0
+
+    jax.lax.fori_loop(0, ri + 1, fresh_body, 0)
+
+    for h in range(hk):
+        denom = jnp.maximum(l_ref[h, :, :1], 1e-9)  # padding rows → 0
+        out_ref[0, :, h, :] = (
+            (acc_ref[h] / denom).reshape(tq, g * d).astype(out_ref.dtype)
+        )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("sm_scale", "rows_per_chunk", "blocks_per_chunk",
+                     "interpret"),
+)
+def paged_prefill_attention(
+    q: jax.Array,             # [B, S, H, D]
+    k_new: jax.Array,         # [B, S, Hk, D] — fresh keys (pre-RoPE'd)
+    v_new: jax.Array,         # [B, S, Hk, D]
+    cache: jax.Array,         # [L, N, 2, Bs, Hk*D]
+    layer: jax.Array,         # scalar int32
+    block_tables: jax.Array,  # [B, M] int32 (prefix blocks lead the table)
+    seq_lens: jax.Array,      # [B] int32
+    start: jax.Array,         # [B] int32 — block-aligned chunk start
+    sm_scale: float | None = None,
+    # 128 rows/chunk keeps scratch (acc + m/l at 128-lane padding) + the
+    # VMEM-resident fresh K/V comfortably under the ~16MB VMEM budget at
+    # S=2048, Hk*D=512
+    rows_per_chunk: int = 128,
+    blocks_per_chunk: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash prefill for S fresh tokens against fresh K/V + cached prefix.
+    Returns [B, S, H, D]."""
+    b, s, h, d = q.shape
+    l, n, _, bs, hkd = cache.shape
+    hk = hkd // d
+    g = h // hk
+    m = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / (d**0.5)
+    tq = min(rows_per_chunk, s)
+    while s % tq:
+        tq //= 2
+    c = min(blocks_per_chunk, m)
+
+    q_in = q.reshape(b, s, hk, g * d)
+    k_in = k_new.reshape(b, s, hkd)
+    v_in = v_new.reshape(b, s, hkd)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b, s // tq),
+        in_specs=[
+            pl.BlockSpec((1, tq, hk, g * d), lambda bi, ri, *_: (bi, ri, 0, 0)),
+            pl.BlockSpec((1, s, hkd), lambda bi, ri, *_: (bi, 0, 0)),
+            pl.BlockSpec((1, s, hkd), lambda bi, ri, *_: (bi, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # cache stays in HBM
+        ],
+        out_specs=pl.BlockSpec(
+            (1, tq, hk, g * d), lambda bi, ri, *_: (bi, ri, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((hk, tq * g, d), jnp.float32),
+            pltpu.VMEM((hk, tq * g, 128), jnp.float32),
+            pltpu.VMEM((hk, tq * g, 128), jnp.float32),
+            pltpu.VMEM((2, c, 2, bs, hkd), cache.dtype),
+            pltpu.SemaphoreType.DMA((2, c)),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, c=c, tq=tq, hk=hk, g=g, d=d, sm_scale=float(sm_scale)
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, s, hk, g * d), q.dtype),
+        interpret=interpret,
+    )(
+        seq_lens.astype(jnp.int32),
+        start.astype(jnp.int32),
+        block_tables.astype(jnp.int32),
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        q_in,
+        k_in,
+        v_in,
+        cache,
+    )
+    return out.reshape(b, s, h, d)
