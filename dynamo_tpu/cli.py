@@ -337,6 +337,39 @@ async def _cmd_coordinator(args) -> None:
     await asyncio.Event().wait()
 
 
+# ------------------------------------------------------------------ router ----
+
+
+async def start_router_service(runtime, namespace: str = "default",
+                               block_size: int = 16):
+    """Wire a live KvRouter behind `dyn://{ns}.router.generate` (shared by
+    the CLI command and tests).  Returns the router."""
+    from dynamo_tpu.llm.kv_router.metrics_aggregator import KvRouterSubscriber
+    from dynamo_tpu.llm.kv_router.router import KvRouter
+
+    router = KvRouter(block_size=block_size)
+    await KvRouterSubscriber(router, runtime.coordinator, namespace).start()
+    # KvRouter IS the endpoint engine: its generate() yields one
+    # wire-serializable decision dict per request
+    ep = runtime.namespace(namespace).component("router").endpoint("generate")
+    await ep.serve(router)
+    return router
+
+
+async def _cmd_router(args) -> None:
+    """Standalone KV-aware router service: serves routing decisions over
+    `dyn://{ns}.router.generate` and keeps its prefix index + cost model
+    live off the coordinator's KV-event/metrics subjects (ref
+    components/router/src/main.rs)."""
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    runtime = await DistributedRuntime.connect(_runtime_config(args))
+    ns = args.namespace or "default"
+    await start_router_service(runtime, ns, args.block_size)
+    log.info("router service up: dyn://%s.router.generate", ns)
+    await asyncio.Event().wait()
+
+
 # ---------------------------------------------------------------- operator ----
 
 
@@ -508,6 +541,12 @@ def _parser() -> argparse.ArgumentParser:
     deploy.add_argument("spec", help="DynamoTpuDeployment YAML")
     deploy.add_argument("-o", "--out", default=None, help="write one file per object")
 
+    router = sub.add_parser(
+        "router", help="standalone KV-aware router service"
+    )
+    router.add_argument("--block-size", type=int, default=16)
+    common(router)
+
     operator = sub.add_parser(
         "operator", help="watch a specs dir and reconcile deployments"
     )
@@ -560,6 +599,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         asyncio.run(_cmd_coordinator(args))
     elif args.cmd == "deploy":
         asyncio.run(_cmd_deploy(args))
+    elif args.cmd == "router":
+        asyncio.run(_cmd_router(args))
     elif args.cmd == "operator":
         asyncio.run(_cmd_operator(args))
     elif args.cmd == "api-store":

@@ -209,6 +209,20 @@ class EngineCore:
             self._multi_impl, donate_argnums=(1,),
             static_argnames=("num_steps", "k_cand", "exact", "use_penalties"),
         )
+        # sequence-parallel long-prefill (ring attention over the "data"
+        # axis): one dispatch computes the whole prompt with the sequence
+        # sharded across the mesh — SURVEY §5 long-context path
+        self._sp_size = 0
+        if (
+            mesh is not None
+            and config.sp_prefill_threshold > 0
+            and "data" in mesh.axis_names
+            and mesh.shape["data"] > 1
+        ):
+            self._sp_size = mesh.shape["data"]
+            self._sp_fn = jax.jit(
+                self._sp_impl, static_argnames=("nb", "k_cand", "exact")
+            )
 
         self.slots: list[Optional[EngineRequest]] = [None] * config.max_batch_size
         self.waiting: "queue.SimpleQueue[EngineRequest]" = queue.SimpleQueue()
@@ -230,6 +244,7 @@ class EngineCore:
         self.decode_steps = 0
         self.tokens_generated = 0
         self.prompt_tokens_computed = 0  # actual prefill work (dedupe-aware)
+        self.sp_prefills = 0             # seq-parallel long-prefill dispatches
         self._last_was_prefill = False
 
     # ----------------------------------------------------------- step kernel
@@ -238,6 +253,29 @@ class EngineCore:
         return unified_step(self.model, params, cache, *args,
                             prefix_blocks=prefix_blocks, k_cand=k_cand,
                             exact=exact)
+
+    def _sp_impl(self, params, tokens, positions, last_idx, rng, temp,
+                 top_k, top_p, *, nb, k_cand=K_MAX, exact=False):
+        """Sequence-parallel prefill: ring attention over mesh["data"],
+        then sample the first token and lay the fresh KV out as cache
+        blocks [L, nb, 2, Bs, HkD] (sharded like the pool, so the
+        follow-up scatter is a resident-layout write)."""
+        from jax.sharding import NamedSharding
+
+        hidden, kv = self.model.forward_seq_parallel(
+            params, tokens, positions, self.mesh, sp_axis="data"
+        )
+        last_h = hidden[jnp.arange(1), last_idx]
+        logits = self.model.compute_logits(params, last_h)
+        out = sample_full(logits, rng, temp, top_k, top_p,
+                          k_cand=k_cand, exact=exact)
+        l, _, b, s, hkd = kv.shape
+        bs = self.config.block_size
+        blocks = kv[:, :, 0].reshape(l, 2, nb, bs, hkd).transpose(0, 2, 1, 3, 4)
+        blocks = jax.lax.with_sharding_constraint(
+            blocks, NamedSharding(self.mesh, self.model.cache_spec())
+        )
+        return out, blocks
 
     def _multi_impl(self, params, cache, *args, num_steps=1, k_cand=K_MAX,
                     exact=False, use_penalties=False):
@@ -395,11 +433,11 @@ class EngineCore:
                 self._run_decode()
             else:
                 self._last_was_prefill = True
-                self._run_prefill(prefill)
+                self._dispatch_prefill(prefill)
             return True
         if prefill is not None:
             self._last_was_prefill = True
-            self._run_prefill(prefill)
+            self._dispatch_prefill(prefill)
             return True
         if decoding:
             self._last_was_prefill = False
@@ -490,6 +528,12 @@ class EngineCore:
                     log.exception("on_allocated callback failed for %s", req.request_id)
                     req.abort_requested = True
 
+    def _dispatch_prefill(self, req: EngineRequest) -> None:
+        if self._sp_eligible(req):
+            self._run_sp_prefill(req)
+        else:
+            self._run_prefill(req)
+
     # ---------------------------------------------------------------- prefill
     def _reserve_own(self, req: EngineRequest) -> None:
         """Register this request as the computer of its not-yet-covered
@@ -577,6 +621,11 @@ class EngineCore:
             )
         if not final:
             return  # more chunks to go; sample discarded (no logits needed)
+        self._complete_prefill(req, sampled, lps, cids, clps)
+
+    def _complete_prefill(self, req, sampled, lps, cids, clps) -> None:
+        """Shared tail of chunked and sequence-parallel prefill: state
+        transition, remote-decode holdout, first-token emission."""
         # a COMPLETED prefill must not count against the next arrival: reset
         # the interleave so a fresh prompt's first chunk runs immediately
         # instead of behind a decode burst.  Only when no OTHER prefill is
@@ -608,6 +657,66 @@ class EngineCore:
             return
         self._append_token(req, int(sampled[0]), first=True,
                            logprob=float(lps[0]), cand=(cids[0], clps[0]))
+
+    # ------------------------------------------------ seq-parallel prefill
+    def _sp_eligible(self, req: EngineRequest) -> bool:
+        return (
+            self._sp_size > 0
+            and req.computed_tokens == 0
+            and req.prompt_len >= self.config.sp_prefill_threshold
+        )
+
+    def _run_sp_prefill(self, req: EngineRequest) -> None:
+        """Whole-prompt prefill in ONE dispatch with the sequence sharded
+        over mesh["data"] (ring attention — ops/ring_attention.py): the
+        long-context path where even a single prompt's activations/KV
+        exceed one chip's comfort.  KV comes back already block-shaped and
+        pool-sharded; a donated scatter drops it into the paged cache."""
+        cfg = self.config
+        bs = cfg.block_size
+        unit = bs * self._sp_size
+        # pow2 bucketing in units of (block_size × sp) keeps the executable
+        # count O(log) while satisfying both divisibility constraints
+        units = -(-req.prompt_len // unit)
+        units = 1 << (units - 1).bit_length()
+        s_pad = units * unit
+        nb_pad = s_pad // bs
+
+        tokens = np.zeros((1, s_pad), np.int32)
+        tokens[0, : req.prompt_len] = req.prompt
+        # padding keys get positions beyond every real query → causally
+        # invisible; padding queries produce discarded (finite) rows
+        positions = np.arange(s_pad, dtype=np.int32)[None, :]
+        last_idx = np.asarray([req.prompt_len - 1], np.int32)
+        self._rng, rng = jax.random.split(self._rng)
+        k_cand, exact = self._sampling_mode([req])
+        (sampled, lps, cids, clps), blocks = self._sp_fn(
+            self.params, jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(last_idx), rng,
+            jnp.asarray([req.sampling.temperature], np.float32),
+            jnp.asarray([req.sampling.top_k], np.int32),
+            jnp.asarray([req.sampling.top_p], np.float32),
+            nb=nb_pad, k_cand=k_cand, exact=exact,
+        )
+        sampled, lps, cids, clps = (
+            np.asarray(sampled), np.asarray(lps), np.asarray(cids),
+            np.asarray(clps),
+        )
+        nb = -(-req.prompt_len // bs)
+        self.cache = scatter_blocks_inplace(
+            self.cache, req.block_ids[:nb], blocks[:, :nb]
+        )
+        self.steps += 1
+        self.prefill_steps += 1
+        self.sp_prefills += 1
+        self.prompt_tokens_computed += req.prompt_len
+        req.computed_tokens = req.prompt_len
+        for blk in req.seq.blocks[: req.prompt_len // bs]:
+            self.block_manager.commit(
+                req.block_ids[blk.position], blk.sequence_hash,
+                blk.parent_sequence_hash, list(blk.tokens)
+            )
+        self._complete_prefill(req, sampled, lps, cids, clps)
 
     # ----------------------------------------------------------------- decode
     def _run_decode(self) -> None:

