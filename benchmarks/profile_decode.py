@@ -1,0 +1,158 @@
+"""Decode-step breakdown profiler (VERDICT r2 ask #9).
+
+Times the components of one decode step in isolation — weight streaming
+(the bf16/int8 matmul chain with attention stubbed), the paged-attention
+kernel, logits+sampling, and the full multi-step burst — so the gap
+between measured ITL and the HBM roofline is attributable, not guessed.
+
+Run on the real chip:  python benchmarks/profile_decode.py [1b|8b]
+Env: DYNAMO_PROF_BATCH (64), DYNAMO_PROF_CTX (512), DYNAMO_PROF_QUANT
+(int8|none), DYNAMO_PROF_STEPS (burst length, 64).
+
+Prints a JSON line per component: {"part", "ms", "hbm_gb", "gbps"}.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODELS = {
+    "tiny": dict(vocab_size=2048, hidden_size=256, intermediate_size=512,
+                 num_layers=4, num_heads=8, num_kv_heads=4,
+                 max_position_embeddings=2048, rope_theta=500000.0),
+    "1b": dict(vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+               num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
+               max_position_embeddings=8192, rope_theta=500000.0,
+               tie_word_embeddings=True),
+    "8b": dict(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+               num_layers=32, num_heads=32, num_kv_heads=8,
+               max_position_embeddings=8192, rope_theta=500000.0),
+}
+
+
+def timeit(fn, *args, iters=20, warmup=3):
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.core import multi_decode_step
+    from dynamo_tpu.engine.sampling import sample_full
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.models.llama import LlamaModel
+    from dynamo_tpu.ops.pallas.decode_attention import paged_decode_attention
+
+    name = sys.argv[1] if len(sys.argv) > 1 else "8b"
+    on_accel = jax.default_backend() != "cpu"
+    batch = int(os.environ.get("DYNAMO_PROF_BATCH", "64" if on_accel else "8"))
+    ctx = int(os.environ.get("DYNAMO_PROF_CTX", "512" if on_accel else "64"))
+    quant = os.environ.get("DYNAMO_PROF_QUANT", "int8" if on_accel else "none")
+    k_steps = int(os.environ.get("DYNAMO_PROF_STEPS", "64" if on_accel else "4"))
+    bs = 32 if on_accel else 16
+    if not on_accel:
+        name = "tiny"
+
+    cfg = ModelConfig(**MODELS[name],
+                      dtype="bfloat16" if on_accel else "float32")
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), quantized=quant == "int8")
+    num_blocks = batch * (ctx // bs) + 8
+    cache = model.init_kv_cache(num_blocks, bs)
+    jax.block_until_ready(params)
+
+    wbytes = 1 if quant == "int8" else 2
+    h, inter, v_, nl = (cfg.hidden_size, cfg.intermediate_size,
+                        cfg.vocab_size, cfg.num_layers)
+    hd = cfg.head_dim
+    param_gb = (nl * (h * cfg.num_heads * hd + 2 * h * cfg.num_kv_heads * hd
+                      + cfg.num_heads * hd * h + 3 * h * inter)
+                + v_ * h * (1 if cfg.tie_word_embeddings else 2)) * wbytes / 1e9
+    kv_gb = (batch * ctx * 2 * cfg.num_kv_heads * hd * nl * 2) / 1e9
+
+    tokens = jnp.ones((batch,), jnp.int32)
+    positions = jnp.full((batch,), ctx - 1, jnp.int32)
+    m = ctx // bs
+    bt = (jnp.arange(batch)[:, None] * m + jnp.arange(m)[None, :]).astype(jnp.int32) % num_blocks
+    seq_lens = jnp.full((batch,), ctx, jnp.int32)
+    limits = jnp.full((batch,), ctx + k_steps + 1, jnp.int32)
+    rng = jax.random.PRNGKey(1)
+    temp = jnp.zeros((batch,), jnp.float32)
+    topk = jnp.zeros((batch,), jnp.int32)
+    topp = jnp.ones((batch,), jnp.float32)
+
+    def emit(part, ms, gb):
+        print(json.dumps({
+            "part": part, "ms": round(ms, 3), "hbm_gb": round(gb, 3),
+            "gbps": round(gb / (ms / 1e3), 1) if ms else None,
+        }))
+
+    # 1. full multi-step burst (what the engine dispatches).  No donation
+    # here: the profiler reuses the same cache buffer across timed calls
+    # (the engine's real dispatch donates; in-place vs copy costs show up
+    # in single_step_dispatch below anyway)
+    burst = jax.jit(functools.partial(
+        multi_decode_step, model, num_steps=k_steps, block_size=bs,
+    ))
+    ms = timeit(
+        lambda: burst(params, cache, tokens, positions, bt, seq_lens,
+                      limits, rng, temp, topk, topp)[0],
+        iters=5, warmup=2,
+    )
+    emit("burst_total_per_step", ms / k_steps,
+         param_gb + kv_gb / 2)  # avg context grows over the burst
+
+    # 2. weights-only: forward with attention output zeroed via 0-len ctx
+    zero_lens = jnp.zeros((batch,), jnp.int32)
+    fwd = jax.jit(lambda p, c, t: model.forward(
+        p, t[:, None], jnp.zeros((batch, 1), jnp.int32), c, bt, zero_lens,
+        jnp.full((batch, 1), -1, jnp.int32))[0])
+    ms = timeit(lambda: fwd(params, cache, tokens))
+    emit("forward_no_attention", ms, param_gb - v_ * h * wbytes / 1e9)
+
+    # 3. paged attention kernel alone (per layer x layers)
+    q = jnp.ones((batch, cfg.num_heads, hd), cfg.jax_dtype)
+    att = jax.jit(lambda qq, cc: paged_decode_attention(
+        qq, cc, jnp.int32(0), bt, seq_lens, interpret=not on_accel))
+    ms_layer = timeit(lambda: att(q, cache))
+    emit("attention_all_layers", ms_layer * nl, kv_gb)
+
+    # 4. logits + sampling
+    hidden = jnp.ones((batch, h), cfg.jax_dtype)
+    lg = jax.jit(lambda p, hh: sample_full(
+        model.compute_logits(p, hh), rng, temp, topk, topp))
+    ms = timeit(lambda: lg(params, hidden))
+    emit("logits_sampling", ms, v_ * h * wbytes / 1e9)
+
+    # 5. dispatch overhead: same burst at K=1 vs K
+    one = jax.jit(functools.partial(
+        multi_decode_step, model, num_steps=1, block_size=bs,
+    ))
+    ms1 = timeit(
+        lambda: one(params, cache, tokens, positions, bt, seq_lens, limits,
+                    rng, temp, topk, topp)[0],
+        iters=10, warmup=2,
+    )
+    emit("single_step_dispatch", ms1, param_gb + kv_gb)
+
+
+if __name__ == "__main__":
+    main()
