@@ -229,6 +229,8 @@ class EngineCore:
         self._admitted: list[EngineRequest] = []  # waiting for a slot/blocks
         self._by_id: dict[str, EngineRequest] = {}
         self._abort_q: "queue.SimpleQueue[str]" = queue.SimpleQueue()
+        # aborts that arrived before their request was even admitted
+        self._pending_aborts: set[str] = set()
         self._lock = threading.Lock()
         # ops enqueued by other threads, run on the engine thread at the next
         # step boundary (KV scatter/gather, remote-prefill completion, ...)
@@ -467,6 +469,18 @@ class EngineCore:
             req = self._by_id.get(rid)
             if req is not None:
                 req.abort_requested = True
+                continue
+            admitted = next(
+                (r for r in self._admitted if r.request_id == rid), None
+            )
+            if admitted is not None:
+                admitted.abort_requested = True
+                continue
+            # not seen yet: the request may still be in the cross-thread
+            # waiting queue — remember the abort so admission applies it
+            # (without this, cancelling a QUEUED request was silently lost
+            # and it ran to completion)
+            self._pending_aborts.add(rid)
 
     def _admit(self) -> None:
         # drain the cross-thread queue
@@ -475,6 +489,9 @@ class EngineCore:
                 req = self.waiting.get_nowait()
             except queue.Empty:
                 break
+            if req.request_id in self._pending_aborts:
+                self._pending_aborts.discard(req.request_id)
+                req.abort_requested = True
             self._admitted.append(req)
         for req in list(self._admitted):
             if req.abort_requested:
