@@ -35,6 +35,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.grammar import (
+    INIT_STATE, JsonGrammar, device_tables, grammar_advance, grammar_mask,
+)
 from dynamo_tpu.engine.request import EngineRequest, RequestState
 from dynamo_tpu.engine.sampling import K_MAX, sample_full
 from dynamo_tpu.ops.block_copy import gather_blocks_padded, scatter_blocks_inplace
@@ -51,7 +54,8 @@ __all__ = ["EngineCore", "unified_step", "multi_decode_step"]
 def unified_step(
     model, params, cache, tokens, positions, block_tables, seq_lens,
     slot_idx, last_idx, rng, temp, top_k, top_p, prefix_blocks=None,
-    k_cand=K_MAX, exact=False,
+    k_cand=K_MAX, exact=False, grammar=None, jrows=None, jstate=None,
+    jdepth=None, jstack=None,
 ):
     """THE jitted serving step: forward over the paged cache, gather each
     row's last hidden state, project to logits, sample.  Shared by the
@@ -66,6 +70,9 @@ def unified_step(
     b = tokens.shape[0]
     last_h = hidden[jnp.arange(b), last_idx]  # [B, Dm]
     logits = model.compute_logits(params, last_h)  # [B, V] f32
+    if grammar is not None:
+        # JSON mode: mask invalid-next-token logits (engine/grammar.py)
+        logits = grammar_mask(logits, grammar, jrows, jstate, jdepth, jstack)
     out = sample_full(logits, rng, temp, top_k, top_p, k_cand=k_cand, exact=exact)
     return out, cache
 
@@ -74,7 +81,8 @@ def multi_decode_step(
     model, params, cache, last_tokens, positions, block_tables, seq_lens,
     limits, rng, temp, top_k, top_p,
     pen_tokens=None, pen_first=None, pen_cursor=None, freq_pen=None,
-    pres_pen=None, *, num_steps: int, block_size: int,
+    pres_pen=None, grammar=None, jrows=None, jstate=None, jdepth=None,
+    jstack=None, *, num_steps: int, block_size: int,
     k_cand: int = K_MAX, exact: bool = False, use_penalties: bool = False,
 ):
     """K decode iterations fully on device in one dispatch (multi-step
@@ -98,10 +106,16 @@ def multi_decode_step(
     cand_lps [K,B,C]), cache).
     """
     m = block_tables.shape[1]
+    use_grammar = grammar is not None
 
     def one(carry, rng_k):
-        if use_penalties:
+        gs = gd = gk = None
+        if use_penalties and use_grammar:
+            cache, toks, pos, lens, ptoks, pfirst, cur, gs, gd, gk = carry
+        elif use_penalties:
             cache, toks, pos, lens, ptoks, pfirst, cur = carry
+        elif use_grammar:
+            cache, toks, pos, lens, gs, gd, gk = carry
         else:
             cache, toks, pos, lens = carry
         blk = jnp.minimum(pos // block_size, m - 1)
@@ -113,6 +127,8 @@ def multi_decode_step(
             slot[:, None],
         )
         logits = model.compute_logits(params, hidden[:, 0])
+        if use_grammar:
+            logits = grammar_mask(logits, grammar, jrows, gs, gd, gk)
         sampled, lp, cids, clps = sample_full(
             logits, rng_k, temp, top_k, top_p,
             ptoks if use_penalties else None,
@@ -125,6 +141,8 @@ def multi_decode_step(
         # and an unclamped length would walk the block table out of bounds
         new_lens = jnp.minimum(lens + 1, limits)
         ys = (sampled, lp, cids, clps)
+        if use_grammar:
+            gs, gd, gk = grammar_advance(grammar, jrows, gs, gd, gk, sampled)
         if use_penalties:
             b = sampled.shape[0]
             rows = jnp.arange(b, dtype=jnp.int32)
@@ -134,12 +152,18 @@ def multi_decode_step(
             ptoks = ptoks.at[rows, at].set(sampled)
             pfirst = pfirst.at[rows, at].set(~seen)
             cur = jnp.minimum(cur + 1, t_cap - 1)
-            return (cache, sampled, pos + 1, new_lens, ptoks, pfirst, cur), ys
-        return (cache, sampled, pos + 1, new_lens), ys
+        nxt = (cache, sampled, pos + 1, new_lens)
+        if use_penalties:
+            nxt = nxt + (ptoks, pfirst, cur)
+        if use_grammar:
+            nxt = nxt + (gs, gd, gk)
+        return nxt, ys
 
     init = (cache, last_tokens, positions, seq_lens)
     if use_penalties:
         init = init + (pen_tokens, pen_first, pen_cursor)
+    if use_grammar:
+        init = init + (jstate, jdepth, jstack)
     carry, out = jax.lax.scan(one, init, jax.random.split(rng, num_steps))
     return out, carry[0]
 
@@ -152,11 +176,18 @@ class EngineCore:
         config: EngineConfig,
         mesh: Optional[jax.sharding.Mesh] = None,
         eos_token_ids: Optional[list[int]] = None,
+        grammar: Optional[JsonGrammar] = None,
     ):
         self.model = model
         self.config = config
         self.mesh = mesh
         self.eos_token_ids = set(eos_token_ids or [])
+        # JSON-mode grammar: compiled tables (host) + lazy device upload.
+        # attach_grammar_tokenizer defers the ~1s vocab compile to the
+        # first json_mode request instead of every engine start.
+        self._grammar = grammar
+        self._grammar_tok = None
+        self._gdev = None
         self.block_manager = KvBlockManager(
             config.num_blocks,
             config.block_size,
@@ -251,10 +282,12 @@ class EngineCore:
 
     # ----------------------------------------------------------- step kernel
     def _step_impl(self, params, cache, *args, prefix_blocks=None,
-                   k_cand=K_MAX, exact=False):
+                   k_cand=K_MAX, exact=False, grammar=None, jrows=None,
+                   jstate=None, jdepth=None, jstack=None):
         return unified_step(self.model, params, cache, *args,
                             prefix_blocks=prefix_blocks, k_cand=k_cand,
-                            exact=exact)
+                            exact=exact, grammar=grammar, jrows=jrows,
+                            jstate=jstate, jdepth=jdepth, jstack=jstack)
 
     def _sp_impl(self, params, tokens, positions, last_idx, rng, temp,
                  top_k, top_p, *, nb, k_cand=K_MAX, exact=False):
@@ -280,12 +313,56 @@ class EngineCore:
         return out, blocks
 
     def _multi_impl(self, params, cache, *args, num_steps=1, k_cand=K_MAX,
-                    exact=False, use_penalties=False):
+                    exact=False, use_penalties=False, grammar=None,
+                    jrows=None, jstate=None, jdepth=None, jstack=None):
         return multi_decode_step(
             self.model, params, cache, *args,
+            grammar=grammar, jrows=jrows, jstate=jstate, jdepth=jdepth,
+            jstack=jstack,
             num_steps=num_steps,
             block_size=self.config.block_size,
             k_cand=k_cand, exact=exact, use_penalties=use_penalties,
+        )
+
+    # ------------------------------------------------------- JSON grammar
+    def attach_grammar_tokenizer(self, tokenizer, eos_ids=None) -> None:
+        """Provide the tokenizer JSON-mode tables are compiled from; the
+        compile itself runs lazily on the first json_mode request."""
+        if self._grammar is None:
+            self._grammar_tok = (tokenizer, tuple(eos_ids or self.eos_token_ids))
+
+    def _ensure_grammar(self) -> Optional[JsonGrammar]:
+        if self._grammar is None and self._grammar_tok is not None:
+            tok, eos = self._grammar_tok
+            self._grammar_tok = None
+            self._grammar = JsonGrammar.from_tokenizer(tok, eos_ids=eos)
+            log.info("compiled JSON grammar tables (%d states x %d tokens)",
+                     self._grammar.tables.n_states,
+                     self._grammar.tables.vocab_size)
+        return self._grammar
+
+    def _grammar_usable(self) -> bool:
+        g = self._ensure_grammar()
+        return g is not None and any(
+            0 <= e < self.model.config.vocab_size for e in g.tables.eos_ids
+        )
+
+    def _grammar_device(self):
+        if self._gdev is None:
+            self._gdev = device_tables(
+                self._grammar.tables, self.model.config.vocab_size
+            )
+        return self._gdev
+
+    def _gram_kwargs(self, gram) -> dict:
+        """Device kwargs for one dispatch's grammar state, or {}."""
+        if gram is None:
+            return {}
+        jrows, jstate, jdepth, jstack = gram
+        return dict(
+            grammar=self._grammar_device(),
+            jrows=jnp.asarray(jrows), jstate=jnp.asarray(jstate),
+            jdepth=jnp.asarray(jdepth), jstack=jnp.asarray(jstack),
         )
 
     def _sampling_mode(self, reqs) -> tuple[int, bool]:
@@ -304,9 +381,10 @@ class EngineCore:
 
     def _run_step(self, tokens, positions, block_tables, seq_lens, slot_idx,
                   last_idx, temp, top_k, top_p, prefix_blocks=None,
-                  k_cand=K_MAX, exact=False):
+                  k_cand=K_MAX, exact=False, gram=None):
         """Returns (sampled [B], logprob [B], cand_ids [B,C], cand_lps [B,C])."""
         self._rng, rng = jax.random.split(self._rng)
+        gkw = self._gram_kwargs(gram)
         out, self.cache = self._step_fn(
             self.params, self.cache,
             jnp.asarray(tokens), jnp.asarray(positions),
@@ -314,13 +392,13 @@ class EngineCore:
             jnp.asarray(slot_idx), jnp.asarray(last_idx),
             rng,
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
-            prefix_blocks=prefix_blocks, k_cand=k_cand, exact=exact,
+            prefix_blocks=prefix_blocks, k_cand=k_cand, exact=exact, **gkw,
         )
         self.steps += 1
         return tuple(np.asarray(a) for a in out)
 
     def _run_multi_decode_step(self, tokens, positions, block_tables, seq_lens,
-                               limits, temp, top_k, top_p, pen=None,
+                               limits, temp, top_k, top_p, pen=None, gram=None,
                                num_steps=1, k_cand=K_MAX, exact=False):
         """Dispatch one multi-step decode; returns (sampled [K,B],
         logprob [K,B], cand_ids [K,B,C], cand_lps [K,B,C])."""
@@ -334,10 +412,11 @@ class EngineCore:
         use_pen = pen is not None
         if use_pen:
             args += [jnp.asarray(a) for a in pen]
+        gkw = self._gram_kwargs(gram)
         out, self.cache = self._multi_fn(
             self.params, self.cache, *args,
             num_steps=num_steps, k_cand=k_cand, exact=exact,
-            use_penalties=use_pen,
+            use_penalties=use_pen, **gkw,
         )
         self.steps += 1
         return tuple(np.asarray(a) for a in out)
@@ -493,6 +572,12 @@ class EngineCore:
                 self._pending_aborts.discard(req.request_id)
                 req.abort_requested = True
             self._admitted.append(req)
+        # pending aborts unmatched after a full queue drain can never match:
+        # a caller that submitted before aborting had its request visible in
+        # this drain (_process_aborts runs before _admit each step), so the
+        # leftovers are finished/unknown ids — drop them or the set grows
+        # forever on abort-vs-finish races
+        self._pending_aborts.clear()
         for req in list(self._admitted):
             if req.abort_requested:
                 self._admitted.remove(req)
@@ -508,6 +593,14 @@ class EngineCore:
             if req.prompt_len >= self.config.max_model_len:
                 self._admitted.remove(req)
                 self._finish(req, FinishReason.LENGTH)
+                continue
+            if req.sampling.json_mode and not self._grammar_usable():
+                # response_format=json_object needs tokenizer-compiled
+                # tables AND a model-vocab EOS id (the terminal state is
+                # eos-only; without one the mask would go all -inf after
+                # the closing brace and sampling degrades to uniform noise)
+                self._admitted.remove(req)
+                self._finish(req, FinishReason.ERROR)
                 continue
             req.seq = TokenBlockSequence(req.prompt, self.config.block_size)
             try:
@@ -619,12 +712,19 @@ class EngineCore:
         pb = min(pb, m)
 
         k_cand, exact = self._sampling_mode([req])
+        gram = None
+        # only the final chunk's sample is kept — masking earlier chunks
+        # would just burn an extra executable per prefill bucket
+        if final and req.sampling.json_mode and self._ensure_grammar() is not None:
+            gs, gd, gk = req.gstate
+            gram = (np.asarray([True]), np.asarray([gs], np.int32),
+                    np.asarray([gd], np.int32), np.asarray([gk], np.int32))
         sampled, lps, cids, clps = self._run_step(
             tokens, positions, bt, seq_lens, slot_idx, last_idx,
             np.asarray([req.sampling.temperature], np.float32),
             np.asarray([req.sampling.top_k], np.int32),
             np.asarray([req.sampling.top_p], np.float32),
-            prefix_blocks=pb, k_cand=k_cand, exact=exact,
+            prefix_blocks=pb, k_cand=k_cand, exact=exact, gram=gram,
         )
         self.prefill_steps += 1
         self.prompt_tokens_computed += take
@@ -681,6 +781,8 @@ class EngineCore:
             self._sp_size > 0
             and req.computed_tokens == 0
             and req.prompt_len >= self.config.sp_prefill_threshold
+            # the SP first-token sample path has no grammar mask hook
+            and not req.sampling.json_mode
         )
 
     def _run_sp_prefill(self, req: EngineRequest) -> None:
@@ -816,9 +918,21 @@ class EngineCore:
         self._drain_offload()
         k_cand, exact = self._sampling_mode(active)
         pen = self._penalty_buffers(active, k_steps)
+        gram = None
+        if any(r.sampling.json_mode for r in active) \
+                and self._ensure_grammar() is not None:
+            jrows = np.zeros(b, bool)
+            jstate = np.full(b, INIT_STATE, np.int32)
+            jdepth = np.zeros(b, np.int32)
+            jstack = np.zeros(b, np.int32)
+            for r in active:
+                if r.sampling.json_mode:
+                    jrows[r.slot] = True
+                    jstate[r.slot], jdepth[r.slot], jstack[r.slot] = r.gstate
+            gram = (jrows, jstate, jdepth, jstack)
         sampled, lps, cids, clps = self._run_multi_decode_step(
             tokens, positions, bt, seq_lens, limits, temp, top_k, top_p,
-            pen=pen, num_steps=k_steps, k_cand=k_cand, exact=exact,
+            pen=pen, gram=gram, num_steps=k_steps, k_cand=k_cand, exact=exact,
         )  # [K, B], [K, B], [K, B, C], [K, B, C]
         self.decode_steps += sampled.shape[0]
         for req in active:
@@ -902,6 +1016,10 @@ class EngineCore:
         req.seq.append(token)
         req.generated += 1
         self.tokens_generated += 1
+        if req.sampling.json_mode and self._grammar is not None:
+            # host mirror of the in-scan grammar advance (deterministic:
+            # same tables, same sampled token)
+            req.gstate = self._grammar.tables.advance(*req.gstate, token)
 
         finish: Optional[FinishReason] = None
         st = req.stops

@@ -1,0 +1,599 @@
+"""Grammar-constrained decoding: JSON mode that runs INSIDE the decode scan.
+
+OpenAI ``response_format={"type": "json_object"}`` guarantees the model
+emits syntactically valid JSON.  The reference delegates this to its
+engines' guided-decoding (vLLM/outlines run a host-side FSM between
+steps); that design needs a host round-trip per token, which would defeat
+this engine's multi-step decode scan (K tokens per device dispatch).
+
+TPU-native design — the automaton itself is device-computable:
+
+* A byte-level DFA for the JSON lexical grammar whose states carry the
+  *current container context* (top-level / object / array), plus a
+  bounded pushdown for bracket matching: depth counter + an int32
+  bit-stack (1 bit per nesting level: OBJ or ARR, max depth 24).
+* Per tokenizer, every (state, token) transition is precomputed by
+  composing the token's bytes symbolically (pops/pushes normalise to
+  "pop a prefix, then push a suffix").  The result is four dense
+  ``[S, V]`` int8 tables — next state, pop count/bits, push count/bits —
+  ~50MB HBM for a 128k vocab, uploaded once on first use.
+* At each decode step the valid-token mask for a row is pure vectorised
+  arithmetic: a table-row gather + bit compares against the row's
+  (state, depth, stack) — no host interaction, so JSON mode rides the
+  ``lax.scan`` decode burst at full speed.  After sampling, the row's
+  automaton state advances via scalar gathers in the same scan.
+* Tokens whose byte behaviour would depend on stack content *below* the
+  levels they pop (e.g. ``},`` — the comma's meaning depends on the
+  container we pop into) are conservatively masked; every JSON
+  construct remains expressible through shorter tokens (all single-byte
+  JSON punctuation exists in any BPE vocab).
+
+Reference parity: response_format in lib/llm/src/protocols/openai
+(chat_completions request surface); enforcement is engine-side here
+because this repo owns the engine.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "JsonGrammar", "VocabTables", "token_bytes_map", "MAX_DEPTH",
+    "INIT_STATE", "DEAD",
+]
+
+MAX_DEPTH = 24          # nesting levels the int32 bit-stack holds
+MAX_TOKEN_OPS = 7       # per-token pop/push bound (3 bits each in tables)
+
+# --------------------------------------------------------------------------
+# state space
+#
+# Contexts: T (top level), O (inside object), A (inside array).  U is the
+# transient "popped into unknown container" context — it only appears
+# mid-token or as a sentinel end-state that the runtime resolves against
+# the real stack.
+DEAD = 0
+
+_CONTEXTS = ("T", "O", "A")
+_NAMES: list[str] = ["DEAD"]
+
+
+def _st(name: str) -> int:
+    _NAMES.append(name)
+    return len(_NAMES) - 1
+
+
+# value-position states, per context
+EXPECT_VALUE = {c: _st(f"EXPECT_VALUE_{c}") for c in _CONTEXTS}
+AFTER_VALUE = {c: _st(f"AFTER_VALUE_{c}") for c in _CONTEXTS}
+AFTER_VALUE_U = _st("AFTER_VALUE_U")  # sentinel: context resolved at runtime
+# strings (value position), per context
+IN_STR = {c: _st(f"IN_STR_{c}") for c in _CONTEXTS}
+STR_ESC = {c: _st(f"STR_ESC_{c}") for c in _CONTEXTS}
+STR_U = {c: [_st(f"STR_U{i}_{c}") for i in range(1, 5)] for c in _CONTEXTS}
+# numbers, per context
+NUM_MINUS = {c: _st(f"NUM_MINUS_{c}") for c in _CONTEXTS}
+NUM_ZERO = {c: _st(f"NUM_ZERO_{c}") for c in _CONTEXTS}
+NUM_INT = {c: _st(f"NUM_INT_{c}") for c in _CONTEXTS}
+NUM_DOT = {c: _st(f"NUM_DOT_{c}") for c in _CONTEXTS}
+NUM_FRAC = {c: _st(f"NUM_FRAC_{c}") for c in _CONTEXTS}
+NUM_E = {c: _st(f"NUM_E_{c}") for c in _CONTEXTS}
+NUM_ESIGN = {c: _st(f"NUM_ESIGN_{c}") for c in _CONTEXTS}
+NUM_EXP = {c: _st(f"NUM_EXP_{c}") for c in _CONTEXTS}
+# literals true/false/null: one state per remaining-suffix position
+_LITS = {"true": "rue", "false": "alse", "null": "ull"}
+LIT = {
+    c: {w: [_st(f"LIT_{w}{i}_{c}") for i in range(len(suf))]
+        for w, suf in _LITS.items()}
+    for c in _CONTEXTS
+}
+# object structure (context is implicitly O)
+OBJ_OPEN = _st("OBJ_OPEN")          # after '{': key or '}'
+OBJ_EXPECT_KEY = _st("OBJ_EXPECT_KEY")  # after ',': key only
+IN_KEY = _st("IN_KEY")
+KEY_ESC = _st("KEY_ESC")
+KEY_U = [_st(f"KEY_U{i}") for i in range(1, 5)]
+AFTER_KEY = _st("AFTER_KEY")        # expect ':'
+# array structure (context is implicitly A)
+ARR_OPEN = _st("ARR_OPEN")          # after '[': value or ']'
+
+N_STATES = len(_NAMES)
+INIT_STATE = EXPECT_VALUE["T"]
+
+# stack symbols (1 bit per level)
+SYM_OBJ, SYM_ARR = 1, 0
+
+# byte-transition ops
+OP_NONE, OP_PUSH_OBJ, OP_PUSH_ARR, OP_POP = 0, 1, 2, 3
+
+_WS = b" \t\n\r"
+_DIGITS = b"0123456789"
+_HEX = b"0123456789abcdefABCDEF"
+
+
+def _build_delta() -> tuple[np.ndarray, np.ndarray]:
+    """(delta_state [S,256] int16, delta_op [S,256] int8); DEAD = invalid."""
+    ds = np.zeros((N_STATES, 256), np.int16)  # DEAD
+    op = np.zeros((N_STATES, 256), np.int8)
+
+    def t(s: int, byte: int, ns: int, o: int = OP_NONE) -> None:
+        ds[s, byte], op[s, byte] = ns, o
+
+    def ws_loop(s: int) -> None:
+        for b in _WS:
+            t(s, b, s)
+
+    def value_start(s: int, c: str) -> None:
+        """Transitions for a value-start position whose *new* values live
+        in context c (i.e. pushes land the state in the opened container,
+        scalars land in c's string/number states)."""
+        t(s, ord("{"), OBJ_OPEN, OP_PUSH_OBJ)
+        t(s, ord("["), ARR_OPEN, OP_PUSH_ARR)
+        t(s, ord('"'), IN_STR[c])
+        t(s, ord("-"), NUM_MINUS[c])
+        t(s, ord("0"), NUM_ZERO[c])
+        for b in _DIGITS[1:]:
+            t(s, b, NUM_INT[c])
+        for w, suf in _LITS.items():
+            t(s, ord(w[0]), LIT[c][w][0])
+
+    def value_end(s: int, c: str) -> None:
+        """Transitions available where a value has just ended in context
+        c: ',' continues the container, '}'/']' pop it."""
+        if c == "O":
+            t(s, ord(","), OBJ_EXPECT_KEY)
+            t(s, ord("}"), AFTER_VALUE_U, OP_POP)
+        elif c == "A":
+            t(s, ord(","), EXPECT_VALUE["A"])
+            t(s, ord("]"), AFTER_VALUE_U, OP_POP)
+        # c == "T": nothing to continue; EOS only (runtime eos_ok)
+
+    for c in _CONTEXTS:
+        ev, av = EXPECT_VALUE[c], AFTER_VALUE[c]
+        ws_loop(ev)
+        value_start(ev, c)
+        ws_loop(av)
+        value_end(av, c)
+        # strings: any byte >= 0x20 except '"' and '\' stays (UTF-8
+        # continuation bytes included; JSON forbids raw control chars)
+        for s_in, s_esc, s_u, done in (
+            (IN_STR[c], STR_ESC[c], STR_U[c], av),
+        ):
+            for b in range(0x20, 256):
+                t(s_in, b, s_in)
+            t(s_in, ord("\\"), s_esc)
+            t(s_in, ord('"'), done)
+            for b in b'"\\/bfnrt':
+                t(s_esc, b, s_in)
+            t(s_esc, ord("u"), s_u[0])
+            for i in range(4):
+                nxt = s_in if i == 3 else s_u[i + 1]
+                for b in _HEX:
+                    t(s_u[i], b, nxt)
+        # numbers
+        for b in _DIGITS[1:]:
+            t(NUM_MINUS[c], b, NUM_INT[c])
+        t(NUM_MINUS[c], ord("0"), NUM_ZERO[c])
+        for s_num in (NUM_ZERO[c], NUM_INT[c], NUM_FRAC[c], NUM_EXP[c]):
+            # implicit number end: whitespace or container punctuation
+            for b in _WS:
+                t(s_num, b, av)
+            value_end(s_num, c)
+        for b in _DIGITS:
+            t(NUM_INT[c], b, NUM_INT[c])
+            t(NUM_DOT[c], b, NUM_FRAC[c])
+            t(NUM_FRAC[c], b, NUM_FRAC[c])
+            t(NUM_ESIGN[c], b, NUM_EXP[c])
+            t(NUM_E[c], b, NUM_EXP[c])
+            t(NUM_EXP[c], b, NUM_EXP[c])
+        for s_num in (NUM_ZERO[c], NUM_INT[c]):
+            t(s_num, ord("."), NUM_DOT[c])
+        for s_num in (NUM_ZERO[c], NUM_INT[c], NUM_FRAC[c]):
+            t(s_num, ord("e"), NUM_E[c])
+            t(s_num, ord("E"), NUM_E[c])
+        for b in b"+-":
+            t(NUM_E[c], b, NUM_ESIGN[c])
+        # literals
+        for w, suf in _LITS.items():
+            chain = LIT[c][w]
+            for i, ch in enumerate(suf):
+                nxt = av if i == len(suf) - 1 else chain[i + 1]
+                t(chain[i], ord(ch), nxt)
+
+    # object keys
+    ws_loop(OBJ_OPEN)
+    t(OBJ_OPEN, ord('"'), IN_KEY)
+    t(OBJ_OPEN, ord("}"), AFTER_VALUE_U, OP_POP)
+    ws_loop(OBJ_EXPECT_KEY)
+    t(OBJ_EXPECT_KEY, ord('"'), IN_KEY)
+    for b in range(0x20, 256):
+        t(IN_KEY, b, IN_KEY)
+    t(IN_KEY, ord("\\"), KEY_ESC)
+    t(IN_KEY, ord('"'), AFTER_KEY)
+    for b in b'"\\/bfnrt':
+        t(KEY_ESC, b, IN_KEY)
+    t(KEY_ESC, ord("u"), KEY_U[0])
+    for i in range(4):
+        nxt = IN_KEY if i == 3 else KEY_U[i + 1]
+        for b in _HEX:
+            t(KEY_U[i], b, nxt)
+    ws_loop(AFTER_KEY)
+    t(AFTER_KEY, ord(":"), EXPECT_VALUE["O"])
+
+    # arrays
+    ws_loop(ARR_OPEN)
+    value_start(ARR_OPEN, "A")
+    t(ARR_OPEN, ord("]"), AFTER_VALUE_U, OP_POP)
+
+    # sentinel context: only whitespace and further pops are
+    # context-independent; anything else mid-token is conservatively dead
+    ws_loop(AFTER_VALUE_U)
+    t(AFTER_VALUE_U, ord("}"), AFTER_VALUE_U, OP_POP)
+    t(AFTER_VALUE_U, ord("]"), AFTER_VALUE_U, OP_POP)
+
+    return ds, op
+
+
+_DELTA_STATE, _DELTA_OP = _build_delta()
+
+# states where a complete top-level JSON value has been produced: EOS is
+# the only allowed continuation (no whitespace padding after completion)
+_EOS_OK = np.zeros(N_STATES, bool)
+_EOS_OK[AFTER_VALUE["T"]] = True
+for _s in (NUM_ZERO["T"], NUM_INT["T"], NUM_FRAC["T"], NUM_EXP["T"]):
+    _EOS_OK[_s] = True
+# completed-value states: once reached at top level, every byte mask goes
+# dead (enforced at runtime via eos-only override rather than in delta,
+# because mid-token trailing whitespace like '0\n' must still compose)
+_TERMINAL_ONLY = np.zeros(N_STATES, bool)
+_TERMINAL_ONLY[AFTER_VALUE["T"]] = True
+
+
+@dataclass
+class VocabTables:
+    """Per-tokenizer compiled transition tables (host numpy; the engine
+    uploads them to device on first use)."""
+
+    next_state: np.ndarray   # [S, V] int8; DEAD = token invalid from state
+    npops: np.ndarray        # [S, V] int8
+    popbits: np.ndarray      # [S, V] int8  (bit npops-1-i = i-th pop, top first)
+    npush: np.ndarray        # [S, V] int8
+    pushbits: np.ndarray     # [S, V] int8  (bit j = j-th push, bottom first)
+    eos_ok: np.ndarray       # [S] bool
+    terminal_only: np.ndarray  # [S] bool
+    eos_ids: tuple[int, ...]
+
+    @property
+    def n_states(self) -> int:
+        return self.next_state.shape[0]
+
+    @property
+    def vocab_size(self) -> int:
+        return self.next_state.shape[1]
+
+    # ------------------------------------------------------------- host side
+    def valid_mask(self, state: int, depth: int, stack: int) -> np.ndarray:
+        """[V] bool valid-token mask for one row (host mirror of the
+        device computation; used by tests and the host fallback)."""
+        ns = self.next_state[state]
+        np_ = self.npops[state].astype(np.int32)
+        nq = self.npush[state].astype(np.int32)
+        pb = self.popbits[state].astype(np.int32)
+        ok = ns != DEAD
+        ok &= np_ <= depth
+        rem = np.maximum(depth - np_, 0)
+        ok &= ((stack >> rem) & ((1 << np_) - 1)) == pb
+        ok &= rem + nq <= MAX_DEPTH
+        if self.terminal_only[state]:
+            ok &= False
+        for e in self.eos_ids:
+            ok[e] = bool(self.eos_ok[state])
+        return ok
+
+    def advance(self, state: int, depth: int, stack: int, token: int
+                ) -> tuple[int, int, int]:
+        """Apply one sampled token to (state, depth, stack) — host mirror
+        of the in-scan update."""
+        if token in self.eos_ids:
+            return state, depth, stack
+        ns = int(self.next_state[state, token])
+        np_ = int(self.npops[state, token])
+        nq = int(self.npush[state, token])
+        qb = int(self.pushbits[state, token])
+        d1 = max(depth - np_, 0)
+        stack = (stack & ((1 << d1) - 1)) | (qb << d1)
+        depth = d1 + nq
+        if ns == AFTER_VALUE_U:
+            if depth == 0:
+                ns = AFTER_VALUE["T"]
+            elif (stack >> (depth - 1)) & 1 == SYM_OBJ:
+                ns = AFTER_VALUE["O"]
+            else:
+                ns = AFTER_VALUE["A"]
+        return ns, depth, stack
+
+
+def compile_vocab(
+    token_bytes: Sequence[Optional[bytes]],
+    eos_ids: Sequence[int] = (),
+) -> VocabTables:
+    """Compose every token's bytes from every start state (vectorised over
+    the [S, V] grid, one pass per byte position).  ~1s for a 128k vocab."""
+    v = len(token_bytes)
+    max_len = max((len(t) for t in token_bytes if t), default=1)
+    # pad byte matrix with sentinel 256 = "past end of token"
+    bmat = np.full((v, max_len), 256, np.int16)
+    for i, tb in enumerate(token_bytes):
+        if tb:
+            bmat[i, : len(tb)] = np.frombuffer(tb, np.uint8)
+
+    state = np.broadcast_to(
+        np.arange(N_STATES, dtype=np.int16)[:, None], (N_STATES, v)
+    ).copy()
+    alive = np.ones((N_STATES, v), bool)
+    # specials / empty tokens are never valid in constrained mode
+    for i, tb in enumerate(token_bytes):
+        if not tb:
+            alive[:, i] = False
+    npops = np.zeros((N_STATES, v), np.int8)
+    popbits = np.zeros((N_STATES, v), np.int8)
+    npush = np.zeros((N_STATES, v), np.int8)
+    pushbits = np.zeros((N_STATES, v), np.int8)
+
+    for l in range(max_len):
+        byte = bmat[:, l]                     # [V] int16
+        has = byte != 256
+        act = alive & has[None, :]
+        if not act.any():
+            break
+        b_idx = np.where(has, byte, 0).astype(np.int64)
+        ns = _DELTA_STATE[state, b_idx[None, :]]   # [S, V]
+        op = _DELTA_OP[state, b_idx[None, :]]
+        alive &= ~(act & (ns == DEAD))
+        act = alive & has[None, :]
+
+        # pushes
+        for o, sym in ((OP_PUSH_OBJ, SYM_OBJ), (OP_PUSH_ARR, SYM_ARR)):
+            m = act & (op == o)
+            over = m & (npush >= MAX_TOKEN_OPS)
+            alive &= ~over
+            m &= ~over
+            pushbits[m] |= (sym << npush[m]).astype(np.int8)
+            npush[m] += 1
+        # pops
+        m = act & (op == OP_POP)
+        if m.any():
+            sym = np.where(byte == ord("}"), SYM_OBJ, SYM_ARR)  # [V]
+            symg = np.broadcast_to(sym[None, :], m.shape)
+            # pop an in-token push when one exists
+            mi = m & (npush > 0)
+            top = (pushbits[mi] >> (npush[mi] - 1)) & 1
+            bad = top != symg[mi]
+            # mismatched close of an in-token container -> dead
+            if bad.any():
+                idx = np.where(mi)
+                alive[idx[0][bad], idx[1][bad]] = False
+                mi_ok = mi.copy()
+                mi_ok[idx[0][bad], idx[1][bad]] = False
+                mi = mi_ok
+            npush[mi] -= 1
+            pushbits[mi] &= ~(1 << npush[mi]).astype(np.int8)
+            # context after the pop: remaining in-token push, or unknown
+            rem_push = np.zeros_like(npush)
+            rem_push[mi] = npush[mi]
+            has_rem = mi & (rem_push > 0)
+            if has_rem.any():
+                topsym = (pushbits[has_rem] >> (npush[has_rem] - 1)) & 1
+                ns[has_rem] = np.where(
+                    topsym == SYM_OBJ, AFTER_VALUE["O"], AFTER_VALUE["A"]
+                )
+            # pop from the outer (runtime) stack
+            mo = m & alive & ~mi & (op == OP_POP)
+            over = mo & (npops >= MAX_TOKEN_OPS)
+            alive &= ~over
+            mo &= ~over
+            popbits[mo] = ((popbits[mo].astype(np.int16) << 1)
+                           | symg[mo]).astype(np.int8)
+            npops[mo] += 1
+        state = np.where(alive & has[None, :], ns, state)
+
+    next_state = np.where(alive, state, DEAD).astype(np.int8)
+    # a token ending exactly at DEAD id 0 can't be conflated: state ids
+    # start at 1, DEAD==0 only means invalid
+    return VocabTables(
+        next_state=next_state,
+        npops=np.where(alive, npops, 0).astype(np.int8),
+        popbits=np.where(alive, popbits, 0).astype(np.int8),
+        npush=np.where(alive, npush, 0).astype(np.int8),
+        pushbits=np.where(alive, pushbits, 0).astype(np.int8),
+        eos_ok=_EOS_OK.copy(),
+        terminal_only=_TERMINAL_ONLY.copy(),
+        eos_ids=tuple(int(e) for e in eos_ids),
+    )
+
+
+# --------------------------------------------------------------------------
+# tokenizer byte mapping
+
+# GPT-2 byte-level BPE printable-unicode <-> byte table (the tokenizers
+# crate's ByteLevel pretokenizer; Llama-3 and GPT vocabs use it)
+def _gpt2_unicode_to_bytes() -> dict[str, int]:
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(0xA1, 0xAD)) + list(range(0xAE, 0x100)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(bs, cs)}
+
+
+def token_bytes_map(tokenizer) -> list[Optional[bytes]]:
+    """token id -> raw bytes (None for special/unmappable tokens).
+
+    Handles the two HF conventions: GPT-2 byte-level BPE (Ġ/Ċ unicode
+    remap) and sentencepiece (▁ space marker + <0xNN> byte tokens).
+    Accepts a ``TokenizerWrapper`` or a raw ``tokenizers.Tokenizer``.
+    """
+    tk = getattr(tokenizer, "_tk", tokenizer)
+    vocab: dict[str, int] = tk.get_vocab()
+    size = max(vocab.values()) + 1 if vocab else 0
+    out: list[Optional[bytes]] = [None] * size
+    byte_level = any(t.startswith(("Ġ", "Ċ")) for t in vocab)
+    u2b = _gpt2_unicode_to_bytes() if byte_level else None
+    special = set()
+    try:
+        special = {t.content for t in tk.get_added_tokens_decoder().values()
+                   if getattr(t, "special", False)}
+    except Exception:
+        pass
+    for tok, i in vocab.items():
+        if i >= size or tok in special:
+            continue
+        if tok.startswith("<") and tok.endswith(">") and len(tok) > 2:
+            if tok.startswith("<0x") and len(tok) == 6:
+                try:
+                    out[i] = bytes([int(tok[3:5], 16)])
+                except ValueError:
+                    pass
+            continue  # other <...> tokens treated as special
+        if byte_level:
+            try:
+                out[i] = bytes(u2b[ch] for ch in tok)
+            except KeyError:
+                out[i] = tok.encode("utf-8")
+        else:
+            out[i] = tok.replace("▁", " ").encode("utf-8")
+    return out
+
+
+# --------------------------------------------------------------------------
+# device side (jax) — used inside the jitted decode scan
+
+from typing import NamedTuple
+
+
+class GrammarTables(NamedTuple):
+    """Device-resident transition tables (a pytree, so it rides jit args)."""
+
+    next_state: object  # [S, V] int8
+    npops: object       # [S, V] int8
+    popbits: object     # [S, V] int8
+    npush: object       # [S, V] int8
+    pushbits: object    # [S, V] int8
+    eos_ok: object      # [S] bool
+    terminal_only: object  # [S] bool
+    eos_cols: object    # [V] bool
+
+
+def device_tables(tables: VocabTables, vocab_size: Optional[int] = None
+                  ) -> GrammarTables:
+    """Upload compiled tables, padding/truncating the vocab axis to the
+    model's logit width (tokenizer vocab can differ from model vocab)."""
+    import jax.numpy as jnp
+
+    v = vocab_size or tables.vocab_size
+
+    def fit(a: np.ndarray) -> np.ndarray:
+        if a.shape[1] == v:
+            return a
+        out = np.zeros((a.shape[0], v), a.dtype)
+        out[:, : min(v, a.shape[1])] = a[:, :v]
+        return out
+
+    eos_cols = np.zeros(v, bool)
+    for e in tables.eos_ids:
+        if 0 <= e < v:
+            eos_cols[e] = True
+    return GrammarTables(
+        next_state=jnp.asarray(fit(tables.next_state)),
+        npops=jnp.asarray(fit(tables.npops)),
+        popbits=jnp.asarray(fit(tables.popbits)),
+        npush=jnp.asarray(fit(tables.npush)),
+        pushbits=jnp.asarray(fit(tables.pushbits)),
+        eos_ok=jnp.asarray(tables.eos_ok),
+        terminal_only=jnp.asarray(tables.terminal_only),
+        eos_cols=jnp.asarray(eos_cols),
+    )
+
+
+def grammar_mask(logits, gt: GrammarTables, jrows, state, depth, stack):
+    """Mask invalid-next-token logits for grammar-constrained rows.
+
+    logits [B, V] f32; jrows [B] bool (row uses the grammar); state/depth/
+    stack [B] int32.  Pure vectorised gathers + bit math — runs inside the
+    decode ``lax.scan`` with no host involvement.
+    """
+    import jax.numpy as jnp
+
+    ns = gt.next_state[state]                      # [B, V] int8
+    np_ = gt.npops[state].astype(jnp.int32)
+    nq = gt.npush[state].astype(jnp.int32)
+    pb = gt.popbits[state].astype(jnp.int32)
+    d = depth[:, None]
+    st = stack[:, None]
+    rem = jnp.maximum(d - np_, 0)
+    ok = (ns != DEAD) & (np_ <= d)
+    ok &= ((st >> rem) & ((1 << np_) - 1)) == pb
+    ok &= rem + nq <= MAX_DEPTH
+    ok &= ~gt.terminal_only[state][:, None]
+    ok = jnp.where(gt.eos_cols[None, :], gt.eos_ok[state][:, None], ok)
+    return jnp.where(jrows[:, None] & ~ok, -1e30, logits)
+
+
+def grammar_advance(gt: GrammarTables, jrows, state, depth, stack, sampled):
+    """Advance each constrained row's (state, depth, stack) by its sampled
+    token (scalar gathers; mirrors VocabTables.advance)."""
+    import jax.numpy as jnp
+
+    ns = gt.next_state[state, sampled].astype(jnp.int32)
+    np_ = gt.npops[state, sampled].astype(jnp.int32)
+    nq = gt.npush[state, sampled].astype(jnp.int32)
+    qb = gt.pushbits[state, sampled].astype(jnp.int32)
+    d1 = jnp.clip(depth - np_, 0, MAX_DEPTH)
+    stack1 = (stack & ((1 << d1) - 1)) | (qb << d1)
+    depth1 = jnp.clip(d1 + nq, 0, MAX_DEPTH + MAX_TOKEN_OPS)
+    exposed = (stack1 >> jnp.maximum(depth1 - 1, 0)) & 1
+    resolved = jnp.where(
+        depth1 == 0,
+        AFTER_VALUE["T"],
+        jnp.where(exposed == SYM_OBJ, AFTER_VALUE["O"], AFTER_VALUE["A"]),
+    )
+    ns = jnp.where(ns == AFTER_VALUE_U, resolved, ns)
+    upd = jrows & ~gt.eos_cols[sampled]
+    return (
+        jnp.where(upd, ns, state),
+        jnp.where(upd, depth1, depth),
+        jnp.where(upd, stack1, stack),
+    )
+
+
+class JsonGrammar:
+    """Facade: compile once per tokenizer, share across requests."""
+
+    def __init__(self, tables: VocabTables):
+        self.tables = tables
+
+    @classmethod
+    def from_tokenizer(cls, tokenizer, eos_ids: Sequence[int] = ()) -> "JsonGrammar":
+        return cls(compile_vocab(token_bytes_map(tokenizer), eos_ids))
+
+    @classmethod
+    def from_token_bytes(
+        cls, token_bytes: Sequence[Optional[bytes]], eos_ids: Sequence[int] = ()
+    ) -> "JsonGrammar":
+        return cls(compile_vocab(token_bytes, eos_ids))
+
+    @staticmethod
+    def validate(text: str) -> bool:
+        try:
+            json.loads(text)
+            return True
+        except Exception:
+            return False

@@ -6,6 +6,7 @@ import enum
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from dynamo_tpu.engine.grammar import INIT_STATE
 from dynamo_tpu.llm.protocols import (
     FinishReason,
     LLMEngineOutput,
@@ -58,6 +59,9 @@ class EngineRequest:
     # are dropped on finish so joiners can take over
     reserved_pairs: list = field(default_factory=list)
     generated: int = 0
+    # JSON-mode grammar automaton state: (dfa_state, depth, bit-stack) —
+    # advanced host-side per appended token, mirrored on device in-scan
+    gstate: tuple = (INIT_STATE, 0, 0)
     slot: int = -1
     finish_reason: Optional[FinishReason] = None
     abort_requested: bool = False

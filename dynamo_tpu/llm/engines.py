@@ -93,4 +93,9 @@ def build_serving_pipeline(
     """
     pre = OpenAIPreprocessor(card, tokenizer)
     back = Backend(pre.tokenizer)
+    # JSON mode (response_format): the core compiles grammar tables from
+    # this tokenizer lazily on the first json_mode request
+    core = getattr(engine, "core", None)
+    if core is not None and hasattr(core, "attach_grammar_tokenizer"):
+        core.attach_grammar_tokenizer(pre.tokenizer, card.eos_token_ids)
     return build_pipeline(engine, pre, back)

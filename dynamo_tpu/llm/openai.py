@@ -49,6 +49,10 @@ class ParsedRequest:
     # tool calling (chat mode): validated OpenAI tool schemas + choice
     tools: Optional[list[dict]] = None
     tool_choice: Any = None  # "none"|"auto"|"required"|{function ref}|None
+    # response_format: None | "json_object" | "json_schema"; schema kept
+    # for prompt injection (enforcement is the generic JSON grammar)
+    response_format: Optional[str] = None
+    json_schema: Optional[dict] = None
     raw: dict = field(default_factory=dict)
 
     @property
@@ -126,6 +130,25 @@ def parse_request(body: dict, chat: bool) -> ParsedRequest:
         top_lp = int(lp) if isinstance(lp, int) and not isinstance(lp, bool) else 0
         _require(0 <= top_lp <= 20, "'logprobs' must be in [0, 20]")
 
+    # response_format (chat mode): json_object / json_schema switch the
+    # engine to grammar-constrained decoding (engine/grammar.py)
+    rf = body.get("response_format")
+    if rf is not None:
+        _require(isinstance(rf, dict) and "type" in rf,
+                 "'response_format' must be an object with a 'type'")
+        rft = rf["type"]
+        _require(rft in ("text", "json_object", "json_schema"),
+                 "'response_format.type' must be 'text', 'json_object' or "
+                 "'json_schema'")
+        if rft == "json_schema":
+            js = rf.get("json_schema")
+            _require(isinstance(js, dict) and isinstance(js.get("schema"), dict),
+                     "'response_format.json_schema.schema' is required")
+            req.response_format = rft
+            req.json_schema = js
+        elif rft == "json_object":
+            req.response_format = rft
+
     req.sampling = SamplingOptions(
         temperature=1.0 if temperature is None else float(temperature),
         top_p=1.0 if top_p is None else float(top_p),
@@ -135,6 +158,7 @@ def parse_request(body: dict, chat: bool) -> ParsedRequest:
         presence_penalty=pres_pen,
         logprobs=want_lp,
         top_logprobs=top_lp,
+        json_mode=req.response_format is not None,
     )
 
     max_tokens = body.get("max_completion_tokens", body.get("max_tokens"))

@@ -104,6 +104,21 @@ class OpenAIPreprocessor(Operator):
                     }
                 ] + list(messages)
                 tools = None
+            if parsed.response_format == "json_schema" and parsed.json_schema:
+                # the grammar guarantees *syntactic* JSON; steer the model
+                # toward the schema's shape via an injected instruction
+                # (same split as vLLM json_object vs outlines schema modes)
+                import json as _json
+
+                schema = parsed.json_schema.get("schema", {})
+                messages = [
+                    {
+                        "role": "system",
+                        "content": "Respond ONLY with a JSON value matching "
+                        "this JSON Schema:\n"
+                        + _json.dumps(schema, indent=2),
+                    }
+                ] + list(messages)
             prompt = self.formatter.render(messages, tools=tools)
             token_ids = self.tokenizer.encode(prompt)
         elif parsed.prompt_token_ids is not None:

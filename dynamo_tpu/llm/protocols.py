@@ -45,6 +45,9 @@ class SamplingOptions:
     # logprob reporting: chosen-token logprob and top-N alternatives
     logprobs: bool = False
     top_logprobs: int = 0
+    # response_format JSON mode: grammar-constrained decoding (the engine
+    # masks invalid-next-token logits inside the decode scan; engine/grammar.py)
+    json_mode: bool = False
 
     @property
     def greedy(self) -> bool:

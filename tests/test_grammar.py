@@ -1,0 +1,296 @@
+"""JSON grammar-constrained decoding: automaton, vocab composer, device parity.
+
+The property under test: ANY token sequence that stays inside the mask and
+ends at EOS decodes to valid JSON (json.loads succeeds) — over random
+rollouts with a vocab that mixes single-byte and multi-byte tokens.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.grammar import (
+    AFTER_VALUE, DEAD, INIT_STATE, JsonGrammar, MAX_DEPTH, VocabTables,
+    compile_vocab, device_tables, grammar_advance, grammar_mask,
+    token_bytes_map,
+)
+
+EOS = 0
+
+
+def make_vocab():
+    """Token 0 = EOS (special); 1..256 = single bytes; then multi-byte."""
+    toks: list = [None]
+    for b in range(256):
+        toks.append(bytes([b]))
+    multi = [b'{"', b'":', b'", "', b'"}', b'true', b'false', b'null',
+             b'123', b'3.14', b'-1e9', b'[1,', b'{}', b'[]', b'  ',
+             b'\\"', b'\\u00ff', b'}}', b']]', b'"a"', b'0.5]',
+             b'},', b'],', b',"', b'{"a":', b'[[', b'{{']
+    toks.extend(multi)
+    return toks
+
+
+@pytest.fixture(scope="module")
+def tables() -> VocabTables:
+    return compile_vocab(make_vocab(), eos_ids=[EOS])
+
+
+def tok_id(toks, b: bytes) -> int:
+    return toks.index(b)
+
+
+def decode_ids(toks, ids) -> bytes:
+    return b"".join(toks[i] for i in ids if i != EOS and toks[i])
+
+
+def test_rollouts_always_valid_json(tables):
+    toks = make_vocab()
+    rng = np.random.default_rng(0)
+    n_done = 0
+    for trial in range(200):
+        s, d, st = INIT_STATE, 0, 0
+        ids = []
+        for _ in range(120):
+            mask = tables.valid_mask(s, d, st)
+            valid = np.flatnonzero(mask)
+            assert valid.size > 0, f"dead end at state {s} depth {d}"
+            t = int(rng.choice(valid))
+            ids.append(t)
+            if t == EOS:
+                break
+            s, d, st = tables.advance(s, d, st, t)
+        if ids and ids[-1] == EOS:
+            n_done += 1
+            # the automaton is byte-level: lone 0x80+ bytes are legal JSON
+            # string *bytes*; substitute them for the utf-8 parse check
+            text = decode_ids(toks, ids).decode("utf-8", errors="replace")
+            assert json.loads(text) is not None or text.strip() in ("null",), text
+    assert n_done >= 50  # most random walks must terminate
+
+
+def test_greedy_style_rollout_objects(tables):
+    """Bias rollouts toward structure tokens so nesting gets exercised."""
+    toks = make_vocab()
+    rng = np.random.default_rng(1)
+    prefer = [tok_id(toks, b) for b in
+              (b'{"', b'":', b'"}', b'[1,', b'123', b'"a"', b'{', b'}',
+               b'[', b']', b'"', b':', b',', b'true')]
+    deep_seen = 0
+    for trial in range(300):
+        s, d, st = INIT_STATE, 0, 0
+        ids = []
+        for _ in range(200):
+            mask = tables.valid_mask(s, d, st)
+            cand = [p for p in prefer if mask[p]]
+            if cand and rng.random() < 0.7:
+                t = int(rng.choice(cand))
+            else:
+                valid = np.flatnonzero(mask)
+                t = int(rng.choice(valid))
+            ids.append(t)
+            if t == EOS:
+                break
+            s, d, st = tables.advance(s, d, st, t)
+            deep_seen = max(deep_seen, d)
+        if ids and ids[-1] == EOS:
+            text = decode_ids(toks, ids).decode("utf-8", errors="replace")
+            json.loads(text)
+    assert deep_seen >= 3  # nesting actually exercised
+
+
+def test_structural_masks(tables):
+    toks = make_vocab()
+    s, d, st = INIT_STATE, 0, 0
+    m = tables.valid_mask(s, d, st)
+    # value starts allowed, EOS not, ':' not, '}' not
+    assert m[tok_id(toks, b'{')] and m[tok_id(toks, b'[')] and m[tok_id(toks, b'"')]
+    assert not m[EOS] and not m[tok_id(toks, b':')] and not m[tok_id(toks, b'}')]
+    # after '{': key or '}' only — no value starts, no ','
+    s, d, st = tables.advance(s, d, st, tok_id(toks, b'{'))
+    m = tables.valid_mask(s, d, st)
+    assert m[tok_id(toks, b'"')] and m[tok_id(toks, b'}')]
+    assert not m[tok_id(toks, b'[')] and not m[tok_id(toks, b',')]
+    assert not m[tok_id(toks, b']')]  # wrong closer for OBJ
+    # close it: complete JSON -> EOS only
+    s, d, st = tables.advance(s, d, st, tok_id(toks, b'}'))
+    m = tables.valid_mask(s, d, st)
+    assert m[EOS]
+    assert m.sum() == 1  # nothing but EOS after a complete value
+
+
+def test_bracket_matching_through_stack(tables):
+    toks = make_vocab()
+    # [[ then {} then ]] — the ']]' multi-pop must check both stack levels
+    s, d, st = INIT_STATE, 0, 0
+    for b in (b'[', b'['):
+        s, d, st = tables.advance(s, d, st, tok_id(toks, b))
+    assert d == 2
+    m = tables.valid_mask(s, d, st)
+    assert m[tok_id(toks, b']]')] is not None
+    # '}}' must be invalid here (stack holds ARR, ARR)
+    assert not m[tok_id(toks, b'}}')]
+    s2, d2, st2 = tables.advance(s, d, st, tok_id(toks, b'1'))
+    m = tables.valid_mask(s2, d2, st2)
+    assert m[tok_id(toks, b']]')]
+    s3, d3, st3 = tables.advance(s2, d2, st2, tok_id(toks, b']]'))
+    assert d3 == 0
+    m = tables.valid_mask(s3, d3, st3)
+    assert m[EOS] and m.sum() == 1
+
+
+def test_context_dependent_tokens_are_conservative(tables):
+    toks = make_vocab()
+    # '},' — comma after popping into unknown context: masked from every
+    # value-position state (it stays valid inside strings, where it is
+    # plain content)
+    jid = tok_id(toks, b'},')
+    for c in ("T", "O", "A"):
+        assert tables.next_state[AFTER_VALUE[c], jid] == DEAD
+    # but the same chars as two tokens work: {"a": {} , ...
+    s, d, st = INIT_STATE, 0, 0
+    for b in (b'{"a":', b'{'):
+        s, d, st = tables.advance(s, d, st, tok_id(toks, b))
+    m = tables.valid_mask(s, d, st)
+    assert m[tok_id(toks, b'}')]
+    s, d, st = tables.advance(s, d, st, tok_id(toks, b'}'))
+    m = tables.valid_mask(s, d, st)
+    assert m[tok_id(toks, b',')] and m[tok_id(toks, b'}')]
+    assert not m[tok_id(toks, b']')]
+
+
+def test_string_escapes_and_numbers(tables):
+    toks = make_vocab()
+    seq = [b'[', b'"', b'\\"', b'a', b'"', b',', b'-1e9', b']']
+    s, d, st = INIT_STATE, 0, 0
+    for b in seq:
+        t = tok_id(toks, b)
+        assert tables.valid_mask(s, d, st)[t], f"{b} rejected"
+        s, d, st = tables.advance(s, d, st, t)
+    m = tables.valid_mask(s, d, st)
+    assert m[EOS]
+    text = b''.join(seq).decode()
+    json.loads(text)
+
+
+def test_number_cannot_be_malformed(tables):
+    toks = make_vocab()
+    s, d, st = INIT_STATE, 0, 0
+    s, d, st = tables.advance(s, d, st, tok_id(toks, b'-'))
+    m = tables.valid_mask(s, d, st)
+    assert not m[EOS] and not m[tok_id(toks, b'-')] and not m[tok_id(toks, b'.')]
+    assert m[tok_id(toks, b'0')]
+    s, d, st = tables.advance(s, d, st, tok_id(toks, b'0'))
+    m = tables.valid_mask(s, d, st)
+    # leading zero: no second digit
+    assert not m[tok_id(toks, b'0')] and not m[tok_id(toks, b'7')]
+    assert m[tok_id(toks, b'.')] and m[EOS]
+
+
+def test_depth_limit(tables):
+    toks = make_vocab()
+    s, d, st = INIT_STATE, 0, 0
+    for _ in range(MAX_DEPTH):
+        t = tok_id(toks, b'[')
+        assert tables.valid_mask(s, d, st)[t]
+        s, d, st = tables.advance(s, d, st, t)
+    m = tables.valid_mask(s, d, st)
+    assert not m[tok_id(toks, b'[')] and not m[tok_id(toks, b'{')]
+    assert m[tok_id(toks, b'1')] and m[tok_id(toks, b']')]
+
+
+def test_device_matches_host(tables):
+    """grammar_mask / grammar_advance (jnp) == valid_mask / advance (numpy)
+    along random constrained walks."""
+    import jax.numpy as jnp
+
+    toks = make_vocab()
+    gt = device_tables(tables)
+    rng = np.random.default_rng(7)
+    B = 4
+    s = np.full(B, INIT_STATE, np.int32)
+    d = np.zeros(B, np.int32)
+    st = np.zeros(B, np.int32)
+    jrows = np.ones(B, bool)
+    v = tables.vocab_size
+    for step in range(40):
+        logits = rng.normal(size=(B, v)).astype(np.float32)
+        masked = np.asarray(grammar_mask(
+            jnp.asarray(logits), gt, jnp.asarray(jrows), jnp.asarray(s),
+            jnp.asarray(d), jnp.asarray(st)))
+        picks = np.zeros(B, np.int32)
+        for i in range(B):
+            host_ok = tables.valid_mask(int(s[i]), int(d[i]), int(st[i]))
+            dev_ok = masked[i] > -1e29
+            np.testing.assert_array_equal(dev_ok, host_ok,
+                                          err_msg=f"row {i} step {step}")
+            choices = np.flatnonzero(host_ok & (np.arange(v) != EOS))
+            picks[i] = int(rng.choice(choices)) if choices.size else EOS
+        s2, d2, st2 = (np.asarray(x) for x in grammar_advance(
+            gt, jnp.asarray(jrows), jnp.asarray(s), jnp.asarray(d),
+            jnp.asarray(st), jnp.asarray(picks)))
+        for i in range(B):
+            hs, hd, hst = tables.advance(int(s[i]), int(d[i]), int(st[i]),
+                                         int(picks[i]))
+            assert (hs, hd, hst) == (int(s2[i]), int(d2[i]), int(st2[i]))
+        s, d, st = s2, d2, st2
+
+
+def test_token_bytes_map_byte_level():
+    class FakeTk:
+        def get_vocab(self):
+            return {"Ġhello": 0, "{": 1, "<|eot|>": 2, "ĊĊ": 3}
+
+        def get_added_tokens_decoder(self):
+            return {}
+
+    out = token_bytes_map(FakeTk())
+    assert out[0] == b" hello"
+    assert out[1] == b"{"
+    assert out[2] is None  # <...> treated as special
+    assert out[3] == b"\n\n"
+
+
+def test_token_bytes_map_sentencepiece():
+    class FakeTk:
+        def get_vocab(self):
+            return {"▁the": 0, "<0x0A>": 1, "a": 2, "<s>": 3}
+
+        def get_added_tokens_decoder(self):
+            return {}
+
+    out = token_bytes_map(FakeTk())
+    assert out[0] == b" the"
+    assert out[1] == b"\n"
+    assert out[2] == b"a"
+    assert out[3] is None
+
+
+def test_parse_request_response_format():
+    from dynamo_tpu.llm.openai import OpenAIError, parse_request
+
+    base = {"model": "m", "messages": [{"role": "user", "content": "hi"}]}
+    req = parse_request({**base, "response_format": {"type": "json_object"}},
+                        chat=True)
+    assert req.response_format == "json_object"
+    assert req.sampling.json_mode
+
+    req = parse_request({**base, "response_format": {"type": "text"}}, chat=True)
+    assert req.response_format is None and not req.sampling.json_mode
+
+    req = parse_request(
+        {**base, "response_format": {
+            "type": "json_schema",
+            "json_schema": {"name": "x", "schema": {"type": "object"}}}},
+        chat=True)
+    assert req.response_format == "json_schema"
+    assert req.json_schema["schema"] == {"type": "object"}
+    assert req.sampling.json_mode
+
+    import pytest as _pytest
+    with _pytest.raises(OpenAIError):
+        parse_request({**base, "response_format": {"type": "yaml"}}, chat=True)
+    with _pytest.raises(OpenAIError):
+        parse_request({**base, "response_format": {"type": "json_schema"}},
+                      chat=True)
