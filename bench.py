@@ -130,6 +130,43 @@ def _probe_pallas_prefill() -> None:
         os.environ["DYNAMO_DISABLE_PALLAS_PREFILL"] = "1"
 
 
+def _probe_kv_quant() -> bool:
+    """Compile-probe BOTH Pallas kernels against an int8 QuantKvCache on the
+    real backend; the int8 KV cache is only enabled when the in-kernel
+    dequant paths actually lower (ops/kv_quant.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        from dynamo_tpu.ops.kv_quant import QuantKvCache
+        from dynamo_tpu.ops.pallas.decode_attention import paged_decode_attention
+        from dynamo_tpu.ops.pallas.prefill_attention import paged_prefill_attention
+
+        b, s, h, hk, d, bs = 2, 128, 8, 4, 64, 16
+        cache = QuantKvCache(
+            jnp.zeros((1, 16, 2, bs, hk * d), jnp.int8),
+            jnp.ones((1, 16, 2, hk, bs), jnp.float32),
+        )
+        bt = jnp.zeros((b, 10), jnp.int32)
+        out = paged_decode_attention(
+            jnp.ones((b, h, d), jnp.bfloat16), cache, jnp.int32(0), bt,
+            jnp.asarray([1, 32], jnp.int32),
+        )
+        jax.block_until_ready(out)
+        q = jnp.ones((b, s, h, d), jnp.bfloat16)
+        kv = jnp.ones((b, s, hk, d), jnp.bfloat16)
+        out = paged_prefill_attention(
+            q, kv, kv, cache, jnp.int32(0), bt,
+            jnp.full((b,), s, jnp.int32), jnp.zeros((b,), jnp.int32),
+        )
+        jax.block_until_ready(out)
+        return True
+    except Exception as e:  # pragma: no cover - hardware-specific
+        print(f"# int8 KV probe failed ({type(e).__name__}: {e}); "
+              "using bf16 KV cache", file=sys.stderr)
+        return False
+
+
 def main() -> None:
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         # explicit CPU run (CI smoke): the image's sitecustomize pins the
@@ -162,11 +199,21 @@ def main() -> None:
     # headline numbers are likewise on FP8 weights, docs/architecture.md:57)
     quant = os.environ.get("DYNAMO_BENCH_QUANT", "int8" if on_accel else "none")
     wbytes = 1 if quant == "int8" else 2
+    # int8 KV cache (ops/kv_quant.py): halves KV footprint + decode KV
+    # traffic.  "auto" = on iff the quantized kernel paths compile-probe OK
+    # on this backend (checked below, before model selection).
+    kv_quant = os.environ.get("DYNAMO_BENCH_KV_QUANT",
+                              "auto" if on_accel else "none")
+    if kv_quant == "auto":
+        kv_quant = "int8" if _probe_kv_quant() else "none"
+    kv_scale_overhead = 1.03125  # per-token-per-head f32 scales at D=128
+    kv_bytes_elem = kv_scale_overhead if kv_quant == "int8" else 2.0
 
     def fit_bytes(cfg: dict, mlen: int) -> int:
         # ~1GB slack: activations, prefill buffers, XLA workspace
-        return (_param_bytes(cfg, wbytes) + batch * mlen *
-                _kv_bytes_per_token(cfg) + (1 << 30))
+        per_tok = int(_kv_bytes_per_token(cfg, 1) * kv_bytes_elem)
+        return (_param_bytes(cfg, wbytes) + batch * mlen * per_tok
+                + (1 << 30))
 
     if name == "auto":
         # largest model whose weights + KV cache fit in ~92% of HBM
@@ -202,6 +249,7 @@ def main() -> None:
         decode_steps=decode_steps,
         prefill_chunk_tokens=min(prefill_chunk, max_len) if prefill_chunk else 0,
         enable_prefix_reuse=False,  # distinct prompts; measure raw decode
+        cache_dtype="int8" if kv_quant == "int8" else None,
     )
     if on_accel:
         _probe_pallas_prefill()
@@ -211,7 +259,7 @@ def main() -> None:
     params = model.init_params(jax.random.PRNGKey(0), quantized=quant == "int8")
     jax.block_until_ready(params)
     engine = EngineCore(model, params, ecfg, eos_token_ids=[])
-    print(f"# model={name} quant={quant} platform={platform} "
+    print(f"# model={name} quant={quant} kv_quant={kv_quant} platform={platform} "
           f"kind={getattr(dev, 'device_kind', '?')} "
           f"hbm={hbm >> 30}GiB batch={batch} max_len={max_len} "
           f"init={time.perf_counter() - t0:.1f}s", file=sys.stderr)
@@ -310,6 +358,7 @@ def main() -> None:
         "vs_baseline": round(tok_s / BASELINE_TOK_S, 3) if name == "8b" else None,
         "model": name,
         "quant": quant,
+        "kv_quant": kv_quant,
         "platform": platform,
         "batch": batch,
         "itl_ms": round(itl_ms, 2),

@@ -96,6 +96,9 @@ def _build_local_engine(args) -> tuple[object, object]:
         max_model_len=args.max_model_len,
         block_size=args.block_size,
         num_blocks=args.num_blocks,
+        cache_dtype=(
+            "int8" if getattr(args, "kv_cache_dtype", "auto") == "int8" else None
+        ),
     )
     core = EngineCore(
         model, params, cfg, mesh=mesh, eos_token_ids=card.eos_token_ids or None
@@ -506,6 +509,10 @@ def _parser() -> argparse.ArgumentParser:
     run.add_argument("--model-name", default=None)
     run.add_argument("--dtype", default="bfloat16")
     run.add_argument("--max-batch-size", type=int, default=8)
+    run.add_argument("--kv-cache-dtype", choices=["auto", "int8"],
+                     default="auto",
+                     help="int8 = quantized KV cache (ops/kv_quant.py): "
+                     "half the KV HBM footprint and decode KV traffic")
     run.add_argument("--quantize", choices=["none", "int8"], default="none",
                      help="int8 weight-only quantization (halves weight HBM)")
     run.add_argument("--tp", type=int, default=1, help="tensor-parallel size")

@@ -50,7 +50,10 @@ class EngineConfig:
     block_size: int = 16
     num_blocks: int = 512             # cache blocks in HBM
     num_host_blocks: int = 0          # host-RAM offload tier (0 = disabled)
-    cache_dtype: Optional[str] = None  # default: model dtype
+    # KV cache dtype: None = model dtype; "int8" = quantized cache with
+    # per-token-per-head scales (ops/kv_quant.py) — half the KV HBM
+    # footprint and decode-step KV traffic
+    cache_dtype: Optional[str] = None
     enable_prefix_reuse: bool = True
     # force exact lax.top_k candidate selection in the sampler (the default
     # approx_max_k path is exact for greedy and ~0.95-recall for the deep

@@ -66,16 +66,42 @@ def _np_dtype(name: str):
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def pack_blocks(arr: np.ndarray) -> tuple[dict, bytes]:
-    """ndarray -> (wire header fields, payload bytes)."""
-    arr = np.ascontiguousarray(arr)
-    return {"dtype": arr.dtype.name, "shape": list(arr.shape)}, arr.tobytes()
+def pack_blocks(arr) -> tuple[dict, bytes]:
+    """Blocks -> (wire header fields, payload bytes).
+
+    ``arr`` is either one ndarray (bf16 cache) or the quantized cache's
+    (data, scale) pair — the multi-part header keeps the wire format
+    self-describing so mixed-precision workers interoperate explicitly.
+    """
+    parts = list(arr) if isinstance(arr, (tuple, list)) else [arr]
+    parts = [np.ascontiguousarray(np.asarray(p)) for p in parts]
+    if len(parts) == 1:
+        # keep the legacy single-array header so upgraded senders stay
+        # readable by not-yet-upgraded receivers (bf16 transfers are the
+        # mixed-version case; quantized pairs need upgraded peers anyway)
+        p = parts[0]
+        return {"dtype": p.dtype.name, "shape": list(p.shape)}, p.tobytes()
+    header = {"parts": [{"dtype": p.dtype.name, "shape": list(p.shape)}
+                        for p in parts]}
+    return header, b"".join(p.tobytes() for p in parts)
 
 
-def unpack_blocks(header: dict, payload: bytes) -> np.ndarray:
-    return np.frombuffer(payload, dtype=_np_dtype(header["dtype"])).reshape(
-        header["shape"]
-    )
+def unpack_blocks(header: dict, payload: bytes):
+    """Inverse of :func:`pack_blocks`; returns an ndarray, or a tuple of
+    ndarrays for multi-part (quantized) payloads.  Accepts the legacy
+    single-array header shape for mixed-version peers."""
+    metas = header.get("parts")
+    if metas is None:  # legacy single-array header
+        return np.frombuffer(payload, dtype=_np_dtype(header["dtype"])).reshape(
+            header["shape"]
+        )
+    out, off = [], 0
+    for m in metas:
+        dt = _np_dtype(m["dtype"])
+        n = int(np.prod(m["shape"])) * dt.itemsize
+        out.append(np.frombuffer(payload[off:off + n], dtype=dt).reshape(m["shape"]))
+        off += n
+    return out[0] if len(out) == 1 else tuple(out)
 
 
 class KvTransferServer:

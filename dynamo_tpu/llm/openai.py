@@ -133,6 +133,8 @@ def parse_request(body: dict, chat: bool) -> ParsedRequest:
     # response_format (chat mode): json_object / json_schema switch the
     # engine to grammar-constrained decoding (engine/grammar.py)
     rf = body.get("response_format")
+    _require(rf is None or chat,
+             "'response_format' is only supported on chat completions")
     if rf is not None:
         _require(isinstance(rf, dict) and "type" in rf,
                  "'response_format' must be an object with a 'type'")

@@ -190,10 +190,17 @@ class LlamaModel:
             specs["lm_head"] = P(None, "model")
         return specs
 
-    def cache_spec(self) -> P:
+    def cache_spec(self, quant: bool = False):
         """KV cache [L,N,2,Bs,Hk*D]: the trailing axis is kv-head-major, so
-        sharding it over "model" splits whole kv heads across the mesh."""
-        return P(None, None, None, None, "model")
+        sharding it over "model" splits whole kv heads across the mesh.
+        For a quantized cache, the scale pool [L,N,2,Hk,Bs] shards its Hk
+        axis the same way (whole kv heads per shard)."""
+        data = P(None, None, None, None, "model")
+        if not quant:
+            return data
+        from dynamo_tpu.ops.kv_quant import QuantKvCache
+
+        return QuantKvCache(data, P(None, None, None, "model", None))
 
     # --------------------------------------------------------------- kv cache
     def init_kv_cache(self, num_blocks: int, block_size: int, dtype=None) -> jax.Array:
@@ -206,19 +213,32 @@ class LlamaModel:
         K and V of a block are adjacent (k/v axis inside the block axis) so
         the decode kernel's per-block fetch is ONE contiguous DMA.  The
         flat Hk*D minor axis is lane-aligned (512+ for real models).
+
+        ``dtype="int8"`` returns a :class:`QuantKvCache` (int8 payload +
+        per-token-per-head scale pool, ops/kv_quant.py) — same layout, half
+        the HBM, transparently handled by every write/attention path.
         """
         cfg = self.config
-        dt = dtype or cfg.jax_dtype
-        return jnp.zeros(
-            (
-                cfg.num_layers,
-                num_blocks,
-                2,
-                block_size,
-                cfg.num_kv_heads * cfg.head_dim,
-            ),
-            dt,
+        shape = (
+            cfg.num_layers,
+            num_blocks,
+            2,
+            block_size,
+            cfg.num_kv_heads * cfg.head_dim,
         )
+        dt = dtype or cfg.jax_dtype
+        if str(dt) in ("int8", "<dtype: int8>") or dt == jnp.int8:
+            from dynamo_tpu.ops.kv_quant import QuantKvCache
+
+            return QuantKvCache(
+                jnp.zeros(shape, jnp.int8),
+                jnp.ones(
+                    (cfg.num_layers, num_blocks, 2, cfg.num_kv_heads,
+                     block_size),
+                    jnp.float32,
+                ),
+            )
+        return jnp.zeros(shape, dt)
 
     # ---------------------------------------------------------------- forward
     def forward(

@@ -29,26 +29,28 @@ __all__ = [
 
 
 @jax.jit
-def gather_blocks(cache: jax.Array, block_ids: jax.Array) -> jax.Array:
+def gather_blocks(cache, block_ids: jax.Array):
     """Pull blocks out of a cache: [L,N,2,Bs,HkD] × [n] -> [L,n,2,Bs,HkD].
 
     Used to extract a sequence's KV for offload / cross-worker transfer.
+    Works on any cache pytree whose leaves index blocks on axis 1 (the
+    plain bf16 array, or QuantKvCache's data+scale pair).
     """
-    return jnp.take(cache, block_ids, axis=1)
+    return jax.tree.map(lambda a: jnp.take(a, block_ids, axis=1), cache)
 
 
 @jax.jit
-def scatter_blocks(
-    cache: jax.Array, block_ids: jax.Array, blocks: jax.Array
-) -> jax.Array:
+def scatter_blocks(cache, block_ids: jax.Array, blocks):
     """Write transferred blocks into a cache at ``block_ids``.
 
     cache: [L,N,2,Bs,HkD]; blocks: [L,n,2,Bs,HkD]; block_ids: [n].
     """
-    return cache.at[:, block_ids].set(blocks.astype(cache.dtype))
+    return jax.tree.map(
+        lambda c, b: c.at[:, block_ids].set(b.astype(c.dtype)), cache, blocks
+    )
 
 
-def gather_blocks_padded(cache: jax.Array, block_ids) -> jax.Array:
+def gather_blocks_padded(cache, block_ids):
     """gather_blocks with the id count padded to a power of two (duplicating
     the last id, sliced off after) so arbitrary eviction/transfer batch
     sizes reuse O(log n) compiled executables instead of one per size."""
@@ -60,12 +62,14 @@ def gather_blocks_padded(cache: jax.Array, block_ids) -> jax.Array:
     if padded != n:
         ids = np.concatenate([ids, np.full(padded - n, ids[-1], np.int32)])
     out = gather_blocks(cache, jnp.asarray(ids))
-    return out[:, :n] if padded != n else out
+    if padded != n:
+        out = jax.tree.map(lambda a: a[:, :n], out)
+    return out
 
 
 _scatter_donated = jax.jit(
-    lambda cache, block_ids, blocks: cache.at[:, block_ids].set(
-        blocks.astype(cache.dtype)
+    lambda cache, block_ids, blocks: jax.tree.map(
+        lambda c, b: c.at[:, block_ids].set(b.astype(c.dtype)), cache, blocks
     ),
     donate_argnums=(0,),
 )
@@ -90,7 +94,10 @@ def scatter_blocks_inplace(cache, block_ids, blocks):
         block_ids = np.concatenate(
             [block_ids, np.full(padded - n, block_ids[-1], np.int32)]
         )
-        blocks = jnp.concatenate(
-            [blocks, jnp.repeat(blocks[:, -1:], padded - n, axis=1)], axis=1
+        blocks = jax.tree.map(
+            lambda b: jnp.concatenate(
+                [b, jnp.repeat(b[:, -1:], padded - n, axis=1)], axis=1
+            ),
+            blocks,
         )
     return _scatter_donated(cache, jnp.asarray(block_ids), blocks)

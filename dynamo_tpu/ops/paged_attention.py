@@ -25,6 +25,10 @@ import os
 import jax
 import jax.numpy as jnp
 
+from dynamo_tpu.ops.kv_quant import (
+    QuantKvCache, dequant_layer_slice, is_quant, quantize_kv_rows,
+)
+
 __all__ = [
     "write_kv_cache",
     "write_kv_cache_layer",
@@ -67,7 +71,9 @@ def paged_attention_layer(
     shapes/backends materialise the layer slice and use the oracle below.
     """
     b, s, h, d = q.shape
-    _, n, _, bs, hkd = cache.shape
+    quant = is_quant(cache)
+    data = cache.data if quant else cache
+    _, n, _, bs, hkd = data.shape
     hk = hkd // d
     if s == 1 and _pallas_decode_enabled():
         from dynamo_tpu.ops.pallas.decode_attention import paged_decode_attention
@@ -77,7 +83,12 @@ def paged_attention_layer(
         )
         return out[:, None]
 
-    layer_kv = jax.lax.dynamic_index_in_dim(cache, layer, axis=0, keepdims=False)
+    layer_kv = jax.lax.dynamic_index_in_dim(data, layer, axis=0, keepdims=False)
+    if quant:
+        layer_sc = jax.lax.dynamic_index_in_dim(
+            cache.scale, layer, axis=0, keepdims=False
+        )
+        layer_kv = dequant_layer_slice(layer_kv, layer_sc, hk)
     k_cache = layer_kv[:, 0].reshape(n, bs, hk, d)
     v_cache = layer_kv[:, 1].reshape(n, bs, hk, d)
     return paged_attention(
@@ -112,6 +123,7 @@ def prefill_attention(
     b, s, h, d = q.shape
     hk = k_new.shape[2]
     g = h // hk
+    quant = is_quant(cache)
     if sm_scale is None:
         sm_scale = 1.0 / (d**0.5)
     if s > 1 and _pallas_prefill_enabled():
@@ -139,9 +151,15 @@ def prefill_attention(
         out = jnp.einsum("bkgst,btkd->bskgd", probs, v_new.astype(jnp.float32))
         return out.reshape(b, s, h, d).astype(q.dtype)
 
-    _, n, _, bs, hkd = cache.shape
-    layer_kv = jax.lax.dynamic_index_in_dim(cache, layer, axis=0, keepdims=False)
+    data = cache.data if quant else cache
+    _, n, _, bs, hkd = data.shape
+    layer_kv = jax.lax.dynamic_index_in_dim(data, layer, axis=0, keepdims=False)
     ctx = layer_kv[block_tables[:, :prefix_blocks]]  # [B, P, 2, Bs, HkD]
+    if quant:
+        layer_sc = jax.lax.dynamic_index_in_dim(
+            cache.scale, layer, axis=0, keepdims=False
+        )
+        ctx = dequant_layer_slice(ctx, layer_sc[block_tables[:, :prefix_blocks]], hk)
     t = prefix_blocks * bs
     kp = ctx[:, :, 0].reshape(b, t, hk, d)
     vp = ctx[:, :, 1].reshape(b, t, hk, d)
@@ -185,35 +203,71 @@ def write_kv_cache_layer(
     select below), honoring the '-1 = drop' contract bit-for-bit.
     Alignment is a caller contract, not data-inspected — callers that
     cannot guarantee it use the default row path.
+
+    For a :class:`QuantKvCache`, the fresh rows are quantized here (one
+    scale per row per kv head) and data + scale scatter with the same base
+    indices — write-time quantization is what keeps every read path
+    (decode kernel, prefill prefix, transfer) a plain rescale.
     """
-    l, n, two, bs, hkd = cache.shape
+    if is_quant(cache):
+        b, s, hk, d = k_new.shape
+        kq, ks = quantize_kv_rows(k_new)
+        vq, vs = quantize_kv_rows(v_new)
+        return QuantKvCache(
+            _write_layer_rows(cache.data, layer,
+                              kq.reshape(b, s, hk * d),
+                              vq.reshape(b, s, hk * d),
+                              slot_idx, block_aligned),
+            _write_layer_scales(cache.scale, layer, ks, vs,
+                                slot_idx, block_aligned),
+        )
     b, s, hk, d = k_new.shape
+    return _write_layer_rows(
+        cache, layer,
+        k_new.astype(cache.dtype).reshape(b, s, hk * d),
+        v_new.astype(cache.dtype).reshape(b, s, hk * d),
+        slot_idx, block_aligned,
+    )
+
+
+def _write_layer_rows(
+    cache: jax.Array,    # [L, N, 2, Bs, R] — R = Hk*D (data) or Hk (scales)
+    layer: jax.Array,
+    rows_k: jax.Array,   # [B, S, R]
+    rows_v: jax.Array,   # [B, S, R]
+    slot_idx: jax.Array,
+    block_aligned: bool,
+) -> jax.Array:
+    l, n, two, bs, r = cache.shape
+    b, s, _ = rows_k.shape
+    rows_k = rows_k.astype(cache.dtype)
+    rows_v = rows_v.astype(cache.dtype)
     if block_aligned and s > 1 and s % bs == 0:
         nb = s // bs
         size = l * n * 2  # one-past-the-end: truly dropped by mode="drop"
         first = slot_idx[:, ::bs]                     # [B, nb] block-leading slot
         bid = jnp.where(first >= 0, first // bs, -1)  # [B, nb]
-        flat = cache.reshape(size, bs, hkd)
+        flat = cache.reshape(size, bs, r)
         base = layer * (n * 2) + bid * 2              # K row of (layer, bid)
         # NOTE: the drop sentinel must be OUT OF BOUNDS (size), never -1 —
         # scatter wraps negative indices like numpy, so -1 would silently
         # corrupt the LAST cache row with padding K/V
         base = jnp.where(bid >= 0, base, size).reshape(-1)
         valid = (slot_idx >= 0).reshape(b * nb, bs, 1)
-        rows_k = k_new.astype(cache.dtype).reshape(b * nb, bs, hkd)
-        rows_v = v_new.astype(cache.dtype).reshape(b * nb, bs, hkd)
+        gk = rows_k.reshape(b * nb, bs, r)
+        gv = rows_v.reshape(b * nb, bs, r)
         # read-modify-write: padding rows inside a partial block preserve
         # the existing cache bytes instead of clobbering them with K/V of
         # padding tokens
         cur_k = flat[jnp.minimum(base, size - 1)]
         cur_v = flat[jnp.minimum(base + 1, size - 1)]
-        flat = flat.at[base].set(jnp.where(valid, rows_k, cur_k), mode="drop")
+        flat = flat.at[base].set(jnp.where(valid, gk, cur_k), mode="drop")
         flat = flat.at[jnp.where(base < size, base + 1, size)].set(
-            jnp.where(valid, rows_v, cur_v), mode="drop"
+            jnp.where(valid, gv, cur_v), mode="drop"
         )
         return flat.reshape(cache.shape)
     size = l * n * 2 * bs
-    flat = cache.reshape(size, hkd)
+    flat = cache.reshape(size, r)
     idx = slot_idx.reshape(-1)
     valid = idx >= 0
     # row for (layer, block=idx//bs, kv, offset=idx%bs) in the flat view
@@ -221,11 +275,55 @@ def write_kv_cache_layer(
     # OOB sentinel, NOT -1: scatter wraps negative indices (see above)
     k_idx = jnp.where(valid, base, size)
     v_idx = jnp.where(valid, base + bs, size)
-    rows_k = k_new.astype(cache.dtype).reshape(-1, hkd)
-    rows_v = v_new.astype(cache.dtype).reshape(-1, hkd)
-    flat = flat.at[k_idx].set(rows_k, mode="drop")
-    flat = flat.at[v_idx].set(rows_v, mode="drop")
+    flat = flat.at[k_idx].set(rows_k.reshape(-1, r), mode="drop")
+    flat = flat.at[v_idx].set(rows_v.reshape(-1, r), mode="drop")
     return flat.reshape(cache.shape)
+
+
+def _write_layer_scales(
+    scale: jax.Array,     # [L, N, 2, Hk, Bs] f32 (token-minor)
+    layer: jax.Array,
+    ks: jax.Array,        # [B, S, Hk] per-token K scales
+    vs: jax.Array,        # [B, S, Hk]
+    slot_idx: jax.Array,  # [B, S]
+    block_aligned: bool,
+) -> jax.Array:
+    """Scatter per-token scales into the token-minor scale pool (mirrors
+    the data writes in :func:`_write_layer_rows`, index-for-index)."""
+    l, n, two, hk, bs = scale.shape
+    b, s, _ = ks.shape
+    ks = ks.astype(scale.dtype)
+    vs = vs.astype(scale.dtype)
+    if block_aligned and s > 1 and s % bs == 0:
+        nb = s // bs
+        size = l * n * 2
+        first = slot_idx[:, ::bs]
+        bid = jnp.where(first >= 0, first // bs, -1)
+        flat = scale.reshape(size, hk, bs)
+        base = layer * (n * 2) + bid * 2
+        base = jnp.where(bid >= 0, base, size).reshape(-1)
+        valid = (slot_idx >= 0).reshape(b * nb, 1, bs)
+        # [B, nb, Bs, Hk] -> [B*nb, Hk, Bs] (token-minor tiles)
+        gk = jnp.swapaxes(ks.reshape(b * nb, bs, hk), 1, 2)
+        gv = jnp.swapaxes(vs.reshape(b * nb, bs, hk), 1, 2)
+        cur_k = flat[jnp.minimum(base, size - 1)]
+        cur_v = flat[jnp.minimum(base + 1, size - 1)]
+        flat = flat.at[base].set(jnp.where(valid, gk, cur_k), mode="drop")
+        flat = flat.at[jnp.where(base < size, base + 1, size)].set(
+            jnp.where(valid, gv, cur_v), mode="drop"
+        )
+        return flat.reshape(scale.shape)
+    size = l * n * 2
+    flat = scale.reshape(size, hk, bs)
+    idx = slot_idx.reshape(-1)
+    valid = idx >= 0
+    row = layer * (n * 2) + (idx // bs) * 2
+    lane = idx % bs
+    row_k = jnp.where(valid, row, size)
+    row_v = jnp.where(valid, row + 1, size)
+    flat = flat.at[row_k, :, lane].set(ks.reshape(-1, hk), mode="drop")
+    flat = flat.at[row_v, :, lane].set(vs.reshape(-1, hk), mode="drop")
+    return flat.reshape(scale.shape)
 
 
 def write_kv_cache(

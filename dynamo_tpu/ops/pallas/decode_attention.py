@@ -60,6 +60,7 @@ def _kernel(
     # inputs
     q_ref,       # [G, H, HkD] VMEM — block-diagonal expanded, pre-scaled f32
     cache_ref,   # [L, N, 2, Bs, HkD] HBM (manual DMA)
+    # (scale_ref [L, N, 2, Hk, Bs] HBM when quant — spliced via *rest)
     # outputs
     out_ref,     # [G, H, HkD] VMEM
     # scratch
@@ -68,6 +69,27 @@ def _kernel(
     l_ref,       # [G, H, 128] f32
     kvbuf,       # [2, G, C, 2, Bs, HkD] cache-dtype (double buffer)
     sems,        # [2, G, C] DMA semaphores
+    # (scbuf [2, G, C, 2, Hk, Bs] f32 + scsems when quant)
+    *,
+    c: int,
+    g: int,
+):
+    return _kernel_impl(seq_ref, bt_ref, layer_ref, q_ref, cache_ref,
+                        None, out_ref, acc_ref, m_ref, l_ref, kvbuf, sems,
+                        None, None, c=c, g=g)
+
+
+def _kernel_quant(seq_ref, bt_ref, layer_ref, q_ref, cache_ref, scale_ref,
+                  out_ref, acc_ref, m_ref, l_ref, kvbuf, sems, scbuf, scsems,
+                  *, c: int, g: int):
+    return _kernel_impl(seq_ref, bt_ref, layer_ref, q_ref, cache_ref,
+                        scale_ref, out_ref, acc_ref, m_ref, l_ref, kvbuf,
+                        sems, scbuf, scsems, c=c, g=g)
+
+
+def _kernel_impl(
+    seq_ref, bt_ref, layer_ref, q_ref, cache_ref, scale_ref,
+    out_ref, acc_ref, m_ref, l_ref, kvbuf, sems, scbuf, scsems,
     *,
     c: int,
     g: int,
@@ -77,6 +99,7 @@ def _kernel(
     h = q_ref.shape[1]
     t = c * bs
     lyr = layer_ref[0]
+    quant = scale_ref is not None
 
     # group-wide chunk bound: max seq_len among the G sequences
     max_len = seq_ref[gi * g]
@@ -98,6 +121,11 @@ def _kernel(
                 out.append(pltpu.make_async_copy(
                     cache_ref.at[lyr, bid], kvbuf.at[slot, j, i], sems.at[slot, j, i]
                 ))
+                if quant:  # the block's scale tile rides a second small DMA
+                    out.append(pltpu.make_async_copy(
+                        scale_ref.at[lyr, bid], scbuf.at[slot, j, i],
+                        scsems.at[slot, j, i]
+                    ))
         return out
 
     acc_ref[:] = jnp.zeros_like(acc_ref)
@@ -134,6 +162,25 @@ def _kernel(
                 s = jax.lax.dot_general(
                     q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
                 )  # [H, T]
+                if quant:
+                    # int8 KV: k rows carry a per-(token, kv-head) scale.
+                    # Column t of s uses k row t whose scale depends on the
+                    # query's kv head — build [H, T] scale tiles by lane-
+                    # concat of the token-minor [Hk, Bs] blocks, then repeat
+                    # each kv head's row for its G query heads (q rows are
+                    # kv-head-major).  V's scale folds into P before the PV
+                    # matmul (not into l: softmax stats use true probs).
+                    hk = scbuf.shape[4]
+                    gq = h // hk
+                    sck = jnp.concatenate(
+                        [scbuf[slot, j, i, 0] for i in range(c)], axis=-1
+                    )  # [Hk, T]
+                    scv = jnp.concatenate(
+                        [scbuf[slot, j, i, 1] for i in range(c)], axis=-1
+                    )
+                    sck = jnp.repeat(sck, gq, axis=0)  # [H, T]
+                    scv = jnp.repeat(scv, gq, axis=0)
+                    s = s * sck
                 pos = ci * t + jax.lax.broadcasted_iota(jnp.int32, (h, t), 1)
                 s = jnp.where(pos < seq_len, s, NEG_INF)
 
@@ -143,7 +190,8 @@ def _kernel(
                 p = jnp.exp(s - m_new)
                 l_ref[j] = l_ref[j] * alpha + jnp.sum(p, axis=1, keepdims=True)
                 m_ref[j] = jnp.broadcast_to(m_new, m_ref.shape[1:])
-                pv = jnp.dot(p, v, preferred_element_type=jnp.float32)
+                pv = jnp.dot(p * scv if quant else p, v,
+                             preferred_element_type=jnp.float32)
                 acc_ref[j] = acc_ref[j] * alpha + pv
         return 0
 
@@ -160,7 +208,7 @@ def _kernel(
 )
 def paged_decode_attention(
     q: jax.Array,             # [B, H, D]
-    cache: jax.Array,         # [L, N, 2, Bs, Hk*D] — full multi-layer cache
+    cache,                    # [L, N, 2, Bs, Hk*D] cache — or QuantKvCache
     layer: jax.Array,         # scalar int32
     block_tables: jax.Array,  # [B, M] int32
     seq_lens: jax.Array,      # [B] int32
@@ -170,8 +218,12 @@ def paged_decode_attention(
     interpret: bool = False,
 ) -> jax.Array:
     """One decode step of attention for B sequences.  Returns [B, H, D]."""
+    from dynamo_tpu.ops.kv_quant import is_quant
+
+    quant = is_quant(cache)
+    data, scale = (cache.data, cache.scale) if quant else (cache, None)
     b, h, d = q.shape
-    l, n, _, bs, hkd = cache.shape
+    l, n, _, bs, hkd = data.shape
     hk = hkd // d
     m = block_tables.shape[1]
     g_heads = h // hk
@@ -191,35 +243,46 @@ def paged_decode_attention(
     q_exp = jnp.einsum("bkgd,ke->bkged", qf.reshape(b, hk, g_heads, d), eye)
     q_exp = q_exp.reshape(b, h, hkd)
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(b // g,),
-        in_specs=[
-            pl.BlockSpec((g, h, hkd), lambda i, *_: (i, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),  # cache stays in HBM
-        ],
-        out_specs=pl.BlockSpec((g, h, hkd), lambda i, *_: (i, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((g, h, hkd), jnp.float32),
-            pltpu.VMEM((g, h, 128), jnp.float32),
-            pltpu.VMEM((g, h, 128), jnp.float32),
-            pltpu.VMEM((2, g, c, 2, bs, hkd), cache.dtype),
-            pltpu.SemaphoreType.DMA((2, g, c)),
-        ],
-    )
-
-    out = pl.pallas_call(
-        functools.partial(_kernel, c=c, g=g),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, hkd), q.dtype),
-        interpret=interpret,
-    )(
+    in_specs = [
+        pl.BlockSpec((g, h, hkd), lambda i, *_: (i, 0, 0)),
+        pl.BlockSpec(memory_space=pltpu.ANY),  # cache stays in HBM
+    ]
+    scratch = [
+        pltpu.VMEM((g, h, hkd), jnp.float32),
+        pltpu.VMEM((g, h, 128), jnp.float32),
+        pltpu.VMEM((g, h, 128), jnp.float32),
+        pltpu.VMEM((2, g, c, 2, bs, hkd), data.dtype),
+        pltpu.SemaphoreType.DMA((2, g, c)),
+    ]
+    operands = [
         seq_lens.astype(jnp.int32),
         block_tables.astype(jnp.int32),
         jnp.asarray(layer, jnp.int32).reshape(1),
         q_exp,
-        cache,
+        data,
+    ]
+    if quant:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))  # scales in HBM
+        scratch += [
+            pltpu.VMEM((2, g, c, 2, hk, bs), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, g, c)),
+        ]
+        operands.append(scale)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b // g,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((g, h, hkd), lambda i, *_: (i, 0, 0)),
+        scratch_shapes=scratch,
     )
+
+    out = pl.pallas_call(
+        functools.partial(_kernel_quant if quant else _kernel, c=c, g=g),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, hkd), q.dtype),
+        interpret=interpret,
+    )(*operands)
 
     # Collapse the block-diagonal layout back to [B, H, D].
     out = out.reshape(b, hk, g_heads, hk, d)

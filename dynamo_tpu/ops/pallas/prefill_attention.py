@@ -35,6 +35,28 @@ NEG_INF = -1e30
 
 
 def _kernel(
+    seq_ref, start_ref, bt_ref, layer_ref, q_ref, k_ref, v_ref, cache_ref,
+    out_ref, acc_ref, m_ref, l_ref, kvbuf, sems,
+    *, c: int, tq: int, hk: int, g: int, d: int, sm_scale: float,
+):
+    return _kernel_impl(seq_ref, start_ref, bt_ref, layer_ref, q_ref, k_ref,
+                        v_ref, cache_ref, None, out_ref, acc_ref, m_ref,
+                        l_ref, kvbuf, sems, None, None, c=c, tq=tq, hk=hk,
+                        g=g, d=d, sm_scale=sm_scale)
+
+
+def _kernel_quant(
+    seq_ref, start_ref, bt_ref, layer_ref, q_ref, k_ref, v_ref, cache_ref,
+    scale_ref, out_ref, acc_ref, m_ref, l_ref, kvbuf, sems, scbuf, scsems,
+    *, c: int, tq: int, hk: int, g: int, d: int, sm_scale: float,
+):
+    return _kernel_impl(seq_ref, start_ref, bt_ref, layer_ref, q_ref, k_ref,
+                        v_ref, cache_ref, scale_ref, out_ref, acc_ref, m_ref,
+                        l_ref, kvbuf, sems, scbuf, scsems, c=c, tq=tq, hk=hk,
+                        g=g, d=d, sm_scale=sm_scale)
+
+
+def _kernel_impl(
     # scalar prefetch (SMEM)
     seq_ref,     # [B] int32 — context length incl. fresh tokens
     start_ref,   # [B] int32 — absolute position of q[:, 0]
@@ -45,6 +67,7 @@ def _kernel(
     k_ref,       # [1, S, Hk*D] VMEM — whole fresh K (chunk-resident)
     v_ref,       # [1, S, Hk*D] VMEM
     cache_ref,   # [L, N, 2, Bs, Hk*D] HBM (manual DMA)
+    scale_ref,   # [L, N, 2, Hk, Bs] HBM f32, or None (bf16 cache)
     # outputs
     out_ref,     # [1, TQ, Hk, G*D] VMEM
     # scratch
@@ -53,6 +76,8 @@ def _kernel(
     l_ref,       # [Hk, TQ*G, 128] f32
     kvbuf,       # [2, C, 2, Bs, Hk*D] cache-dtype (double buffer)
     sems,        # [2, C] DMA semaphores
+    scbuf,       # [2, C, 2, Hk, Bs] f32, or None
+    scsems,      # [2, C] DMA semaphores, or None
     *,
     c: int,
     tq: int,
@@ -61,6 +86,7 @@ def _kernel(
     d: int,
     sm_scale: float,
 ):
+    quant = scale_ref is not None
     bi = pl.program_id(0)
     ri = pl.program_id(1)
     bs = kvbuf.shape[3]
@@ -76,15 +102,18 @@ def _kernel(
 
     rows = jax.lax.broadcasted_iota(jnp.int32, (tq * g, 1), 0) // g  # query row
 
-    def flash_update(h, s_scores, v_cols):
-        """Online-softmax fold of one [TQ*G, TKV] score tile (masked)."""
+    def flash_update(h, s_scores, v_cols, p_scale=None):
+        """Online-softmax fold of one [TQ*G, TKV] score tile (masked).
+        ``p_scale`` [1, TKV] rescales P before the PV product (int8 V
+        dequant folded per column; softmax stats use the true probs)."""
         m_prev = m_ref[h, :, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s_scores, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s_scores - m_new)
         l_ref[h] = l_ref[h] * alpha + jnp.sum(p, axis=1, keepdims=True)
         m_ref[h] = jnp.broadcast_to(m_new, m_ref.shape[1:])
-        pv = jnp.dot(p, v_cols, preferred_element_type=jnp.float32)
+        pv = jnp.dot(p if p_scale is None else p * p_scale, v_cols,
+                     preferred_element_type=jnp.float32)
         acc_ref[h] = acc_ref[h] * alpha + pv
 
     def q_head(h):
@@ -100,6 +129,11 @@ def _kernel(
             out.append(pltpu.make_async_copy(
                 cache_ref.at[lyr, bid], kvbuf.at[slot, i], sems.at[slot, i]
             ))
+            if quant:  # the block's scale tile rides a second small DMA
+                out.append(pltpu.make_async_copy(
+                    scale_ref.at[lyr, bid], scbuf.at[slot, i],
+                    scsems.at[slot, i]
+                ))
         return out
 
     @pl.when(n_pref > 0)
@@ -120,6 +154,13 @@ def _kernel(
 
         kc = kvbuf[slot, :, 0].reshape(t, hk * d).astype(jnp.float32)
         vc = kvbuf[slot, :, 1].reshape(t, hk * d).astype(jnp.float32)
+        if quant:
+            # [C, Hk, Bs] tiles -> [Hk, T] by lane concat (token-minor
+            # scale layout exists exactly for this — no transpose)
+            sck = jnp.concatenate([scbuf[slot, i, 0] for i in range(c)],
+                                  axis=-1)
+            scv = jnp.concatenate([scbuf[slot, i, 1] for i in range(c)],
+                                  axis=-1)
         col = ci * t + jax.lax.broadcasted_iota(jnp.int32, (1, t), 1)
         allow = col < prefix                              # [1, T]
         for h in range(hk):  # static unroll over kv heads
@@ -127,8 +168,13 @@ def _kernel(
                 q_head(h), kc[:, h * d:(h + 1) * d],
                 (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
             )  # [TQ*G, T]
+            if quant:
+                # K's per-token scale multiplies score columns; V's folds
+                # into P inside flash_update's PV product via p_scale
+                s_ = s_ * sck[h:h + 1, :]
             s_ = jnp.where(allow, s_, NEG_INF)
-            flash_update(h, s_, vc[:, h * d:(h + 1) * d])
+            flash_update(h, s_, vc[:, h * d:(h + 1) * d],
+                         p_scale=scv[h:h + 1, :] if quant else None)
         return 0
 
     jax.lax.fori_loop(0, n_pref, pref_body, 0)
@@ -183,8 +229,12 @@ def paged_prefill_attention(
 ) -> jax.Array:
     """Flash prefill for S fresh tokens against fresh K/V + cached prefix.
     Returns [B, S, H, D]."""
+    from dynamo_tpu.ops.kv_quant import is_quant
+
+    quant = is_quant(cache)
+    data, scale = (cache.data, cache.scale) if quant else (cache, None)
     b, s, h, d = q.shape
-    l, n, _, bs, hkd = cache.shape
+    l, n, _, bs, hkd = data.shape
     hk = hkd // d
     g = h // hk
     m = block_tables.shape[1]
@@ -199,35 +249,20 @@ def paged_prefill_attention(
     k_in = k_new.reshape(b, s, hkd)
     v_in = v_new.reshape(b, s, hkd)
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
-        grid=(b, s // tq),
-        in_specs=[
-            pl.BlockSpec((1, tq, hk, g * d), lambda bi, ri, *_: (bi, ri, 0, 0)),
-            pl.BlockSpec((1, s, hkd), lambda bi, ri, *_: (bi, 0, 0)),
-            pl.BlockSpec((1, s, hkd), lambda bi, ri, *_: (bi, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),  # cache stays in HBM
-        ],
-        out_specs=pl.BlockSpec(
-            (1, tq, hk, g * d), lambda bi, ri, *_: (bi, ri, 0, 0)
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((hk, tq * g, d), jnp.float32),
-            pltpu.VMEM((hk, tq * g, 128), jnp.float32),
-            pltpu.VMEM((hk, tq * g, 128), jnp.float32),
-            pltpu.VMEM((2, c, 2, bs, hkd), cache.dtype),
-            pltpu.SemaphoreType.DMA((2, c)),
-        ],
-    )
-
-    out = pl.pallas_call(
-        functools.partial(
-            _kernel, c=c, tq=tq, hk=hk, g=g, d=d, sm_scale=float(sm_scale)
-        ),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, s, hk, g * d), q.dtype),
-        interpret=interpret,
-    )(
+    in_specs = [
+        pl.BlockSpec((1, tq, hk, g * d), lambda bi, ri, *_: (bi, ri, 0, 0)),
+        pl.BlockSpec((1, s, hkd), lambda bi, ri, *_: (bi, 0, 0)),
+        pl.BlockSpec((1, s, hkd), lambda bi, ri, *_: (bi, 0, 0)),
+        pl.BlockSpec(memory_space=pltpu.ANY),  # cache stays in HBM
+    ]
+    scratch = [
+        pltpu.VMEM((hk, tq * g, d), jnp.float32),
+        pltpu.VMEM((hk, tq * g, 128), jnp.float32),
+        pltpu.VMEM((hk, tq * g, 128), jnp.float32),
+        pltpu.VMEM((2, c, 2, bs, hkd), data.dtype),
+        pltpu.SemaphoreType.DMA((2, c)),
+    ]
+    operands = [
         seq_lens.astype(jnp.int32),
         start.astype(jnp.int32),
         block_tables.astype(jnp.int32),
@@ -235,6 +270,33 @@ def paged_prefill_attention(
         q_in,
         k_in,
         v_in,
-        cache,
+        data,
+    ]
+    if quant:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+        scratch += [
+            pltpu.VMEM((2, c, 2, hk, bs), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, c)),
+        ]
+        operands.append(scale)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b, s // tq),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, tq, hk, g * d), lambda bi, ri, *_: (bi, ri, 0, 0)
+        ),
+        scratch_shapes=scratch,
     )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel_quant if quant else _kernel,
+            c=c, tq=tq, hk=hk, g=g, d=d, sm_scale=float(sm_scale),
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, s, hk, g * d), q.dtype),
+        interpret=interpret,
+    )(*operands)
     return out.reshape(b, s, h, d)
