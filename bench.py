@@ -206,11 +206,11 @@ def main() -> None:
                               "auto" if on_accel else "none")
     if kv_quant == "auto":
         kv_quant = "int8" if _probe_kv_quant() else "none"
-    kv_scale_overhead = 1.03125  # per-token-per-head f32 scales at D=128
-    kv_bytes_elem = kv_scale_overhead if kv_quant == "int8" else 2.0
-
     def fit_bytes(cfg: dict, mlen: int) -> int:
         # ~1GB slack: activations, prefill buffers, XLA workspace
+        hd = cfg.get("head_dim", cfg["hidden_size"] // cfg["num_heads"])
+        # int8 payload + one f32 scale per token per kv head per k/v
+        kv_bytes_elem = (1.0 + 4.0 / hd) if kv_quant == "int8" else 2.0
         per_tok = int(_kv_bytes_per_token(cfg, 1) * kv_bytes_elem)
         return (_param_bytes(cfg, wbytes) + batch * mlen * per_tok
                 + (1 << 30))

@@ -97,7 +97,7 @@ def _build_local_engine(args) -> tuple[object, object]:
         block_size=args.block_size,
         num_blocks=args.num_blocks,
         cache_dtype=(
-            "int8" if getattr(args, "kv_cache_dtype", "auto") == "int8" else None
+            "int8" if getattr(args, "kv_cache_dtype", "model") == "int8" else None
         ),
     )
     core = EngineCore(
@@ -509,10 +509,11 @@ def _parser() -> argparse.ArgumentParser:
     run.add_argument("--model-name", default=None)
     run.add_argument("--dtype", default="bfloat16")
     run.add_argument("--max-batch-size", type=int, default=8)
-    run.add_argument("--kv-cache-dtype", choices=["auto", "int8"],
-                     default="auto",
-                     help="int8 = quantized KV cache (ops/kv_quant.py): "
-                     "half the KV HBM footprint and decode KV traffic")
+    run.add_argument("--kv-cache-dtype", choices=["model", "int8"],
+                     default="model",
+                     help="model = cache in the model dtype; int8 = "
+                     "quantized KV cache (ops/kv_quant.py): half the KV "
+                     "HBM footprint and decode KV traffic")
     run.add_argument("--quantize", choices=["none", "int8"], default="none",
                      help="int8 weight-only quantization (halves weight HBM)")
     run.add_argument("--tp", type=int, default=1, help="tensor-parallel size")

@@ -55,7 +55,7 @@ def unified_step(
     model, params, cache, tokens, positions, block_tables, seq_lens,
     slot_idx, last_idx, rng, temp, top_k, top_p, prefix_blocks=None,
     k_cand=K_MAX, exact=False, grammar=None, jrows=None, jstate=None,
-    jdepth=None, jstack=None,
+    jdepth=None, jstack=None, min_p=None, bias_tokens=None, bias_vals=None,
 ):
     """THE jitted serving step: forward over the paged cache, gather each
     row's last hidden state, project to logits, sample.  Shared by the
@@ -73,7 +73,9 @@ def unified_step(
     if grammar is not None:
         # JSON mode: mask invalid-next-token logits (engine/grammar.py)
         logits = grammar_mask(logits, grammar, jrows, jstate, jdepth, jstack)
-    out = sample_full(logits, rng, temp, top_k, top_p, k_cand=k_cand, exact=exact)
+    out = sample_full(logits, rng, temp, top_k, top_p,
+                      bias_tokens=bias_tokens, bias_vals=bias_vals,
+                      min_p=min_p, k_cand=k_cand, exact=exact)
     return out, cache
 
 
@@ -82,7 +84,8 @@ def multi_decode_step(
     limits, rng, temp, top_k, top_p,
     pen_tokens=None, pen_first=None, pen_cursor=None, freq_pen=None,
     pres_pen=None, grammar=None, jrows=None, jstate=None, jdepth=None,
-    jstack=None, *, num_steps: int, block_size: int,
+    jstack=None, min_p=None, bias_tokens=None, bias_vals=None,
+    *, num_steps: int, block_size: int,
     k_cand: int = K_MAX, exact: bool = False, use_penalties: bool = False,
 ):
     """K decode iterations fully on device in one dispatch (multi-step
@@ -135,6 +138,9 @@ def multi_decode_step(
             pfirst if use_penalties else None,
             freq_pen if use_penalties else None,
             pres_pen if use_penalties else None,
+            # bias/min_p are constant across the burst: closure capture,
+            # no scan carry needed
+            bias_tokens=bias_tokens, bias_vals=bias_vals, min_p=min_p,
             k_cand=k_cand, exact=exact,
         )
         # clamp the context length at the limit: past it no KV was written,
@@ -295,11 +301,14 @@ class EngineCore:
     # ----------------------------------------------------------- step kernel
     def _step_impl(self, params, cache, *args, prefix_blocks=None,
                    k_cand=K_MAX, exact=False, grammar=None, jrows=None,
-                   jstate=None, jdepth=None, jstack=None):
+                   jstate=None, jdepth=None, jstack=None, min_p=None,
+                   bias_tokens=None, bias_vals=None):
         return unified_step(self.model, params, cache, *args,
                             prefix_blocks=prefix_blocks, k_cand=k_cand,
                             exact=exact, grammar=grammar, jrows=jrows,
-                            jstate=jstate, jdepth=jdepth, jstack=jstack)
+                            jstate=jstate, jdepth=jdepth, jstack=jstack,
+                            min_p=min_p, bias_tokens=bias_tokens,
+                            bias_vals=bias_vals)
 
     def _sp_impl(self, params, tokens, positions, last_idx, rng, temp,
                  top_k, top_p, *, nb, k_cand=K_MAX, exact=False):
@@ -326,11 +335,13 @@ class EngineCore:
 
     def _multi_impl(self, params, cache, *args, num_steps=1, k_cand=K_MAX,
                     exact=False, use_penalties=False, grammar=None,
-                    jrows=None, jstate=None, jdepth=None, jstack=None):
+                    jrows=None, jstate=None, jdepth=None, jstack=None,
+                    min_p=None, bias_tokens=None, bias_vals=None):
         return multi_decode_step(
             self.model, params, cache, *args,
             grammar=grammar, jrows=jrows, jstate=jstate, jdepth=jdepth,
-            jstack=jstack,
+            jstack=jstack, min_p=min_p, bias_tokens=bias_tokens,
+            bias_vals=bias_vals,
             num_steps=num_steps,
             block_size=self.config.block_size,
             k_cand=k_cand, exact=exact, use_penalties=use_penalties,
@@ -377,6 +388,36 @@ class EngineCore:
             )
         return self._gdev
 
+    def _sampling_extras(self, reqs, rows=None) -> dict:
+        """min_p / logit_bias device kwargs for one dispatch, or {} when no
+        request uses them (the common case compiles no extra executables).
+
+        ``rows``: slot index per request for batch-shaped dispatches
+        (decode); None = requests are the dispatch rows in order (prefill).
+        """
+        kw = {}
+        b = self.config.max_batch_size if rows is not None else len(reqs)
+        at = (lambda i: rows[i]) if rows is not None else (lambda i: i)
+        if any(r.sampling.min_p > 0 for r in reqs):
+            mp = np.zeros(b, np.float32)
+            for i, r in enumerate(reqs):
+                mp[at(i)] = r.sampling.min_p
+            kw["min_p"] = jnp.asarray(mp)
+        if any(r.sampling.logit_bias for r in reqs):
+            longest = max(len(r.sampling.logit_bias or {}) for r in reqs)
+            nb = max(8, 1 << (longest - 1).bit_length())  # pow2 buckets
+            toks = np.full((b, nb), -1, np.int32)
+            vals = np.zeros((b, nb), np.float32)
+            for i, r in enumerate(reqs):
+                for j, (t, v) in enumerate(
+                    list((r.sampling.logit_bias or {}).items())[:nb]
+                ):
+                    toks[at(i), j] = int(t)
+                    vals[at(i), j] = float(v)
+            kw["bias_tokens"] = jnp.asarray(toks)
+            kw["bias_vals"] = jnp.asarray(vals)
+        return kw
+
     def _gram_kwargs(self, gram) -> dict:
         """Device kwargs for one dispatch's grammar state, or {}."""
         if gram is None:
@@ -404,10 +445,11 @@ class EngineCore:
 
     def _run_step(self, tokens, positions, block_tables, seq_lens, slot_idx,
                   last_idx, temp, top_k, top_p, prefix_blocks=None,
-                  k_cand=K_MAX, exact=False, gram=None):
+                  k_cand=K_MAX, exact=False, gram=None, extras=None):
         """Returns (sampled [B], logprob [B], cand_ids [B,C], cand_lps [B,C])."""
         self._rng, rng = jax.random.split(self._rng)
         gkw = self._gram_kwargs(gram)
+        gkw.update(extras or {})
         out, self.cache = self._step_fn(
             self.params, self.cache,
             jnp.asarray(tokens), jnp.asarray(positions),
@@ -422,7 +464,8 @@ class EngineCore:
 
     def _run_multi_decode_step(self, tokens, positions, block_tables, seq_lens,
                                limits, temp, top_k, top_p, pen=None, gram=None,
-                               num_steps=1, k_cand=K_MAX, exact=False):
+                               extras=None, num_steps=1, k_cand=K_MAX,
+                               exact=False):
         """Dispatch one multi-step decode; returns (sampled [K,B],
         logprob [K,B], cand_ids [K,B,C], cand_lps [K,B,C])."""
         self._rng, rng = jax.random.split(self._rng)
@@ -436,6 +479,7 @@ class EngineCore:
         if use_pen:
             args += [jnp.asarray(a) for a in pen]
         gkw = self._gram_kwargs(gram)
+        gkw.update(extras or {})
         out, self.cache = self._multi_fn(
             self.params, self.cache, *args,
             num_steps=num_steps, k_cand=k_cand, exact=exact,
@@ -748,6 +792,7 @@ class EngineCore:
             np.asarray([req.sampling.top_k], np.int32),
             np.asarray([req.sampling.top_p], np.float32),
             prefix_blocks=pb, k_cand=k_cand, exact=exact, gram=gram,
+            extras=self._sampling_extras([req]) if final else None,
         )
         self.prefill_steps += 1
         self.prompt_tokens_computed += take
@@ -955,7 +1000,9 @@ class EngineCore:
             gram = (jrows, jstate, jdepth, jstack)
         sampled, lps, cids, clps = self._run_multi_decode_step(
             tokens, positions, bt, seq_lens, limits, temp, top_k, top_p,
-            pen=pen, gram=gram, num_steps=k_steps, k_cand=k_cand, exact=exact,
+            pen=pen, gram=gram,
+            extras=self._sampling_extras(active, rows=[r.slot for r in active]),
+            num_steps=k_steps, k_cand=k_cand, exact=exact,
         )  # [K, B], [K, B], [K, B, C], [K, B, C]
         self.decode_steps += sampled.shape[0]
         for req in active:

@@ -62,6 +62,9 @@ def sample_full(
     pen_first: jax.Array | None = None,   # [B, T] bool
     freq_pen: jax.Array | None = None,    # [B] f32
     pres_pen: jax.Array | None = None,    # [B] f32
+    bias_tokens: jax.Array | None = None,  # [B, Nb] int32 (-1 pad)
+    bias_vals: jax.Array | None = None,    # [B, Nb] f32
+    min_p: jax.Array | None = None,        # [B] f32; 0 → disabled
     *,
     k_cand: int = K_MAX,
     exact: bool = False,
@@ -72,6 +75,17 @@ def sample_full(
     b, v = logits.shape
     k_cand = min(k_cand, v)
 
+    if bias_tokens is not None:
+        # OpenAI logit_bias: sparse per-request additive bias, scatter-added
+        # BEFORE candidate selection so a +100 bias can promote any token
+        rows = jnp.broadcast_to(
+            jnp.arange(b, dtype=jnp.int32)[:, None], bias_tokens.shape
+        )
+        valid = bias_tokens >= 0
+        tok = jnp.where(valid, bias_tokens, 0)
+        logits = logits.at[rows.reshape(-1), tok.reshape(-1)].add(
+            jnp.where(valid, bias_vals, 0.0).reshape(-1), mode="drop"
+        )
     if pen_tokens is not None:
         logits = _apply_penalties(logits, pen_tokens, pen_first, freq_pen, pres_pen)
 
@@ -100,6 +114,12 @@ def sample_full(
     probs = jax.nn.softmax(jnp.where(keep, scaled, -jnp.inf), axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     keep = keep & ((cum - probs) < top_p[:, None])
+
+    if min_p is not None:
+        # min-p (vLLM extension, ref protocols/common.rs:293): drop
+        # candidates whose probability is below min_p * max_prob.  The
+        # first (max) candidate always survives.
+        keep = keep & (probs >= min_p[:, None] * probs[:, :1])
 
     masked = jnp.where(keep, scaled, -jnp.inf)
     gumbel = jax.random.gumbel(rng, (b, k_cand), dtype=jnp.float32)

@@ -110,7 +110,22 @@ def parse_request(body: dict, chat: bool) -> ParsedRequest:
     temperature = body.get("temperature")
     top_p = body.get("top_p")
     top_k = body.get("top_k")  # extension (vLLM-compatible)
+    try:  # extension (vLLM-compatible)
+        min_p = float(body.get("min_p") or 0.0)
+    except (TypeError, ValueError):
+        raise OpenAIError("'min_p' must be a number")
+    _require(0.0 <= min_p <= 1.0, "'min_p' must be in [0, 1]")
     seed = body.get("seed")
+    logit_bias = body.get("logit_bias")
+    if logit_bias is not None:
+        _require(isinstance(logit_bias, dict), "'logit_bias' must be an object")
+        _require(len(logit_bias) <= 300, "'logit_bias' supports at most 300 tokens")
+        try:
+            logit_bias = {int(k): float(v) for k, v in logit_bias.items()}
+        except (TypeError, ValueError):
+            raise OpenAIError("'logit_bias' keys must be token ids, values numbers")
+        _require(all(-100.0 <= v <= 100.0 for v in logit_bias.values()),
+                 "'logit_bias' values must be in [-100, 100]")
     freq_pen = float(body.get("frequency_penalty") or 0.0)
     pres_pen = float(body.get("presence_penalty") or 0.0)
     _require(-2.0 <= freq_pen <= 2.0, "'frequency_penalty' must be in [-2, 2]")
@@ -130,11 +145,11 @@ def parse_request(body: dict, chat: bool) -> ParsedRequest:
         top_lp = int(lp) if isinstance(lp, int) and not isinstance(lp, bool) else 0
         _require(0 <= top_lp <= 20, "'logprobs' must be in [0, 20]")
 
-    # response_format (chat mode): json_object / json_schema switch the
-    # engine to grammar-constrained decoding (engine/grammar.py)
+    # response_format: json_object / json_schema switch the engine to
+    # grammar-constrained decoding (engine/grammar.py).  json_object is
+    # endpoint-agnostic; json_schema needs a chat transcript to inject the
+    # schema instruction into, so it is chat-only.
     rf = body.get("response_format")
-    _require(rf is None or chat,
-             "'response_format' is only supported on chat completions")
     if rf is not None:
         _require(isinstance(rf, dict) and "type" in rf,
                  "'response_format' must be an object with a 'type'")
@@ -142,6 +157,9 @@ def parse_request(body: dict, chat: bool) -> ParsedRequest:
         _require(rft in ("text", "json_object", "json_schema"),
                  "'response_format.type' must be 'text', 'json_object' or "
                  "'json_schema'")
+        _require(rft != "json_schema" or chat,
+                 "'json_schema' response_format is only supported on chat "
+                 "completions")
         if rft == "json_schema":
             js = rf.get("json_schema")
             _require(isinstance(js, dict) and isinstance(js.get("schema"), dict),
@@ -155,6 +173,8 @@ def parse_request(body: dict, chat: bool) -> ParsedRequest:
         temperature=1.0 if temperature is None else float(temperature),
         top_p=1.0 if top_p is None else float(top_p),
         top_k=0 if top_k is None else int(top_k),
+        min_p=min_p,
+        logit_bias=logit_bias or None,
         seed=seed,
         frequency_penalty=freq_pen,
         presence_penalty=pres_pen,

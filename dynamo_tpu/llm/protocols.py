@@ -37,6 +37,11 @@ class SamplingOptions:
     temperature: float = 1.0
     top_k: int = 0          # 0 = disabled
     top_p: float = 1.0
+    # min-p nucleus floor (vLLM extension; ref protocols/common.rs:293):
+    # drop candidates with prob < min_p * max_prob.  0 = disabled
+    min_p: float = 0.0
+    # OpenAI logit_bias: token id -> additive bias in [-100, 100]
+    logit_bias: Optional[dict[int, float]] = None
     seed: Optional[int] = None
     # OpenAI penalties over generated tokens (engine/sampling.py applies
     # them by scatter-add on device; vLLM-compatible semantics)

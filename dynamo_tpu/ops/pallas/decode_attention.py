@@ -245,7 +245,7 @@ def paged_decode_attention(
 
     in_specs = [
         pl.BlockSpec((g, h, hkd), lambda i, *_: (i, 0, 0)),
-        pl.BlockSpec(memory_space=pltpu.ANY),  # cache stays in HBM
+        pl.BlockSpec(memory_space=pl.ANY),  # cache stays in HBM
     ]
     scratch = [
         pltpu.VMEM((g, h, hkd), jnp.float32),
@@ -262,7 +262,7 @@ def paged_decode_attention(
         data,
     ]
     if quant:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))  # scales in HBM
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))  # scales in HBM
         scratch += [
             pltpu.VMEM((2, g, c, 2, hk, bs), jnp.float32),
             pltpu.SemaphoreType.DMA((2, g, c)),

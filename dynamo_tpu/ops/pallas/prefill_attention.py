@@ -253,7 +253,7 @@ def paged_prefill_attention(
         pl.BlockSpec((1, tq, hk, g * d), lambda bi, ri, *_: (bi, ri, 0, 0)),
         pl.BlockSpec((1, s, hkd), lambda bi, ri, *_: (bi, 0, 0)),
         pl.BlockSpec((1, s, hkd), lambda bi, ri, *_: (bi, 0, 0)),
-        pl.BlockSpec(memory_space=pltpu.ANY),  # cache stays in HBM
+        pl.BlockSpec(memory_space=pl.ANY),  # cache stays in HBM
     ]
     scratch = [
         pltpu.VMEM((hk, tq * g, d), jnp.float32),
@@ -273,7 +273,7 @@ def paged_prefill_attention(
         data,
     ]
     if quant:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
         scratch += [
             pltpu.VMEM((2, c, 2, hk, bs), jnp.float32),
             pltpu.SemaphoreType.DMA((2, c)),

@@ -294,3 +294,21 @@ def test_parse_request_response_format():
     with _pytest.raises(OpenAIError):
         parse_request({**base, "response_format": {"type": "json_schema"}},
                       chat=True)
+
+
+def test_parse_request_response_format_completions():
+    from dynamo_tpu.llm.openai import OpenAIError, parse_request
+
+    base = {"model": "m", "prompt": "say json"}
+    # json_object is endpoint-agnostic
+    req = parse_request({**base, "response_format": {"type": "json_object"}},
+                        chat=False)
+    assert req.sampling.json_mode
+    # json_schema needs a chat transcript for schema injection
+    import pytest as _pytest
+    with _pytest.raises(OpenAIError):
+        parse_request(
+            {**base, "response_format": {
+                "type": "json_schema",
+                "json_schema": {"name": "x", "schema": {}}}},
+            chat=False)
