@@ -212,13 +212,6 @@ class EngineCore:
                     "enable_prefix_reuse=True (blocks are keyed by prefix hash)",
                     config.num_host_blocks,
                 )
-            elif self.cache_quant:
-                # the host pool stores one ndarray per block; the quantized
-                # cache's (data, scale) pair is not plumbed through it yet
-                log.warning(
-                    "num_host_blocks=%d ignored: host offload does not yet "
-                    "support the int8 KV cache", config.num_host_blocks,
-                )
             else:
                 from dynamo_tpu.llm.kv.host_pool import HostKvPool
 
@@ -258,11 +251,7 @@ class EngineCore:
         # axis): one dispatch computes the whole prompt with the sequence
         # sharded across the mesh — SURVEY §5 long-context path
         self._sp_size = 0
-        if self.cache_quant and config.sp_prefill_threshold > 0 and mesh is not None:
-            # SP prefill produces bf16 blocks that scatter straight into the
-            # cache; quantize-on-scatter isn't wired yet
-            log.warning("sp_prefill_threshold ignored with the int8 KV cache")
-        elif (
+        if (
             mesh is not None
             and config.sp_prefill_threshold > 0
             and "data" in mesh.axis_names
@@ -315,9 +304,8 @@ class EngineCore:
         """Sequence-parallel prefill: ring attention over mesh["data"],
         then sample the first token and lay the fresh KV out as cache
         blocks [L, nb, 2, Bs, HkD] (sharded like the pool, so the
-        follow-up scatter is a resident-layout write)."""
-        from jax.sharding import NamedSharding
-
+        follow-up scatter is a resident-layout write).  With the int8
+        cache the blocks are quantized here, in the same dispatch."""
         hidden, kv = self.model.forward_seq_parallel(
             params, tokens, positions, self.mesh, sp_axis="data"
         )
@@ -328,8 +316,19 @@ class EngineCore:
         l, _, b, s, hkd = kv.shape
         bs = self.config.block_size
         blocks = kv[:, :, 0].reshape(l, 2, nb, bs, hkd).transpose(0, 2, 1, 3, 4)
+        if self.cache_quant:
+            from dynamo_tpu.ops.kv_quant import QuantKvCache, quantize_kv_rows
+
+            hk = self.model.config.num_kv_heads
+            q8, sc = quantize_kv_rows(
+                blocks.reshape(l, nb, 2, bs, hk, hkd // hk)
+            )  # int8 [..., Bs, Hk, D], scale f32 [..., Bs, Hk]
+            blocks = QuantKvCache(
+                q8.reshape(l, nb, 2, bs, hkd),
+                jnp.swapaxes(sc, -1, -2),  # token-minor [L, nb, 2, Hk, Bs]
+            )
         blocks = jax.lax.with_sharding_constraint(
-            blocks, NamedSharding(self.mesh, self.model.cache_spec())
+            blocks, self._cache_sharding()
         )
         return out, blocks
 
@@ -891,7 +890,8 @@ class EngineCore:
         )
         nb = -(-req.prompt_len // bs)
         self.cache = scatter_blocks_inplace(
-            self.cache, req.block_ids[:nb], blocks[:, :nb]
+            self.cache, req.block_ids[:nb],
+            jax.tree.map(lambda a: a[:, :nb], blocks),
         )
         self.steps += 1
         self.prefill_steps += 1
@@ -1172,8 +1172,10 @@ class EngineCore:
             return
         bids = [b for b, _ in fresh]
         hashes = [h for _, h in fresh]
-        arr = self.gather_blocks_np(bids)        # [L, n, 2, Bs, HkD]
-        self.host_pool.store(hashes, np.moveaxis(arr, 1, 0))
+        arr = self.gather_blocks_np(bids)        # [L, n, 2, Bs, HkD] (pytree)
+        self.host_pool.store(
+            hashes, jax.tree.map(lambda a: np.moveaxis(a, 1, 0), arr)
+        )
 
     def _restore_from_host(self, req: EngineRequest) -> None:
         """Upload host-resident prefix blocks into the request's fresh
@@ -1188,9 +1190,11 @@ class EngineCore:
         )
         if not hit:
             return
-        blocks = self.host_pool.gather(hit)      # [n, L, 2, Bs, HkD]
+        blocks = self.host_pool.gather(hit)      # [n, L, 2, Bs, HkD] (pytree)
         target = req.block_ids[dev : dev + len(hit)]
-        self.scatter_external(target, np.moveaxis(blocks, 0, 1))
+        self.scatter_external(
+            target, jax.tree.map(lambda a: np.moveaxis(a, 0, 1), blocks)
+        )
         for i in range(len(hit)):
             blk = req.seq.blocks[dev + i]
             self.block_manager.commit(

@@ -38,7 +38,11 @@ class HostKvPool:
         if num_blocks <= 0:
             raise ValueError("num_blocks must be positive")
         self.num_blocks = num_blocks
-        self._arr: Optional[np.ndarray] = None  # [H, ...block shape...]
+        # one pool array per block part: [arr] for the bf16 cache,
+        # [data, scale] for the quantized cache (ops/kv_quant.py) — the
+        # pool is structure-generic, mirroring whatever the engine gathers
+        self._arrs: Optional[list[np.ndarray]] = None
+        self._multi = False  # incoming blocks were a tuple (restore shape)
         self._free: deque[int] = deque(range(num_blocks))
         self._lru: "OrderedDict[int, None]" = OrderedDict()  # hid -> (order)
         self._hash_of: list[Optional[int]] = [None] * num_blocks
@@ -55,22 +59,36 @@ class HostKvPool:
 
     @property
     def block_nbytes(self) -> int:
-        if self._arr is None:
+        if self._arrs is None:
             return 0
-        return self._arr[0].nbytes
+        return sum(a[0].nbytes for a in self._arrs)
 
     def __contains__(self, seq_hash: int) -> bool:
         return seq_hash in self._table
 
     # ------------------------------------------------------------------ store
-    def _ensure_arr(self, block_shape: tuple[int, ...], dtype) -> None:
-        if self._arr is None:
-            self._arr = np.empty((self.num_blocks,) + block_shape, dtype=dtype)
-        elif self._arr.shape[1:] != block_shape or self._arr.dtype != dtype:
+    def _parts(self, blocks) -> list[np.ndarray]:
+        return list(blocks) if isinstance(blocks, (tuple, list)) else [blocks]
+
+    def _ensure_arrs(self, parts: list[np.ndarray], multi: bool) -> None:
+        if self._arrs is None:
+            self._multi = multi
+            self._arrs = [
+                np.empty((self.num_blocks,) + p.shape[1:], dtype=p.dtype)
+                for p in parts
+            ]
+            return
+        if len(parts) != len(self._arrs):
             raise ValueError(
-                f"block shape changed: pool {self._arr.shape[1:]}/{self._arr.dtype}"
-                f" vs incoming {block_shape}/{dtype}"
+                f"block structure changed: pool has {len(self._arrs)} parts,"
+                f" incoming {len(parts)}"
             )
+        for a, p in zip(self._arrs, parts):
+            if a.shape[1:] != p.shape[1:] or a.dtype != p.dtype:
+                raise ValueError(
+                    f"block shape changed: pool {a.shape[1:]}/{a.dtype}"
+                    f" vs incoming {p.shape[1:]}/{p.dtype}"
+                )
 
     def _alloc(self) -> int:
         if self._free:
@@ -83,15 +101,19 @@ class HostKvPool:
             self.evicted_blocks += 1
         return hid
 
-    def store(self, seq_hashes: Sequence[int], blocks: np.ndarray) -> int:
-        """Offload blocks (block-major: blocks[i] belongs to seq_hashes[i]).
+    def store(self, seq_hashes: Sequence[int], blocks) -> int:
+        """Offload blocks (block-major: blocks[i] belongs to seq_hashes[i];
+        a tuple of block-major arrays for the quantized cache).
 
         Already-resident hashes are refreshed in LRU order but not
         re-copied.  Returns how many new blocks were written.
         """
-        if len(seq_hashes) != len(blocks):
-            raise ValueError(f"{len(seq_hashes)} hashes vs {len(blocks)} blocks")
-        self._ensure_arr(blocks.shape[1:], blocks.dtype)
+        parts = self._parts(blocks)
+        if any(len(seq_hashes) != len(p) for p in parts):
+            raise ValueError(
+                f"{len(seq_hashes)} hashes vs {[len(p) for p in parts]} blocks"
+            )
+        self._ensure_arrs(parts, isinstance(blocks, (tuple, list)))
         new_ids: list[int] = []
         new_rows: list[int] = []
         for i, h in enumerate(seq_hashes):
@@ -106,8 +128,9 @@ class HostKvPool:
             new_ids.append(hid)
             new_rows.append(i)
         if new_ids:
-            # fancy indexing already yields a fresh contiguous array
-            native.blocks_scatter(self._arr, new_ids, blocks[new_rows])
+            for arr, p in zip(self._arrs, parts):
+                # fancy indexing already yields a fresh contiguous array
+                native.blocks_scatter(arr, new_ids, p[new_rows])
             self.stored_blocks += len(new_ids)
         return len(new_ids)
 
@@ -129,8 +152,9 @@ class HostKvPool:
             out.append(h)
         return out
 
-    def gather(self, seq_hashes: Sequence[int]) -> np.ndarray:
-        """Fetch resident blocks (block-major) for upload back to device."""
+    def gather(self, seq_hashes: Sequence[int]):
+        """Fetch resident blocks (block-major) for upload back to device.
+        Returns the same structure ``store`` received (array or tuple)."""
         hids = []
         for h in seq_hashes:
             hid = self._table.get(h)
@@ -139,7 +163,8 @@ class HostKvPool:
             self._lru.move_to_end(hid)
             hids.append(hid)
         self.restored_blocks += len(hids)
-        return native.blocks_gather(self._arr, hids)
+        out = [native.blocks_gather(a, hids) for a in self._arrs]
+        return tuple(out) if self._multi else out[0]
 
     def clear(self) -> None:
         self._table.clear()
