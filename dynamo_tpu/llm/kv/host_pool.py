@@ -38,11 +38,12 @@ class HostKvPool:
         if num_blocks <= 0:
             raise ValueError("num_blocks must be positive")
         self.num_blocks = num_blocks
-        # one pool array per block part: [arr] for the bf16 cache,
+        # one pool array per block-pytree leaf: [arr] for the bf16 cache,
         # [data, scale] for the quantized cache (ops/kv_quant.py) — the
-        # pool is structure-generic, mirroring whatever the engine gathers
+        # pool is structure-generic; the treedef captured at first store
+        # lets gather() return exactly the structure store() received
         self._arrs: Optional[list[np.ndarray]] = None
-        self._multi = False  # incoming blocks were a tuple (restore shape)
+        self._treedef = None
         self._free: deque[int] = deque(range(num_blocks))
         self._lru: "OrderedDict[int, None]" = OrderedDict()  # hid -> (order)
         self._hash_of: list[Optional[int]] = [None] * num_blocks
@@ -67,21 +68,18 @@ class HostKvPool:
         return seq_hash in self._table
 
     # ------------------------------------------------------------------ store
-    def _parts(self, blocks) -> list[np.ndarray]:
-        return list(blocks) if isinstance(blocks, (tuple, list)) else [blocks]
-
-    def _ensure_arrs(self, parts: list[np.ndarray], multi: bool) -> None:
+    def _ensure_arrs(self, parts: list[np.ndarray], treedef) -> None:
         if self._arrs is None:
-            self._multi = multi
+            self._treedef = treedef
             self._arrs = [
                 np.empty((self.num_blocks,) + p.shape[1:], dtype=p.dtype)
                 for p in parts
             ]
             return
-        if len(parts) != len(self._arrs):
+        if treedef != self._treedef:
             raise ValueError(
-                f"block structure changed: pool has {len(self._arrs)} parts,"
-                f" incoming {len(parts)}"
+                f"block structure changed: pool holds {self._treedef},"
+                f" incoming {treedef}"
             )
         for a, p in zip(self._arrs, parts):
             if a.shape[1:] != p.shape[1:] or a.dtype != p.dtype:
@@ -108,12 +106,14 @@ class HostKvPool:
         Already-resident hashes are refreshed in LRU order but not
         re-copied.  Returns how many new blocks were written.
         """
-        parts = self._parts(blocks)
+        import jax
+
+        parts, treedef = jax.tree.flatten(blocks)
         if any(len(seq_hashes) != len(p) for p in parts):
             raise ValueError(
                 f"{len(seq_hashes)} hashes vs {[len(p) for p in parts]} blocks"
             )
-        self._ensure_arrs(parts, isinstance(blocks, (tuple, list)))
+        self._ensure_arrs(parts, treedef)
         new_ids: list[int] = []
         new_rows: list[int] = []
         for i, h in enumerate(seq_hashes):
@@ -153,8 +153,8 @@ class HostKvPool:
         return out
 
     def gather(self, seq_hashes: Sequence[int]):
-        """Fetch resident blocks (block-major) for upload back to device.
-        Returns the same structure ``store`` received (array or tuple)."""
+        """Fetch resident blocks (block-major) for upload back to device,
+        in exactly the pytree structure ``store`` received."""
         hids = []
         for h in seq_hashes:
             hid = self._table.get(h)
@@ -162,9 +162,11 @@ class HostKvPool:
                 raise KeyError(f"block {h:#x} not resident in host pool")
             self._lru.move_to_end(hid)
             hids.append(hid)
+        import jax
+
         self.restored_blocks += len(hids)
         out = [native.blocks_gather(a, hids) for a in self._arrs]
-        return tuple(out) if self._multi else out[0]
+        return jax.tree.unflatten(self._treedef, out)
 
     def clear(self) -> None:
         self._table.clear()

@@ -9,22 +9,34 @@ reservation left dangling, and identical-greedy requests that ran to
 completion agree on their tokens.
 """
 
+import json
+
 import jax
 import numpy as np
 import pytest
 
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.core import EngineCore
+from dynamo_tpu.engine.grammar import JsonGrammar
 from dynamo_tpu.engine.request import EngineRequest
 from dynamo_tpu.llm.protocols import SamplingOptions, StopConditions
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.models.llama import LlamaModel
 
 BS = 16
+EOS = 2
 
 
-@pytest.mark.parametrize("seed", [0, 7])
-def test_engine_soak_invariants(seed):
+def _soak_grammar(vocab_size):
+    """JSON grammar over a byte-per-token vocab slice (ids 3..258)."""
+    toks: list = [None] * vocab_size
+    for b in range(min(256, vocab_size - 3)):  # ASCII covers all JSON chars
+        toks[3 + b] = bytes([b])
+    return toks, JsonGrammar.from_token_bytes(toks, eos_ids=[EOS])
+
+
+@pytest.mark.parametrize("seed,cache_dtype", [(0, None), (7, None), (3, "int8")])
+def test_engine_soak_invariants(seed, cache_dtype):
     cfg = ModelConfig.tiny()
     model = LlamaModel(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
@@ -36,8 +48,11 @@ def test_engine_soak_invariants(seed):
         decode_steps=4,
         prefill_chunk_tokens=32,
         enable_prefix_reuse=True,
+        cache_dtype=cache_dtype,
     )
-    engine = EngineCore(model, params, ecfg, eos_token_ids=[])
+    vocab_toks, grammar = _soak_grammar(cfg.vocab_size)
+    engine = EngineCore(model, params, ecfg, eos_token_ids=[EOS],
+                        grammar=grammar)
     rng = np.random.default_rng(seed)
 
     shared_prefix = list(rng.integers(1, 200, size=48))
@@ -46,13 +61,17 @@ def test_engine_soak_invariants(seed):
 
     duplicates: list[str] = []
 
+    json_rids: list[str] = []
+
     def submit(i):
-        kind = rng.integers(0, 3)
-        if kind == 0:
-            prompt = list(rng.integers(1, 200, size=int(rng.integers(5, 120))))
+        kind = rng.integers(0, 4)
+        if kind == 0 or kind == 3:
+            # fresh random prompt (JSON-mode requests too: grammar masking
+            # must churn against varied prefill lengths, not one prompt)
+            prompt = list(rng.integers(3, 200, size=int(rng.integers(5, 120))))
         elif kind == 1:  # shared prefix → dedupe/reuse paths
             prompt = shared_prefix + list(
-                rng.integers(1, 200, size=int(rng.integers(1, 40)))
+                rng.integers(3, 200, size=int(rng.integers(1, 40)))
             )
         else:            # exact duplicate prompt → one prefill, same tokens
             prompt = list(shared_prefix) + [7, 8, 9]
@@ -66,12 +85,25 @@ def test_engine_soak_invariants(seed):
             if out.finish_reason is not None:
                 finished[rid] = out.finish_reason.value
 
-        engine.submit(EngineRequest(
-            request_id=rid, prompt=prompt,
-            sampling=SamplingOptions(temperature=0.0),
-            stops=StopConditions(
+        if kind == 3:
+            # JSON mode rides the same batch: grammar-masked sampling plus
+            # random min_p/logit_bias interactions
+            json_rids.append(rid)
+            sampling = SamplingOptions(temperature=1.0, json_mode=True,
+                                       min_p=float(rng.choice([0.0, 0.05])))
+            stops = StopConditions(max_tokens=int(rng.integers(4, 24)))
+        else:
+            bias = None
+            # duplicates must stay bias-free: the invariant check relies
+            # on identical greedy sampling for identical prompts
+            if kind != 2 and rng.random() < 0.3:
+                bias = {int(rng.integers(3, 200)): float(rng.integers(-5, 6))}
+            sampling = SamplingOptions(temperature=0.0, logit_bias=bias)
+            stops = StopConditions(
                 max_tokens=int(rng.integers(1, 12)), ignore_eos=True
-            ),
+            )
+        engine.submit(EngineRequest(
+            request_id=rid, prompt=prompt, sampling=sampling, stops=stops,
             emit=emit,
         ))
         return rid
@@ -117,6 +149,31 @@ def test_engine_soak_invariants(seed):
     )
     for a, b in zip(dup_outs, dup_outs[1:]):
         assert b[: len(a)] == a, "duplicate prompts diverged under greedy"
+    # Every JSON-mode token sequence must replay inside the grammar —
+    # whatever finish reason — and EOS-completed ones must parse.  The
+    # replay check is never vacuous: it runs for every non-cancelled
+    # JSON request.
+    from dynamo_tpu.engine.grammar import INIT_STATE
+
+    replayed = 0
+    tb = grammar.tables
+    for r in json_rids:
+        if finished.get(r) == "cancelled":
+            continue
+        st, d, stk = INIT_STATE, 0, 0
+        for t in outs[r]:
+            if t == EOS:
+                break
+            assert tb.valid_mask(st, d, stk)[t], (
+                f"{r}: token {t} escaped the grammar mask"
+            )
+            st, d, stk = tb.advance(st, d, stk, t)
+        replayed += 1
+        if finished.get(r) == "eos":
+            raw = b"".join(vocab_toks[t] for t in outs[r]
+                           if t != EOS and vocab_toks[t])
+            json.loads(raw.decode("utf-8", errors="replace"))
+    assert not json_rids or replayed > 0
 
 
 def test_abort_of_queued_request_is_honored():
