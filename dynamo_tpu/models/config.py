@@ -16,12 +16,14 @@ import jax.numpy as jnp
 
 # Llama-family architectures the unified decoder serves (reference parity:
 # vLLM's model zoo; these cover the reference's example deployments —
-# Llama/R1-Distill, Mistral, Mixtral MoE, Qwen).
+# Llama/R1-Distill, Mistral, Mixtral MoE, Qwen, Gemma).
 SUPPORTED_ARCHITECTURES = {
     "LlamaForCausalLM",
     "MistralForCausalLM",
     "MixtralForCausalLM",
     "Qwen2ForCausalLM",
+    "GemmaForCausalLM",
+    "Gemma2ForCausalLM",
 }
 
 
@@ -46,6 +48,21 @@ class ModelConfig:
     # MoE (Mixtral-style); num_experts == 0 → dense MLP
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # --- Gemma-family deltas (all default to the Llama behavior) ---
+    # MLP activation on the gate branch: "silu" (Llama) or "gelu_tanh"
+    # (Gemma GeGLU)
+    hidden_activation: str = "silu"
+    # RMSNorm multiplies by (1 + weight): Gemma stores zero-centred scales
+    rmsnorm_unit_offset: bool = False
+    # multiply embeddings by sqrt(hidden_size) after lookup
+    scale_embeddings: bool = False
+    # Gemma2 sandwich norms: extra post-attention / post-MLP RMSNorms
+    post_norms: bool = False
+    # attention sm_scale = query_pre_attn_scalar**-0.5 (None = head_dim)
+    query_pre_attn_scalar: Optional[float] = None
+    # tanh softcaps: scores (Gemma2 attn_logit_softcapping) and final logits
+    attn_logit_softcap: Optional[float] = None
+    final_logit_softcap: Optional[float] = None
     # runtime
     dtype: str = "bfloat16"
 
@@ -94,6 +111,36 @@ class ModelConfig:
                 f"unsupported architecture {arch!r}; supported: "
                 f"{sorted(SUPPORTED_ARCHITECTURES)}"
             )
+        gemma = arch in ("GemmaForCausalLM", "Gemma2ForCausalLM")
+        act = cfg.get("hidden_activation") or cfg.get("hidden_act") or "silu"
+        # original Gemma-1 configs say "gelu" but the canonical weights were
+        # trained with tanh-approx GELU (transformers maps it the same way);
+        # unknown activations must fail loudly, not silently run SiLU
+        act_map = {
+            "silu": "silu",
+            "gelu": "gelu_tanh",
+            "gelu_pytorch_tanh": "gelu_tanh",
+            "gelu_tanh": "gelu_tanh",
+        }
+        if act not in act_map:
+            raise ValueError(
+                f"unsupported hidden activation {act!r} for {arch}; "
+                f"supported: {sorted(act_map)}"
+            )
+        if arch == "Gemma2ForCausalLM" and cfg.get("sliding_window") and (
+            cfg.get("sliding_window") < cfg.get("max_position_embeddings", 0)
+        ):
+            import logging
+
+            # interleaved local attention is served as full attention (a
+            # superset): exact for contexts up to the window, divergent
+            # beyond it on the local-attention layers
+            logging.getLogger("dynamo_tpu.models").warning(
+                "Gemma2 sliding_window=%d < max_position_embeddings=%d: "
+                "local-attention layers run full attention — outputs match "
+                "HF only for contexts within the window",
+                cfg["sliding_window"], cfg.get("max_position_embeddings", 0),
+            )
         return cls(
             vocab_size=cfg["vocab_size"],
             hidden_size=cfg["hidden_size"],
@@ -105,12 +152,20 @@ class ModelConfig:
             rope_theta=cfg.get("rope_theta", 10000.0),
             rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
             max_position_embeddings=cfg.get("max_position_embeddings", 4096),
-            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            # HF Gemma checkpoints tie embeddings and omit the flag
+            tie_word_embeddings=cfg.get("tie_word_embeddings", gemma),
             # HF Qwen2 attention always carries QKV bias; Llama exposes an
             # explicit attention_bias flag (default False)
             attention_bias=cfg.get("attention_bias", arch == "Qwen2ForCausalLM"),
             sliding_window=cfg.get("sliding_window"),
             num_experts=cfg.get("num_local_experts", 0),
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
+            hidden_activation=act_map[act],
+            rmsnorm_unit_offset=gemma,
+            scale_embeddings=gemma,
+            post_norms=arch == "Gemma2ForCausalLM",
+            query_pre_attn_scalar=cfg.get("query_pre_attn_scalar"),
+            attn_logit_softcap=cfg.get("attn_logit_softcapping"),
+            final_logit_softcap=cfg.get("final_logit_softcapping"),
             dtype=dtype,
         )

@@ -45,16 +45,21 @@ from dynamo_tpu.models.quant import (
 from dynamo_tpu.ops.paged_attention import (
     paged_attention_layer,
     prefill_attention,
+    softcap,
     write_kv_cache_layer,
 )
 
 Params = Any  # pytree of jax.Array
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float,
+             unit_offset: bool = False) -> jax.Array:
     xf = x.astype(jnp.float32)
     norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
-    return (norm * weight.astype(jnp.float32)).astype(x.dtype)
+    w = weight.astype(jnp.float32)
+    if unit_offset:  # Gemma stores zero-centred scales: multiply by (1 + w)
+        w = w + 1.0
+    return (norm * w).astype(x.dtype)
 
 
 def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -75,6 +80,10 @@ class LlamaModel:
 
     def __init__(self, config: ModelConfig):
         self.config = config
+        # Gemma2 scales scores by query_pre_attn_scalar**-0.5, not head_dim
+        self.sm_scale = float(
+            (config.query_pre_attn_scalar or config.head_dim) ** -0.5
+        )
 
     # ------------------------------------------------------------------ init
     def init_params(self, rng: jax.Array, quantized: bool = False) -> Params:
@@ -99,14 +108,21 @@ class LlamaModel:
                 return random_qtensor(key, shape, fan_in, axes)
             return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(dt)
 
+        # Gemma's (1 + w) RMSNorm wants zero-init scales; Llama wants ones
+        norm_init = jnp.zeros if cfg.rmsnorm_unit_offset else jnp.ones
         layers: dict[str, jax.Array] = {
-            "attn_norm": jnp.ones((L, dm), dt),
+            "attn_norm": norm_init((L, dm), dt),
             "wq": dense(next(keys), (L, dm, hq * dh), dm),
             "wk": dense(next(keys), (L, dm, hk * dh), dm),
             "wv": dense(next(keys), (L, dm, hk * dh), dm),
             "wo": dense(next(keys), (L, hq * dh, dm), hq * dh),
-            "mlp_norm": jnp.ones((L, dm), dt),
+            "mlp_norm": norm_init((L, dm), dt),
         }
+        if cfg.post_norms:  # Gemma2 sandwich norms
+            layers.update(
+                post_attn_norm=norm_init((L, dm), dt),
+                post_mlp_norm=norm_init((L, dm), dt),
+            )
         if cfg.attention_bias:  # Qwen2-style QKV bias
             layers.update(
                 bq=jnp.zeros((L, hq * dh), dt),
@@ -137,7 +153,7 @@ class LlamaModel:
             # per-row scales so the same tensor serves lookup + tied lm_head
             "embed": dense(next(keys), (cfg.vocab_size, dm), dm, channel_axes=(0,)),
             "layers": layers,
-            "final_norm": jnp.ones((dm,), dt),
+            "final_norm": norm_init((dm,), dt),
         }
         if not cfg.tie_word_embeddings:
             params["lm_head"] = dense(next(keys), (dm, cfg.vocab_size), dm)
@@ -167,6 +183,10 @@ class LlamaModel:
         if cfg.attention_bias:
             layers.update(
                 bq=P(None, "model"), bk=P(None, "model"), bv=P(None, "model")
+            )
+        if cfg.post_norms:
+            layers.update(
+                post_attn_norm=P(None, None), post_mlp_norm=P(None, None)
             )
         if cfg.is_moe:
             layers.update(
@@ -267,15 +287,21 @@ class LlamaModel:
         fast_prefill = prefix_blocks is not None and s > 1
 
         hidden = take_rows(params["embed"], tokens, cfg.jax_dtype)
+        if cfg.scale_embeddings:  # Gemma multiplies by sqrt(hidden_size)
+            hidden = hidden * jnp.asarray(
+                math.sqrt(cfg.hidden_size), cfg.jax_dtype
+            )
 
         # The cache rides the scan as CARRY, updated by scatter: XLA keeps
         # one buffer and updates it in place.  (Passing it as xs/ys instead
         # copies the whole multi-GB cache through the loop every step —
         # that copy, not attention, dominated decode ITL.)
+        uo = cfg.rmsnorm_unit_offset
+
         def layer_step(carry, layer_in):
             h, cache = carry
             lp, li = layer_in
-            x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+            x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps, uo)
             q, k, v = _qkv_proj(cfg, lp, x, b, s)
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
@@ -288,21 +314,25 @@ class LlamaModel:
                 attn = prefill_attention(
                     q, k, v, cache, li, block_tables, seq_lens,
                     positions[:, 0], prefix_blocks,
+                    sm_scale=self.sm_scale, logit_cap=cfg.attn_logit_softcap,
                 )
             else:
                 attn = paged_attention_layer(
-                    q, cache, li, block_tables, seq_lens, positions
+                    q, cache, li, block_tables, seq_lens, positions,
+                    sm_scale=self.sm_scale, logit_cap=cfg.attn_logit_softcap,
                 )
-            h = h + matmul(attn.reshape(b, s, hq * dh), lp["wo"])
+            attn_out = matmul(attn.reshape(b, s, hq * dh), lp["wo"])
+            if cfg.post_norms:  # Gemma2 sandwich: norm the residual branch
+                attn_out = rms_norm(attn_out, lp["post_attn_norm"],
+                                    cfg.rms_norm_eps, uo)
+            h = h + attn_out
 
-            x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-            if cfg.is_moe:
-                h = h + _moe_mlp(cfg, lp, x)
-            else:
-                h = h + matmul(
-                    jax.nn.silu(matmul(x, lp["w_gate"])) * matmul(x, lp["w_up"]),
-                    lp["w_down"],
-                )
+            x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps, uo)
+            mlp_out = _moe_mlp(cfg, lp, x) if cfg.is_moe else _dense_mlp(cfg, lp, x)
+            if cfg.post_norms:
+                mlp_out = rms_norm(mlp_out, lp["post_mlp_norm"],
+                                   cfg.rms_norm_eps, uo)
+            h = h + mlp_out
             return (h, cache), None
 
         (hidden, new_cache), _ = jax.lax.scan(
@@ -310,7 +340,8 @@ class LlamaModel:
             (hidden, kv_cache),
             (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
         )
-        hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
+        hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps,
+                          cfg.rmsnorm_unit_offset)
         return hidden, new_cache
 
     def forward_seq_parallel(
@@ -340,32 +371,41 @@ class LlamaModel:
         dh, hq, hk = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
 
         hidden = take_rows(params["embed"], tokens, cfg.jax_dtype)
+        if cfg.scale_embeddings:
+            hidden = hidden * jnp.asarray(
+                math.sqrt(cfg.hidden_size), cfg.jax_dtype
+            )
+        uo = cfg.rmsnorm_unit_offset
 
         def layer_step(h, lp):
-            x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+            x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps, uo)
             q, k, v = _qkv_proj(cfg, lp, x, b, s)
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
             attn = ring_attention(
-                q, k, v, positions, positions, mesh=mesh, axis=sp_axis
+                q, k, v, positions, positions, mesh=mesh, axis=sp_axis,
+                sm_scale=self.sm_scale, logit_cap=cfg.attn_logit_softcap,
             )
-            h = h + matmul(attn.reshape(b, s, hq * dh), lp["wo"])
+            attn_out = matmul(attn.reshape(b, s, hq * dh), lp["wo"])
+            if cfg.post_norms:
+                attn_out = rms_norm(attn_out, lp["post_attn_norm"],
+                                    cfg.rms_norm_eps, uo)
+            h = h + attn_out
 
-            x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-            if cfg.is_moe:
-                h = h + _moe_mlp(cfg, lp, x)
-            else:
-                h = h + matmul(
-                    jax.nn.silu(matmul(x, lp["w_gate"])) * matmul(x, lp["w_up"]),
-                    lp["w_down"],
-                )
+            x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps, uo)
+            mlp_out = _moe_mlp(cfg, lp, x) if cfg.is_moe else _dense_mlp(cfg, lp, x)
+            if cfg.post_norms:
+                mlp_out = rms_norm(mlp_out, lp["post_mlp_norm"],
+                                   cfg.rms_norm_eps, uo)
+            h = h + mlp_out
             kv = jnp.stack(
                 [k.reshape(b, s, hk * dh), v.reshape(b, s, hk * dh)], axis=0
             )
             return h, kv
 
         hidden, kv = jax.lax.scan(layer_step, hidden, params["layers"])
-        hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
+        hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps,
+                          cfg.rmsnorm_unit_offset)
         return hidden, kv  # kv: [L, 2, B, S, Hk*D]
 
     def compute_logits(self, params: Params, hidden: jax.Array) -> jax.Array:
@@ -381,10 +421,15 @@ class LlamaModel:
         else:
             w = params["lm_head"]
         if isinstance(w, QTensor):
-            return matmul(hidden, w, preferred_element_type=jnp.float32)
-        return jnp.matmul(
-            hidden.astype(w.dtype), w, preferred_element_type=jnp.float32
-        )
+            logits = matmul(hidden, w, preferred_element_type=jnp.float32)
+        else:
+            logits = jnp.matmul(
+                hidden.astype(w.dtype), w, preferred_element_type=jnp.float32
+            )
+        cap = self.config.final_logit_softcap
+        if cap:  # Gemma2 final logit softcap
+            logits = softcap(logits, float(cap))
+        return logits
 
 
 def _qkv_proj(
@@ -400,6 +445,15 @@ def _qkv_proj(
         k.reshape(b, s, hk, dh),
         v.reshape(b, s, hk, dh),
     )
+
+
+def _dense_mlp(cfg: ModelConfig, lp: dict, x: jax.Array) -> jax.Array:
+    """Gated MLP: act(x·Wg) * (x·Wu) · Wd — SiLU (Llama) or tanh-GELU
+    (Gemma GeGLU)."""
+    gate = matmul(x, lp["w_gate"])
+    act = (jax.nn.gelu(gate, approximate=True)
+           if cfg.hidden_activation == "gelu_tanh" else jax.nn.silu(gate))
+    return matmul(act * matmul(x, lp["w_up"]), lp["w_down"])
 
 
 def _moe_mlp(cfg: ModelConfig, lp: dict, x: jax.Array) -> jax.Array:

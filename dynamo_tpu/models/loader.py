@@ -53,10 +53,26 @@ def load_params_from_state_dict(
         "wk": stack("model.layers.{i}.self_attn.k_proj.weight"),
         "wv": stack("model.layers.{i}.self_attn.v_proj.weight"),
         "wo": stack("model.layers.{i}.self_attn.o_proj.weight"),
+        # Gemma2 renames the pre-MLP norm and adds sandwich norms; in the
+        # Llama family post_attention_layernorm IS the pre-MLP norm
         "mlp_norm": stack(
-            "model.layers.{i}.post_attention_layernorm.weight", transpose=False
+            "model.layers.{i}.pre_feedforward_layernorm.weight"
+            if cfg.post_norms
+            else "model.layers.{i}.post_attention_layernorm.weight",
+            transpose=False,
         ),
     }
+    if cfg.post_norms:
+        layers.update(
+            post_attn_norm=stack(
+                "model.layers.{i}.post_attention_layernorm.weight",
+                transpose=False,
+            ),
+            post_mlp_norm=stack(
+                "model.layers.{i}.post_feedforward_layernorm.weight",
+                transpose=False,
+            ),
+        )
     if cfg.attention_bias:
         layers.update(
             bq=stack("model.layers.{i}.self_attn.q_proj.bias", transpose=False),

@@ -30,12 +30,18 @@ from dynamo_tpu.ops.kv_quant import (
 )
 
 __all__ = [
+    "softcap",
     "write_kv_cache",
     "write_kv_cache_layer",
     "paged_attention",
     "paged_attention_layer",
     "prefill_attention",
 ]
+
+
+def softcap(x: jax.Array, cap: float) -> jax.Array:
+    """Gemma2-style tanh logit softcap (shared by every attention path)."""
+    return jnp.tanh(x / cap) * cap
 
 
 def _pallas_decode_enabled() -> bool:
@@ -62,6 +68,7 @@ def paged_attention_layer(
     seq_lens: jax.Array,      # [B] int32
     positions: jax.Array,     # [B, S] int32
     sm_scale: float | None = None,
+    logit_cap: float | None = None,
 ) -> jax.Array:
     """Attention for layer ``layer`` against the full paged cache.
 
@@ -79,7 +86,8 @@ def paged_attention_layer(
         from dynamo_tpu.ops.pallas.decode_attention import paged_decode_attention
 
         out = paged_decode_attention(
-            q[:, 0], cache, layer, block_tables, seq_lens, sm_scale=sm_scale
+            q[:, 0], cache, layer, block_tables, seq_lens, sm_scale=sm_scale,
+            logit_cap=logit_cap,
         )
         return out[:, None]
 
@@ -92,7 +100,8 @@ def paged_attention_layer(
     k_cache = layer_kv[:, 0].reshape(n, bs, hk, d)
     v_cache = layer_kv[:, 1].reshape(n, bs, hk, d)
     return paged_attention(
-        q, k_cache, v_cache, block_tables, seq_lens, positions, sm_scale
+        q, k_cache, v_cache, block_tables, seq_lens, positions, sm_scale,
+        logit_cap,
     )
 
 
@@ -107,6 +116,7 @@ def prefill_attention(
     start: jax.Array,         # [B] int32 — absolute position of q[:, 0] (block-aligned)
     prefix_blocks: int,       # STATIC: cache blocks holding the cached prefix (bucketed)
     sm_scale: float | None = None,
+    logit_cap: float | None = None,
 ) -> jax.Array:
     """Prefill attention without gathering the sequence's whole block table.
 
@@ -136,12 +146,14 @@ def prefill_attention(
 
         return paged_prefill_attention(
             q, k_new, v_new, cache, layer, block_tables, seq_lens, start,
-            sm_scale=sm_scale,
+            sm_scale=sm_scale, logit_cap=logit_cap,
         )
     qg = q.reshape(b, s, hk, g, d).astype(jnp.float32)
     fresh = (seq_lens - start)[:, None, None]  # valid fresh tokens per row
 
     sf = jnp.einsum("bskgd,btkd->bkgst", qg, k_new.astype(jnp.float32)) * sm_scale
+    if logit_cap is not None:  # Gemma2 attention score softcap
+        sf = softcap(sf, logit_cap)
     i = jnp.arange(s, dtype=jnp.int32)
     allow_f = (i[None, :, None] >= i[None, None, :]) & (i[None, None, :] < fresh)
     sf = jnp.where(allow_f[:, None, None], sf, -jnp.inf)
@@ -164,6 +176,8 @@ def prefill_attention(
     kp = ctx[:, :, 0].reshape(b, t, hk, d)
     vp = ctx[:, :, 1].reshape(b, t, hk, d)
     sp = jnp.einsum("bskgd,btkd->bkgst", qg, kp.astype(jnp.float32)) * sm_scale
+    if logit_cap is not None:
+        sp = softcap(sp, logit_cap)
     slot = jnp.arange(t, dtype=jnp.int32)
     allow_p = slot[None, None, :] < start[:, None, None]
     sp = jnp.where(allow_p[:, None, None], sp, -jnp.inf)
@@ -357,6 +371,7 @@ def paged_attention(
     seq_lens: jax.Array,     # [B] int32 — context length including the new tokens
     positions: jax.Array,    # [B, S] int32 — absolute position of each query token
     sm_scale: float | None = None,
+    logit_cap: float | None = None,
 ) -> jax.Array:
     """Attention of S new tokens against their sequence's paged context.
 
@@ -378,6 +393,8 @@ def paged_attention(
 
     qg = q.reshape(b, s, hk, g, d).astype(jnp.float32)
     scores = jnp.einsum("bskgd,btkd->bkgst", qg, k_ctx.astype(jnp.float32)) * sm_scale
+    if logit_cap is not None:
+        scores = softcap(scores, logit_cap)
 
     # mask: slot j visible iff j <= position(query) and j < seq_len
     slot = jnp.arange(t, dtype=jnp.int32)

@@ -47,6 +47,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dynamo_tpu.ops.paged_attention import softcap
+
 __all__ = ["paged_decode_attention"]
 
 NEG_INF = -1e30
@@ -73,18 +75,19 @@ def _kernel(
     *,
     c: int,
     g: int,
+    logit_cap=None,
 ):
     return _kernel_impl(seq_ref, bt_ref, layer_ref, q_ref, cache_ref,
                         None, out_ref, acc_ref, m_ref, l_ref, kvbuf, sems,
-                        None, None, c=c, g=g)
+                        None, None, c=c, g=g, logit_cap=logit_cap)
 
 
 def _kernel_quant(seq_ref, bt_ref, layer_ref, q_ref, cache_ref, scale_ref,
                   out_ref, acc_ref, m_ref, l_ref, kvbuf, sems, scbuf, scsems,
-                  *, c: int, g: int):
+                  *, c: int, g: int, logit_cap=None):
     return _kernel_impl(seq_ref, bt_ref, layer_ref, q_ref, cache_ref,
                         scale_ref, out_ref, acc_ref, m_ref, l_ref, kvbuf,
-                        sems, scbuf, scsems, c=c, g=g)
+                        sems, scbuf, scsems, c=c, g=g, logit_cap=logit_cap)
 
 
 def _kernel_impl(
@@ -93,6 +96,7 @@ def _kernel_impl(
     *,
     c: int,
     g: int,
+    logit_cap=None,
 ):
     gi = pl.program_id(0)
     bs, hkd = kvbuf.shape[4], kvbuf.shape[5]
@@ -181,6 +185,8 @@ def _kernel_impl(
                     sck = jnp.repeat(sck, gq, axis=0)  # [H, T]
                     scv = jnp.repeat(scv, gq, axis=0)
                     s = s * sck
+                if logit_cap is not None:  # Gemma2 attention softcap
+                    s = softcap(s, logit_cap)
                 pos = ci * t + jax.lax.broadcasted_iota(jnp.int32, (h, t), 1)
                 s = jnp.where(pos < seq_len, s, NEG_INF)
 
@@ -204,7 +210,8 @@ def _kernel_impl(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("sm_scale", "blocks_per_chunk", "seqs_per_group", "interpret"),
+    static_argnames=("sm_scale", "logit_cap", "blocks_per_chunk",
+                     "seqs_per_group", "interpret"),
 )
 def paged_decode_attention(
     q: jax.Array,             # [B, H, D]
@@ -213,6 +220,7 @@ def paged_decode_attention(
     block_tables: jax.Array,  # [B, M] int32
     seq_lens: jax.Array,      # [B] int32
     sm_scale: float | None = None,
+    logit_cap: float | None = None,
     blocks_per_chunk: int = 4,
     seqs_per_group: int = 8,
     interpret: bool = False,
@@ -278,7 +286,8 @@ def paged_decode_attention(
     )
 
     out = pl.pallas_call(
-        functools.partial(_kernel_quant if quant else _kernel, c=c, g=g),
+        functools.partial(_kernel_quant if quant else _kernel, c=c, g=g,
+                          logit_cap=logit_cap),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, hkd), q.dtype),
         interpret=interpret,

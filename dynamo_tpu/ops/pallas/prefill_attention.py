@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dynamo_tpu.ops.paged_attention import softcap
+
 __all__ = ["paged_prefill_attention"]
 
 NEG_INF = -1e30
@@ -38,22 +40,24 @@ def _kernel(
     seq_ref, start_ref, bt_ref, layer_ref, q_ref, k_ref, v_ref, cache_ref,
     out_ref, acc_ref, m_ref, l_ref, kvbuf, sems,
     *, c: int, tq: int, hk: int, g: int, d: int, sm_scale: float,
+    logit_cap=None,
 ):
     return _kernel_impl(seq_ref, start_ref, bt_ref, layer_ref, q_ref, k_ref,
                         v_ref, cache_ref, None, out_ref, acc_ref, m_ref,
                         l_ref, kvbuf, sems, None, None, c=c, tq=tq, hk=hk,
-                        g=g, d=d, sm_scale=sm_scale)
+                        g=g, d=d, sm_scale=sm_scale, logit_cap=logit_cap)
 
 
 def _kernel_quant(
     seq_ref, start_ref, bt_ref, layer_ref, q_ref, k_ref, v_ref, cache_ref,
     scale_ref, out_ref, acc_ref, m_ref, l_ref, kvbuf, sems, scbuf, scsems,
     *, c: int, tq: int, hk: int, g: int, d: int, sm_scale: float,
+    logit_cap=None,
 ):
     return _kernel_impl(seq_ref, start_ref, bt_ref, layer_ref, q_ref, k_ref,
                         v_ref, cache_ref, scale_ref, out_ref, acc_ref, m_ref,
                         l_ref, kvbuf, sems, scbuf, scsems, c=c, tq=tq, hk=hk,
-                        g=g, d=d, sm_scale=sm_scale)
+                        g=g, d=d, sm_scale=sm_scale, logit_cap=logit_cap)
 
 
 def _kernel_impl(
@@ -85,6 +89,7 @@ def _kernel_impl(
     g: int,
     d: int,
     sm_scale: float,
+    logit_cap=None,
 ):
     quant = scale_ref is not None
     bi = pl.program_id(0)
@@ -172,6 +177,8 @@ def _kernel_impl(
                 # K's per-token scale multiplies score columns; V's folds
                 # into P inside flash_update's PV product via p_scale
                 s_ = s_ * sck[h:h + 1, :]
+            if logit_cap is not None:  # Gemma2 attention softcap
+                s_ = softcap(s_, logit_cap)
             s_ = jnp.where(allow, s_, NEG_INF)
             flash_update(h, s_, vc[:, h * d:(h + 1) * d],
                          p_scale=scv[h:h + 1, :] if quant else None)
@@ -192,6 +199,8 @@ def _kernel_impl(
                 q_head(h), kc[:, h * d:(h + 1) * d],
                 (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
             )
+            if logit_cap is not None:
+                s_ = softcap(s_, logit_cap)
             s_ = jnp.where(allow, s_, NEG_INF)
             flash_update(h, s_, vc[:, h * d:(h + 1) * d])
         return 0
@@ -207,8 +216,8 @@ def _kernel_impl(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("sm_scale", "rows_per_chunk", "blocks_per_chunk",
-                     "interpret"),
+    static_argnames=("sm_scale", "logit_cap", "rows_per_chunk",
+                     "blocks_per_chunk", "interpret"),
 )
 def paged_prefill_attention(
     q: jax.Array,             # [B, S, H, D]
@@ -220,6 +229,7 @@ def paged_prefill_attention(
     seq_lens: jax.Array,      # [B] int32
     start: jax.Array,         # [B] int32 — block-aligned chunk start
     sm_scale: float | None = None,
+    logit_cap: float | None = None,
     # 128 rows/chunk keeps scratch (acc + m/l at 128-lane padding) + the
     # VMEM-resident fresh K/V comfortably under the ~16MB VMEM budget at
     # S=2048, Hk*D=512
@@ -294,6 +304,7 @@ def paged_prefill_attention(
         functools.partial(
             _kernel_quant if quant else _kernel,
             c=c, tq=tq, hk=hk, g=g, d=d, sm_scale=float(sm_scale),
+            logit_cap=logit_cap,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, s, hk, g * d), q.dtype),

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from dynamo_tpu.ops.paged_attention import softcap
+
 __all__ = ["ring_attention", "ring_attention_inner"]
 
 _NEG_INF = -1e30
@@ -40,6 +42,7 @@ def ring_attention_inner(
     axis_name: str,
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    logit_cap: Optional[float] = None,
 ) -> jax.Array:
     """Per-device ring attention body (call under shard_map).
 
@@ -64,6 +67,8 @@ def ring_attention_inner(
         vf = v_c.astype(jnp.float32)
         # [B, Hk, rep, Sq, Sk]
         s = jnp.einsum("bqgrd,bkgd->bgrqk", qf, kf) * scale
+        if logit_cap is not None:  # Gemma2 attention score softcap
+            s = softcap(s, logit_cap)
         if causal:
             mask = q_pos[:, None, None, :, None] >= kv_pos_c[:, None, None, None, :]
             s = jnp.where(mask, s, _NEG_INF)
@@ -104,12 +109,14 @@ def ring_attention(
     axis: str = "sp",
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    logit_cap: Optional[float] = None,
 ) -> jax.Array:
     """Sequence-parallel attention: inputs sharded on their seq axis over
     ``mesh[axis]``; output keeps that sharding.  q/k/v: [B, S, H, D] global;
     q_pos/kv_pos: [B, S] global positions."""
     inner = functools.partial(
-        ring_attention_inner, axis_name=axis, causal=causal, sm_scale=sm_scale
+        ring_attention_inner, axis_name=axis, causal=causal,
+        sm_scale=sm_scale, logit_cap=logit_cap,
     )
     seq = P(None, axis, None, None)
     pos = P(None, axis)

@@ -203,3 +203,101 @@ def test_moe_forward_runs():
     )
     assert hidden.shape == (1, 3, cfg.hidden_size)
     assert np.isfinite(np.asarray(hidden)).all()
+
+
+def test_gemma2_matches_hf():
+    """Gemma2 = GeGLU + (1+w) RMSNorm + embed scaling + sandwich norms +
+    query_pre_attn_scalar + attn/final logit softcaps, all through the
+    paged cache path."""
+    torch = pytest.importorskip("torch")
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    torch.manual_seed(6)
+    hf_cfg = Gemma2Config(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        max_position_embeddings=256,
+        query_pre_attn_scalar=24,
+        attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0,
+        # HF eager attention applies softcap; sliding window off for the
+        # tiny ctx (both layer types behave identically under SEQ < window)
+        attn_implementation="eager",
+    )
+    hf = Gemma2ForCausalLM(hf_cfg).eval()
+    d = hf_cfg.to_dict()
+    d["architectures"] = ["Gemma2ForCausalLM"]
+    cfg = ModelConfig.from_hf_config(d, dtype="float32")
+    assert cfg.post_norms and cfg.rmsnorm_unit_offset and cfg.scale_embeddings
+    assert cfg.hidden_activation == "gelu_tanh"
+    assert cfg.attn_logit_softcap == 50.0 and cfg.final_logit_softcap == 30.0
+    model = LlamaModel(cfg)
+    params = load_params_from_state_dict(cfg, hf.state_dict())
+
+    tokens = list(np.random.RandomState(7).randint(0, 128, size=SEQ))
+    import torch as _t
+
+    with _t.no_grad():
+        ref = hf(_t.tensor([tokens])).logits[0].float().numpy()
+    got = _run_ours(model, params, tokens, chunks=[SEQ])
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=5e-3)
+    # incremental decode through the paged cache too
+    got = _run_ours(model, params, tokens, chunks=[9, 7] + [1] * (SEQ - 16))
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=5e-3)
+
+
+def test_gemma1_matches_hf():
+    """Gemma (v1): GeGLU + (1+w) norms + embed scaling, no softcaps."""
+    torch = pytest.importorskip("torch")
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    torch.manual_seed(8)
+    hf_cfg = GemmaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        max_position_embeddings=256,
+        hidden_activation="gelu_pytorch_tanh",
+    )
+    hf = GemmaForCausalLM(hf_cfg).eval()
+    d = hf_cfg.to_dict()
+    d["architectures"] = ["GemmaForCausalLM"]
+    cfg = ModelConfig.from_hf_config(d, dtype="float32")
+    assert not cfg.post_norms and cfg.rmsnorm_unit_offset
+    model = LlamaModel(cfg)
+    params = load_params_from_state_dict(cfg, hf.state_dict())
+
+    tokens = list(np.random.RandomState(9).randint(0, 128, size=SEQ))
+    import torch as _t
+
+    with _t.no_grad():
+        ref = hf(_t.tensor([tokens])).logits[0].float().numpy()
+    got = _run_ours(model, params, tokens, chunks=[9, 7] + [1] * (SEQ - 16))
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=5e-3)
+
+
+def test_activation_mapping_strict():
+    """'gelu' (original Gemma-1 configs) maps to tanh-GELU; unknown
+    activations raise instead of silently running SiLU."""
+    import pytest as _pytest
+
+    base = dict(
+        architectures=["GemmaForCausalLM"], vocab_size=64, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=1, num_attention_heads=2,
+        num_key_value_heads=1, head_dim=16,
+    )
+    cfg = ModelConfig.from_hf_config({**base, "hidden_act": "gelu"},
+                                     dtype="float32")
+    assert cfg.hidden_activation == "gelu_tanh"
+    with _pytest.raises(ValueError, match="unsupported hidden activation"):
+        ModelConfig.from_hf_config({**base, "hidden_act": "relu"},
+                                   dtype="float32")

@@ -197,3 +197,57 @@ def test_decode_kernel_bf16_cache():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
     )
+
+
+def test_decode_kernel_logit_softcap_matches_oracle():
+    """Gemma2 attention score softcap inside the flash-decode kernel."""
+    rng = np.random.default_rng(11)
+    b, h, hk, d, bs, n, m, cap = 2, 8, 4, 64, 16, 32, 8, 50.0
+    q = jnp.asarray(rng.normal(size=(b, 1, h, d)) * 3, jnp.float32)
+    cache = _mk_cache(rng, 2, n, bs, hk, d)
+    bt = jnp.asarray(np.resize(rng.permutation(n), (b, m)).astype(np.int32))
+    seq_lens = jnp.asarray([5, m * bs], jnp.int32)
+
+    l_, n_, _, bs_, hkd = cache.shape
+    kc = cache[1, :, 0].reshape(n_, bs_, hk, d)
+    vc = cache[1, :, 1].reshape(n_, bs_, hk, d)
+    ref = paged_attention(q, kc, vc, bt, seq_lens,
+                          (seq_lens - 1)[:, None].astype(jnp.int32),
+                          logit_cap=cap)[:, 0]
+    from dynamo_tpu.ops.pallas.decode_attention import paged_decode_attention
+
+    got = paged_decode_attention(
+        q[:, 0], cache, jnp.int32(1), bt, seq_lens, logit_cap=cap,
+        blocks_per_chunk=4, seqs_per_group=2, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+
+def test_prefill_kernel_logit_softcap_matches_oracle():
+    import os
+
+    from dynamo_tpu.ops.paged_attention import prefill_attention
+    from dynamo_tpu.ops.pallas.prefill_attention import paged_prefill_attention
+
+    rng = np.random.default_rng(12)
+    b, s, h, hk, d, bs, cap = 2, 32, 4, 2, 32, 16, 30.0
+    n = 8
+    cache = _mk_cache(rng, 1, n, bs, hk, d)
+    bt = jnp.asarray(np.arange(b * 4).reshape(b, 4).astype(np.int32))
+    prefix = 16
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)) * 2, jnp.float32)
+    kn = jnp.asarray(rng.normal(size=(b, s, hk, d)), jnp.float32)
+    vn = jnp.asarray(rng.normal(size=(b, s, hk, d)), jnp.float32)
+    seq_lens = jnp.asarray([prefix + s, prefix + s - 3], jnp.int32)
+    start = jnp.full((b,), prefix, jnp.int32)
+    os.environ["DYNAMO_DISABLE_PALLAS"] = "1"
+    try:
+        ref = prefill_attention(q, kn, vn, cache, jnp.int32(0), bt, seq_lens,
+                                start, prefix_blocks=1, logit_cap=cap)
+    finally:
+        del os.environ["DYNAMO_DISABLE_PALLAS"]
+    got = paged_prefill_attention(q, kn, vn, cache, jnp.int32(0), bt,
+                                  seq_lens, start, logit_cap=cap,
+                                  rows_per_chunk=16, blocks_per_chunk=2,
+                                  interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=3e-5)
