@@ -16,12 +16,14 @@ import jax.numpy as jnp
 
 # Llama-family architectures the unified decoder serves (reference parity:
 # vLLM's model zoo; these cover the reference's example deployments —
-# Llama/R1-Distill, Mistral, Mixtral MoE, Qwen, Gemma).
+# Llama/R1-Distill, Mistral, Mixtral MoE, Qwen2/3, Phi3, Gemma 1/2).
 SUPPORTED_ARCHITECTURES = {
     "LlamaForCausalLM",
     "MistralForCausalLM",
     "MixtralForCausalLM",
     "Qwen2ForCausalLM",
+    "Qwen3ForCausalLM",
+    "Phi3ForCausalLM",
     "GemmaForCausalLM",
     "Gemma2ForCausalLM",
 }
@@ -42,6 +44,8 @@ class ModelConfig:
     tie_word_embeddings: bool = False
     # Qwen2-style QKV projection bias (o_proj stays bias-free)
     attention_bias: bool = False
+    # Qwen3-style per-head RMSNorm on q and k (over head_dim, before RoPE)
+    qk_norm: bool = False
     # Mistral sliding-window size (metadata; full attention is a superset —
     # exact up to window length, the common serving regime)
     sliding_window: Optional[int] = None
@@ -112,6 +116,13 @@ class ModelConfig:
                 f"{sorted(SUPPORTED_ARCHITECTURES)}"
             )
         gemma = arch in ("GemmaForCausalLM", "Gemma2ForCausalLM")
+        if arch == "Phi3ForCausalLM":
+            rs = cfg.get("rope_scaling")
+            if rs:  # longrope (128k variants) is not implemented — be loud
+                raise ValueError(
+                    f"Phi3 rope_scaling={rs.get('type', rs)!r} not supported"
+                    " (serve the 4k-context checkpoints)"
+                )
         act = cfg.get("hidden_activation") or cfg.get("hidden_act") or "silu"
         # original Gemma-1 configs say "gelu" but the canonical weights were
         # trained with tanh-approx GELU (transformers maps it the same way);
@@ -127,19 +138,20 @@ class ModelConfig:
                 f"unsupported hidden activation {act!r} for {arch}; "
                 f"supported: {sorted(act_map)}"
             )
-        if arch == "Gemma2ForCausalLM" and cfg.get("sliding_window") and (
+        if cfg.get("sliding_window") and (
             cfg.get("sliding_window") < cfg.get("max_position_embeddings", 0)
         ):
             import logging
 
-            # interleaved local attention is served as full attention (a
-            # superset): exact for contexts up to the window, divergent
-            # beyond it on the local-attention layers
+            # windowed attention (Mistral, Phi3, Gemma2's interleaved local
+            # layers) is served as full attention — a superset: exact for
+            # contexts up to the window, divergent beyond it
             logging.getLogger("dynamo_tpu.models").warning(
-                "Gemma2 sliding_window=%d < max_position_embeddings=%d: "
-                "local-attention layers run full attention — outputs match "
-                "HF only for contexts within the window",
-                cfg["sliding_window"], cfg.get("max_position_embeddings", 0),
+                "%s sliding_window=%d < max_position_embeddings=%d: served "
+                "with full attention — outputs match HF only for contexts "
+                "within the window",
+                arch, cfg["sliding_window"],
+                cfg.get("max_position_embeddings", 0),
             )
         return cls(
             vocab_size=cfg["vocab_size"],
@@ -157,6 +169,7 @@ class ModelConfig:
             # HF Qwen2 attention always carries QKV bias; Llama exposes an
             # explicit attention_bias flag (default False)
             attention_bias=cfg.get("attention_bias", arch == "Qwen2ForCausalLM"),
+            qk_norm=arch == "Qwen3ForCausalLM",
             sliding_window=cfg.get("sliding_window"),
             num_experts=cfg.get("num_local_experts", 0),
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),

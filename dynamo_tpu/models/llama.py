@@ -129,6 +129,11 @@ class LlamaModel:
                 bk=jnp.zeros((L, hk * dh), dt),
                 bv=jnp.zeros((L, hk * dh), dt),
             )
+        if cfg.qk_norm:  # Qwen3 per-head q/k RMSNorm
+            layers.update(
+                q_norm=jnp.ones((L, dh), dt),
+                k_norm=jnp.ones((L, dh), dt),
+            )
         if cfg.is_moe:
             e = cfg.num_experts
             # router stays dense even under quantization: it is tiny and
@@ -184,6 +189,8 @@ class LlamaModel:
             layers.update(
                 bq=P(None, "model"), bk=P(None, "model"), bv=P(None, "model")
             )
+        if cfg.qk_norm:
+            layers.update(q_norm=P(None, None), k_norm=P(None, None))
         if cfg.post_norms:
             layers.update(
                 post_attn_norm=P(None, None), post_mlp_norm=P(None, None)
@@ -435,16 +442,17 @@ class LlamaModel:
 def _qkv_proj(
     cfg: ModelConfig, lp: dict, x: jax.Array, b: int, s: int
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """QKV projections (+ Qwen2-style bias when configured)."""
+    """QKV projections (+ Qwen2 bias / Qwen3 per-head q-k norms)."""
     dh, hq, hk = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
     q, k, v = matmul(x, lp["wq"]), matmul(x, lp["wk"]), matmul(x, lp["wv"])
     if cfg.attention_bias:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
-    return (
-        q.reshape(b, s, hq, dh),
-        k.reshape(b, s, hk, dh),
-        v.reshape(b, s, hk, dh),
-    )
+    q = q.reshape(b, s, hq, dh)
+    k = k.reshape(b, s, hk, dh)
+    if cfg.qk_norm:  # Qwen3: RMSNorm over head_dim, pre-RoPE
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+    return q, k, v.reshape(b, s, hk, dh)
 
 
 def _dense_mlp(cfg: ModelConfig, lp: dict, x: jax.Array) -> jax.Array:

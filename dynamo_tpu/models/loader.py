@@ -47,11 +47,39 @@ def load_params_from_state_dict(
             ws.append(w.T if transpose else w)
         return jnp.asarray(np.stack(ws), dtype=dt)
 
+    # Phi3 fuses qkv_proj and gate_up_proj into single matrices
+    fused_qkv = "model.layers.0.self_attn.qkv_proj.weight" in state
+    fused_gate_up = "model.layers.0.mlp.gate_up_proj.weight" in state
+
+    def stack_fused(fmt: str, sizes: list[int]) -> list[jnp.ndarray]:
+        """One read of each layer's fused [sum(sizes), in] matrix, split
+        into len(sizes) stacked parts (the lazy safetensors mapping
+        re-reads the whole tensor per get(), so per-part reads would cost
+        len(sizes)x the host I/O at load)."""
+        parts: list[list[np.ndarray]] = [[] for _ in sizes]
+        for i in range(L):
+            w = get(fmt.format(i=i))
+            off = 0
+            for j, sz in enumerate(sizes):
+                parts[j].append(w[off:off + sz].T)
+                off += sz
+        return [jnp.asarray(np.stack(p), dtype=dt) for p in parts]
+
+    dh = cfg.head_dim
+    if fused_qkv:
+        wq, wk, wv = stack_fused(
+            "model.layers.{i}.self_attn.qkv_proj.weight",
+            [cfg.num_heads * dh, cfg.num_kv_heads * dh, cfg.num_kv_heads * dh],
+        )
+    else:
+        wq = stack("model.layers.{i}.self_attn.q_proj.weight")
+        wk = stack("model.layers.{i}.self_attn.k_proj.weight")
+        wv = stack("model.layers.{i}.self_attn.v_proj.weight")
     layers = {
         "attn_norm": stack("model.layers.{i}.input_layernorm.weight", transpose=False),
-        "wq": stack("model.layers.{i}.self_attn.q_proj.weight"),
-        "wk": stack("model.layers.{i}.self_attn.k_proj.weight"),
-        "wv": stack("model.layers.{i}.self_attn.v_proj.weight"),
+        "wq": wq,
+        "wk": wk,
+        "wv": wv,
         "wo": stack("model.layers.{i}.self_attn.o_proj.weight"),
         # Gemma2 renames the pre-MLP norm and adds sandwich norms; in the
         # Llama family post_attention_layernorm IS the pre-MLP norm
@@ -79,6 +107,13 @@ def load_params_from_state_dict(
             bk=stack("model.layers.{i}.self_attn.k_proj.bias", transpose=False),
             bv=stack("model.layers.{i}.self_attn.v_proj.bias", transpose=False),
         )
+    if cfg.qk_norm:  # Qwen3 per-head norms
+        layers.update(
+            q_norm=stack("model.layers.{i}.self_attn.q_norm.weight",
+                         transpose=False),
+            k_norm=stack("model.layers.{i}.self_attn.k_norm.weight",
+                         transpose=False),
+        )
     if cfg.is_moe:
         e = cfg.num_experts
 
@@ -100,9 +135,17 @@ def load_params_from_state_dict(
             w_up=stack_experts("model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight"),
         )
     else:
+        if fused_gate_up:
+            w_gate, w_up = stack_fused(
+                "model.layers.{i}.mlp.gate_up_proj.weight",
+                [cfg.intermediate_size, cfg.intermediate_size],
+            )
+        else:
+            w_gate = stack("model.layers.{i}.mlp.gate_proj.weight")
+            w_up = stack("model.layers.{i}.mlp.up_proj.weight")
         layers.update(
-            w_gate=stack("model.layers.{i}.mlp.gate_proj.weight"),
-            w_up=stack("model.layers.{i}.mlp.up_proj.weight"),
+            w_gate=w_gate,
+            w_up=w_up,
             w_down=stack("model.layers.{i}.mlp.down_proj.weight"),
         )
 

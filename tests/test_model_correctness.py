@@ -301,3 +301,76 @@ def test_activation_mapping_strict():
     with _pytest.raises(ValueError, match="unsupported hidden activation"):
         ModelConfig.from_hf_config({**base, "hidden_act": "relu"},
                                    dtype="float32")
+
+
+def test_qwen3_qk_norm_matches_hf():
+    """Qwen3 = Llama + per-head q/k RMSNorm (pre-RoPE), explicit head_dim."""
+    torch = pytest.importorskip("torch")
+    from transformers import Qwen3Config, Qwen3ForCausalLM
+
+    torch.manual_seed(10)
+    hf_cfg = Qwen3Config(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        max_position_embeddings=256,
+        tie_word_embeddings=True,
+    )
+    hf = Qwen3ForCausalLM(hf_cfg).eval()
+    d = hf_cfg.to_dict()
+    d["architectures"] = ["Qwen3ForCausalLM"]
+    cfg = ModelConfig.from_hf_config(d, dtype="float32")
+    assert cfg.qk_norm and not cfg.attention_bias
+    model = LlamaModel(cfg)
+    params = load_params_from_state_dict(cfg, hf.state_dict())
+
+    tokens = list(np.random.RandomState(11).randint(0, 128, size=SEQ))
+    import torch as _t
+
+    with _t.no_grad():
+        ref = hf(_t.tensor([tokens])).logits[0].float().numpy()
+    got = _run_ours(model, params, tokens, chunks=[9, 7] + [1] * (SEQ - 16))
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=5e-3)
+
+
+def test_phi3_fused_projections_match_hf():
+    """Phi3 = Llama with fused qkv_proj / gate_up_proj weights (the loader
+    splits them)."""
+    torch = pytest.importorskip("torch")
+    from transformers import Phi3Config, Phi3ForCausalLM
+
+    torch.manual_seed(12)
+    hf_cfg = Phi3Config(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rope_scaling=None,
+        pad_token_id=0,  # default 32000 exceeds the tiny vocab
+    )
+    hf = Phi3ForCausalLM(hf_cfg).eval()
+    d = hf_cfg.to_dict()
+    d["architectures"] = ["Phi3ForCausalLM"]
+    cfg = ModelConfig.from_hf_config(d, dtype="float32")
+    model = LlamaModel(cfg)
+    params = load_params_from_state_dict(cfg, hf.state_dict())
+
+    tokens = list(np.random.RandomState(13).randint(0, 128, size=SEQ))
+    import torch as _t
+
+    with _t.no_grad():
+        ref = hf(_t.tensor([tokens])).logits[0].float().numpy()
+    got = _run_ours(model, params, tokens, chunks=[9, 7] + [1] * (SEQ - 16))
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=5e-3)
+    # longrope configs are rejected loudly
+    with pytest.raises(ValueError, match="rope_scaling"):
+        ModelConfig.from_hf_config(
+            {**d, "rope_scaling": {"type": "longrope"}}, dtype="float32"
+        )
