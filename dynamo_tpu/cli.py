@@ -74,14 +74,28 @@ def _build_local_engine(args) -> tuple[object, object]:
             coordinator_url=getattr(args, "coordinator", None),
         ))
 
-    if is_gguf:
-        from dynamo_tpu.llm.gguf import load_gguf_model
+    from dynamo_tpu.models.checkpoint import is_native_checkpoint, load_checkpoint
 
-        model_cfg, params = load_gguf_model(args.model_path, dtype=args.dtype)
+    # --dtype default is None so the native branch can tell "explicitly
+    # requested" from "use the checkpoint's stored dtype"
+    dtype = getattr(args, "dtype", None)
+    if is_native_checkpoint(args.model_path):
+        # pre-converted native checkpoint (dynamo-tpu quantize): params load
+        # in their serving dtype — no per-start bf16 load + quantize pass
+        model_cfg, params, quantized = load_checkpoint(
+            args.model_path, dtype=dtype
+        )
     else:
-        model_cfg, params = load_model_dir(args.model_path, dtype=args.dtype)
+        dtype = dtype or "bfloat16"
+        quantized = False
+        if is_gguf:
+            from dynamo_tpu.llm.gguf import load_gguf_model
+
+            model_cfg, params = load_gguf_model(args.model_path, dtype=dtype)
+        else:
+            model_cfg, params = load_model_dir(args.model_path, dtype=dtype)
     model = LlamaModel(model_cfg)
-    if getattr(args, "quantize", "none") == "int8":
+    if getattr(args, "quantize", "none") == "int8" and not quantized:
         # int8 weight-only serving (models/quant.py): ~2x HBM headroom
         params = model.quantize_params(params)
 
@@ -468,6 +482,62 @@ async def _cmd_mock_worker(args) -> None:
 # ----------------------------------------------------------------- models -----
 
 
+def _cmd_quantize(args) -> None:
+    """Offline conversion: HF/GGUF -> native orbax checkpoint (+ tokenizer
+    and config copied alongside so --model-path works unchanged)."""
+    import shutil
+
+    from dynamo_tpu.models.checkpoint import save_checkpoint
+    from dynamo_tpu.models.llama import LlamaModel
+    from dynamo_tpu.models.loader import load_model_dir
+
+    t0 = time.monotonic()
+    if args.src.endswith(".gguf"):
+        from dynamo_tpu.llm.gguf import load_gguf_model
+
+        cfg, params = load_gguf_model(args.src, dtype=args.dtype)
+    else:
+        cfg, params = load_model_dir(args.src, dtype=args.dtype)
+    quantized = args.scheme == "int8"
+    if quantized:
+        params = LlamaModel(cfg).quantize_params(params)
+    save_checkpoint(args.out, cfg, params, quantized=quantized)
+    # tokenizer + config ride along so ModelDeploymentCard.from_hf_dir and
+    # the preprocessor work off the converted dir directly
+    src = Path(args.src)
+    if src.is_dir():
+        for name in ("tokenizer.json", "tokenizer_config.json", "config.json",
+                     "generation_config.json", "special_tokens_map.json"):
+            if (src / name).is_file():
+                shutil.copy2(src / name, Path(args.out) / name)
+    else:
+        from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+        card = ModelDeploymentCard.from_gguf(args.src)
+        if card.tokenizer_path and Path(card.tokenizer_path).is_file():
+            shutil.copy2(card.tokenizer_path, Path(args.out) / "tokenizer.json")
+        else:
+            log.warning(
+                "gguf carried no materialisable tokenizer; place a "
+                "tokenizer.json next to %s before serving", args.out,
+            )
+        if card.chat_template:
+            # from_hf_dir picks this up, so chat rendering survives the
+            # conversion instead of falling back to the default template
+            (Path(args.out) / "chat_template.jinja").write_text(
+                card.chat_template
+            )
+        # minimal config.json so from_hf_dir finds eos/context on the
+        # converted dir (the gguf metadata carried them)
+        (Path(args.out) / "config.json").write_text(json.dumps({
+            "eos_token_id": card.eos_token_ids,
+            "bos_token_id": card.bos_token_id,
+            "max_position_embeddings": card.context_length,
+        }))
+    log.info("wrote %s (%s, scheme=%s) in %.1fs", args.out, cfg.dtype,
+             args.scheme, time.monotonic() - t0)
+
+
 async def _cmd_models(args) -> None:
     """llmctl parity: manage ModelEntry records on the coordinator."""
     from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient
@@ -507,7 +577,9 @@ def _parser() -> argparse.ArgumentParser:
     run.add_argument("inout", nargs="+", help="in=<...> out=<...>")
     run.add_argument("--model-path", default=None)
     run.add_argument("--model-name", default=None)
-    run.add_argument("--dtype", default="bfloat16")
+    run.add_argument("--dtype", default=None,
+                     help="activation dtype (default: bfloat16, or the "
+                     "native checkpoint's stored dtype)")
     run.add_argument("--max-batch-size", type=int, default=8)
     run.add_argument("--kv-cache-dtype", choices=["model", "int8"],
                      default="model",
@@ -586,6 +658,18 @@ def _parser() -> argparse.ArgumentParser:
     models.add_argument("endpoint", nargs="?", help="dyn://ns.component.endpoint")
     models.add_argument("--model-path", default=None)
     common(models)
+
+    quant = sub.add_parser(
+        "quantize",
+        help="convert an HF/GGUF checkpoint to a native serving checkpoint "
+        "(int8 weight-only by default) — engines then start without the "
+        "per-boot load+quantize pass",
+    )
+    quant.add_argument("src", help="HF model dir or .gguf file")
+    quant.add_argument("out", help="output checkpoint dir")
+    quant.add_argument("--scheme", choices=["int8", "none"], default="int8",
+                       help="none = just convert/stack weights, no quant")
+    quant.add_argument("--dtype", default="bfloat16")
     return p
 
 
@@ -619,6 +703,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         asyncio.run(_cmd_mock_worker(args))
     elif args.cmd == "models":
         asyncio.run(_cmd_models(args))
+    elif args.cmd == "quantize":
+        _cmd_quantize(args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,92 @@
+"""Native checkpoint format: pre-quantized / pre-converted params on disk.
+
+Serving 8B+ from an HF checkpoint pays bf16 load + int8 quantize at every
+engine start; saving the converted params once (orbax, the JAX-native
+checkpoint library) turns startup into a direct mmap-friendly restore —
+the TPU analogue of the reference pointing vLLM at a pre-quantized FP8
+repo (docs/architecture.md:57).  `dynamo-tpu quantize` (cli.py) writes
+one; `--model-path <dir>` serves one transparently (detected by the
+`dynamo_tpu.json` manifest).
+
+Layout: `<dir>/dynamo_tpu.json` (ModelConfig fields + quantized flag) and
+`<dir>/params/` (orbax PyTree checkpoint).  QTensor leaves round-trip as
+`{"__qtensor__": {"q": int8, "scale": f32}}` subtrees.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.quant import QTensor
+
+__all__ = ["save_checkpoint", "load_checkpoint", "is_native_checkpoint"]
+
+MANIFEST = "dynamo_tpu.json"
+_QKEY = "__qtensor__"
+
+
+def is_native_checkpoint(path: str | Path) -> bool:
+    return (Path(path) / MANIFEST).is_file()
+
+
+def _encode(tree: Any) -> Any:
+    """QTensor leaves -> plain dict subtrees orbax can store."""
+    return jax.tree.map(
+        lambda x: {_QKEY: {"q": x.q, "scale": x.scale}}
+        if isinstance(x, QTensor) else x,
+        tree,
+        is_leaf=lambda x: isinstance(x, QTensor),
+    )
+
+
+def _decode(tree: Any) -> Any:
+    """Inverse of :func:`_encode` over the restored nested dicts."""
+    if isinstance(tree, dict):
+        if set(tree.keys()) == {_QKEY}:
+            return QTensor(tree[_QKEY]["q"], tree[_QKEY]["scale"])
+        return {k: _decode(v) for k, v in tree.items()}
+    return tree
+
+
+def save_checkpoint(path: str | Path, cfg: ModelConfig, params: Any,
+                    quantized: bool) -> None:
+    """Write config manifest + params under ``path`` (created/overwritten)."""
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    path.mkdir(parents=True, exist_ok=True)
+    ckpt = ocp.PyTreeCheckpointer()
+    ckpt.save(path / "params", _encode(params), force=True)
+    # the manifest is the commit marker: written LAST, so an interrupted
+    # conversion never leaves a dir that passes is_native_checkpoint with
+    # partial params
+    manifest = {
+        "format": 1,
+        "quantized": quantized,
+        "config": dataclasses.asdict(cfg),
+    }
+    (path / MANIFEST).write_text(json.dumps(manifest, indent=2))
+
+
+def load_checkpoint(path: str | Path, dtype: Optional[str] = None
+                    ) -> tuple[ModelConfig, Any, bool]:
+    """Returns (ModelConfig, params, quantized).  ``dtype`` overrides the
+    saved activation dtype (weights keep their stored dtype)."""
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    manifest = json.loads((path / MANIFEST).read_text())
+    if manifest.get("format") != 1:
+        raise ValueError(f"unknown checkpoint format {manifest.get('format')}")
+    cfg_kw = manifest["config"]
+    if dtype:
+        cfg_kw = {**cfg_kw, "dtype": dtype}
+    cfg = ModelConfig(**cfg_kw)
+    params = _decode(ocp.PyTreeCheckpointer().restore(path / "params"))
+    return cfg, params, bool(manifest.get("quantized"))
