@@ -113,6 +113,7 @@ def _build_local_engine(args) -> tuple[object, object]:
         cache_dtype=(
             "int8" if getattr(args, "kv_cache_dtype", "model") == "int8" else None
         ),
+        spec_tokens=int(getattr(args, "spec_tokens", 0) or 0),
     )
     core = EngineCore(
         model, params, cfg, mesh=mesh, eos_token_ids=card.eos_token_ids or None
@@ -581,6 +582,9 @@ def _parser() -> argparse.ArgumentParser:
                      help="activation dtype (default: bfloat16, or the "
                      "native checkpoint's stored dtype)")
     run.add_argument("--max-batch-size", type=int, default=8)
+    run.add_argument("--spec-tokens", type=int, default=0,
+                     help="prompt-lookup speculative decoding: verify up to "
+                     "N proposed tokens per dispatch (greedy requests only)")
     run.add_argument("--kv-cache-dtype", choices=["model", "int8"],
                      default="model",
                      help="model = cache in the model dtype; int8 = "

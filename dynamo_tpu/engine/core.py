@@ -247,6 +247,7 @@ class EngineCore:
             self._multi_impl, donate_argnums=(1,),
             static_argnames=("num_steps", "k_cand", "exact", "use_penalties"),
         )
+        self._spec_fn = jax.jit(self._spec_impl, donate_argnums=(1,))
         # sequence-parallel long-prefill (ring attention over the "data"
         # axis): one dispatch computes the whole prompt with the sequence
         # sharded across the mesh — SURVEY §5 long-context path
@@ -285,6 +286,9 @@ class EngineCore:
         self.tokens_generated = 0
         self.prompt_tokens_computed = 0  # actual prefill work (dedupe-aware)
         self.sp_prefills = 0             # seq-parallel long-prefill dispatches
+        self.spec_steps = 0              # speculative verify dispatches
+        self.spec_proposed = 0           # tokens proposed by n-gram lookup
+        self.spec_accepted = 0           # proposals the model agreed with
         self._last_was_prefill = False
 
     # ----------------------------------------------------------- step kernel
@@ -331,6 +335,17 @@ class EngineCore:
             blocks, self._cache_sharding()
         )
         return out, blocks
+
+    def _spec_impl(self, params, cache, tokens, positions, block_tables,
+                   seq_lens, slot_idx):
+        """Speculative verify: forward S tokens per row against the paged
+        cache (KV scattered like prefill), greedy argmax at EVERY position
+        — the host accepts the proposal prefix that matches."""
+        hidden, cache = self.model.forward(
+            params, tokens, positions, cache, block_tables, seq_lens, slot_idx
+        )
+        logits = self.model.compute_logits(params, hidden)  # [B, S, V]
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
     def _multi_impl(self, params, cache, *args, num_steps=1, k_cand=K_MAX,
                     exact=False, use_penalties=False, grammar=None,
@@ -536,6 +551,9 @@ class EngineCore:
             "num_requests_waiting": self.waiting.qsize() + len(self._admitted),
             "kv_usage_perc": self.block_manager.usage,
             "tokens_generated": self.tokens_generated,
+            "spec_steps": self.spec_steps,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
         }
         if self.host_pool is not None:
             out.update(self.host_pool.stats())
@@ -906,6 +924,139 @@ class EngineCore:
         self._complete_prefill(req, sampled, lps, cids, clps)
 
     # ----------------------------------------------------------------- decode
+    # ----------------------------------------------------- speculative decode
+    def _spec_eligible(self, reqs) -> bool:
+        """Speculation is greedy-exact only: every active request must be
+        plain greedy with no feature that needs the real sampler."""
+        return all(
+            r.sampling.greedy
+            and not r.sampling.frequency_penalty
+            and not r.sampling.presence_penalty
+            and not r.sampling.logprobs
+            and not r.sampling.top_logprobs
+            and not r.sampling.logit_bias
+            and not r.sampling.min_p
+            and not r.sampling.json_mode
+            for r in reqs
+        )
+
+    def _grow_blocks(self, req: EngineRequest, extra_tokens: int
+                     ) -> Optional[int]:
+        """Extend ``req``'s block table to cover ``extra_tokens`` more
+        positions beyond its uncomputed tail; returns the row's token
+        limit, or None when not even the current token has a slot (the
+        request was finished at LENGTH).  Shared by the burst and
+        speculative dispatch builders."""
+        cfg = self.config
+        p = req.seq.total_tokens - 1
+        want_tokens = min(p + extra_tokens, cfg.max_model_len)
+        needed = (want_tokens - 1) // cfg.block_size + 1
+        if len(req.block_ids) < needed:
+            try:
+                req.block_ids.extend(
+                    self.block_manager.allocate_raw(needed - len(req.block_ids))
+                )
+            except NoFreeBlocks:
+                if len(req.block_ids) * cfg.block_size <= p:
+                    self._finish_slot(req, FinishReason.LENGTH)
+                    return None
+        return min(len(req.block_ids) * cfg.block_size, cfg.max_model_len)
+
+    def _try_spec_decode(self) -> bool:
+        """Prompt-lookup speculative dispatch (engine/spec.py): verify up
+        to spec_tokens proposed continuations per row in ONE forward and
+        emit the matching prefix + one bonus token.  Returns False when no
+        row has a proposal (caller falls back to the burst path).
+
+        The verify forward runs the pure-JAX paged path with the block
+        table SLICED to the batch's live context (power-of-two bucketed,
+        so executables stay O(log)): its gather cost scales with actual
+        context, not max_model_len.  (A multi-query flash kernel is the
+        structural follow-up.)"""
+        from dynamo_tpu.engine.spec import propose_ngram
+
+        cfg = self.config
+        k = cfg.spec_tokens
+        b, m = cfg.max_batch_size, cfg.max_blocks_per_seq
+        s = k + 1
+        active = [
+            r for r in self.slots
+            if r is not None and r.state is RequestState.RUNNING
+        ]
+        if not active or not self._spec_eligible(active):
+            return False
+
+        tokens = np.zeros((b, s), np.int32)
+        positions = np.zeros((b, s), np.int32)
+        slot_idx = np.full((b, s), -1, np.int32)
+        bt = np.zeros((b, m), np.int32)
+        seq_lens = np.zeros(b, np.int32)
+        limits = np.zeros(b, np.int32)
+        props: dict[int, list[int]] = {}
+        rows: list[EngineRequest] = []
+        any_prop = False
+        for req in active:
+            i = req.slot
+            p = req.seq.total_tokens - 1  # position of the uncomputed tail
+            limit = self._grow_blocks(req, s)
+            if limit is None:
+                continue
+            prop = propose_ngram(req.seq.tokens, cfg.spec_ngram, k)
+            prop = prop[: max(0, limit - (p + 1))]  # KV positions stay in range
+            props[i] = prop
+            any_prop = any_prop or bool(prop)
+            rows.append(req)
+            row_tokens = [req.seq.tokens[-1]] + prop
+            n = len(row_tokens)
+            tokens[i, :n] = row_tokens
+            positions[i, :n] = np.arange(p, p + n, dtype=np.int32)
+            blk = positions[i, :n] // cfg.block_size
+            slot_idx[i, :n] = (
+                np.asarray(req.block_ids, np.int32)[blk] * cfg.block_size
+                + positions[i, :n] % cfg.block_size
+            )
+            bt[i, : len(req.block_ids)] = req.block_ids
+            seq_lens[i] = p + n
+            limits[i] = limit
+        if not any_prop or not rows:
+            return False
+
+        # slice the block table to the batch's live context, pow2-bucketed:
+        # the verify gather then reads O(max context) KV, not O(model_len)
+        blocks_used = max(1, -(-int(seq_lens.max()) // cfg.block_size))
+        m_used = min(m, 1 << (blocks_used - 1).bit_length())
+
+        self._drain_offload()
+        argmax, self.cache = self._spec_fn(
+            self.params, self.cache,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(bt[:, :m_used]),
+            jnp.asarray(seq_lens), jnp.asarray(slot_idx),
+        )
+        argmax = np.asarray(argmax)
+        self.steps += 1
+        self.decode_steps += 1
+        self.spec_steps += 1
+        for req in rows:
+            i = req.slot
+            prop = props.get(i, [])
+            # accept the proposal prefix the model agrees with, then the
+            # bonus token from the first disagreeing (or final) position
+            a = 0
+            while a < len(prop) and prop[a] == int(argmax[i, a]):
+                a += 1
+            emit = [int(argmax[i, j]) for j in range(a + 1)]
+            self.spec_proposed += len(prop)
+            self.spec_accepted += a
+            allowed = min(len(emit), int(limits[i] - (req.seq.total_tokens - 1)))
+            for t in emit[:allowed]:
+                if req.state is not RequestState.RUNNING:
+                    break  # EOS/stop/max_tokens mid-acceptance
+                self._append_token(req, t)
+            if req.state is RequestState.RUNNING and allowed < len(emit):
+                self._finish_slot(req, FinishReason.LENGTH)
+        return True
+
     def _run_decode(self) -> None:
         """One decode dispatch = up to ``config.decode_steps`` tokens per
         active sequence, generated entirely on device (multi-step
@@ -920,6 +1071,8 @@ class EngineCore:
         ~8 ITLs, not a whole 64-step burst, before its first prefill chunk
         — the dominant term in chunked-prefill TTFT (VERDICT r2 weak #3)."""
         cfg = self.config
+        if cfg.spec_tokens > 0 and self._try_spec_decode():
+            return
         b, m = cfg.max_batch_size, cfg.max_blocks_per_seq
         # REMOTE_PREFILL counts too: the disagg first token arrives via the
         # ops queue, processed only between dispatches.  Queued requests
@@ -957,24 +1110,15 @@ class EngineCore:
                 continue
             p = req.seq.total_tokens - 1  # position of the not-yet-computed last token
             # cover the whole burst: positions p .. p+k-1, clamped to model len
-            want_tokens = min(p + k_steps, cfg.max_model_len)
-            needed = (want_tokens - 1) // cfg.block_size + 1
-            if len(req.block_ids) < needed:
-                try:
-                    req.block_ids.extend(
-                        self.block_manager.allocate_raw(needed - len(req.block_ids))
-                    )
-                except NoFreeBlocks:
-                    if len(req.block_ids) * cfg.block_size <= p:
-                        # not even the current token has a slot
-                        self._finish_slot(req, FinishReason.LENGTH)
-                        continue
+            limit = self._grow_blocks(req, k_steps)
+            if limit is None:
+                continue  # not even the current token has a slot
             active.append(req)
             tokens[i] = req.seq.tokens[-1]
             positions[i] = p
             bt[i, : len(req.block_ids)] = req.block_ids
             seq_lens[i] = req.seq.total_tokens
-            limits[i] = min(len(req.block_ids) * cfg.block_size, cfg.max_model_len)
+            limits[i] = limit
             temp[i] = req.sampling.temperature
             top_k[i] = req.sampling.top_k
             top_p[i] = req.sampling.top_p

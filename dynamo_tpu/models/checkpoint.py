@@ -61,11 +61,13 @@ def save_checkpoint(path: str | Path, cfg: ModelConfig, params: Any,
 
     path = Path(path).absolute()
     path.mkdir(parents=True, exist_ok=True)
+    # the manifest is the commit marker: removed FIRST (re-converting into
+    # an existing checkpoint dir must not leave the old manifest validating
+    # half-rewritten params) and written LAST, so an interrupted conversion
+    # never leaves a dir that passes is_native_checkpoint
+    (path / MANIFEST).unlink(missing_ok=True)
     ckpt = ocp.PyTreeCheckpointer()
     ckpt.save(path / "params", _encode(params), force=True)
-    # the manifest is the commit marker: written LAST, so an interrupted
-    # conversion never leaves a dir that passes is_native_checkpoint with
-    # partial params
     manifest = {
         "format": 1,
         "quantized": quantized,

@@ -1,0 +1,174 @@
+"""Prompt-lookup speculative decoding: proposer, greedy-exactness, and
+acceptance/dispatch-reduction on a deterministic model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, EngineCore
+from dynamo_tpu.engine.request import EngineRequest
+from dynamo_tpu.engine.spec import propose_ngram
+from dynamo_tpu.llm.protocols import SamplingOptions, StopConditions
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import LlamaModel
+
+
+# ------------------------------------------------------------- proposer ----
+def test_propose_ngram_basic():
+    #       0  1  2  3  4  5  6
+    toks = [1, 2, 3, 9, 1, 2, 3]
+    assert propose_ngram(toks, 3, 2) == [9, 1]
+    assert propose_ngram(toks, 3, 5) == [9, 1, 2, 3]
+    assert propose_ngram([1, 2, 3, 4], 3, 2) == []          # no recurrence
+    # overlapping repeats: an earlier match with a full-k continuation
+    # beats the nearest match's truncated tail
+    assert propose_ngram([7, 7, 7, 7], 2, 2) == [7, 7]
+    assert propose_ngram([7, 7, 7, 7, 7], 2, 2) == [7, 7]
+    assert propose_ngram([], 3, 2) == []
+    assert propose_ngram([1], 3, 2) == []
+
+
+def test_propose_ngram_prefers_recent_and_longest():
+    # suffix [5,6] occurs twice; the most recent earlier occurrence wins
+    toks = [5, 6, 1, 5, 6, 2, 5, 6]
+    assert propose_ngram(toks, 2, 1) == [2]
+    # longer suffix match preferred over shorter
+    toks = [9, 5, 6, 3, 2, 5, 6, 3]  # suffix [5,6,3] matched at idx 1
+    assert propose_ngram(toks, 3, 1) == [2]
+
+
+# ------------------------------------------------- deterministic cycle model
+CYCLE = [11, 12, 13, 14]
+
+
+class CycleModel:
+    """Minimal engine-compatible model: argmax at position p is
+    CYCLE[p % len(CYCLE)] regardless of input — generation is a known
+    repeating stream, so n-gram proposals become perfect after one cycle."""
+
+    def __init__(self, vocab=64):
+        self.config = ModelConfig.tiny(vocab_size=vocab)
+
+    def init_params(self):
+        return {"zero": jnp.zeros((1,))}
+
+    def init_kv_cache(self, num_blocks, block_size, dtype=None):
+        cfg = self.config
+        return jnp.zeros(
+            (cfg.num_layers, num_blocks, 2, block_size,
+             cfg.num_kv_heads * cfg.head_dim), jnp.float32,
+        )
+
+    def forward(self, params, tokens, positions, cache, block_tables,
+                seq_lens, slot_idx, prefix_blocks=None):
+        b, s = tokens.shape
+        # encode each token's position into its hidden row
+        hidden = jnp.zeros((b, s, self.config.hidden_size), jnp.float32)
+        hidden = hidden.at[:, :, 0].set(positions.astype(jnp.float32))
+        return hidden, cache
+
+    def compute_logits(self, params, hidden):
+        pos = hidden[..., 0].astype(jnp.int32)
+        cyc = jnp.asarray(CYCLE, jnp.int32)
+        nxt = cyc[(pos + 1) % len(CYCLE)]
+        return jax.nn.one_hot(nxt, self.config.vocab_size, dtype=jnp.float32)
+
+
+def _run(core, prompt, n, rid="s"):
+    outs = []
+    core.submit(EngineRequest(
+        request_id=rid, prompt=list(prompt),
+        sampling=SamplingOptions(temperature=0.0),
+        stops=StopConditions(max_tokens=n, ignore_eos=True),
+        emit=outs.append,
+    ))
+    for _ in range(400):
+        if not core.step():
+            break
+    return [t for o in outs for t in o.token_ids]
+
+
+def _cfg(**kw):
+    return EngineConfig(max_batch_size=2, max_model_len=256, block_size=16,
+                        num_blocks=40, **kw)
+
+
+def test_spec_accepts_on_cyclic_model():
+    model = CycleModel()
+    params = model.init_params()
+    # prompt already contains one full cycle so lookup matches immediately
+    prompt = [11, 12, 13, 14, 11, 12, 13, 14]
+    base = EngineCore(model, params, _cfg(), eos_token_ids=[])
+    want = _run(base, prompt, 24, "base")
+    spec = EngineCore(model, params, _cfg(spec_tokens=4), eos_token_ids=[])
+    got = _run(spec, prompt, 24, "spec")
+    assert got == want  # greedy-exact
+    assert spec.spec_steps > 0
+    assert spec.spec_accepted > 0
+    # perfect proposals: ~5 tokens per dispatch vs 1 for the base engine
+    assert spec.decode_steps < base.decode_steps / 2
+    accept_rate = spec.spec_accepted / max(spec.spec_proposed, 1)
+    assert accept_rate > 0.9, (spec.spec_accepted, spec.spec_proposed)
+
+
+def test_spec_greedy_exact_on_real_model():
+    """On a real tiny Llama (arbitrary argmax) speculation may accept
+    little, but output must equal plain greedy decoding exactly."""
+    cfg = ModelConfig.tiny()
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    # a prompt with internal repetition to give the proposer material
+    prompt = [5, 6, 7, 8, 5, 6, 7, 8, 9, 10]
+    base = EngineCore(model, params, _cfg(), eos_token_ids=[])
+    want = _run(base, prompt, 20, "b")
+    spec = EngineCore(model, params, _cfg(spec_tokens=3), eos_token_ids=[])
+    got = _run(spec, prompt, 20, "s")
+    assert got == want
+    assert spec.spec_steps > 0  # proposals were attempted
+
+
+def test_spec_defers_to_sampler_features():
+    """A non-greedy (or penalized) request in the batch disables the
+    speculative path for that dispatch — the burst path runs instead."""
+    model = CycleModel()
+    params = model.init_params()
+    core = EngineCore(model, params, _cfg(spec_tokens=4), eos_token_ids=[])
+    outs = []
+    core.submit(EngineRequest(
+        request_id="t", prompt=[11, 12, 13, 14, 11, 12, 13, 14],
+        sampling=SamplingOptions(temperature=1.0),  # not greedy
+        stops=StopConditions(max_tokens=8, ignore_eos=True),
+        emit=outs.append,
+    ))
+    for _ in range(100):
+        if not core.step():
+            break
+    assert sum(len(o.token_ids) for o in outs) == 8
+    assert core.spec_steps == 0
+
+
+def test_spec_respects_block_limits():
+    """Proposals are clamped to the sequence's block space; running out
+    finishes at LENGTH exactly like the burst path."""
+    model = CycleModel()
+    params = model.init_params()
+    core = EngineCore(
+        model, params,
+        EngineConfig(max_batch_size=1, max_model_len=48, block_size=16,
+                     num_blocks=3, spec_tokens=4),
+        eos_token_ids=[],
+    )
+    outs = []
+    core.submit(EngineRequest(
+        request_id="lim", prompt=[11, 12, 13, 14] * 3,
+        sampling=SamplingOptions(temperature=0.0),
+        stops=StopConditions(max_tokens=100, ignore_eos=True),
+        emit=outs.append,
+    ))
+    for _ in range(200):
+        if not core.step():
+            break
+    assert outs[-1].finish_reason is not None
+    total = 12 + sum(len(o.token_ids) for o in outs)
+    assert total <= 48
