@@ -968,11 +968,11 @@ class EngineCore:
         emit the matching prefix + one bonus token.  Returns False when no
         row has a proposal (caller falls back to the burst path).
 
-        The verify forward runs the pure-JAX paged path with the block
-        table SLICED to the batch's live context (power-of-two bucketed,
-        so executables stay O(log)): its gather cost scales with actual
-        context, not max_model_len.  (A multi-query flash kernel is the
-        structural follow-up.)"""
+        On TPU the verify forward takes the multi-query flash-decode
+        kernel (ops/pallas/decode_attention.py) — only owned blocks
+        stream from HBM.  The block table is additionally SLICED to the
+        batch's live context (power-of-two bucketed, so executables stay
+        O(log)), which is what bounds the pure-JAX fallback's gather."""
         from dynamo_tpu.engine.spec import propose_ngram
 
         cfg = self.config

@@ -60,6 +60,20 @@ def _pallas_prefill_enabled() -> bool:
     return jax.default_backend() == "tpu"
 
 
+MQ_MAX_S = 8  # multi-query decode kernel: trailing-query count it serves
+
+
+def _pallas_mq_enabled() -> bool:
+    """Use the multi-query flash-decode kernel for small S>1 steps on TPU
+    (the speculative-verify shape; positions must be contiguous per row,
+    which every in-repo caller guarantees)."""
+    if os.environ.get("DYNAMO_DISABLE_PALLAS"):
+        return False
+    if os.environ.get("DYNAMO_DISABLE_PALLAS_MQ"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
 def paged_attention_layer(
     q: jax.Array,             # [B, S, H, D]
     cache: jax.Array,         # [L, N, 2, Bs, Hk*D] — full multi-layer cache
@@ -72,10 +86,15 @@ def paged_attention_layer(
 ) -> jax.Array:
     """Attention for layer ``layer`` against the full paged cache.
 
-    Decode steps (S=1) on TPU take the Pallas flash-decoding kernel, which
-    reads only the owned blocks straight from HBM (positions are seq_lens-1
-    by construction — the engine always queries the next token).  Other
-    shapes/backends materialise the layer slice and use the oracle below.
+    Dispatch on TPU: S=1 takes the Pallas flash-decode kernel; 1 < S <=
+    MQ_MAX_S takes the multi-query variant (the speculative-verify shape).
+    BOTH kernel paths require each row's positions to be CONTIGUOUS
+    (positions[:, j] == positions[:, 0] + j) — true for every engine
+    caller (decode tails, spec verify, prefill chunks); a caller with
+    gapped/repeated positions must disable them (DYNAMO_DISABLE_PALLAS /
+    DYNAMO_DISABLE_PALLAS_MQ) to get the position-exact oracle, which also
+    serves S > MQ_MAX_S and non-TPU backends by materialising the layer
+    slice.
     """
     b, s, h, d = q.shape
     quant = is_quant(cache)
@@ -90,6 +109,17 @@ def paged_attention_layer(
             logit_cap=logit_cap,
         )
         return out[:, None]
+    if 1 < s <= MQ_MAX_S and _pallas_mq_enabled():
+        # speculative-verify shape: a few trailing queries per row — stream
+        # only the owned blocks instead of gathering the padded table
+        from dynamo_tpu.ops.pallas.decode_attention import (
+            paged_decode_attention_mq,
+        )
+
+        return paged_decode_attention_mq(
+            q, cache, layer, block_tables, seq_lens, positions[:, 0],
+            sm_scale=sm_scale, logit_cap=logit_cap,
+        )
 
     layer_kv = jax.lax.dynamic_index_in_dim(data, layer, axis=0, keepdims=False)
     if quant:

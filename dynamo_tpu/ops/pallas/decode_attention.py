@@ -49,7 +49,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from dynamo_tpu.ops.paged_attention import softcap
 
-__all__ = ["paged_decode_attention"]
+__all__ = ["paged_decode_attention", "paged_decode_attention_mq"]
 
 NEG_INF = -1e30
 
@@ -57,50 +57,54 @@ NEG_INF = -1e30
 def _kernel(
     # scalar prefetch (SMEM)
     seq_ref,     # [B] int32
+    q0_ref,      # [B] int32 — absolute position of each row's FIRST query
     bt_ref,      # [B, M] int32
     layer_ref,   # [1] int32
     # inputs
-    q_ref,       # [G, H, HkD] VMEM — block-diagonal expanded, pre-scaled f32
+    q_ref,       # [G, S*H, HkD] VMEM — block-diagonal expanded, pre-scaled f32
     cache_ref,   # [L, N, 2, Bs, HkD] HBM (manual DMA)
     # (scale_ref [L, N, 2, Hk, Bs] HBM when quant — spliced via *rest)
     # outputs
-    out_ref,     # [G, H, HkD] VMEM
+    out_ref,     # [G, S*H, HkD] VMEM
     # scratch
-    acc_ref,     # [G, H, HkD] f32
-    m_ref,       # [G, H, 128] f32
-    l_ref,       # [G, H, 128] f32
+    acc_ref,     # [G, S*H, HkD] f32
+    m_ref,       # [G, S*H, 128] f32
+    l_ref,       # [G, S*H, 128] f32
     kvbuf,       # [2, G, C, 2, Bs, HkD] cache-dtype (double buffer)
     sems,        # [2, G, C] DMA semaphores
     # (scbuf [2, G, C, 2, Hk, Bs] f32 + scsems when quant)
     *,
     c: int,
     g: int,
+    s_q: int,
     logit_cap=None,
 ):
-    return _kernel_impl(seq_ref, bt_ref, layer_ref, q_ref, cache_ref,
+    return _kernel_impl(seq_ref, q0_ref, bt_ref, layer_ref, q_ref, cache_ref,
                         None, out_ref, acc_ref, m_ref, l_ref, kvbuf, sems,
-                        None, None, c=c, g=g, logit_cap=logit_cap)
+                        None, None, c=c, g=g, s_q=s_q, logit_cap=logit_cap)
 
 
-def _kernel_quant(seq_ref, bt_ref, layer_ref, q_ref, cache_ref, scale_ref,
-                  out_ref, acc_ref, m_ref, l_ref, kvbuf, sems, scbuf, scsems,
-                  *, c: int, g: int, logit_cap=None):
-    return _kernel_impl(seq_ref, bt_ref, layer_ref, q_ref, cache_ref,
+def _kernel_quant(seq_ref, q0_ref, bt_ref, layer_ref, q_ref, cache_ref,
+                  scale_ref, out_ref, acc_ref, m_ref, l_ref, kvbuf, sems,
+                  scbuf, scsems, *, c: int, g: int, s_q: int, logit_cap=None):
+    return _kernel_impl(seq_ref, q0_ref, bt_ref, layer_ref, q_ref, cache_ref,
                         scale_ref, out_ref, acc_ref, m_ref, l_ref, kvbuf,
-                        sems, scbuf, scsems, c=c, g=g, logit_cap=logit_cap)
+                        sems, scbuf, scsems, c=c, g=g, s_q=s_q,
+                        logit_cap=logit_cap)
 
 
 def _kernel_impl(
-    seq_ref, bt_ref, layer_ref, q_ref, cache_ref, scale_ref,
+    seq_ref, q0_ref, bt_ref, layer_ref, q_ref, cache_ref, scale_ref,
     out_ref, acc_ref, m_ref, l_ref, kvbuf, sems, scbuf, scsems,
     *,
     c: int,
     g: int,
+    s_q: int,
     logit_cap=None,
 ):
     gi = pl.program_id(0)
     bs, hkd = kvbuf.shape[4], kvbuf.shape[5]
-    h = q_ref.shape[1]
+    h = q_ref.shape[1] // s_q  # rows are (query, head)-major
     t = c * bs
     lyr = layer_ref[0]
     quant = scale_ref is not None
@@ -159,7 +163,7 @@ def _kernel_impl(
             # their acc/l stay 0 → output 0)
             @pl.when(ci * t < seq_len)
             def _update(j=j, seq_len=seq_len):
-                q = q_ref[j]  # [H, HkD]
+                q = q_ref[j]  # [S*H, HkD]
                 k = kvbuf[slot, j, :, 0].reshape(t, hkd).astype(jnp.float32)
                 v = kvbuf[slot, j, :, 1].reshape(t, hkd).astype(jnp.float32)
 
@@ -184,11 +188,20 @@ def _kernel_impl(
                     )
                     sck = jnp.repeat(sck, gq, axis=0)  # [H, T]
                     scv = jnp.repeat(scv, gq, axis=0)
+                    if s_q > 1:  # row layout is (query, head)-major
+                        sck = jnp.concatenate([sck] * s_q, axis=0)
+                        scv = jnp.concatenate([scv] * s_q, axis=0)
                     s = s * sck
                 if logit_cap is not None:  # Gemma2 attention softcap
                     s = softcap(s, logit_cap)
-                pos = ci * t + jax.lax.broadcasted_iota(jnp.int32, (h, t), 1)
-                s = jnp.where(pos < seq_len, s, NEG_INF)
+                rows = s_q * h
+                pos = ci * t + jax.lax.broadcasted_iota(jnp.int32, (rows, t), 1)
+                # causal per query: query sq (row sq*H + h) sits at absolute
+                # position q0 + sq and sees cache slots <= that position
+                q_pos = q0_ref[gi * g + j] + (
+                    jax.lax.broadcasted_iota(jnp.int32, (rows, t), 0) // h
+                )
+                s = jnp.where((pos <= q_pos) & (pos < seq_len), s, NEG_INF)
 
                 m_prev = m_ref[j, :, :1]
                 m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -226,44 +239,80 @@ def paged_decode_attention(
     interpret: bool = False,
 ) -> jax.Array:
     """One decode step of attention for B sequences.  Returns [B, H, D]."""
+    return paged_decode_attention_mq(
+        q[:, None], cache, layer, block_tables, seq_lens,
+        seq_lens - 1,  # the single query is the sequence tail
+        sm_scale=sm_scale, logit_cap=logit_cap,
+        blocks_per_chunk=blocks_per_chunk, seqs_per_group=seqs_per_group,
+        interpret=interpret,
+    )[:, 0]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("sm_scale", "logit_cap", "blocks_per_chunk",
+                     "seqs_per_group", "interpret"),
+)
+def paged_decode_attention_mq(
+    q: jax.Array,             # [B, S, H, D] — S contiguous trailing queries
+    cache,                    # [L, N, 2, Bs, Hk*D] cache — or QuantKvCache
+    layer: jax.Array,         # scalar int32
+    block_tables: jax.Array,  # [B, M] int32
+    seq_lens: jax.Array,      # [B] int32 — context incl. the new queries
+    q0_pos: jax.Array,        # [B] int32 — absolute position of q[:, 0]
+    sm_scale: float | None = None,
+    logit_cap: float | None = None,
+    blocks_per_chunk: int = 4,
+    seqs_per_group: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """Multi-query flash decode: S queries per row (query j at position
+    q0_pos+j, causal) against the row's owned blocks — the speculative
+    verify pass and other short non-block-aligned S>1 steps stream only
+    live KV instead of gathering the padded table.  Returns [B, S, H, D].
+    Rows whose real query count is < S put padding at the tail; their
+    outputs are finite garbage the caller discards."""
     from dynamo_tpu.ops.kv_quant import is_quant
 
     quant = is_quant(cache)
     data, scale = (cache.data, cache.scale) if quant else (cache, None)
-    b, h, d = q.shape
+    b, s_q, h, d = q.shape
     l, n, _, bs, hkd = data.shape
     hk = hkd // d
     m = block_tables.shape[1]
     g_heads = h // hk
+    rows = s_q * h
     if sm_scale is None:
         sm_scale = 1.0 / (d**0.5)
     c = min(blocks_per_chunk, m)
-    g = seqs_per_group
-    while b % g:  # group size must divide the batch
-        g //= 2
-    g = max(g, 1)
+    # VMEM scratch scales with S*H rows: shrink the group accordingly
+    g = max(1, seqs_per_group // s_q)
+    while b % g:  # group size must divide the batch (terminates at g=1)
+        g -= 1
 
-    # Block-diagonal q expansion: row for head (k, gh) lives in kv-head k's
-    # D-wide column slot; zeros elsewhere.  [B, H, D] -> [B, H, Hk*D] f32,
-    # columns ordered (kv_head, d) to match the cache's trailing axis.
+    # Block-diagonal q expansion: row for (query sq, head (k, gh)) lives in
+    # kv-head k's D-wide column slot; zeros elsewhere.  [B, S, H, D] ->
+    # [B, S*H, Hk*D] f32, columns ordered (kv_head, d) to match the cache.
     qf = q.astype(jnp.float32) * sm_scale
     eye = jnp.eye(hk, dtype=jnp.float32)
-    q_exp = jnp.einsum("bkgd,ke->bkged", qf.reshape(b, hk, g_heads, d), eye)
-    q_exp = q_exp.reshape(b, h, hkd)
+    q_exp = jnp.einsum("bskgd,ke->bskged",
+                       qf.reshape(b, s_q, hk, g_heads, d), eye)
+    q_exp = q_exp.reshape(b, rows, hkd)
 
     in_specs = [
-        pl.BlockSpec((g, h, hkd), lambda i, *_: (i, 0, 0)),
+        pl.BlockSpec((g, rows, hkd), lambda i, *_: (i, 0, 0)),
         pl.BlockSpec(memory_space=pl.ANY),  # cache stays in HBM
     ]
     scratch = [
-        pltpu.VMEM((g, h, hkd), jnp.float32),
-        pltpu.VMEM((g, h, 128), jnp.float32),
-        pltpu.VMEM((g, h, 128), jnp.float32),
+        pltpu.VMEM((g, rows, hkd), jnp.float32),
+        pltpu.VMEM((g, rows, 128), jnp.float32),
+        pltpu.VMEM((g, rows, 128), jnp.float32),
         pltpu.VMEM((2, g, c, 2, bs, hkd), data.dtype),
         pltpu.SemaphoreType.DMA((2, g, c)),
     ]
     operands = [
         seq_lens.astype(jnp.int32),
+        q0_pos.astype(jnp.int32),
         block_tables.astype(jnp.int32),
         jnp.asarray(layer, jnp.int32).reshape(1),
         q_exp,
@@ -278,22 +327,22 @@ def paged_decode_attention(
         operands.append(scale)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(b // g,),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((g, h, hkd), lambda i, *_: (i, 0, 0)),
+        out_specs=pl.BlockSpec((g, rows, hkd), lambda i, *_: (i, 0, 0)),
         scratch_shapes=scratch,
     )
 
     out = pl.pallas_call(
         functools.partial(_kernel_quant if quant else _kernel, c=c, g=g,
-                          logit_cap=logit_cap),
+                          s_q=s_q, logit_cap=logit_cap),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, hkd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, rows, hkd), q.dtype),
         interpret=interpret,
     )(*operands)
 
-    # Collapse the block-diagonal layout back to [B, H, D].
-    out = out.reshape(b, hk, g_heads, hk, d)
-    out = jnp.einsum("bkged,ke->bkgd", out, jnp.eye(hk, dtype=out.dtype))
-    return out.reshape(b, h, d)
+    # Collapse the block-diagonal layout back to [B, S, H, D].
+    out = out.reshape(b, s_q, hk, g_heads, hk, d)
+    out = jnp.einsum("bskged,ke->bskgd", out, jnp.eye(hk, dtype=out.dtype))
+    return out.reshape(b, s_q, h, d)

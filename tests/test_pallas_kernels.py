@@ -251,3 +251,67 @@ def test_prefill_kernel_logit_softcap_matches_oracle():
                                   rows_per_chunk=16, blocks_per_chunk=2,
                                   interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=3e-5)
+
+
+def test_mq_decode_kernel_matches_oracle():
+    """Multi-query flash decode (speculative verify shape): S trailing
+    queries per row, variable real query counts, vs the padded oracle."""
+    from dynamo_tpu.ops.pallas.decode_attention import (
+        paged_decode_attention_mq,
+    )
+
+    rng = np.random.default_rng(21)
+    b, s, h, hk, d, bs, n, m = 4, 4, 8, 4, 64, 16, 32, 8
+    cache = _mk_cache(rng, 2, n, bs, hk, d)
+    bt = jnp.asarray(np.resize(rng.permutation(n), (b, m)).astype(np.int32))
+    # per-row context lengths; queries are the TRAILING s positions
+    lens = np.asarray([5, 17, 64, 128], np.int32)
+    q0 = lens - s  # first query position
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    positions = jnp.asarray(q0[:, None] + np.arange(s)[None, :], jnp.int32)
+
+    ref = paged_attention(
+        q,
+        cache[1, :, 0].reshape(n, bs, hk, d),
+        cache[1, :, 1].reshape(n, bs, hk, d),
+        bt, jnp.asarray(lens), positions,
+    )
+    got = paged_decode_attention_mq(
+        q, cache, jnp.int32(1), bt, jnp.asarray(lens), jnp.asarray(q0),
+        blocks_per_chunk=2, seqs_per_group=4, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=3e-5)
+
+
+def test_mq_decode_kernel_quant_and_softcap():
+    """MQ kernel with the int8 cache and a Gemma2-style score softcap."""
+    from dynamo_tpu.ops.kv_quant import QuantKvCache, dequant_layer_slice
+    from dynamo_tpu.ops.pallas.decode_attention import (
+        paged_decode_attention_mq,
+    )
+
+    rng = np.random.default_rng(22)
+    b, s, h, hk, d, bs, n, m, cap = 2, 3, 4, 2, 32, 16, 16, 4, 30.0
+    data = jnp.asarray(
+        rng.integers(-127, 127, size=(1, n, 2, bs, hk * d)), jnp.int8)
+    scale = jnp.asarray(rng.random((1, n, 2, hk, bs)) * 0.05 + 0.01,
+                        jnp.float32)
+    cache = QuantKvCache(data, scale)
+    bt = jnp.asarray(np.arange(b * m).reshape(b, m).astype(np.int32))
+    lens = np.asarray([s + 9, m * bs], np.int32)
+    q0 = lens - s
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    positions = jnp.asarray(q0[:, None] + np.arange(s)[None, :], jnp.int32)
+
+    layer_kv = dequant_layer_slice(cache.data[0], cache.scale[0], hk)
+    ref = paged_attention(
+        q,
+        layer_kv[:, 0].reshape(n, bs, hk, d),
+        layer_kv[:, 1].reshape(n, bs, hk, d),
+        bt, jnp.asarray(lens), positions, logit_cap=cap,
+    )
+    got = paged_decode_attention_mq(
+        q, cache, jnp.int32(0), bt, jnp.asarray(lens), jnp.asarray(q0),
+        logit_cap=cap, blocks_per_chunk=2, seqs_per_group=2, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=3e-5)
