@@ -167,13 +167,14 @@ def setup():
     return model, params
 
 
-def make_engine(model, params, mesh=None):
+def make_engine(model, params, mesh=None, cache_dtype=None):
     cfg = EngineConfig(
         max_batch_size=4,
         max_model_len=128,
         block_size=8,
         num_blocks=64,
         prefill_buckets=[16, 32, 64, 128],
+        cache_dtype=cache_dtype,
     )
     return AsyncLLMEngine(EngineCore(model, params, cfg, mesh=mesh)).start()
 
@@ -202,19 +203,37 @@ def force_tcp(monkeypatch):
     monkeypatch.setenv("DYN_KV_TRANSFER_FORCE_TCP", "1")
 
 
-def test_disagg_e2e_matches_local(setup, force_tcp):
+@pytest.mark.parametrize("cache_dtype", [None, "int8"])
+def test_disagg_e2e_matches_local(setup, force_tcp, cache_dtype,
+                                  monkeypatch):
     """Remote-prefill decode must produce exactly the local greedy tokens,
     including on a second request that hits the decode-side prefix cache
-    (skip_blocks > 0 path)."""
+    (skip_blocks > 0 path).  With cache_dtype=int8 the transferred blocks
+    are (data, scale) pairs end to end — quantized once on the prefill
+    worker, moved bit-exactly, decoded against on the decode worker."""
     model, params = setup
     rng = np.random.default_rng(7)
     prompt = rng.integers(1, 128, size=30).tolist()
 
+    # pin the wire format: int8 runs must actually move (int8 data, f32
+    # scale) pairs — token equality alone would also pass a dequantizing
+    # fallback
+    import dynamo_tpu.llm.kv.transfer as tr
+
+    payload_parts: list = []
+    real_pack = tr.pack_blocks
+
+    def spy_pack(arr):
+        parts = list(arr) if isinstance(arr, (tuple, list)) else [arr]
+        payload_parts.append([(np.asarray(p).dtype.name,) for p in parts])
+        return real_pack(arr)
+
     async def go():
+        monkeypatch.setattr(tr, "pack_blocks", spy_pack)
         srv = await CoordinatorServer(port=0).start()
-        decode_engine = make_engine(model, params)
-        prefill_engine = make_engine(model, params)
-        reference_engine = make_engine(model, params)
+        decode_engine = make_engine(model, params, cache_dtype=cache_dtype)
+        prefill_engine = make_engine(model, params, cache_dtype=cache_dtype)
+        reference_engine = make_engine(model, params, cache_dtype=cache_dtype)
         try:
             c_dec = await CoordinatorClient(srv.url).connect()
             c_pre = await CoordinatorClient(srv.url).connect()
@@ -239,6 +258,11 @@ def test_disagg_e2e_matches_local(setup, force_tcp):
             assert prefill.handled == 1
             # prefill-side blocks were released after transfer
             assert prefill_engine.core._held == {}
+            assert payload_parts, "no KV payload crossed the wire"
+            if cache_dtype == "int8":
+                assert payload_parts[0] == [("int8",), ("float32",)]
+            else:
+                assert payload_parts[0] == [("float32",)]
 
             # second identical request: decode-side prefix cache supplies the
             # full-block prefix; remainder (30-24=6 < any threshold... use
