@@ -130,6 +130,9 @@ class Operator:
         self.watch_dir = watch_dir  # rescanned every tick when set
         self.specs: dict[str, DeploymentSpec] = {}
         self.status: dict[str, dict] = {}
+        # last successfully parsed spec name per watched file: a torn read
+        # must keep its previous spec, not delete it (see load_dir)
+        self._file_spec: dict[str, str] = {}
         self._task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self._stop = False
@@ -146,16 +149,34 @@ class Operator:
 
     def load_dir(self, path: str | Path) -> None:
         """Sync specs from a directory of YAML files (CRD-watch stand-in):
-        files present become specs; specs whose file vanished are deleted."""
+        files present become specs; specs whose file vanished are deleted.
+
+        A file that fails to PARSE keeps its previous spec: non-atomic
+        writers (editors, CI) produce transient torn reads, and treating
+        those as deletions would tear down a healthy deployment's objects
+        for one reconcile tick and recreate them the next (full pod churn).
+        """
+        files = sorted(Path(path).glob("*.yaml"))
         seen = set()
-        for f in sorted(Path(path).glob("*.yaml")):
+        for f in files:
+            key = str(f)
             try:
                 spec = DeploymentSpec.from_yaml(f)
             except Exception:
-                log.exception("bad spec file %s skipped", f)
+                log.exception("bad spec file %s skipped (keeping previous "
+                              "spec if any)", f)
+                # the file is still present: whatever it last parsed to
+                # stays live until it parses again
+                prev = self._file_spec.get(key)
+                if prev is not None:
+                    seen.add(prev)
                 continue
             seen.add(spec.name)
+            self._file_spec[key] = spec.name
             self.specs[spec.name] = spec
+        self._file_spec = {
+            k: v for k, v in self._file_spec.items() if Path(k).exists()
+        }
         for name in [n for n in self.specs if n not in seen]:
             del self.specs[name]
         self._wake.set()

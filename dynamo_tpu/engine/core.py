@@ -866,8 +866,12 @@ class EngineCore:
             self._sp_size > 0
             and req.computed_tokens == 0
             and req.prompt_len >= self.config.sp_prefill_threshold
-            # the SP first-token sample path has no grammar mask hook
+            # the SP first-token sample path has no grammar/bias/min_p
+            # hooks — those requests take the chunked prefill path, which
+            # threads _sampling_extras into the final chunk's sampler
             and not req.sampling.json_mode
+            and not req.sampling.logit_bias
+            and not req.sampling.min_p
         )
 
     def _run_sp_prefill(self, req: EngineRequest) -> None:
@@ -1019,6 +1023,14 @@ class EngineCore:
             seq_lens[i] = p + n
             limits[i] = limit
         if not any_prop or not rows:
+            return False
+        # a speculative dispatch emits 1 token for every non-proposing row
+        # (vs up to decode_steps in a burst): one repetitive request must
+        # not collapse the whole batch's throughput, so speculate only when
+        # proposals cover at least half the rows (single-row batches always
+        # qualify — speculation is the latency lever there)
+        proposing = sum(1 for r in rows if props.get(r.slot))
+        if self.config.decode_steps > 1 and proposing * 2 < len(rows):
             return False
 
         # slice the block table to the batch's live context, pow2-bucketed:

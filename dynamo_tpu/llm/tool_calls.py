@@ -178,7 +178,7 @@ class ToolCallParser:
             if at >= 0:
                 out, self._pending = p[:at], p[at:]
                 self._jailed = True
-                self._emitted_any = self._emitted_any or bool(out)
+                self._emitted_any = self._emitted_any or bool(out.strip())
                 return out
         # hold back a tail that could still become a marker
         hold = 0
@@ -188,7 +188,10 @@ class ToolCallParser:
                     hold = max(hold, k)
                     break
         out, self._pending = p[: len(p) - hold], p[len(p) - hold:]
-        self._emitted_any = self._emitted_any or bool(out)
+        # whitespace-only output must NOT count as emitted prose: a leading
+        # "\n" delta before a bare-JSON llama3 call would otherwise disarm
+        # the message-initial jail and stream the call out as content
+        self._emitted_any = self._emitted_any or bool(out.strip())
         return out
 
     # --------------------------------------------------------------- parsing

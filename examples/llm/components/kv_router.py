@@ -40,16 +40,10 @@ class Router:
 
     @dynamo_endpoint
     async def route(self, req: dict):
-        from dynamo_tpu.llm.kv_router.scheduler import AllWorkersBusy
+        # delegate to the library's AsyncEngine surface so the decision
+        # wire contract ({worker_id, overlap_*}, worker_id=None on cold
+        # start) has exactly one definition (llm/kv_router/router.py)
+        from dynamo_tpu.runtime.engine import Context
 
-        try:
-            decision = self.router.schedule(req["token_ids"])
-        except AllWorkersBusy:
-            # no metrics yet (cold start) — caller falls back to round-robin
-            yield {"worker_id": None}
-            return
-        yield {
-            "worker_id": decision.worker_id,
-            "overlap_blocks": decision.overlap_blocks,
-            "overlap_tokens": decision.overlap_tokens,
-        }
+        async for decision in self.router.generate(Context(req)):
+            yield decision

@@ -122,3 +122,30 @@ def test_async_loop_reconciles_on_set_spec():
         await op.stop()
 
     asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_load_dir_torn_read_keeps_previous_spec(tmp_path):
+    """A spec file that transiently fails to parse (non-atomic write /
+    truncation) must keep its previous spec — NOT delete it and tear down
+    the live deployment's objects for one reconcile tick."""
+    (tmp_path / "a.yaml").write_text(SPEC_YAML)
+    cluster = MemoryCluster()
+    op = Operator(cluster)
+    op.load_dir(tmp_path)
+    op.reconcile_once()
+    owned = cluster.list_owned(op.owner)
+    assert owned
+    # torn read: file momentarily invalid
+    (tmp_path / "a.yaml").write_text("{this is : not yaml ::")
+    op.load_dir(tmp_path)
+    op.reconcile_once()
+    assert cluster.list_owned(op.owner) == owned  # nothing torn down
+    # file repaired → still live; file deleted → objects pruned
+    (tmp_path / "a.yaml").write_text(SPEC_YAML)
+    op.load_dir(tmp_path)
+    op.reconcile_once()
+    assert cluster.list_owned(op.owner) == owned
+    (tmp_path / "a.yaml").unlink()
+    op.load_dir(tmp_path)
+    op.reconcile_once()
+    assert cluster.list_owned(op.owner) == []

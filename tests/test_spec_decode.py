@@ -172,3 +172,49 @@ def test_spec_respects_block_limits():
     assert outs[-1].finish_reason is not None
     total = 12 + sum(len(o.token_ids) for o in outs)
     assert total <= 48
+
+
+def test_spec_skips_batch_with_low_proposal_coverage(monkeypatch):
+    """One repetitive request must not drag a whole multi-step batch onto
+    the 1-token-per-row verify path: speculation requires proposals on at
+    least half the rows when bursts are configured.  (Proposals are
+    stubbed: only prompts starting with the marker token propose.)"""
+    import dynamo_tpu.engine.spec as spec_mod
+
+    MARK = 11
+
+    def stub(tokens, ngram, k, min_ngram=1):
+        return [12, 13] if tokens and tokens[0] == MARK else []
+
+    monkeypatch.setattr(spec_mod, "propose_ngram", stub)
+
+    def run(marked_rows):
+        model = CycleModel()
+        core = EngineCore(
+            model, model.init_params(),
+            EngineConfig(max_batch_size=4, max_model_len=256, block_size=16,
+                         num_blocks=64, decode_steps=8, spec_tokens=4),
+            eos_token_ids=[],
+        )
+        outs = {}
+        for j in range(4):
+            rid = f"r{j}"
+            outs[rid] = []
+            first = MARK if j < marked_rows else 40 + 5 * j
+            core.submit(EngineRequest(
+                request_id=rid, prompt=[first, 31 + j, 32 + j],
+                sampling=SamplingOptions(temperature=0.0),
+                stops=StopConditions(max_tokens=12, ignore_eos=True),
+                emit=outs[rid].append,
+            ))
+        for _ in range(300):
+            if not core.step():
+                break
+        for rid, lst in outs.items():
+            assert sum(len(o.token_ids) for o in lst) == 12, rid
+        return core
+
+    # 1 proposing row of 4: the gate keeps the burst path
+    assert run(marked_rows=1).spec_steps == 0
+    # 3 proposing rows of 4: speculation engages
+    assert run(marked_rows=3).spec_steps > 0

@@ -276,3 +276,15 @@ def test_http_tools_plain_answer_keeps_content():
             await svc.stop()
 
     _run(go())
+
+
+def test_leading_whitespace_delta_does_not_disarm_bare_json_jail():
+    """llama3-style bare-JSON call preceded by a newline delta: the
+    whitespace-only emission must not count as 'prose emitted', or the
+    message-initial jail never triggers and the call streams as content."""
+    p = ToolCallParser()
+    out = p.feed("\n")
+    out += p.feed('{"name": "get_weather", "parameters": {"city": "SF"}}')
+    tail, calls = p.finish()
+    assert calls and calls[0]["function"]["name"] == "get_weather"
+    assert (out + tail).strip() == ""
