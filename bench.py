@@ -130,10 +130,13 @@ def _probe_pallas_prefill() -> None:
         os.environ["DYNAMO_DISABLE_PALLAS_PREFILL"] = "1"
 
 
-def _probe_kv_quant() -> bool:
-    """Compile-probe BOTH Pallas kernels against an int8 QuantKvCache on the
-    real backend; the int8 KV cache is only enabled when the in-kernel
-    dequant paths actually lower (ops/kv_quant.py)."""
+def _probe_kv_quant(mcfg: dict, batch: int, max_len: int, bs: int,
+                    prefill_chunk: int) -> bool:
+    """Compile-probe BOTH Pallas kernels against an int8 QuantKvCache at
+    the EXACT geometry the bench will run (model heads/head_dim, its
+    block table width, batch, prefill chunk) — a differently-shaped probe
+    could lower while the real executable hits a Mosaic limit
+    mid-measurement.  One layer keeps the probe cache small."""
     import jax
     import jax.numpy as jnp
 
@@ -142,22 +145,29 @@ def _probe_kv_quant() -> bool:
         from dynamo_tpu.ops.pallas.decode_attention import paged_decode_attention
         from dynamo_tpu.ops.pallas.prefill_attention import paged_prefill_attention
 
-        b, s, h, hk, d, bs = 2, 128, 8, 4, 64, 16
+        hd = mcfg.get("head_dim", mcfg["hidden_size"] // mcfg["num_heads"])
+        h, hk = mcfg["num_heads"], mcfg["num_kv_heads"]
+        m = -(-max_len // bs)  # the engine's block-table width
+        n = min(batch * m + 4, 4096)
         cache = QuantKvCache(
-            jnp.zeros((1, 16, 2, bs, hk * d), jnp.int8),
-            jnp.ones((1, 16, 2, hk, bs), jnp.float32),
+            jnp.zeros((1, n, 2, bs, hk * hd), jnp.int8),
+            jnp.ones((1, n, 2, hk, bs), jnp.float32),
         )
-        bt = jnp.zeros((b, 10), jnp.int32)
+        bt = ((jnp.arange(batch, dtype=jnp.int32)[:, None] * m
+               + jnp.arange(m, dtype=jnp.int32)[None, :]) % n)
+        lens = jnp.full((batch,), min(4 * bs, max_len), jnp.int32)
         out = paged_decode_attention(
-            jnp.ones((b, h, d), jnp.bfloat16), cache, jnp.int32(0), bt,
-            jnp.asarray([1, 32], jnp.int32),
+            jnp.ones((batch, h, hd), jnp.bfloat16), cache, jnp.int32(0),
+            bt, lens,
         )
         jax.block_until_ready(out)
-        q = jnp.ones((b, s, h, d), jnp.bfloat16)
-        kv = jnp.ones((b, s, hk, d), jnp.bfloat16)
+        s = min(prefill_chunk or 512, max_len)
+        q = jnp.ones((1, s, h, hd), jnp.bfloat16)
+        kv = jnp.ones((1, s, hk, hd), jnp.bfloat16)
         out = paged_prefill_attention(
-            q, kv, kv, cache, jnp.int32(0), bt,
-            jnp.full((b,), s, jnp.int32), jnp.zeros((b,), jnp.int32),
+            q, kv, kv, cache, jnp.int32(0), bt[:1],
+            jnp.asarray([min(2 * bs + s, max_len)], jnp.int32),
+            jnp.asarray([min(2 * bs, max_len - s)], jnp.int32),
         )
         jax.block_until_ready(out)
         return True
@@ -190,9 +200,14 @@ def main() -> None:
     on_accel = platform != "cpu"
     hbm = _hbm_limit(dev) if on_accel else (8 << 30)
 
-    name = os.environ.get("DYNAMO_BENCH_MODEL", "auto" if on_accel else "tiny")
+    name_req = os.environ.get("DYNAMO_BENCH_MODEL", "auto" if on_accel else "tiny")
     batch = int(os.environ.get("DYNAMO_BENCH_BATCH", "64" if on_accel else "8"))
-    max_len = int(os.environ.get("DYNAMO_BENCH_MAX_LEN", "2048"))
+    max_len_req = int(os.environ.get("DYNAMO_BENCH_MAX_LEN", "2048"))
+    # 32-token blocks halve the decode kernel's per-block DMA count
+    block_size = int(os.environ.get("DYNAMO_BENCH_BLOCK_SIZE",
+                                    "32" if on_accel else "16"))
+    prefill_chunk = int(os.environ.get("DYNAMO_BENCH_PREFILL_CHUNK",
+                                       "512" if on_accel else "0"))
     # int8 weight-only quantization (models/quant.py): halves weight HBM
     # footprint AND per-decode-step weight traffic — this is what fits the
     # north-star 8B model on a single 16GiB v5e chip (the reference's
@@ -201,28 +216,41 @@ def main() -> None:
     wbytes = 1 if quant == "int8" else 2
     # int8 KV cache (ops/kv_quant.py): halves KV footprint + decode KV
     # traffic.  "auto" = on iff the quantized kernel paths compile-probe OK
-    # on this backend (checked below, before model selection).
-    kv_quant = os.environ.get("DYNAMO_BENCH_KV_QUANT",
-                              "auto" if on_accel else "none")
-    if kv_quant == "auto":
-        kv_quant = "int8" if _probe_kv_quant() else "none"
-    def fit_bytes(cfg: dict, mlen: int) -> int:
-        # ~1GB slack: activations, prefill buffers, XLA workspace
-        hd = cfg.get("head_dim", cfg["hidden_size"] // cfg["num_heads"])
-        # int8 payload + one f32 scale per token per kv head per k/v
-        kv_bytes_elem = (1.0 + 4.0 / hd) if kv_quant == "int8" else 2.0
-        per_tok = int(_kv_bytes_per_token(cfg, 1) * kv_bytes_elem)
-        return (_param_bytes(cfg, wbytes) + batch * mlen * per_tok
-                + (1 << 30))
+    # at the exact geometry the selected config will run.
+    kv_req = os.environ.get("DYNAMO_BENCH_KV_QUANT",
+                            "auto" if on_accel else "none")
 
-    if name == "auto":
-        # largest model whose weights + KV cache fit in ~92% of HBM
-        # (at the post-shrink minimum cache size of 512 tokens/seq)
-        name = "8b" if fit_bytes(MODELS["8b"], 512) < hbm * 0.92 else "1b"
+    def select(kvq: str) -> tuple[str, int]:
+        """(model name, max_len) fitting ~92% of HBM under KV mode kvq."""
+
+        def fit_bytes(cfg: dict, mlen: int) -> int:
+            # ~1GB slack: activations, prefill buffers, XLA workspace
+            hd = cfg.get("head_dim", cfg["hidden_size"] // cfg["num_heads"])
+            # int8 payload + one f32 scale per token per kv head per k/v
+            kv_bytes_elem = (1.0 + 4.0 / hd) if kvq == "int8" else 2.0
+            per_tok = int(_kv_bytes_per_token(cfg, 1) * kv_bytes_elem)
+            return (_param_bytes(cfg, wbytes) + batch * mlen * per_tok
+                    + (1 << 30))
+
+        name = name_req
+        if name == "auto":
+            # largest model whose weights + KV cache fit in ~92% of HBM
+            # (at the post-shrink minimum cache size of 512 tokens/seq)
+            name = "8b" if fit_bytes(MODELS["8b"], 512) < hbm * 0.92 else "1b"
+        # shrink the cache (not the batch) if the model is tight on HBM
+        mlen = max_len_req
+        while on_accel and mlen > 512 and fit_bytes(MODELS[name], mlen) > hbm * 0.92:
+            mlen //= 2
+        return name, mlen
+
+    kv_quant = "int8" if kv_req in ("auto", "int8") else "none"
+    name, max_len = select(kv_quant)
+    if kv_quant == "int8" and kv_req == "auto" and not _probe_kv_quant(
+        MODELS[name], batch, max_len, block_size, prefill_chunk
+    ):
+        kv_quant = "none"
+        name, max_len = select(kv_quant)
     mcfg = MODELS[name]
-    # shrink the cache (not the batch) if the chosen model is tight on HBM
-    while on_accel and max_len > 512 and fit_bytes(mcfg, max_len) > hbm * 0.92:
-        max_len //= 2
 
     steps = int(os.environ.get("DYNAMO_BENCH_STEPS", "300" if on_accel else "30"))
     isl = int(os.environ.get("DYNAMO_BENCH_ISL", "128"))
@@ -232,15 +260,10 @@ def main() -> None:
                                       "64" if on_accel else "4"))
 
     cfg = ModelConfig(**mcfg, dtype="bfloat16" if on_accel else "float32")
-    # 32-token blocks halve the decode kernel's per-block DMA count
-    block_size = int(os.environ.get("DYNAMO_BENCH_BLOCK_SIZE",
-                                    "32" if on_accel else "16"))
     # chunked prefill bounds each prefill dispatch so decode bursts (and a
     # fresh prompt's first chunk) interleave at fine grain — this is the
     # config the driver-measured TTFT exercises (VERDICT r2 weak #3 asked
     # for exactly this)
-    prefill_chunk = int(os.environ.get("DYNAMO_BENCH_PREFILL_CHUNK",
-                                       "512" if on_accel else "0"))
     ecfg = EngineConfig(
         max_batch_size=batch,
         max_model_len=max_len,

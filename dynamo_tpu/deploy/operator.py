@@ -157,6 +157,7 @@ class Operator:
         for one reconcile tick and recreate them the next (full pod churn).
         """
         files = sorted(Path(path).glob("*.yaml"))
+        before = dict(self.specs)
         seen = set()
         for f in files:
             key = str(f)
@@ -179,7 +180,11 @@ class Operator:
         }
         for name in [n for n in self.specs if n not in seen]:
             del self.specs[name]
-        self._wake.set()
+        # wake only on actual change: run() calls load_dir every tick when
+        # watch_dir is set, and an unconditional set() would make the
+        # interval wait return instantly — a 100%-CPU reconcile hot-spin
+        if self.specs != before:
+            self._wake.set()
 
     # ------------------------------------------------------------- reconcile
     def desired_objects(self) -> dict[tuple[str, str, str], dict]:

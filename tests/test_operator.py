@@ -149,3 +149,19 @@ def test_load_dir_torn_read_keeps_previous_spec(tmp_path):
     op.load_dir(tmp_path)
     op.reconcile_once()
     assert cluster.list_owned(op.owner) == []
+
+
+def test_load_dir_unchanged_specs_do_not_wake(tmp_path):
+    """The watch loop calls load_dir every tick; an unchanged directory
+    must NOT set the wake event or the interval wait degenerates into a
+    100%-CPU hot spin."""
+    (tmp_path / "a.yaml").write_text(SPEC_YAML)
+    op = Operator(MemoryCluster())
+    op.load_dir(tmp_path)
+    assert op._wake.is_set()  # first load is a change
+    op._wake.clear()
+    op.load_dir(tmp_path)     # nothing changed
+    assert not op._wake.is_set()
+    (tmp_path / "a.yaml").unlink()
+    op.load_dir(tmp_path)     # deletion is a change
+    assert op._wake.is_set()
