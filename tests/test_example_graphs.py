@@ -127,3 +127,19 @@ def test_disagg_router_graph_serves():
         assert "Router" in handle.instances
 
     run(go())
+
+
+def test_hello_world_example_runs():
+    """examples/hello_world: the three-stage SDK pipeline streams through
+    the whole graph (ref examples/hello_world/hello_world.py)."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [sys.executable, "examples/hello_world/hello_world.py"],
+        capture_output=True, text=True, timeout=180, cwd=str(repo),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.strip().endswith("HELLO WORLD!")

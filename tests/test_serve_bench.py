@@ -22,3 +22,4 @@ def test_serve_bench_echo_mode():
     levels = lines[:-1]
     assert [l["concurrency"] for l in levels] == [1, 2]
     assert all(l["ttft_p50_ms"] >= 0 for l in levels)
+
