@@ -488,3 +488,82 @@ def test_remote_prefill_cancellation(setup):
             await srv.stop()
 
     run(go())
+
+
+def test_disagg_json_mode_end_to_end(setup, force_tcp):
+    """JSON mode across the disagg split: the prefill worker samples the
+    grammar-masked first token, the decode worker continues the automaton
+    from it (host advance on the transferred first token), and the final
+    text parses as JSON."""
+    import json as _json
+
+    from dynamo_tpu.engine.grammar import JsonGrammar
+
+    model, params = setup
+    # byte-per-token vocab slice over the tiny model's 128-token vocab
+    toks: list = [None] * 128
+    for b in range(125):
+        toks[3 + b] = bytes([b])
+    EOS = 2
+    grammar = JsonGrammar.from_token_bytes(toks, eos_ids=[EOS])
+
+    def engine():
+        cfg = EngineConfig(
+            max_batch_size=4, max_model_len=128, block_size=8, num_blocks=64,
+            prefill_buckets=[16, 32, 64, 128],
+        )
+        return AsyncLLMEngine(EngineCore(
+            model, params, cfg, eos_token_ids=[EOS], grammar=grammar
+        )).start()
+
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        decode_engine = engine()
+        prefill_engine = engine()
+        try:
+            c_dec = await CoordinatorClient(srv.url).connect()
+            c_pre = await CoordinatorClient(srv.url).connect()
+            worker = DecodeWorker(
+                decode_engine, coordinator=c_dec, namespace="jdis",
+                router=DisaggregatedRouter(
+                    DisaggRouterConf(max_local_prefill_length=0),
+                    namespace="jdis",
+                ),
+            )
+            await worker.start()
+            prefill = PrefillWorker(prefill_engine, c_pre, "jdis")
+            prefill_task = asyncio.ensure_future(prefill.run())
+
+            ctx = Context(BackendInput(
+                token_ids=list(range(5, 25)),
+                sampling=SamplingOptions(temperature=1.0, json_mode=True),
+                stops=StopConditions(max_tokens=40),
+            ))
+            outs = [o async for o in worker.generate(ctx)]
+            assert prefill.handled == 1
+            ids = [t for o in outs for t in o.token_ids]
+            assert ids, outs
+            raw = b"".join(toks[t] for t in ids if t != EOS and toks[t])
+            if outs[-1].finish_reason is FinishReason.EOS:
+                _json.loads(raw.decode("utf-8", errors="replace"))
+            else:  # LENGTH: a valid JSON prefix — replay the automaton
+                from dynamo_tpu.engine.grammar import INIT_STATE
+
+                s, d, st = INIT_STATE, 0, 0
+                for t in ids:
+                    if t == EOS:
+                        break
+                    assert grammar.tables.valid_mask(s, d, st)[t]
+                    s, d, st = grammar.tables.advance(s, d, st, t)
+
+            prefill.request_stop()
+            await prefill_task
+            await worker.stop()
+            await c_dec.close()
+            await c_pre.close()
+        finally:
+            decode_engine.shutdown()
+            prefill_engine.shutdown()
+            await srv.stop()
+
+    run(go())
