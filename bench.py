@@ -17,6 +17,10 @@ whichever model the chip fits and labels it).
 
 Env knobs: DYNAMO_BENCH_MODEL (tiny|1b|8b|auto), DYNAMO_BENCH_BATCH,
 DYNAMO_BENCH_STEPS, DYNAMO_BENCH_ISL, DYNAMO_BENCH_MAX_LEN,
+DYNAMO_BENCH_BLOCK_SIZE, DYNAMO_BENCH_DECODE_STEPS,
+DYNAMO_BENCH_PREFILL_CHUNK, DYNAMO_BENCH_TTFT_ISL,
+DYNAMO_BENCH_QUANT (int8|none, weights),
+DYNAMO_BENCH_KV_QUANT (auto|int8|none, KV cache),
 DYNAMO_BENCH_INIT_TIMEOUT (seconds to wait for the TPU backend).
 """
 

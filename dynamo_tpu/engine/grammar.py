@@ -383,16 +383,14 @@ def compile_vocab(
             npush[mi] -= 1
             pushbits[mi] &= ~(1 << npush[mi]).astype(np.int8)
             # context after the pop: remaining in-token push, or unknown
-            rem_push = np.zeros_like(npush)
-            rem_push[mi] = npush[mi]
-            has_rem = mi & (rem_push > 0)
+            has_rem = mi & (npush > 0)
             if has_rem.any():
                 topsym = (pushbits[has_rem] >> (npush[has_rem] - 1)) & 1
                 ns[has_rem] = np.where(
                     topsym == SYM_OBJ, AFTER_VALUE["O"], AFTER_VALUE["A"]
                 )
             # pop from the outer (runtime) stack
-            mo = m & alive & ~mi & (op == OP_POP)
+            mo = m & alive & ~mi
             over = mo & (npops >= MAX_TOKEN_OPS)
             alive &= ~over
             mo &= ~over
