@@ -35,6 +35,12 @@ import numpy as np
 
 BASELINE_TOK_S = 2000.0  # north star: >=2000 output tok/s/chip (8B disagg)
 
+# set by main() once jax.devices() succeeds: the crash-respawn wrapper only
+# retries failures AFTER a live backend attach (a dead-at-init backend
+# already burned DYNAMO_BENCH_INIT_TIMEOUT; doubling it helps nobody, and
+# deterministic config errors would just re-fail identically)
+_BACKEND_READY = False
+
 MODELS = {
     # fast CI / CPU smoke
     "tiny": dict(vocab_size=2048, hidden_size=256, intermediate_size=512,
@@ -190,6 +196,8 @@ def main() -> None:
         force_cpu_devices(1)
     init_timeout = float(os.environ.get("DYNAMO_BENCH_INIT_TIMEOUT", "600"))
     devices = _wait_for_backend(init_timeout)
+    global _BACKEND_READY
+    _BACKEND_READY = True
     import jax
 
     from dynamo_tpu.engine.config import EngineConfig
@@ -394,5 +402,29 @@ def main() -> None:
     }))
 
 
+def _main_with_respawn() -> None:
+    """One self-respawn on a mid-run crash: the tunneled TPU backend can
+    die AFTER init (round-3 build window saw hours-long outages with
+    flapping recovery), and a dead backend poisons the in-process JAX
+    client — only a fresh process can re-attach.  The driver runs this
+    file exactly once per round; a transient blip should cost a retry,
+    not the round's measurement."""
+    if os.environ.get("DYNAMO_BENCH_RESPAWNED"):
+        main()
+        return
+    try:
+        main()
+    except Exception:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        if not _BACKEND_READY:
+            raise  # init failure or config error: retrying can't help
+        print("# bench crashed mid-run; respawning once with a fresh "
+              "backend", file=sys.stderr)
+        os.environ["DYNAMO_BENCH_RESPAWNED"] = "1"
+        os.execv(sys.executable, [sys.executable, os.path.abspath(__file__)])
+
+
 if __name__ == "__main__":
-    main()
+    _main_with_respawn()
