@@ -25,6 +25,7 @@ are the only cross-thread entry points and only touch thread-safe queues.
 from __future__ import annotations
 
 import concurrent.futures
+import dataclasses
 import logging
 import queue
 import threading
@@ -36,7 +37,8 @@ import numpy as np
 
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.grammar import (
-    INIT_STATE, JsonGrammar, device_tables, grammar_advance, grammar_mask,
+    INIT_STATE, JsonGrammar, compile_choice_vocab, compose_tables,
+    device_tables, grammar_advance, grammar_mask,
 )
 from dynamo_tpu.engine.request import EngineRequest, RequestState
 from dynamo_tpu.engine.sampling import K_MAX, sample_full
@@ -193,7 +195,8 @@ class EngineCore:
         # first json_mode request instead of every engine start.
         self._grammar = grammar
         self._grammar_tok = None
-        self._gdev = None
+        self._choice_tables: dict[tuple, object] = {}
+        self._gdev_cache: dict[tuple, tuple] = {}
         self.block_manager = KvBlockManager(
             config.num_blocks,
             config.block_size,
@@ -395,12 +398,89 @@ class EngineCore:
             0 <= e < self.model.config.vocab_size for e in g.tables.eos_ids
         )
 
-    def _grammar_device(self):
-        if self._gdev is None:
-            self._gdev = device_tables(
-                self._grammar.tables, self.model.config.vocab_size
+    @staticmethod
+    def _grammar_key(req: EngineRequest):
+        """None | "json" | ("choice", choices...) — which grammar (if any)
+        constrains this request.  JSON wins when both are set."""
+        if req.sampling.json_mode:
+            return "json"
+        if req.sampling.guided_choice:
+            return ("choice",) + tuple(req.sampling.guided_choice)
+        return None
+
+    # composite state budget: a dispatch's composed tables must stay well
+    # inside int16 ids; requests that would exceed it wait for slots to
+    # free (same backpressure shape as NoFreeBlocks)
+    GRAMMAR_STATE_BUDGET = 16384
+
+    @staticmethod
+    def _grammar_states_bound(key) -> int:
+        """Cheap upper bound on a grammar's state count (no compile)."""
+        if key == "json":
+            return 128  # the JSON pushdown automaton is ~90 states
+        return sum(len(c.encode("utf-8")) for c in key[1:]) + 2
+
+    def _active_grammar_budget_ok(self, new_key) -> bool:
+        keys = {self._grammar_key(r) for r in self.slots if r is not None}
+        keys.discard(None)
+        keys.add(new_key)
+        return (sum(self._grammar_states_bound(k) for k in keys)
+                <= self.GRAMMAR_STATE_BUDGET)
+
+    def _tables_for(self, key):
+        """Host VocabTables for one grammar key (request-relative state
+        space).  Choice tables compile on first use and cache by choices."""
+        if key == "json":
+            return self._grammar.tables
+        if key in self._choice_tables:
+            return self._choice_tables[key]
+        tables = compile_choice_vocab(
+            self._grammar.token_bytes, list(key[1:]),
+            eos_ids=self._grammar.tables.eos_ids,
+        )
+        cap = max(16, self.config.max_batch_size)
+        if len(self._choice_tables) >= cap:
+            # evict a set no active request is using — in-flight grammars
+            # must stay resident or every dispatch would recompile them
+            active = {self._grammar_key(r) for r in self.slots
+                      if r is not None}
+            victim = next((k for k in self._choice_tables
+                           if k not in active), None)
+            if victim is not None:
+                self._choice_tables.pop(victim)
+                self._gdev_cache.clear()  # composites may reference it
+        self._choice_tables[key] = tables
+        return tables
+
+    def _composite_for(self, keys: tuple):
+        """(device tables, {key: state offset}) for a dispatch whose
+        constrained rows use exactly ``keys`` (json first — the pushdown
+        sentinel resolves against offset-0 ids)."""
+        if keys not in self._gdev_cache:
+            comp, offs = compose_tables([self._tables_for(k) for k in keys])
+            # pad the state axis to a power of two: the table rides the
+            # jitted step as a pytree, so each distinct shape is a fresh
+            # executable — bucketing keeps the count O(log) over keysets
+            n = comp.n_states
+            pad = (1 << max(0, (n - 1).bit_length())) - n
+            if pad:
+                comp = dataclasses.replace(
+                    comp,
+                    next_state=np.pad(comp.next_state, ((0, pad), (0, 0))),
+                    npops=np.pad(comp.npops, ((0, pad), (0, 0))),
+                    popbits=np.pad(comp.popbits, ((0, pad), (0, 0))),
+                    npush=np.pad(comp.npush, ((0, pad), (0, 0))),
+                    pushbits=np.pad(comp.pushbits, ((0, pad), (0, 0))),
+                    eos_ok=np.pad(comp.eos_ok, (0, pad)),
+                    terminal_only=np.pad(comp.terminal_only, (0, pad)),
+                )
+            if len(self._gdev_cache) >= 8:
+                self._gdev_cache.clear()
+            self._gdev_cache[keys] = (
+                device_tables(comp, self.model.config.vocab_size),
+                dict(zip(keys, offs)),
             )
-        return self._gdev
+        return self._gdev_cache[keys]
 
     def _sampling_extras(self, reqs, rows=None) -> dict:
         """min_p / logit_bias device kwargs for one dispatch, or {} when no
@@ -432,13 +512,23 @@ class EngineCore:
             kw["bias_vals"] = jnp.asarray(vals)
         return kw
 
+    def _dispatch_keys(self, reqs) -> tuple:
+        """Ordered grammar keys for one dispatch: json first (pushdown
+        sentinel constraint), then choice sets in first-seen order."""
+        keys = {self._grammar_key(r) for r in reqs}
+        keys.discard(None)
+        # canonical order: identical grammar sets must hit the same cached
+        # composite regardless of request arrival order
+        return tuple(sorted(keys, key=lambda k: (k != "json", k)))
+
     def _gram_kwargs(self, gram) -> dict:
         """Device kwargs for one dispatch's grammar state, or {}."""
         if gram is None:
             return {}
-        jrows, jstate, jdepth, jstack = gram
+        keys, jrows, jstate, jdepth, jstack = gram
+        gdev, _ = self._composite_for(keys)
         return dict(
-            grammar=self._grammar_device(),
+            grammar=gdev,
             jrows=jnp.asarray(jrows), jstate=jnp.asarray(jstate),
             jdepth=jnp.asarray(jdepth), jstack=jnp.asarray(jstack),
         )
@@ -678,14 +768,23 @@ class EngineCore:
                 self._admitted.remove(req)
                 self._finish(req, FinishReason.LENGTH)
                 continue
-            if req.sampling.json_mode and not self._grammar_usable():
-                # response_format=json_object needs tokenizer-compiled
-                # tables AND a model-vocab EOS id (the terminal state is
-                # eos-only; without one the mask would go all -inf after
-                # the closing brace and sampling degrades to uniform noise)
+            gkey = self._grammar_key(req)
+            if gkey is not None and not (
+                self._grammar_usable()
+                and (gkey == "json" or self._grammar.token_bytes is not None)
+            ):
+                # constrained decoding needs tokenizer-compiled tables AND
+                # a model-vocab EOS id (terminal states are eos-only;
+                # without one the mask would go all -inf on completion and
+                # sampling degrades to uniform noise)
                 self._admitted.remove(req)
                 self._finish(req, FinishReason.ERROR)
                 continue
+            if gkey is not None and not self._active_grammar_budget_ok(gkey):
+                # composed dispatch tables must stay inside int16 state ids:
+                # wait for constrained slots to free (NoFreeBlocks-style
+                # backpressure, not an error — the request is valid)
+                break
             req.seq = TokenBlockSequence(req.prompt, self.config.block_size)
             try:
                 alloc = self.block_manager.allocate(
@@ -799,9 +898,13 @@ class EngineCore:
         gram = None
         # only the final chunk's sample is kept — masking earlier chunks
         # would just burn an extra executable per prefill bucket
-        if final and req.sampling.json_mode and self._ensure_grammar() is not None:
+        gkey = self._grammar_key(req)
+        if final and gkey is not None and self._ensure_grammar() is not None:
+            keys = self._dispatch_keys([req])
+            off = self._composite_for(keys)[1][gkey]
             gs, gd, gk = req.gstate
-            gram = (np.asarray([True]), np.asarray([gs], np.int32),
+            gram = (keys, np.asarray([True]),
+                    np.asarray([gs + off if gs > 0 else gs], np.int32),
                     np.asarray([gd], np.int32), np.asarray([gk], np.int32))
         sampled, lps, cids, clps = self._run_step(
             tokens, positions, bt, seq_lens, slot_idx, last_idx,
@@ -870,6 +973,7 @@ class EngineCore:
             # hooks — those requests take the chunked prefill path, which
             # threads _sampling_extras into the final chunk's sampler
             and not req.sampling.json_mode
+            and not req.sampling.guided_choice
             and not req.sampling.logit_bias
             and not req.sampling.min_p
         )
@@ -941,6 +1045,7 @@ class EngineCore:
             and not r.sampling.logit_bias
             and not r.sampling.min_p
             and not r.sampling.json_mode
+            and not r.sampling.guided_choice
             for r in reqs
         )
 
@@ -1143,17 +1248,23 @@ class EngineCore:
         k_cand, exact = self._sampling_mode(active)
         pen = self._penalty_buffers(active, k_steps)
         gram = None
-        if any(r.sampling.json_mode for r in active) \
+        if any(self._grammar_key(r) for r in active) \
                 and self._ensure_grammar() is not None:
+            keys = self._dispatch_keys(active)
+            offs = self._composite_for(keys)[1]
             jrows = np.zeros(b, bool)
             jstate = np.full(b, INIT_STATE, np.int32)
             jdepth = np.zeros(b, np.int32)
             jstack = np.zeros(b, np.int32)
             for r in active:
-                if r.sampling.json_mode:
+                k = self._grammar_key(r)
+                if k is not None:
                     jrows[r.slot] = True
-                    jstate[r.slot], jdepth[r.slot], jstack[r.slot] = r.gstate
-            gram = (jrows, jstate, jdepth, jstack)
+                    gs, gd, gk = r.gstate
+                    # request-relative state id -> composite id
+                    jstate[r.slot] = gs + offs[k] if gs > 0 else gs
+                    jdepth[r.slot], jstack[r.slot] = gd, gk
+            gram = (keys, jrows, jstate, jdepth, jstack)
         sampled, lps, cids, clps = self._run_multi_decode_step(
             tokens, positions, bt, seq_lens, limits, temp, top_k, top_p,
             pen=pen, gram=gram,
@@ -1242,10 +1353,11 @@ class EngineCore:
         req.seq.append(token)
         req.generated += 1
         self.tokens_generated += 1
-        if req.sampling.json_mode and self._grammar is not None:
+        gkey = self._grammar_key(req)
+        if gkey is not None and self._grammar is not None:
             # host mirror of the in-scan grammar advance (deterministic:
-            # same tables, same sampled token)
-            req.gstate = self._grammar.tables.advance(*req.gstate, token)
+            # same tables, same sampled token; request-relative state ids)
+            req.gstate = self._tables_for(gkey).advance(*req.gstate, token)
 
         finish: Optional[FinishReason] = None
         st = req.stops

@@ -14,9 +14,10 @@ TPU-native design — the automaton itself is device-computable:
   bit-stack (1 bit per nesting level: OBJ or ARR, max depth 24).
 * Per tokenizer, every (state, token) transition is precomputed by
   composing the token's bytes symbolically (pops/pushes normalise to
-  "pop a prefix, then push a suffix").  The result is four dense
-  ``[S, V]`` int8 tables — next state, pop count/bits, push count/bits —
-  ~50MB HBM for a 128k vocab, uploaded once on first use.
+  "pop a prefix, then push a suffix").  The result is dense ``[S, V]``
+  tables — next state (int16: composed grammars exceed 127 states), pop
+  count/bits, push count/bits (int8) — ~60MB HBM for a 128k vocab,
+  uploaded once on first use.
 * At each decode step the valid-token mask for a row is pure vectorised
   arithmetic: a table-row gather + bit compares against the row's
   (state, depth, stack) — no host interaction, so JSON mode rides the
@@ -43,11 +44,15 @@ import numpy as np
 
 __all__ = [
     "JsonGrammar", "VocabTables", "token_bytes_map", "MAX_DEPTH",
-    "INIT_STATE", "DEAD",
+    "INIT_STATE", "DEAD", "compile_choice_vocab", "compose_tables",
 ]
 
 MAX_DEPTH = 24          # nesting levels the int32 bit-stack holds
 MAX_TOKEN_OPS = 7       # per-token pop/push bound (3 bits each in tables)
+# next_state value meaning "landed in a popped-into container whose type the
+# runtime resolves against the stack".  Negative so it can never collide
+# with a composed grammar's (positive, offset-shifted) state ids.
+SENTINEL = -1
 
 # --------------------------------------------------------------------------
 # state space
@@ -258,7 +263,7 @@ class VocabTables:
     """Per-tokenizer compiled transition tables (host numpy; the engine
     uploads them to device on first use)."""
 
-    next_state: np.ndarray   # [S, V] int8; DEAD = token invalid from state
+    next_state: np.ndarray   # [S, V] int16; DEAD = token invalid from state
     npops: np.ndarray        # [S, V] int8
     popbits: np.ndarray      # [S, V] int8  (bit npops-1-i = i-th pop, top first)
     npush: np.ndarray        # [S, V] int8
@@ -307,7 +312,9 @@ class VocabTables:
         d1 = max(depth - np_, 0)
         stack = (stack & ((1 << d1) - 1)) | (qb << d1)
         depth = d1 + nq
-        if ns == AFTER_VALUE_U:
+        if ns == SENTINEL:
+            # pushdown grammars sit at composite offset 0, so the resolved
+            # AFTER_VALUE ids need no shift (compose_tables enforces this)
             if depth == 0:
                 ns = AFTER_VALUE["T"]
             elif (stack >> (depth - 1)) & 1 == SYM_OBJ:
@@ -399,9 +406,14 @@ def compile_vocab(
             npops[mo] += 1
         state = np.where(alive & has[None, :], ns, state)
 
-    next_state = np.where(alive, state, DEAD).astype(np.int8)
+    next_state = np.where(alive, state, DEAD).astype(np.int16)
+    # the AFTER_VALUE_U end-state becomes the runtime SENTINEL value (-1):
+    # composed grammars shift positive state ids, and a shifted id must
+    # never be mistaken for the resolve-against-the-stack marker
+    next_state = np.where(next_state == AFTER_VALUE_U, SENTINEL, next_state)
     # a token ending exactly at DEAD id 0 can't be conflated: state ids
-    # start at 1, DEAD==0 only means invalid
+    # start at 1, DEAD==0 only means invalid.  int16: composed tables
+    # (JSON + choice grammars, compose_tables) exceed 127 states.
     return VocabTables(
         next_state=next_state,
         npops=np.where(alive, npops, 0).astype(np.int8),
@@ -472,6 +484,136 @@ def token_bytes_map(tokenizer) -> list[Optional[bytes]]:
 
 
 # --------------------------------------------------------------------------
+# choice grammars + composition (guided_choice)
+
+
+def compile_choice_vocab(
+    token_bytes: Sequence[Optional[bytes]],
+    choices: Sequence[str],
+    eos_ids: Sequence[int] = (),
+) -> VocabTables:
+    """Tables for "the output is exactly one of ``choices``": a byte trie
+    over the candidate strings, composed against the vocab.  No pushdown —
+    pops/pushes stay zero, so these tables compose with the JSON grammar's
+    via :func:`compose_tables`.  EOS is allowed exactly at complete
+    choices; a complete choice that is no other choice's prefix becomes
+    terminal (EOS only)."""
+    if not choices:
+        raise ValueError("guided_choice needs at least one choice")
+    enc = [c.encode("utf-8") for c in choices]
+    # trie over byte prefixes; state 0 = DEAD, 1 = root
+    nodes: dict[bytes, int] = {b"": 1}
+    for c in enc:
+        for i in range(1, len(c) + 1):
+            nodes.setdefault(c[:i], len(nodes) + 1)
+    n_states = len(nodes) + 1  # + DEAD
+    delta = np.zeros((n_states, 256), np.int16)  # DEAD
+    for prefix, sid in nodes.items():
+        for c in enc:
+            if c[: len(prefix)] == prefix and len(c) > len(prefix):
+                delta[sid, c[len(prefix)]] = nodes[c[: len(prefix) + 1]]
+    eos_ok = np.zeros(n_states, bool)
+    terminal_only = np.zeros(n_states, bool)
+    for c in enc:
+        sid = nodes[c]
+        eos_ok[sid] = True
+        terminal_only[sid] = not delta[sid].any()
+    return _compose_dfa_vocab(delta, token_bytes, eos_ok, terminal_only,
+                              eos_ids)
+
+
+def _compose_dfa_vocab(
+    delta: np.ndarray,  # [S, 256] int16 byte transitions, DEAD = invalid
+    token_bytes: Sequence[Optional[bytes]],
+    eos_ok: np.ndarray,
+    terminal_only: np.ndarray,
+    eos_ids: Sequence[int],
+) -> VocabTables:
+    """Compose a plain (pushdown-free) byte DFA against the vocab."""
+    v = len(token_bytes)
+    n_states = delta.shape[0]
+    max_len = max((len(t) for t in token_bytes if t), default=1)
+    bmat = np.full((v, max_len), 256, np.int16)
+    for i, tb in enumerate(token_bytes):
+        if tb:
+            bmat[i, : len(tb)] = np.frombuffer(tb, np.uint8)
+    state = np.broadcast_to(
+        np.arange(n_states, dtype=np.int16)[:, None], (n_states, v)
+    ).copy()
+    alive = np.ones((n_states, v), bool)
+    for i, tb in enumerate(token_bytes):
+        if not tb:
+            alive[:, i] = False
+    for col in range(max_len):
+        byte = bmat[:, col]
+        has = byte != 256
+        act = alive & has[None, :]
+        if not act.any():
+            break
+        ns = delta[state, np.where(has, byte, 0).astype(np.int64)[None, :]]
+        alive &= ~(act & (ns == DEAD))
+        state = np.where(alive & has[None, :], ns, state)
+    zeros = np.zeros((n_states, v), np.int8)
+    return VocabTables(
+        next_state=np.where(alive, state, DEAD).astype(np.int16),
+        npops=zeros, popbits=zeros, npush=zeros, pushbits=zeros.copy(),
+        eos_ok=np.asarray(eos_ok, bool),
+        terminal_only=np.asarray(terminal_only, bool),
+        eos_ids=tuple(int(e) for e in eos_ids),
+    )
+
+
+def compose_tables(parts: Sequence[VocabTables]) -> tuple[VocabTables, list[int]]:
+    """Stack several grammars into one table set for mixed-grammar batches.
+
+    Returns (composite, offsets): grammar i's state ``s`` lives at
+    ``s + offsets[i]`` in the composite (DEAD stays 0 and is shared).
+    Rows carry per-request composite state; stack ops are offset-free.
+    """
+    if not parts:
+        raise ValueError("compose_tables needs at least one grammar")
+    v = parts[0].vocab_size
+    eos = parts[0].eos_ids
+    for t in parts:
+        if t.vocab_size != v or t.eos_ids != eos:
+            raise ValueError("grammars must share vocab and eos ids")
+    if len(parts) == 1:
+        return parts[0], [0]
+    offsets: list[int] = []
+    ns_rows, misc = [], {k: [] for k in
+                         ("npops", "popbits", "npush", "pushbits")}
+    eos_ok, term = [], []
+    off = 0
+    for i, t in enumerate(parts):
+        offsets.append(off)
+        if i > 0 and (t.next_state == SENTINEL).any():
+            # the sentinel resolves to the JSON grammar's absolute
+            # AFTER_VALUE ids, which are only correct at offset 0
+            raise ValueError("a pushdown (JSON) grammar must be the first "
+                             "part of a composite")
+        shifted = t.next_state.astype(np.int32)
+        shifted = np.where(shifted > DEAD, shifted + off, shifted)
+        ns_rows.append(shifted)
+        for k in misc:
+            misc[k].append(getattr(t, k))
+        eos_ok.append(t.eos_ok)
+        term.append(t.terminal_only)
+        off += t.n_states
+    if off > np.iinfo(np.int16).max:
+        raise ValueError(f"composite grammar too large ({off} states)")
+    return VocabTables(
+        next_state=np.concatenate(ns_rows).astype(np.int16),
+        npops=np.concatenate(misc["npops"]),
+        popbits=np.concatenate(misc["popbits"]),
+        npush=np.concatenate(misc["npush"]),
+        pushbits=np.concatenate(misc["pushbits"]),
+        eos_ok=np.concatenate(eos_ok),
+        terminal_only=np.concatenate(term),
+        eos_ids=eos,
+    ), offsets
+
+
+# --------------------------------------------------------------------------
 # device side (jax) — used inside the jitted decode scan
 
 from typing import NamedTuple
@@ -480,7 +622,7 @@ from typing import NamedTuple
 class GrammarTables(NamedTuple):
     """Device-resident transition tables (a pytree, so it rides jit args)."""
 
-    next_state: object  # [S, V] int8
+    next_state: object  # [S, V] int16
     npops: object       # [S, V] int8
     popbits: object     # [S, V] int8
     npush: object       # [S, V] int8
@@ -563,7 +705,7 @@ def grammar_advance(gt: GrammarTables, jrows, state, depth, stack, sampled):
         AFTER_VALUE["T"],
         jnp.where(exposed == SYM_OBJ, AFTER_VALUE["O"], AFTER_VALUE["A"]),
     )
-    ns = jnp.where(ns == AFTER_VALUE_U, resolved, ns)
+    ns = jnp.where(ns == SENTINEL, resolved, ns)
     upd = jrows & ~gt.eos_cols[sampled]
     return (
         jnp.where(upd, ns, state),
@@ -573,20 +715,25 @@ def grammar_advance(gt: GrammarTables, jrows, state, depth, stack, sampled):
 
 
 class JsonGrammar:
-    """Facade: compile once per tokenizer, share across requests."""
+    """Facade: compile once per tokenizer, share across requests.  Keeps
+    the token byte map so per-request choice grammars (guided_choice)
+    compile against the same vocab."""
 
-    def __init__(self, tables: VocabTables):
+    def __init__(self, tables: VocabTables,
+                 token_bytes: Optional[Sequence[Optional[bytes]]] = None):
         self.tables = tables
+        self.token_bytes = list(token_bytes) if token_bytes is not None else None
 
     @classmethod
     def from_tokenizer(cls, tokenizer, eos_ids: Sequence[int] = ()) -> "JsonGrammar":
-        return cls(compile_vocab(token_bytes_map(tokenizer), eos_ids))
+        tb = token_bytes_map(tokenizer)
+        return cls(compile_vocab(tb, eos_ids), tb)
 
     @classmethod
     def from_token_bytes(
         cls, token_bytes: Sequence[Optional[bytes]], eos_ids: Sequence[int] = ()
     ) -> "JsonGrammar":
-        return cls(compile_vocab(token_bytes, eos_ids))
+        return cls(compile_vocab(token_bytes, eos_ids), token_bytes)
 
     @staticmethod
     def validate(text: str) -> bool:

@@ -169,12 +169,27 @@ def parse_request(body: dict, chat: bool) -> ParsedRequest:
         elif rft == "json_object":
             req.response_format = rft
 
+    # guided_choice (vLLM-compatible extension): output constrained to
+    # exactly one of the given strings (engine/grammar.py choice trie)
+    guided_choice = body.get("guided_choice")
+    if guided_choice is not None:
+        _require(isinstance(guided_choice, list) and guided_choice
+                 and all(isinstance(c, str) and c for c in guided_choice),
+                 "'guided_choice' must be a non-empty array of strings")
+        _require(len(guided_choice) <= 256,
+                 "'guided_choice' supports at most 256 choices")
+        _require(sum(len(c.encode("utf-8")) for c in guided_choice) <= 4096,
+                 "'guided_choice' total length exceeds 4096 bytes")
+        _require(rf is None,
+                 "'guided_choice' cannot be combined with 'response_format'")
+
     req.sampling = SamplingOptions(
         temperature=1.0 if temperature is None else float(temperature),
         top_p=1.0 if top_p is None else float(top_p),
         top_k=0 if top_k is None else int(top_k),
         min_p=min_p,
         logit_bias=logit_bias or None,
+        guided_choice=guided_choice,
         seed=seed,
         frequency_penalty=freq_pen,
         presence_penalty=pres_pen,

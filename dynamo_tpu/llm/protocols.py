@@ -53,6 +53,9 @@ class SamplingOptions:
     # response_format JSON mode: grammar-constrained decoding (the engine
     # masks invalid-next-token logits inside the decode scan; engine/grammar.py)
     json_mode: bool = False
+    # guided_choice (vLLM-compatible extension): the output is exactly one
+    # of these strings — enforced by a choice-trie grammar in the same scan
+    guided_choice: Optional[list[str]] = None
 
     @property
     def greedy(self) -> bool:

@@ -312,3 +312,96 @@ def test_parse_request_response_format_completions():
                 "type": "json_schema",
                 "json_schema": {"name": "x", "schema": {}}}},
             chat=False)
+
+
+def test_choice_grammar_masks_to_choices(tables):
+    from dynamo_tpu.engine.grammar import compile_choice_vocab
+
+    toks = make_vocab()
+    ct = compile_choice_vocab(toks, ["yes", "no", "nope"], eos_ids=[EOS])
+    s, d, st = 1, 0, 0  # root
+    m = ct.valid_mask(s, d, st)
+    assert m[tok_id(toks, b"y")] and m[tok_id(toks, b"n")]
+    assert not m[tok_id(toks, b"x")] and not m[EOS]
+    # walk "n" -> "o": complete choice "no" but also prefix of "nope"
+    s, d, st = ct.advance(s, d, st, tok_id(toks, b"n"))
+    s, d, st = ct.advance(s, d, st, tok_id(toks, b"o"))
+    m = ct.valid_mask(s, d, st)
+    assert m[EOS] and m[tok_id(toks, b"p")]
+    # complete "nope": terminal, EOS only
+    s, d, st = ct.advance(s, d, st, tok_id(toks, b"p"))
+    s, d, st = ct.advance(s, d, st, tok_id(toks, b"e"))
+    m = ct.valid_mask(s, d, st)
+    assert m[EOS] and m.sum() == 1
+    # multi-byte vocab tokens compose: "true" is not a choice here
+    assert not ct.valid_mask(1, 0, 0)[tok_id(toks, b"true")]
+
+
+def test_choice_grammar_rollout_terminates(tables):
+    import numpy as _np
+
+    from dynamo_tpu.engine.grammar import compile_choice_vocab
+
+    toks = make_vocab()
+    choices = ["alpha", "beta", "true"]  # 'true' is a single vocab token
+    ct = compile_choice_vocab(toks, choices, eos_ids=[EOS])
+    rng = _np.random.default_rng(3)
+    for _ in range(30):
+        s, d, st = 1, 0, 0
+        out = []
+        for _ in range(20):
+            m = ct.valid_mask(s, d, st)
+            t = int(rng.choice(_np.flatnonzero(m)))
+            if t == EOS:
+                break
+            out.append(t)
+            s, d, st = ct.advance(s, d, st, t)
+        text = decode_ids(toks, out).decode()
+        assert text in choices, text
+
+
+def test_compose_tables_offsets(tables):
+    from dynamo_tpu.engine.grammar import (
+        compile_choice_vocab, compose_tables,
+    )
+
+    toks = make_vocab()
+    c1 = compile_choice_vocab(toks, ["on", "off"], eos_ids=[EOS])
+    comp, offs = compose_tables([tables, c1])
+    assert offs[0] == 0 and offs[1] == tables.n_states
+    # JSON rows behave identically at offset 0
+    import numpy as _np
+
+    _np.testing.assert_array_equal(comp.valid_mask(1, 0, 0),
+                                   tables.valid_mask(1, 0, 0))
+    # choice rows behave identically at their offset
+    root = offs[1] + 1
+    m = comp.valid_mask(root, 0, 0)
+    _np.testing.assert_array_equal(m, c1.valid_mask(1, 0, 0))
+    # walking 'o' in the composite lands at a shifted state with the
+    # same continuations
+    s, d, st = comp.advance(root, 0, 0, tok_id(toks, b"o"))
+    assert s > offs[1]
+    m2 = comp.valid_mask(s, d, st)
+    assert m2[tok_id(toks, b"n")] and m2[tok_id(toks, b"f")]
+    # choice-first composites with a pushdown part later are rejected
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="pushdown"):
+        compose_tables([c1, tables])
+
+
+def test_parse_request_guided_choice():
+    from dynamo_tpu.llm.openai import OpenAIError, parse_request
+
+    base = {"model": "m", "messages": [{"role": "user", "content": "x"}]}
+    req = parse_request({**base, "guided_choice": ["yes", "no"]}, chat=True)
+    assert req.sampling.guided_choice == ["yes", "no"]
+
+    import pytest as _pytest
+    with _pytest.raises(OpenAIError):
+        parse_request({**base, "guided_choice": []}, chat=True)
+    with _pytest.raises(OpenAIError):
+        parse_request({**base, "guided_choice": ["ok", 3]}, chat=True)
+    with _pytest.raises(OpenAIError):
+        parse_request({**base, "guided_choice": ["a"],
+                       "response_format": {"type": "json_object"}}, chat=True)

@@ -198,3 +198,111 @@ def test_json_mode_rejected_without_usable_eos(setup):
         if not core.step():
             break
     assert outs and outs[-1].finish_reason is FinishReason.ERROR
+
+
+def test_guided_choice_emits_a_choice(setup):
+    """guided_choice through the real engine: output is exactly one of
+    the candidate strings, at any temperature."""
+    model, params, grammar, toks = setup
+    cfg = EngineConfig(
+        max_batch_size=2, max_model_len=128, block_size=8, num_blocks=64,
+        prefill_buckets=[16, 32, 64, 128], decode_steps=4,
+    )
+    core = EngineCore(model, params, cfg, eos_token_ids=[EOS], grammar=grammar)
+    choices = ["alpha", "beta", "true"]
+    for trial, temp in enumerate([0.0, 1.0, 1.0]):
+        outs = []
+        core.submit(EngineRequest(
+            request_id=f"gc{trial}", prompt=[5 + trial, 6, 7],
+            sampling=SamplingOptions(temperature=temp,
+                                     guided_choice=list(choices)),
+            stops=StopConditions(max_tokens=16),
+            emit=outs.append,
+        ))
+        for _ in range(200):
+            if not core.step():
+                break
+        assert outs[-1].finish_reason is FinishReason.EOS
+        ids = [t for o in outs for t in o.token_ids]
+        text = decode(toks, ids).decode()
+        assert text in choices, text
+
+
+def test_mixed_grammar_batch_json_and_choices(setup):
+    """One dispatch with a JSON row, two different choice rows, and a free
+    row: each obeys its own grammar (composite tables, offset-mapped)."""
+    model, params, grammar, toks = setup
+    cfg = EngineConfig(
+        max_batch_size=4, max_model_len=128, block_size=8, num_blocks=96,
+        prefill_buckets=[16, 32, 64, 128], decode_steps=4,
+    )
+    core = EngineCore(model, params, cfg, eos_token_ids=[EOS], grammar=grammar)
+    outs = {r: [] for r in ("json", "c1", "c2", "free")}
+    core.submit(EngineRequest(
+        request_id="json", prompt=[5, 6, 7],
+        sampling=SamplingOptions(temperature=1.0, json_mode=True),
+        stops=StopConditions(max_tokens=24), emit=outs["json"].append,
+    ))
+    core.submit(EngineRequest(
+        request_id="c1", prompt=[8, 9],
+        sampling=SamplingOptions(temperature=1.0,
+                                 guided_choice=["yes", "no"]),
+        stops=StopConditions(max_tokens=12), emit=outs["c1"].append,
+    ))
+    core.submit(EngineRequest(
+        request_id="c2", prompt=[10, 11],
+        sampling=SamplingOptions(temperature=1.0,
+                                 guided_choice=["left", "right", "up"]),
+        stops=StopConditions(max_tokens=12), emit=outs["c2"].append,
+    ))
+    core.submit(EngineRequest(
+        request_id="free", prompt=[12, 13],
+        sampling=SamplingOptions(temperature=1.0),
+        stops=StopConditions(max_tokens=12, ignore_eos=True),
+        emit=outs["free"].append,
+    ))
+    for _ in range(600):
+        if not core.step():
+            break
+    for rid, lst in outs.items():
+        assert lst and lst[-1].finish_reason is not None, rid
+    ids = lambda r: [t for o in outs[r] for t in o.token_ids]
+    assert decode(toks, ids("c1")).decode() in ("yes", "no")
+    assert decode(toks, ids("c2")).decode() in ("left", "right", "up")
+    if outs["json"][-1].finish_reason is FinishReason.EOS:
+        json.loads(decode(toks, ids("json")).decode("utf-8", errors="replace")
+                   if isinstance(decode(toks, ids("json")), bytes)
+                   else decode(toks, ids("json")))
+    assert sum(len(o.token_ids) for o in outs["free"]) == 12
+
+
+def test_grammar_budget_backpressure(setup):
+    """Requests whose combined grammar states would overflow the composite
+    budget WAIT for slots instead of crashing the engine step."""
+    model, params, grammar, toks = setup
+    cfg = EngineConfig(
+        max_batch_size=4, max_model_len=128, block_size=8, num_blocks=96,
+        prefill_buckets=[16, 32, 64, 128],
+    )
+    core = EngineCore(model, params, cfg, eos_token_ids=[EOS], grammar=grammar)
+    core.GRAMMAR_STATE_BUDGET = 300  # tiny budget for the test
+    big = ["x" * 120, "y" * 120]     # bound ~242 states each set
+    outs = {r: [] for r in ("a", "b")}
+    for rid in ("a", "b"):
+        core.submit(EngineRequest(
+            request_id=rid, prompt=[5, 6],
+            sampling=SamplingOptions(
+                temperature=0.0,
+                guided_choice=[c + rid for c in big],  # distinct sets
+            ),
+            stops=StopConditions(max_tokens=200),
+            emit=outs[rid].append,
+        ))
+    for _ in range(1500):
+        if not core.step():
+            break
+    # both finish (serialized through the budget), neither errors
+    for rid in ("a", "b"):
+        assert outs[rid] and outs[rid][-1].finish_reason is FinishReason.EOS
+        text = decode(toks, [t for o in outs[rid] for t in o.token_ids]).decode()
+        assert text in [c + rid for c in big]
