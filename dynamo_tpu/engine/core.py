@@ -37,8 +37,8 @@ import numpy as np
 
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.grammar import (
-    INIT_STATE, JsonGrammar, compile_choice_vocab, compose_tables,
-    device_tables, grammar_advance, grammar_mask,
+    INIT_STATE, JsonGrammar, compile_choice_vocab, compile_regex_vocab,
+    compose_tables, device_tables, grammar_advance, grammar_mask,
 )
 from dynamo_tpu.engine.request import EngineRequest, RequestState
 from dynamo_tpu.engine.sampling import K_MAX, sample_full
@@ -406,6 +406,8 @@ class EngineCore:
             return "json"
         if req.sampling.guided_choice:
             return ("choice",) + tuple(req.sampling.guided_choice)
+        if req.sampling.guided_regex:
+            return ("regex", req.sampling.guided_regex)
         return None
 
     # composite state budget: a dispatch's composed tables must stay well
@@ -413,11 +415,15 @@ class EngineCore:
     # free (same backpressure shape as NoFreeBlocks)
     GRAMMAR_STATE_BUDGET = 16384
 
-    @staticmethod
-    def _grammar_states_bound(key) -> int:
-        """Cheap upper bound on a grammar's state count (no compile)."""
+    def _grammar_states_bound(self, key) -> int:
+        """Upper bound on a grammar's state count.  Regex grammars compile
+        (and cache) their tables here — the DFA size is not knowable from
+        the pattern text, and admission must reject/stall BEFORE a
+        dispatch composes an overflowing table."""
         if key == "json":
             return 128  # the JSON pushdown automaton is ~90 states
+        if key[0] == "regex":
+            return self._tables_for(key).n_states
         return sum(len(c.encode("utf-8")) for c in key[1:]) + 2
 
     def _active_grammar_budget_ok(self, new_key) -> bool:
@@ -433,19 +439,37 @@ class EngineCore:
         if key == "json":
             return self._grammar.tables
         if key in self._choice_tables:
-            return self._choice_tables[key]
-        tables = compile_choice_vocab(
-            self._grammar.token_bytes, list(key[1:]),
-            eos_ids=self._grammar.tables.eos_ids,
-        )
+            cached = self._choice_tables[key]
+            if isinstance(cached, Exception):
+                raise cached  # known-bad pattern: re-raise, don't recompile
+            return cached
+        try:
+            if key[0] == "regex":
+                tables = compile_regex_vocab(
+                    self._grammar.token_bytes, key[1],
+                    eos_ids=self._grammar.tables.eos_ids,
+                )
+            else:
+                tables = compile_choice_vocab(
+                    self._grammar.token_bytes, list(key[1:]),
+                    eos_ids=self._grammar.tables.eos_ids,
+                )
+        except Exception as e:
+            # cache the failure: a resubmitted bad pattern must not pay
+            # (or inflict) the compile cost again
+            self._choice_tables[key] = e
+            raise
         cap = max(16, self.config.max_batch_size)
         if len(self._choice_tables) >= cap:
             # evict a set no active request is using — in-flight grammars
             # must stay resident or every dispatch would recompile them
             active = {self._grammar_key(r) for r in self.slots
                       if r is not None}
-            victim = next((k for k in self._choice_tables
-                           if k not in active), None)
+            victim = next(
+                (k for k, v in self._choice_tables.items()
+                 if k not in active and not isinstance(v, Exception)),
+                None,
+            )
             if victim is not None:
                 self._choice_tables.pop(victim)
                 self._gdev_cache.clear()  # composites may reference it
@@ -780,11 +804,22 @@ class EngineCore:
                 self._admitted.remove(req)
                 self._finish(req, FinishReason.ERROR)
                 continue
-            if gkey is not None and not self._active_grammar_budget_ok(gkey):
-                # composed dispatch tables must stay inside int16 state ids:
-                # wait for constrained slots to free (NoFreeBlocks-style
-                # backpressure, not an error — the request is valid)
-                break
+            if gkey is not None:
+                try:
+                    budget_ok = self._active_grammar_budget_ok(gkey)
+                except Exception:
+                    # bad pattern / oversized DFA: this request can never
+                    # run — fail it, don't crash the engine step
+                    log.exception("grammar compile failed for %s",
+                                  req.request_id)
+                    self._admitted.remove(req)
+                    self._finish(req, FinishReason.ERROR)
+                    continue
+                if not budget_ok:
+                    # composed dispatch tables must stay inside int16 state
+                    # ids: wait for constrained slots to free
+                    # (NoFreeBlocks-style backpressure, not an error)
+                    break
             req.seq = TokenBlockSequence(req.prompt, self.config.block_size)
             try:
                 alloc = self.block_manager.allocate(
@@ -974,6 +1009,7 @@ class EngineCore:
             # threads _sampling_extras into the final chunk's sampler
             and not req.sampling.json_mode
             and not req.sampling.guided_choice
+            and not req.sampling.guided_regex
             and not req.sampling.logit_bias
             and not req.sampling.min_p
         )
@@ -1046,6 +1082,7 @@ class EngineCore:
             and not r.sampling.min_p
             and not r.sampling.json_mode
             and not r.sampling.guided_choice
+            and not r.sampling.guided_regex
             for r in reqs
         )
 

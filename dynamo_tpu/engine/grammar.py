@@ -44,7 +44,8 @@ import numpy as np
 
 __all__ = [
     "JsonGrammar", "VocabTables", "token_bytes_map", "MAX_DEPTH",
-    "INIT_STATE", "DEAD", "compile_choice_vocab", "compose_tables",
+    "INIT_STATE", "DEAD", "compile_choice_vocab", "compile_regex_vocab",
+    "compose_tables",
 ]
 
 MAX_DEPTH = 24          # nesting levels the int32 bit-stack holds
@@ -518,6 +519,328 @@ def compile_choice_vocab(
         sid = nodes[c]
         eos_ok[sid] = True
         terminal_only[sid] = not delta[sid].any()
+    return _compose_dfa_vocab(delta, token_bytes, eos_ok, terminal_only,
+                              eos_ids)
+
+
+MAX_REGEX_STATES = 2048
+
+
+class RegexError(ValueError):
+    pass
+
+
+def _parse_regex(pattern: str):
+    """Parse a bounded regex subset into an NFA (Thompson construction
+    over BYTES).  Supported: literals (UTF-8, escapes), '.', character
+    classes [a-z0-9_] (ASCII ranges, negation), groups (), alternation |,
+    quantifiers * + ?.  Fullmatch semantics (implicit anchors), matching
+    vLLM's guided_regex.  Unsupported syntax raises RegexError.
+
+    NFA representation: list of nodes; node = (eps: list[int],
+    edges: list[(bool[256], int)]).
+    """
+    eps: list[list[int]] = []
+    edges: list[list] = []
+
+    def new_node() -> int:
+        eps.append([])
+        edges.append([])
+        return len(eps) - 1
+
+    i = 0
+    n = len(pattern)
+
+    def class_endpoint():
+        """One class member: returns an ASCII byte, or a mask for \d-style
+        escapes (which cannot anchor a range)."""
+        nonlocal i
+        c = pattern[i]
+        if c == "\\":
+            if i + 1 >= n:
+                raise RegexError("trailing backslash in class")
+            i += 1
+            b = _escape_byte(pattern[i])
+            if b is None:
+                if pattern[i] in "DWS":
+                    # char-level complements inside a byte-level class
+                    # would be wrong for multi-byte chars — be loud
+                    raise RegexError(
+                        f"negated class escape \\{pattern[i]} not "
+                        "supported inside [...]"
+                    )
+                m = _class_escape(pattern[i])
+                i += 1
+                return m
+            i += 1
+            return b
+        bs = c.encode("utf-8")
+        if len(bs) != 1:
+            raise RegexError("non-ASCII in character class")
+        i += 1
+        return bs[0]
+
+    def parse_class() -> tuple[np.ndarray, bool]:
+        """Returns (ascii mask, negated?).  Negation is resolved by the
+        caller at the character level (multi-byte chars count)."""
+        nonlocal i
+        assert pattern[i] == "["
+        i += 1
+        mask = np.zeros(256, bool)
+        negate = i < n and pattern[i] == "^"
+        if negate:
+            i += 1
+        first = True
+        while i < n and (pattern[i] != "]" or first):
+            first = False
+            lo = class_endpoint()
+            if isinstance(lo, np.ndarray):
+                mask |= lo
+                continue
+            if i + 1 < n and pattern[i] == "-" and pattern[i + 1] != "]":
+                i += 1
+                hi = class_endpoint()
+                if isinstance(hi, np.ndarray) or hi < lo:
+                    raise RegexError("bad character range in class")
+                mask[lo:hi + 1] = True
+            else:
+                mask[lo] = True
+        if i >= n:
+            raise RegexError("unterminated character class")
+        i += 1  # ']'
+        return mask, negate
+
+    def char_fragment(ascii_mask: np.ndarray):
+        """One CHARACTER matching ascii_mask for single-byte chars plus
+        every multi-byte UTF-8 character — '.' and negated classes are
+        char-level (vLLM semantics), and must never emit lone
+        continuation bytes (invalid UTF-8 output)."""
+        a, b = new_node(), new_node()
+        m = ascii_mask.copy()
+        m[0x80:] = False
+        edges[a].append((m, b))
+
+        def seq(*byte_ranges):
+            cur = a
+            for j, (lo, hi) in enumerate(byte_ranges):
+                nxt = b if j == len(byte_ranges) - 1 else new_node()
+                mm = np.zeros(256, bool)
+                mm[lo:hi + 1] = True
+                edges[cur].append((mm, nxt))
+                cur = nxt
+
+        cont = (0x80, 0xBF)
+        seq((0xC2, 0xDF), cont)
+        seq((0xE0, 0xE0), (0xA0, 0xBF), cont)
+        seq((0xE1, 0xEC), cont, cont)
+        seq((0xED, 0xED), (0x80, 0x9F), cont)
+        seq((0xEE, 0xEF), cont, cont)
+        seq((0xF0, 0xF0), (0x90, 0xBF), cont, cont)
+        seq((0xF1, 0xF3), cont, cont, cont)
+        seq((0xF4, 0xF4), (0x80, 0x8F), cont, cont)
+        return a, b
+
+    def atom():
+        """Returns (start, end) NFA fragment for one atom."""
+        nonlocal i
+        if i >= n:
+            raise RegexError("unexpected end of pattern")
+        c = pattern[i]
+        if c == "(":
+            i += 1
+            frag = alternation()
+            if i >= n or pattern[i] != ")":
+                raise RegexError("unbalanced group")
+            i += 1
+            return frag
+        if c == "[":
+            mask, negate = parse_class()
+            if negate:
+                inv = ~mask
+                inv[:0x09] = False  # raw control noise stays excluded
+                return char_fragment(inv)
+            a, b = new_node(), new_node()
+            edges[a].append((mask, b))
+            return a, b
+        if c == ".":
+            i += 1
+            any_ascii = np.ones(256, bool)
+            any_ascii[ord("\n")] = False
+            return char_fragment(any_ascii)
+        if c == "\\":
+            i += 1
+            if i >= n:
+                raise RegexError("trailing backslash")
+            esc = pattern[i]
+            i += 1
+            byte = _escape_byte(esc)
+            if byte is None:
+                if esc in "DWS":
+                    inv = ~_class_escape(esc.lower())
+                    inv[:0x09] = False
+                    return char_fragment(inv)
+                mask = _class_escape(esc)
+                a, b = new_node(), new_node()
+                edges[a].append((mask, b))
+                return a, b
+            return _literal_bytes(bytes([byte]))
+        if c in ")|*+?{}":
+            # {m,n} quantifiers are unsupported — reject rather than
+            # silently matching literal braces
+            raise RegexError(f"unexpected {c!r}")
+        i += 1
+        return _literal_bytes(c.encode("utf-8"))
+
+    def _literal_bytes(bs: bytes):
+        start = new_node()
+        cur = start
+        for byte in bs:
+            nxt = new_node()
+            mask = np.zeros(256, bool)
+            mask[byte] = True
+            edges[cur].append((mask, nxt))
+            cur = nxt
+        return start, cur
+
+    def piece():
+        nonlocal i
+        a, b = atom()
+        while i < n and pattern[i] in "*+?":
+            q = pattern[i]
+            i += 1
+            s2, e2 = new_node(), new_node()
+            eps[s2].append(a)
+            eps[b].append(e2)
+            if q in "*?":
+                eps[s2].append(e2)
+            if q in "*+":
+                eps[b].append(a)
+            a, b = s2, e2
+        return a, b
+
+    def concat():
+        nonlocal i
+        a, b = piece()
+        while i < n and pattern[i] not in ")|":
+            a2, b2 = piece()
+            eps[b].append(a2)
+            b = b2
+        return a, b
+
+    def alternation():
+        nonlocal i
+        frags = [concat()]
+        while i < n and pattern[i] == "|":
+            i += 1
+            frags.append(concat())
+        if len(frags) == 1:
+            return frags[0]
+        a, b = new_node(), new_node()
+        for fa, fb in frags:
+            eps[a].append(fa)
+            eps[fb].append(b)
+        return a, b
+
+    start, accept = alternation()
+    if i != n:
+        raise RegexError(f"unexpected {pattern[i]!r} at {i}")
+    return eps, edges, start, accept
+
+
+def _escape_byte(c: str):
+    simple = {"n": 0x0A, "t": 0x09, "r": 0x0D, "\\": 0x5C, ".": 0x2E,
+              "(": 0x28, ")": 0x29, "[": 0x5B, "]": 0x5D, "|": 0x7C,
+              "*": 0x2A, "+": 0x2B, "?": 0x3F, "^": 0x5E, "$": 0x24,
+              "{": 0x7B, "}": 0x7D, "/": 0x2F, '"': 0x22, "'": 0x27,
+              "-": 0x2D}
+    if c in simple:
+        return simple[c]
+    if c in "dwsDWS":
+        return None  # class escape
+    if len(c.encode("utf-8")) == 1 and not c.isalnum():
+        return c.encode("utf-8")[0]
+    raise RegexError(f"unsupported escape \\{c}")
+
+
+def _class_escape(c: str) -> np.ndarray:
+    mask = np.zeros(256, bool)
+    if c == "d":
+        mask[ord("0"):ord("9") + 1] = True
+    elif c == "w":
+        mask[ord("0"):ord("9") + 1] = True
+        mask[ord("a"):ord("z") + 1] = True
+        mask[ord("A"):ord("Z") + 1] = True
+        mask[ord("_")] = True
+    elif c == "s":
+        for b in b" \t\n\r\f\v":
+            mask[b] = True
+    else:
+        # D/W/S are resolved by the caller at the character level
+        raise RegexError(f"unsupported class escape \\{c}")
+    return mask
+
+
+def compile_regex_vocab(
+    token_bytes: Sequence[Optional[bytes]],
+    pattern: str,
+    eos_ids: Sequence[int] = (),
+) -> VocabTables:
+    """Tables for "the output fullmatches ``pattern``" (bounded regex
+    subset; see :func:`_parse_regex`).  NFA -> DFA by subset construction,
+    capped at MAX_REGEX_STATES, then composed against the vocab like the
+    choice grammars."""
+    eps, edges, start, accept = _parse_regex(pattern)
+
+    def closure(states: frozenset) -> frozenset:
+        out = set(states)
+        stack = list(states)
+        while stack:
+            s0 = stack.pop()
+            for t in eps[s0]:
+                if t not in out:
+                    out.add(t)
+                    stack.append(t)
+        return frozenset(out)
+
+    init = closure(frozenset([start]))
+    dfa_ids: dict[frozenset, int] = {init: 1}  # 0 = DEAD
+    order = [init]
+    delta_rows = {1: np.zeros(256, np.int16)}
+    qi = 0
+    while qi < len(order):
+        cur = order[qi]
+        qi += 1
+        sid = dfa_ids[cur]
+        row = delta_rows[sid]
+        # group outgoing byte masks -> target NFA sets
+        for byte in range(256):
+            targets = set()
+            for s0 in cur:
+                for mask, t in edges[s0]:
+                    if mask[byte]:
+                        targets.add(t)
+            if not targets:
+                continue
+            tgt = closure(frozenset(targets))
+            if tgt not in dfa_ids:
+                if len(dfa_ids) >= MAX_REGEX_STATES:
+                    raise RegexError(
+                        f"regex needs more than {MAX_REGEX_STATES} DFA states"
+                    )
+                dfa_ids[tgt] = len(dfa_ids) + 1
+                delta_rows[dfa_ids[tgt]] = np.zeros(256, np.int16)
+                order.append(tgt)
+            row[byte] = dfa_ids[tgt]
+    n_states = len(dfa_ids) + 1
+    delta = np.zeros((n_states, 256), np.int16)
+    for sid, row in delta_rows.items():
+        delta[sid] = row
+    eos_ok = np.zeros(n_states, bool)
+    terminal_only = np.zeros(n_states, bool)
+    for st, sid in dfa_ids.items():
+        if accept in st:
+            eos_ok[sid] = True
+            terminal_only[sid] = not delta[sid].any()
     return _compose_dfa_vocab(delta, token_bytes, eos_ok, terminal_only,
                               eos_ids)
 

@@ -183,6 +183,24 @@ def parse_request(body: dict, chat: bool) -> ParsedRequest:
         _require(rf is None,
                  "'guided_choice' cannot be combined with 'response_format'")
 
+    # guided_regex (vLLM-compatible extension): bounded regex subset,
+    # validated up front so syntax errors are 400s, not engine errors
+    guided_regex = body.get("guided_regex")
+    if guided_regex is not None:
+        _require(isinstance(guided_regex, str) and guided_regex,
+                 "'guided_regex' must be a non-empty string")
+        _require(len(guided_regex) <= 1024,
+                 "'guided_regex' exceeds 1024 chars")
+        _require(rf is None and guided_choice is None,
+                 "'guided_regex' cannot be combined with 'response_format' "
+                 "or 'guided_choice'")
+        from dynamo_tpu.engine.grammar import RegexError, _parse_regex
+
+        try:
+            _parse_regex(guided_regex)
+        except RegexError as e:
+            raise OpenAIError(f"'guided_regex': {e}")
+
     req.sampling = SamplingOptions(
         temperature=1.0 if temperature is None else float(temperature),
         top_p=1.0 if top_p is None else float(top_p),
@@ -190,6 +208,7 @@ def parse_request(body: dict, chat: bool) -> ParsedRequest:
         min_p=min_p,
         logit_bias=logit_bias or None,
         guided_choice=guided_choice,
+        guided_regex=guided_regex,
         seed=seed,
         frequency_penalty=freq_pen,
         presence_penalty=pres_pen,

@@ -56,6 +56,9 @@ class SamplingOptions:
     # guided_choice (vLLM-compatible extension): the output is exactly one
     # of these strings — enforced by a choice-trie grammar in the same scan
     guided_choice: Optional[list[str]] = None
+    # guided_regex (vLLM-compatible extension): the output fullmatches this
+    # pattern (bounded regex subset compiled to a byte DFA)
+    guided_regex: Optional[str] = None
 
     @property
     def greedy(self) -> bool:

@@ -405,3 +405,95 @@ def test_parse_request_guided_choice():
     with _pytest.raises(OpenAIError):
         parse_request({**base, "guided_choice": ["a"],
                        "response_format": {"type": "json_object"}}, chat=True)
+
+
+def test_regex_grammar_basics(tables):
+    from dynamo_tpu.engine.grammar import RegexError, compile_regex_vocab
+
+    toks = make_vocab()
+    rt = compile_regex_vocab(toks, r"(yes|no)[0-9]+", eos_ids=[EOS])
+    rng = np.random.default_rng(9)
+    for _ in range(25):
+        s, d, st = 1, 0, 0
+        out = []
+        for _ in range(30):
+            m = rt.valid_mask(s, d, st)
+            t = int(rng.choice(np.flatnonzero(m)))
+            if t == EOS:
+                break
+            out.append(t)
+            s, d, st = rt.advance(s, d, st, t)
+        text = decode_ids(toks, out).decode()
+        import re
+        if out and t == EOS:
+            assert re.fullmatch(r"(yes|no)[0-9]+", text), text
+    # escapes, classes, quantifiers
+    rt = compile_regex_vocab(toks, r"v\d+\.\d+", eos_ids=[EOS])
+    s, d, st = 1, 0, 0
+    for ch in "v12.3":
+        assert rt.valid_mask(s, d, st)[tok_id(toks, ch.encode())], ch
+        s, d, st = rt.advance(s, d, st, tok_id(toks, ch.encode()))
+    assert rt.valid_mask(s, d, st)[EOS]
+    # multi-byte vocab tokens ride the DFA: "123" is one token
+    rt = compile_regex_vocab(toks, r"[0-9]+", eos_ids=[EOS])
+    assert rt.valid_mask(1, 0, 0)[tok_id(toks, b"123")]
+    # unsupported syntax is loud
+    import pytest as _pytest
+    with _pytest.raises(RegexError):
+        compile_regex_vocab(toks, r"a{2,5}", eos_ids=[EOS])
+    with _pytest.raises(RegexError):
+        compile_regex_vocab(toks, r"(unclosed", eos_ids=[EOS])
+
+
+def test_parse_request_guided_regex():
+    from dynamo_tpu.llm.openai import OpenAIError, parse_request
+
+    base = {"model": "m", "messages": [{"role": "user", "content": "x"}]}
+    req = parse_request({**base, "guided_regex": "[a-z]+"}, chat=True)
+    assert req.sampling.guided_regex == "[a-z]+"
+
+    import pytest as _pytest
+    with _pytest.raises(OpenAIError, match="guided_regex"):
+        parse_request({**base, "guided_regex": "(bad"}, chat=True)
+    with _pytest.raises(OpenAIError):
+        parse_request({**base, "guided_regex": "[a-z]+",
+                       "guided_choice": ["a"]}, chat=True)
+
+
+def test_regex_edge_cases(tables):
+    import re
+
+    from dynamo_tpu.engine.grammar import RegexError, compile_regex_vocab
+
+    toks = make_vocab()
+    # truncated patterns raise RegexError (not IndexError -> 500s)
+    import pytest as _pytest
+    for bad in ("a|", "(", "a(", "[a-\\]", "[z-a]", "a\\"):
+        with _pytest.raises(RegexError):
+            compile_regex_vocab(toks, bad, eos_ids=[EOS])
+    # escaped-]-as-range-bound parses; escaped space matches ' '
+    rt = compile_regex_vocab(toks, r"[a-z\]]+", eos_ids=[EOS])
+    s, d, st = 1, 0, 0
+    for ch in b"ab]z":
+        assert rt.valid_mask(s, d, st)[1 + ch]
+        s, d, st = rt.advance(s, d, st, 1 + ch)
+    rt = compile_regex_vocab(toks, r"a\ b", eos_ids=[EOS])
+    s, d, st = 1, 0, 0
+    for ch in b"a b":
+        assert rt.valid_mask(s, d, st)[1 + ch], ch
+        s, d, st = rt.advance(s, d, st, 1 + ch)
+    assert rt.valid_mask(s, d, st)[EOS]
+    # '.' is character-level: never a lone continuation byte, but a full
+    # multi-byte char (as byte tokens) fullmatches
+    rt = compile_regex_vocab(toks, r".", eos_ids=[EOS])
+    assert not rt.valid_mask(1, 0, 0)[1 + 0x80]  # lone continuation
+    s, d, st = 1, 0, 0
+    for ch in "é".encode("utf-8"):  # 0xC3 0xA9
+        assert rt.valid_mask(s, d, st)[1 + ch], hex(ch)
+        s, d, st = rt.advance(s, d, st, 1 + ch)
+    assert rt.valid_mask(s, d, st)[EOS]
+    # negated class likewise: multi-byte chars allowed, excluded ASCII not
+    rt = compile_regex_vocab(toks, r"[^a]", eos_ids=[EOS])
+    m = rt.valid_mask(1, 0, 0)
+    assert not m[1 + ord("a")] and m[1 + ord("b")]
+    assert m[1 + 0xC3] and not m[1 + 0x80]

@@ -306,3 +306,67 @@ def test_grammar_budget_backpressure(setup):
         assert outs[rid] and outs[rid][-1].finish_reason is FinishReason.EOS
         text = decode(toks, [t for o in outs[rid] for t in o.token_ids]).decode()
         assert text in [c + rid for c in big]
+
+
+def test_guided_regex_through_engine(setup):
+    """guided_regex end to end: output fullmatches the pattern at any
+    temperature, terminating at EOS."""
+    import re
+
+    model, params, grammar, toks = setup
+    cfg = EngineConfig(
+        max_batch_size=2, max_model_len=128, block_size=8, num_blocks=64,
+        prefill_buckets=[16, 32, 64, 128], decode_steps=4,
+    )
+    core = EngineCore(model, params, cfg, eos_token_ids=[EOS], grammar=grammar)
+    pattern = r"(up|down) [0-9][0-9]?%"
+    for trial in range(3):
+        outs = []
+        core.submit(EngineRequest(
+            request_id=f"rx{trial}", prompt=[5 + trial, 6],
+            sampling=SamplingOptions(temperature=1.0, guided_regex=pattern),
+            stops=StopConditions(max_tokens=24),
+            emit=outs.append,
+        ))
+        for _ in range(300):
+            if not core.step():
+                break
+        assert outs[-1].finish_reason is FinishReason.EOS
+        text = decode(toks, [t for o in outs for t in o.token_ids]).decode()
+        assert re.fullmatch(pattern, text), text
+
+
+def test_guided_regex_bad_pattern_errors_request_not_engine(setup):
+    """A pattern that blows the DFA cap ERROR-finishes that request; the
+    engine keeps serving others."""
+    model, params, grammar, toks = setup
+    cfg = EngineConfig(
+        max_batch_size=2, max_model_len=128, block_size=8, num_blocks=64,
+        prefill_buckets=[16, 32, 64, 128],
+    )
+    core = EngineCore(model, params, cfg, eos_token_ids=[EOS], grammar=grammar)
+    import dynamo_tpu.engine.grammar as gmod
+
+    # force a tiny DFA cap so an ordinary pattern trips it
+    old = gmod.MAX_REGEX_STATES
+    gmod.MAX_REGEX_STATES = 3
+    try:
+        outs_bad, outs_ok = [], []
+        core.submit(EngineRequest(
+            request_id="bad", prompt=[5, 6],
+            sampling=SamplingOptions(guided_regex="abcdefgh"),
+            stops=StopConditions(max_tokens=8), emit=outs_bad.append,
+        ))
+        core.submit(EngineRequest(
+            request_id="ok", prompt=[7, 8],
+            sampling=SamplingOptions(temperature=0.0),
+            stops=StopConditions(max_tokens=4, ignore_eos=True),
+            emit=outs_ok.append,
+        ))
+        for _ in range(100):
+            if not core.step():
+                break
+        assert outs_bad[-1].finish_reason is FinishReason.ERROR
+        assert sum(len(o.token_ids) for o in outs_ok) == 4
+    finally:
+        gmod.MAX_REGEX_STATES = old
