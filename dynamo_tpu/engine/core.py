@@ -455,8 +455,13 @@ class EngineCore:
                     eos_ids=self._grammar.tables.eos_ids,
                 )
         except Exception as e:
-            # cache the failure: a resubmitted bad pattern must not pay
-            # (or inflict) the compile cost again
+            # cache the failure (bounded): a resubmitted bad pattern must
+            # not pay the compile cost again, and varied bad patterns must
+            # not grow the cache without limit or starve live tables
+            failures = [k for k, v in self._choice_tables.items()
+                        if isinstance(v, Exception)]
+            if len(failures) >= 32:
+                self._choice_tables.pop(failures[0])
             self._choice_tables[key] = e
             raise
         cap = max(16, self.config.max_batch_size)

@@ -540,6 +540,13 @@ def _parse_regex(pattern: str):
     NFA representation: list of nodes; node = (eps: list[int],
     edges: list[(bool[256], int)]).
     """
+    # fullmatch semantics: a leading ^ / trailing $ are redundant no-ops
+    # (the common anchored form); anywhere else they are rejected below
+    if pattern.startswith("^"):
+        pattern = pattern[1:]
+    if pattern.endswith("$") and not pattern.endswith("\\$"):
+        pattern = pattern[:-1]
+
     eps: list[list[int]] = []
     edges: list[list] = []
 
@@ -552,7 +559,7 @@ def _parse_regex(pattern: str):
     n = len(pattern)
 
     def class_endpoint():
-        """One class member: returns an ASCII byte, or a mask for \d-style
+        r"""One class member: returns an ASCII byte, or a mask for \d-style
         escapes (which cannot anchor a range)."""
         nonlocal i
         c = pattern[i]
@@ -684,9 +691,9 @@ def _parse_regex(pattern: str):
                 edges[a].append((mask, b))
                 return a, b
             return _literal_bytes(bytes([byte]))
-        if c in ")|*+?{}":
-            # {m,n} quantifiers are unsupported — reject rather than
-            # silently matching literal braces
+        if c in ")|*+?{}^$":
+            # {m,n} quantifiers and mid-pattern anchors are unsupported —
+            # reject rather than silently matching literal chars
             raise RegexError(f"unexpected {c!r}")
         i += 1
         return _literal_bytes(c.encode("utf-8"))
@@ -806,22 +813,27 @@ def compile_regex_vocab(
     dfa_ids: dict[frozenset, int] = {init: 1}  # 0 = DEAD
     order = [init]
     delta_rows = {1: np.zeros(256, np.int16)}
+    n_nfa = len(edges)
     qi = 0
     while qi < len(order):
         cur = order[qi]
         qi += 1
         sid = dfa_ids[cur]
         row = delta_rows[sid]
-        # group outgoing byte masks -> target NFA sets
-        for byte in range(256):
-            targets = set()
-            for s0 in cur:
-                for mask, t in edges[s0]:
-                    if mask[byte]:
-                        targets.add(t)
-            if not targets:
+        # vectorised per-byte target sets: one bool matrix over the state's
+        # outgoing edges, grouped by identical rows (a Python loop over
+        # 256 bytes x edges here stalls the engine thread for seconds on
+        # near-cap patterns)
+        tmat = np.zeros((256, n_nfa), bool)
+        for s0 in cur:
+            for mask, t in edges[s0]:
+                tmat[mask, t] = True
+        uniq, inv = np.unique(tmat, axis=0, return_inverse=True)
+        for u in range(uniq.shape[0]):
+            members = np.flatnonzero(uniq[u])
+            if members.size == 0:
                 continue
-            tgt = closure(frozenset(targets))
+            tgt = closure(frozenset(int(x) for x in members))
             if tgt not in dfa_ids:
                 if len(dfa_ids) >= MAX_REGEX_STATES:
                     raise RegexError(
@@ -830,7 +842,7 @@ def compile_regex_vocab(
                 dfa_ids[tgt] = len(dfa_ids) + 1
                 delta_rows[dfa_ids[tgt]] = np.zeros(256, np.int16)
                 order.append(tgt)
-            row[byte] = dfa_ids[tgt]
+            row[inv == u] = dfa_ids[tgt]
     n_states = len(dfa_ids) + 1
     delta = np.zeros((n_states, 256), np.int16)
     for sid, row in delta_rows.items():

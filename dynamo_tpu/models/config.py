@@ -23,6 +23,7 @@ SUPPORTED_ARCHITECTURES = {
     "MixtralForCausalLM",
     "Qwen2ForCausalLM",
     "Qwen3ForCausalLM",
+    "Qwen3MoeForCausalLM",
     "Phi3ForCausalLM",
     "GemmaForCausalLM",
     "Gemma2ForCausalLM",
@@ -52,6 +53,8 @@ class ModelConfig:
     # MoE (Mixtral-style); num_experts == 0 → dense MLP
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # renormalize top-k router probs (Mixtral always; Qwen3-MoE flag)
+    norm_topk_prob: bool = True
     # --- Gemma-family deltas (all default to the Llama behavior) ---
     # MLP activation on the gate branch: "silu" (Llama) or "gelu_tanh"
     # (Gemma GeGLU)
@@ -62,6 +65,10 @@ class ModelConfig:
     scale_embeddings: bool = False
     # Gemma2 sandwich norms: extra post-attention / post-MLP RMSNorms
     post_norms: bool = False
+    # rope_scaling (HF config.json): {"rope_type": "llama3"|"linear", ...}
+    # — Llama-3.1+ checkpoints REQUIRE llama3 frequency scaling; ignoring
+    # it would silently corrupt long-context behavior
+    rope_scaling: Optional[dict] = None
     # attention sm_scale = query_pre_attn_scalar**-0.5 (None = head_dim)
     query_pre_attn_scalar: Optional[float] = None
     # tanh softcaps: scores (Gemma2 attn_logit_softcapping) and final logits
@@ -116,12 +123,25 @@ class ModelConfig:
                 f"{sorted(SUPPORTED_ARCHITECTURES)}"
             )
         gemma = arch in ("GemmaForCausalLM", "Gemma2ForCausalLM")
-        if arch == "Phi3ForCausalLM":
-            rs = cfg.get("rope_scaling")
-            if rs:  # longrope (128k variants) is not implemented — be loud
+        qwen3_moe = arch == "Qwen3MoeForCausalLM"
+        if qwen3_moe and (
+            cfg.get("decoder_sparse_step", 1) != 1 or cfg.get("mlp_only_layers")
+        ):
+            # partially-sparse stacks interleave dense and MoE layers; the
+            # scan-over-layers decoder assumes a uniform layer type
+            raise ValueError(
+                "Qwen3-MoE with decoder_sparse_step != 1 or mlp_only_layers "
+                "is not supported (non-uniform layer stack)"
+            )
+        rs = cfg.get("rope_scaling")
+        if rs:
+            kind = rs.get("rope_type") or rs.get("type")
+            if kind not in ("llama3", "linear", "default", None):
+                # longrope/yarn/dynamic are not implemented — be loud, a
+                # silently-unscaled rope corrupts every long prompt
                 raise ValueError(
-                    f"Phi3 rope_scaling={rs.get('type', rs)!r} not supported"
-                    " (serve the 4k-context checkpoints)"
+                    f"rope_scaling type {kind!r} not supported "
+                    "(supported: llama3, linear)"
                 )
         act = cfg.get("hidden_activation") or cfg.get("hidden_act") or "silu"
         # original Gemma-1 configs say "gelu" but the canonical weights were
@@ -156,7 +176,11 @@ class ModelConfig:
         return cls(
             vocab_size=cfg["vocab_size"],
             hidden_size=cfg["hidden_size"],
-            intermediate_size=cfg["intermediate_size"],
+            # MoE experts use their own width (Qwen3-MoE moe_intermediate_size)
+            intermediate_size=(
+                cfg["moe_intermediate_size"] if qwen3_moe
+                else cfg["intermediate_size"]
+            ),
             num_layers=cfg["num_hidden_layers"],
             num_heads=cfg["num_attention_heads"],
             num_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
@@ -169,10 +193,15 @@ class ModelConfig:
             # HF Qwen2 attention always carries QKV bias; Llama exposes an
             # explicit attention_bias flag (default False)
             attention_bias=cfg.get("attention_bias", arch == "Qwen2ForCausalLM"),
-            qk_norm=arch == "Qwen3ForCausalLM",
+            qk_norm=arch in ("Qwen3ForCausalLM", "Qwen3MoeForCausalLM"),
             sliding_window=cfg.get("sliding_window"),
-            num_experts=cfg.get("num_local_experts", 0),
+            num_experts=cfg.get("num_local_experts",
+                                cfg.get("num_experts", 0) if qwen3_moe else 0),
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
+            # HF default differs by family: Mixtral always renormalizes,
+            # Qwen3MoeConfig defaults the flag to False
+            norm_topk_prob=bool(cfg.get("norm_topk_prob", not qwen3_moe)),
+            rope_scaling=dict(rs) if rs else None,
             hidden_activation=act_map[act],
             rmsnorm_unit_offset=gemma,
             scale_embeddings=gemma,

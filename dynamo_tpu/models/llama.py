@@ -26,10 +26,11 @@ adds (BASELINE.json north star).
 from __future__ import annotations
 
 import math
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from dynamo_tpu.models.config import ModelConfig
@@ -62,11 +63,48 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float,
     return (norm * w).astype(x.dtype)
 
 
-def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def rope_inv_freq(head_dim: int, theta: float,
+                  rope_scaling: Optional[dict] = None) -> jax.Array:
+    """Rotary inverse frequencies [D/2], with HF rope_scaling applied.
+
+    llama3 scaling (Llama-3.1+): low-frequency components divide by
+    ``factor``, high-frequency ones stay, the band between interpolates —
+    matching transformers' _compute_llama3_parameters.  "linear" divides
+    every frequency by ``factor``.
+    """
+    half = head_dim // 2
+    inv = 1.0 / (theta ** (np.arange(0, half, dtype=np.float64) * 2.0
+                           / head_dim))
+    if rope_scaling:
+        kind = rope_scaling.get("rope_type") or rope_scaling.get("type")
+        if kind == "linear":
+            inv = inv / float(rope_scaling["factor"])
+        elif kind == "llama3":
+            factor = float(rope_scaling["factor"])
+            low = float(rope_scaling.get("low_freq_factor", 1.0))
+            high = float(rope_scaling.get("high_freq_factor", 4.0))
+            old_ctx = float(
+                rope_scaling.get("original_max_position_embeddings", 8192)
+            )
+            wavelen = 2.0 * np.pi / inv
+            # long wavelengths (low freq): fully scaled; short: untouched;
+            # medium: smooth interpolation — transformers parity
+            scaled = inv / factor
+            smooth = (old_ctx / wavelen - low) / (high - low)
+            smooth = np.clip(smooth, 0.0, 1.0)
+            interp = (1.0 - smooth) * scaled + smooth * inv
+            inv = np.where(wavelen > old_ctx / low, scaled,
+                           np.where(wavelen < old_ctx / high, inv, interp))
+    return jnp.asarray(inv, jnp.float32)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float,
+               inv_freq: Optional[jax.Array] = None) -> jax.Array:
     """HF-Llama rotate-half RoPE.  x: [B,S,H,D], positions: [B,S]."""
     d = x.shape[-1]
     half = d // 2
-    inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) * 2.0 / d))
+    if inv_freq is None:
+        inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) * 2.0 / d))
     angles = positions.astype(jnp.float32)[:, :, None] * inv_freq[None, None, :]
     cos = jnp.cos(angles)[:, :, None, :]  # [B,S,1,half]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -83,6 +121,10 @@ class LlamaModel:
         # Gemma2 scales scores by query_pre_attn_scalar**-0.5, not head_dim
         self.sm_scale = float(
             (config.query_pre_attn_scalar or config.head_dim) ** -0.5
+        )
+        # rotary frequencies with rope_scaling applied (llama3/linear)
+        self.inv_freq = rope_inv_freq(
+            config.head_dim, config.rope_theta, config.rope_scaling
         )
 
     # ------------------------------------------------------------------ init
@@ -310,8 +352,8 @@ class LlamaModel:
             lp, li = layer_in
             x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps, uo)
             q, k, v = _qkv_proj(cfg, lp, x, b, s)
-            q = apply_rope(q, positions, cfg.rope_theta)
-            k = apply_rope(k, positions, cfg.rope_theta)
+            q = apply_rope(q, positions, cfg.rope_theta, self.inv_freq)
+            k = apply_rope(k, positions, cfg.rope_theta, self.inv_freq)
             # fast_prefill implies the engine's block-aligned contiguous
             # chunk layout — unlocks the block-granular cache write
             cache = write_kv_cache_layer(
@@ -387,8 +429,8 @@ class LlamaModel:
         def layer_step(h, lp):
             x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps, uo)
             q, k, v = _qkv_proj(cfg, lp, x, b, s)
-            q = apply_rope(q, positions, cfg.rope_theta)
-            k = apply_rope(k, positions, cfg.rope_theta)
+            q = apply_rope(q, positions, cfg.rope_theta, self.inv_freq)
+            k = apply_rope(k, positions, cfg.rope_theta, self.inv_freq)
             attn = ring_attention(
                 q, k, v, positions, positions, mesh=mesh, axis=sp_axis,
                 sm_scale=self.sm_scale, logit_cap=cfg.attn_logit_softcap,
@@ -472,7 +514,13 @@ def _moe_mlp(cfg: ModelConfig, lp: dict, x: jax.Array) -> jax.Array:
     k = cfg.num_experts_per_tok
     router_logits = (x @ lp["router"]).astype(jnp.float32)  # [B,S,E]
     topv, topi = jax.lax.top_k(router_logits, k)
-    weights = jax.nn.softmax(topv, axis=-1)  # [B,S,k]
+    if cfg.norm_topk_prob:
+        # renormalized top-k == softmax over the top-k logits
+        weights = jax.nn.softmax(topv, axis=-1)  # [B,S,k]
+    else:
+        # Qwen3-MoE norm_topk_prob=False: full-softmax probs of the top-k
+        probs_all = jax.nn.softmax(router_logits, axis=-1)
+        weights = jnp.take_along_axis(probs_all, topi, axis=-1)
     onehot = jax.nn.one_hot(topi, cfg.num_experts, dtype=jnp.float32)  # [B,S,k,E]
     gate_probs = jnp.einsum("bske,bsk->bse", onehot, weights)  # [B,S,E]
     # every expert runs all tokens: [B,S,E,F] intermediates.  Quantized

@@ -128,12 +128,20 @@ def load_params_from_state_dict(
                 dtype=dt,
             )
 
-        layers.update(
-            router=stack("model.layers.{i}.block_sparse_moe.gate.weight"),
-            w_gate=stack_experts("model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight"),
-            w_down=stack_experts("model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight"),
-            w_up=stack_experts("model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight"),
-        )
+        if "model.layers.0.mlp.gate.weight" in state:  # Qwen3-MoE naming
+            layers.update(
+                router=stack("model.layers.{i}.mlp.gate.weight"),
+                w_gate=stack_experts("model.layers.{i}.mlp.experts.{e}.gate_proj.weight"),
+                w_down=stack_experts("model.layers.{i}.mlp.experts.{e}.down_proj.weight"),
+                w_up=stack_experts("model.layers.{i}.mlp.experts.{e}.up_proj.weight"),
+            )
+        else:  # Mixtral naming
+            layers.update(
+                router=stack("model.layers.{i}.block_sparse_moe.gate.weight"),
+                w_gate=stack_experts("model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight"),
+                w_down=stack_experts("model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight"),
+                w_up=stack_experts("model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight"),
+            )
     else:
         if fused_gate_up:
             w_gate, w_up = stack_fused(

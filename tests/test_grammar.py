@@ -497,3 +497,30 @@ def test_regex_edge_cases(tables):
     m = rt.valid_mask(1, 0, 0)
     assert not m[1 + ord("a")] and m[1 + ord("b")]
     assert m[1 + 0xC3] and not m[1 + 0x80]
+
+
+def test_regex_anchors_and_perf(tables):
+    import time
+
+    from dynamo_tpu.engine.grammar import RegexError, compile_regex_vocab
+
+    toks = make_vocab()
+    # ^...$ anchors are no-ops (fullmatch semantics already)
+    rt = compile_regex_vocab(toks, r"^(yes|no)$", eos_ids=[EOS])
+    s, d, st = 1, 0, 0
+    assert not rt.valid_mask(s, d, st)[tok_id(toks, b"^")]
+    for ch in b"yes":
+        s, d, st = rt.advance(s, d, st, 1 + ch - 0)  # byte tokens at 1+b
+    # mid-pattern anchors are loud
+    import pytest as _pytest
+    with _pytest.raises(RegexError):
+        compile_regex_vocab(toks, r"a^b", eos_ids=[EOS])
+    with _pytest.raises(RegexError):
+        compile_regex_vocab(toks, r"a$b", eos_ids=[EOS])
+    # the exponential-ish pattern compiles (or caps) in bounded time
+    t0 = time.monotonic()
+    try:
+        compile_regex_vocab(toks, "(a|b)*a" + "(a|b)" * 9, eos_ids=[EOS])
+    except RegexError:
+        pass
+    assert time.monotonic() - t0 < 5.0

@@ -374,3 +374,110 @@ def test_phi3_fused_projections_match_hf():
         ModelConfig.from_hf_config(
             {**d, "rope_scaling": {"type": "longrope"}}, dtype="float32"
         )
+
+
+def test_llama31_rope_scaling_matches_hf():
+    """Llama-3.1-style llama3 rope_scaling — frequencies scaled per HF's
+    _compute_llama3_parameters — verified logit-for-logit, including at
+    positions past the pre-scaling regime."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(14)
+    hf_cfg = LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rope_theta=10000.0,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 32},
+    )
+    hf = LlamaForCausalLM(hf_cfg).eval()
+    cfg = ModelConfig.from_hf_config(hf_cfg.to_dict(), dtype="float32")
+    assert cfg.rope_scaling and cfg.rope_scaling["factor"] == 8.0
+    model = LlamaModel(cfg)
+    params = load_params_from_state_dict(cfg, hf.state_dict())
+
+    # 60 tokens: well past original_max_position_embeddings=32, so the
+    # scaled low-frequency band actually matters
+    tokens = list(np.random.RandomState(15).randint(0, 128, size=60))
+    import torch as _t
+
+    with _t.no_grad():
+        ref = hf(_t.tensor([tokens])).logits[0].float().numpy()
+    got = _run_ours(model, params, tokens, chunks=[32, 16] + [1] * 12)
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=5e-3)
+
+
+def test_rope_scaling_linear_and_rejects_unknown():
+    base = dict(
+        architectures=["LlamaForCausalLM"], vocab_size=64, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=1, num_attention_heads=2,
+        num_key_value_heads=1,
+    )
+    cfg = ModelConfig.from_hf_config(
+        {**base, "rope_scaling": {"rope_type": "linear", "factor": 2.0}},
+        dtype="float32",
+    )
+    assert cfg.rope_scaling["factor"] == 2.0
+    from dynamo_tpu.models.llama import rope_inv_freq
+
+    import numpy as np_
+    plain = np_.asarray(rope_inv_freq(16, 10000.0))
+    lin = np_.asarray(rope_inv_freq(16, 10000.0, cfg.rope_scaling))
+    np_.testing.assert_allclose(lin, plain / 2.0, rtol=1e-6)
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="rope_scaling"):
+        ModelConfig.from_hf_config(
+            {**base, "rope_scaling": {"rope_type": "yarn", "factor": 2.0}},
+            dtype="float32",
+        )
+
+
+def test_qwen3_moe_matches_hf():
+    """Qwen3-MoE: qk-norm attention + per-expert gate/up/down naming +
+    norm_topk_prob routing, through the paged path vs transformers."""
+    torch = pytest.importorskip("torch")
+    from transformers import Qwen3MoeConfig, Qwen3MoeForCausalLM
+
+    torch.manual_seed(16)
+    hf_cfg = Qwen3MoeConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        moe_intermediate_size=48,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        num_experts=4,
+        num_experts_per_tok=2,
+        norm_topk_prob=True,
+        max_position_embeddings=256,
+        tie_word_embeddings=True,
+    )
+    hf = Qwen3MoeForCausalLM(hf_cfg).eval()
+    d = hf_cfg.to_dict()
+    d["architectures"] = ["Qwen3MoeForCausalLM"]
+    cfg = ModelConfig.from_hf_config(d, dtype="float32")
+    assert cfg.is_moe and cfg.qk_norm and cfg.intermediate_size == 48
+    model = LlamaModel(cfg)
+    params = load_params_from_state_dict(cfg, hf.state_dict())
+
+    tokens = list(np.random.RandomState(17).randint(0, 128, size=SEQ))
+    import torch as _t
+
+    with _t.no_grad():
+        ref = hf(_t.tensor([tokens])).logits[0].float().numpy()
+    got = _run_ours(model, params, tokens, chunks=[9, 7] + [1] * (SEQ - 16))
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=5e-3)
+    # non-uniform sparse stacks are rejected loudly
+    with pytest.raises(ValueError, match="sparse"):
+        ModelConfig.from_hf_config({**d, "mlp_only_layers": [0]},
+                                   dtype="float32")
