@@ -402,12 +402,15 @@ class EngineCore:
     def _grammar_key(req: EngineRequest):
         """None | "json" | ("choice", choices...) — which grammar (if any)
         constrains this request.  JSON wins when both are set."""
+        # regex before json: schema requests carry BOTH (the regex enforces
+        # the schema's shape; json_mode is the documented fallback if that
+        # regex turns out uncompilable)
+        if req.sampling.guided_regex:
+            return ("regex", req.sampling.guided_regex)
         if req.sampling.json_mode:
             return "json"
         if req.sampling.guided_choice:
             return ("choice",) + tuple(req.sampling.guided_choice)
-        if req.sampling.guided_regex:
-            return ("regex", req.sampling.guided_regex)
         return None
 
     # composite state budget: a dispatch's composed tables must stay well
@@ -813,13 +816,25 @@ class EngineCore:
                 try:
                     budget_ok = self._active_grammar_budget_ok(gkey)
                 except Exception:
-                    # bad pattern / oversized DFA: this request can never
-                    # run — fail it, don't crash the engine step
-                    log.exception("grammar compile failed for %s",
-                                  req.request_id)
-                    self._admitted.remove(req)
-                    self._finish(req, FinishReason.ERROR)
-                    continue
+                    if gkey[0] == "regex" and req.sampling.json_mode:
+                        # schema-derived regex overflowed the DFA cap:
+                        # fall back to the generic JSON grammar (prompt
+                        # injection still steers the shape)
+                        log.warning(
+                            "schema regex uncompilable for %s; falling "
+                            "back to generic JSON mode", req.request_id,
+                        )
+                        req.sampling.guided_regex = None
+                        gkey = "json"
+                        budget_ok = self._active_grammar_budget_ok(gkey)
+                    else:
+                        # bad pattern / oversized DFA with no fallback:
+                        # fail the request, don't crash the engine step
+                        log.exception("grammar compile failed for %s",
+                                      req.request_id)
+                        self._admitted.remove(req)
+                        self._finish(req, FinishReason.ERROR)
+                        continue
                 if not budget_ok:
                     # composed dispatch tables must stay inside int16 state
                     # ids: wait for constrained slots to free

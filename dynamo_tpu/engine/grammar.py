@@ -45,7 +45,7 @@ import numpy as np
 __all__ = [
     "JsonGrammar", "VocabTables", "token_bytes_map", "MAX_DEPTH",
     "INIT_STATE", "DEAD", "compile_choice_vocab", "compile_regex_vocab",
-    "compose_tables",
+    "compose_tables", "json_schema_to_regex",
 ]
 
 MAX_DEPTH = 24          # nesting levels the int32 bit-stack holds
@@ -523,6 +523,94 @@ def compile_choice_vocab(
                               eos_ids)
 
 
+def _regex_escape(text: str) -> str:
+    out = []
+    for ch in text:
+        if ch in r"\.()[]|*+?{}^$/-'" + '"':
+            out.append("\\" + ch)
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+# regex fragments for JSON primitives (match the JSON grammar's lexing)
+_RX_STRING = r'"([^"\\]|\\.)*"'
+_RX_INT = r"-?(0|[1-9][0-9]*)"
+_RX_NUMBER = _RX_INT + r"(\.[0-9]+)?([eE][-+]?[0-9]+)?"
+_RX_BOOL = r"(true|false)"
+_RX_WS = r"[ \n\t]*"
+
+
+def json_schema_to_regex(schema: dict, _depth: int = 0) -> Optional[str]:
+    """Translate a JSON-Schema SUBSET into a pattern for the bounded regex
+    engine, so ``response_format: json_schema`` enforces the schema's
+    SHAPE at decode time (not just syntactic JSON + prompt steering).
+
+    Supported: type string/integer/number/boolean/null, enum/const of
+    scalars, object with ``properties`` (required-only emission, declared
+    order), array of a supported item type.  Returns None when the schema
+    uses anything else (caller falls back to the generic JSON grammar).
+    """
+    if _depth > 6 or not isinstance(schema, dict):
+        return None
+    if "enum" in schema:
+        alts = []
+        for v in schema["enum"]:
+            if isinstance(v, str):
+                # json.dumps first: quotes/backslashes/control chars must
+                # appear ESCAPED in the emitted JSON, not raw
+                alts.append(_regex_escape(json.dumps(v)))
+            elif isinstance(v, bool):
+                alts.append("true" if v else "false")
+            elif isinstance(v, (int, float)):
+                alts.append(_regex_escape(json.dumps(v)))
+            elif v is None:
+                alts.append("null")
+            else:
+                return None
+        return "(" + "|".join(alts) + ")"
+    if "const" in schema:
+        return json_schema_to_regex({"enum": [schema["const"]]}, _depth)
+    t = schema.get("type")
+    if t == "string":
+        return _RX_STRING
+    if t == "integer":
+        return _RX_INT
+    if t == "number":
+        return _RX_NUMBER
+    if t == "boolean":
+        return _RX_BOOL
+    if t == "null":
+        return "null"
+    if t == "array":
+        item = json_schema_to_regex(schema.get("items", {}), _depth + 1)
+        if item is None:
+            return None
+        w = _RX_WS
+        return (r"\[" + w + "(" + item + "(" + w + "," + w + item + ")*"
+                + w + r")?\]")
+    if t == "object":
+        props = schema.get("properties")
+        if not isinstance(props, dict) or not props:
+            return None
+        required = schema.get("required")
+        keys = list(props.keys())
+        if required is not None and set(required) != set(keys):
+            # optional properties explode the alternation; the generic
+            # JSON grammar + prompt steering handles those schemas
+            return None
+        w = _RX_WS
+        parts = []
+        for k in keys:
+            sub = json_schema_to_regex(props[k], _depth + 1)
+            if sub is None:
+                return None
+            parts.append(_regex_escape(json.dumps(k)) + w + ":" + w + sub)
+        body = ("," + w).join(p + w for p in parts)
+        return r"\{" + w + body + r"\}"
+    return None
+
+
 MAX_REGEX_STATES = 2048
 
 
@@ -544,8 +632,10 @@ def _parse_regex(pattern: str):
     # (the common anchored form); anywhere else they are rejected below
     if pattern.startswith("^"):
         pattern = pattern[1:]
-    if pattern.endswith("$") and not pattern.endswith("\\$"):
-        pattern = pattern[:-1]
+    if pattern.endswith("$"):
+        bs_run = len(pattern) - 1 - len(pattern[:-1].rstrip("\\"))
+        if bs_run % 2 == 0:  # even backslashes -> the $ is a real anchor
+            pattern = pattern[:-1]
 
     eps: list[list[int]] = []
     edges: list[list] = []
@@ -797,60 +887,80 @@ def compile_regex_vocab(
     capped at MAX_REGEX_STATES, then composed against the vocab like the
     choice grammars."""
     eps, edges, start, accept = _parse_regex(pattern)
+    n_nfa = len(edges)
 
-    def closure(states: frozenset) -> frozenset:
-        out = set(states)
-        stack = list(states)
+    # precomputed per-node epsilon closures as a bool matrix: subset states
+    # become bool VECTORS (bytes-keyed), and closure-of-set is one OR-
+    # reduction — Python set/frozenset bookkeeping on large NFAs cost tens
+    # of seconds for enum-style alternations
+    nclo = np.eye(n_nfa, dtype=bool)
+    for node in range(n_nfa):
+        stack = [node]
         while stack:
             s0 = stack.pop()
             for t in eps[s0]:
-                if t not in out:
-                    out.add(t)
+                if not nclo[node, t]:
+                    nclo[node, t] = True
                     stack.append(t)
-        return frozenset(out)
 
-    init = closure(frozenset([start]))
-    dfa_ids: dict[frozenset, int] = {init: 1}  # 0 = DEAD
-    order = [init]
+    # per-node outgoing edges, stacked once: masks [E, 256], targets [E]
+    edge_masks = []
+    edge_targets = []
+    edge_owner = np.zeros((n_nfa, max(1, sum(len(e) for e in edges))), bool)
+    ei = 0
+    for s0, elist in enumerate(edges):
+        for mask, t in elist:
+            edge_masks.append(mask)
+            edge_targets.append(t)
+            edge_owner[s0, ei] = True
+            ei += 1
+    edge_masks = (np.stack(edge_masks) if edge_masks
+                  else np.zeros((0, 256), bool))
+    edge_targets = np.asarray(edge_targets, np.int64)
+    edge_owner = edge_owner[:, :len(edge_targets)]
+
+    init_vec = nclo[start].copy()
+    dfa_ids: dict[bytes, int] = {init_vec.tobytes(): 1}  # 0 = DEAD
+    order = [init_vec]
+    accept_flags = {1: bool(init_vec[accept])}
     delta_rows = {1: np.zeros(256, np.int16)}
-    n_nfa = len(edges)
     qi = 0
     while qi < len(order):
         cur = order[qi]
         qi += 1
-        sid = dfa_ids[cur]
+        sid = dfa_ids[cur.tobytes()]
         row = delta_rows[sid]
-        # vectorised per-byte target sets: one bool matrix over the state's
-        # outgoing edges, grouped by identical rows (a Python loop over
-        # 256 bytes x edges here stalls the engine thread for seconds on
-        # near-cap patterns)
-        tmat = np.zeros((256, n_nfa), bool)
-        for s0 in cur:
-            for mask, t in edges[s0]:
-                tmat[mask, t] = True
-        uniq, inv = np.unique(tmat, axis=0, return_inverse=True)
+        live = cur @ edge_owner  # [E] bool: edges leaving this subset
+        if not live.any():
+            continue
+        # [256, E_live] per-byte edge activation -> unique target classes
+        m = edge_masks[live].T  # [256, E_live]
+        tgts = edge_targets[live]
+        uniq, inv = np.unique(m, axis=0, return_inverse=True)
         for u in range(uniq.shape[0]):
-            members = np.flatnonzero(uniq[u])
-            if members.size == 0:
+            hit = tgts[uniq[u]]
+            if hit.size == 0:
                 continue
-            tgt = closure(frozenset(int(x) for x in members))
-            if tgt not in dfa_ids:
+            vec = nclo[hit].any(axis=0)
+            key = vec.tobytes()
+            if key not in dfa_ids:
                 if len(dfa_ids) >= MAX_REGEX_STATES:
                     raise RegexError(
                         f"regex needs more than {MAX_REGEX_STATES} DFA states"
                     )
-                dfa_ids[tgt] = len(dfa_ids) + 1
-                delta_rows[dfa_ids[tgt]] = np.zeros(256, np.int16)
-                order.append(tgt)
-            row[inv == u] = dfa_ids[tgt]
+                dfa_ids[key] = len(dfa_ids) + 1
+                accept_flags[dfa_ids[key]] = bool(vec[accept])
+                delta_rows[dfa_ids[key]] = np.zeros(256, np.int16)
+                order.append(vec)
+            row[inv == u] = dfa_ids[key]
     n_states = len(dfa_ids) + 1
     delta = np.zeros((n_states, 256), np.int16)
     for sid, row in delta_rows.items():
         delta[sid] = row
     eos_ok = np.zeros(n_states, bool)
     terminal_only = np.zeros(n_states, bool)
-    for st, sid in dfa_ids.items():
-        if accept in st:
+    for sid, is_accept in accept_flags.items():
+        if is_accept:
             eos_ok[sid] = True
             terminal_only[sid] = not delta[sid].any()
     return _compose_dfa_vocab(delta, token_bytes, eos_ok, terminal_only,

@@ -50,9 +50,11 @@ class ParsedRequest:
     tools: Optional[list[dict]] = None
     tool_choice: Any = None  # "none"|"auto"|"required"|{function ref}|None
     # response_format: None | "json_object" | "json_schema"; schema kept
-    # for prompt injection (enforcement is the generic JSON grammar)
+    # for prompt injection; enforcement = schema-shaped regex when the
+    # schema translates (schema_regex), else the generic JSON grammar
     response_format: Optional[str] = None
     json_schema: Optional[dict] = None
+    schema_regex: Optional[str] = None
     raw: dict = field(default_factory=dict)
 
     @property
@@ -166,6 +168,14 @@ def parse_request(body: dict, chat: bool) -> ParsedRequest:
                      "'response_format.json_schema.schema' is required")
             req.response_format = rft
             req.json_schema = js
+            # enforce the schema's SHAPE when it translates to the bounded
+            # regex engine (objects with required scalar/array/enum props);
+            # otherwise the generic JSON grammar + prompt injection applies
+            from dynamo_tpu.engine.grammar import json_schema_to_regex
+
+            req.schema_regex = json_schema_to_regex(js["schema"])
+            if req.schema_regex and len(req.schema_regex) > 4096:
+                req.schema_regex = None  # generic JSON grammar instead
         elif rft == "json_object":
             req.response_format = rft
 
@@ -208,12 +218,15 @@ def parse_request(body: dict, chat: bool) -> ParsedRequest:
         min_p=min_p,
         logit_bias=logit_bias or None,
         guided_choice=guided_choice,
-        guided_regex=guided_regex,
+        guided_regex=guided_regex or req.schema_regex,
         seed=seed,
         frequency_penalty=freq_pen,
         presence_penalty=pres_pen,
         logprobs=want_lp,
         top_logprobs=top_lp,
+        # json_mode stays set alongside a schema regex: the engine prefers
+        # the regex grammar and falls back to generic JSON if its DFA
+        # exceeds the cap (schema requests must never hard-fail on size)
         json_mode=req.response_format is not None,
     )
 

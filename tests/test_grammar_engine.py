@@ -370,3 +370,44 @@ def test_guided_regex_bad_pattern_errors_request_not_engine(setup):
         assert sum(len(o.token_ids) for o in outs_ok) == 4
     finally:
         gmod.MAX_REGEX_STATES = old
+
+
+def test_schema_regex_falls_back_to_json_mode(setup):
+    """A schema-derived regex whose DFA exceeds the cap degrades to the
+    generic JSON grammar instead of failing the request."""
+    import dynamo_tpu.engine.grammar as gmod
+
+    model, params, grammar, toks = setup
+    cfg = EngineConfig(
+        max_batch_size=2, max_model_len=128, block_size=8, num_blocks=64,
+        prefill_buckets=[16, 32, 64, 128],
+    )
+    core = EngineCore(model, params, cfg, eos_token_ids=[EOS], grammar=grammar)
+    old = gmod.MAX_REGEX_STATES
+    gmod.MAX_REGEX_STATES = 3  # force the overflow
+    try:
+        outs = []
+        core.submit(EngineRequest(
+            request_id="sf", prompt=[5, 6, 7],
+            sampling=SamplingOptions(temperature=1.0, json_mode=True,
+                                     guided_regex="abcdefgh"),
+            stops=StopConditions(max_tokens=24), emit=outs.append,
+        ))
+        for _ in range(300):
+            if not core.step():
+                break
+        assert outs[-1].finish_reason in (FinishReason.EOS,
+                                          FinishReason.LENGTH)
+        ids = [t for o in outs for t in o.token_ids]
+        # output obeys the JSON grammar (fallback), replayed host-side
+        from dynamo_tpu.engine.grammar import INIT_STATE
+
+        tb = grammar.tables
+        s, d, st = INIT_STATE, 0, 0
+        for t in ids:
+            if t == EOS:
+                break
+            assert tb.valid_mask(s, d, st)[t]
+            s, d, st = tb.advance(s, d, st, t)
+    finally:
+        gmod.MAX_REGEX_STATES = old
