@@ -411,3 +411,35 @@ def test_schema_regex_falls_back_to_json_mode(setup):
             s, d, st = tb.advance(s, d, st, t)
     finally:
         gmod.MAX_REGEX_STATES = old
+
+
+def test_json_mode_under_tp_mesh(setup):
+    """Grammar masking composes with tensor parallelism: sharded logits,
+    replicated tables, one valid JSON out."""
+    import jax
+    import numpy as np_
+    from jax.sharding import Mesh
+
+    model, params, grammar, toks = setup
+    mesh = Mesh(np_.array(jax.devices()[:2]).reshape(1, 2),
+                ("data", "model"))
+    cfg = EngineConfig(
+        max_batch_size=2, max_model_len=128, block_size=8, num_blocks=64,
+        prefill_buckets=[16, 32, 64, 128], decode_steps=4,
+    )
+    core = EngineCore(model, params, cfg, mesh=mesh, eos_token_ids=[EOS],
+                      grammar=grammar)
+    ids, reason = run_one(core, toks, temperature=1.0, rid="mesh")
+    text = decode(toks, ids).decode("utf-8", errors="replace")
+    if reason is FinishReason.EOS:
+        json.loads(text)
+    else:
+        from dynamo_tpu.engine.grammar import INIT_STATE
+
+        tb = grammar.tables
+        s, d, st = INIT_STATE, 0, 0
+        for t in ids:
+            if t == EOS:
+                break
+            assert tb.valid_mask(s, d, st)[t]
+            s, d, st = tb.advance(s, d, st, t)
