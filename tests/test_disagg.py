@@ -567,3 +567,57 @@ def test_disagg_json_mode_end_to_end(setup, force_tcp):
             await srv.stop()
 
     run(go())
+
+
+def test_disagg_decode_with_speculation(setup, force_tcp):
+    """Prompt-lookup speculation on the DECODE worker composes with remote
+    prefill: identical greedy tokens, fewer decode dispatches."""
+    model, params = setup
+    rng = np.random.default_rng(11)
+    # a repetitive prompt gives the proposer material
+    base_pat = rng.integers(1, 128, size=6).tolist()
+    prompt = (base_pat * 4)[:22]
+
+    def spec_engine():
+        cfg = EngineConfig(
+            max_batch_size=4, max_model_len=128, block_size=8, num_blocks=64,
+            prefill_buckets=[16, 32, 64, 128], spec_tokens=4,
+        )
+        return AsyncLLMEngine(EngineCore(model, params, cfg)).start()
+
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        decode_engine = spec_engine()
+        prefill_engine = make_engine(model, params)
+        reference_engine = make_engine(model, params)
+        try:
+            c_dec = await CoordinatorClient(srv.url).connect()
+            c_pre = await CoordinatorClient(srv.url).connect()
+            worker = DecodeWorker(
+                decode_engine, coordinator=c_dec, namespace="spdis",
+                router=DisaggregatedRouter(
+                    DisaggRouterConf(max_local_prefill_length=0),
+                    namespace="spdis",
+                ),
+            )
+            await worker.start()
+            prefill = PrefillWorker(prefill_engine, c_pre, "spdis")
+            prefill_task = asyncio.ensure_future(prefill.run())
+
+            expected = await _drain(reference_engine, prompt, 10)
+            got = await _drain(worker, prompt, 10)
+            assert got == expected
+            assert prefill.handled == 1
+
+            prefill.request_stop()
+            await prefill_task
+            await worker.stop()
+            await c_dec.close()
+            await c_pre.close()
+        finally:
+            decode_engine.shutdown()
+            prefill_engine.shutdown()
+            reference_engine.shutdown()
+            await srv.stop()
+
+    run(go())

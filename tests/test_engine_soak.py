@@ -62,6 +62,7 @@ def test_engine_soak_invariants(seed, cache_dtype):
     duplicates: list[str] = []
 
     json_rids: list[str] = []
+    choice_sets: dict[str, list[str]] = {}
 
     def submit(i):
         kind = rng.integers(0, 4)
@@ -86,11 +87,22 @@ def test_engine_soak_invariants(seed, cache_dtype):
                 finished[rid] = out.finish_reason.value
 
         if kind == 3:
-            # JSON mode rides the same batch: grammar-masked sampling plus
-            # random min_p/logit_bias interactions
-            json_rids.append(rid)
-            sampling = SamplingOptions(temperature=1.0, json_mode=True,
-                                       min_p=float(rng.choice([0.0, 0.05])))
+            # constrained rows ride the same batch: half JSON mode, half
+            # guided_choice — mixed-grammar dispatches compose tables
+            # under churn, plus random min_p/logit_bias interactions
+            if rng.random() < 0.5:
+                json_rids.append(rid)
+                sampling = SamplingOptions(temperature=1.0, json_mode=True,
+                                           min_p=float(rng.choice([0.0, 0.05])))
+            else:
+                n_choices = int(rng.integers(2, 5))
+                choice_sets[rid] = [
+                    "opt" + "".join(chr(97 + int(c))
+                                    for c in rng.integers(0, 26, size=3))
+                    for _ in range(n_choices)
+                ]
+                sampling = SamplingOptions(temperature=1.0,
+                                           guided_choice=choice_sets[rid])
             stops = StopConditions(max_tokens=int(rng.integers(4, 24)))
         else:
             bias = None
@@ -174,6 +186,19 @@ def test_engine_soak_invariants(seed, cache_dtype):
                            if t != EOS and vocab_toks[t])
             json.loads(raw.decode("utf-8", errors="replace"))
     assert not json_rids or replayed > 0
+    # guided_choice rows that completed emitted exactly one of their
+    # choices; LENGTH-cut ones emitted a strict prefix of one
+    for rid, choices in choice_sets.items():
+        fin = finished.get(rid)
+        if fin == "cancelled":
+            continue
+        raw = b"".join(vocab_toks[t] for t in outs[rid]
+                       if t != EOS and vocab_toks[t]).decode(
+            "utf-8", errors="replace")
+        if fin == "eos":
+            assert raw in choices, (rid, raw)
+        else:
+            assert any(c.startswith(raw) for c in choices), (rid, raw)
 
 
 def test_abort_of_queued_request_is_honored():
