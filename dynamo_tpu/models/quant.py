@@ -98,6 +98,12 @@ def matmul(x: jax.Array, w, preferred_element_type=None) -> jax.Array:
     dot, scale applies to the output (valid because the scale is constant
     along every contracted axis — it is per-*output*-channel)."""
     if isinstance(w, QTensor):
+        if _pallas_int8_matmul_enabled() and w.q.ndim == 2 and x.ndim >= 2:
+            # opt-in dequant-in-kernel path (perf hypothesis #2): falls
+            # back when shapes don't tile the kernel's blocks
+            y = _pallas_int8_matmul(x, w, preferred_element_type)
+            if y is not None:
+                return y
         y = jnp.matmul(x, w.q.astype(x.dtype),
                        preferred_element_type=preferred_element_type)
         s = w.scale
@@ -108,6 +114,35 @@ def matmul(x: jax.Array, w, preferred_element_type=None) -> jax.Array:
     if preferred_element_type is not None:
         return jnp.matmul(x, w, preferred_element_type=preferred_element_type)
     return x @ w
+
+
+def _pallas_int8_matmul_enabled() -> bool:
+    import os
+
+    flag = os.environ.get("DYNAMO_PALLAS_INT8_MATMUL", "").lower()
+    return flag in ("1", "true", "yes") and jax.default_backend() == "tpu"
+
+
+def _pallas_int8_matmul(x: jax.Array, w: "QTensor", pet):
+    """Route a 2-D QTensor matmul through the dequant-in-kernel Pallas
+    path; returns None when shapes don't tile (caller falls back)."""
+    from dynamo_tpu.ops.pallas.int8_matmul import BK, BM, BN, int8_matmul
+
+    k, n = w.q.shape
+    lead = x.shape[:-1]
+    m = 1
+    for d in lead:
+        m *= d
+    if m == 0:
+        return None  # empty batch: the XLA path handles zero-size fine
+    bm = min(BM, m)
+    if m % bm or n % min(BN, n) or k % min(BK, k):
+        return None
+    out = int8_matmul(
+        x.reshape(m, k), w.q, jnp.squeeze(w.scale, axis=-2),
+        out_dtype=pet or x.dtype,
+    )
+    return out.reshape(*lead, n)
 
 
 def take_rows(w, idx: jax.Array, dtype) -> jax.Array:

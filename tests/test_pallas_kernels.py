@@ -315,3 +315,28 @@ def test_mq_decode_kernel_quant_and_softcap():
         logit_cap=cap, blocks_per_chunk=2, seqs_per_group=2, interpret=True,
     )
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=3e-5)
+
+
+def test_int8_matmul_kernel_matches_xla_path():
+    """Dequant-in-kernel matmul (interpret) vs the XLA int8 path."""
+    from dynamo_tpu.models.quant import QTensor, matmul, quantize
+    from dynamo_tpu.ops.pallas.int8_matmul import int8_matmul
+
+    rng = np.random.default_rng(31)
+    m, k, n = 128, 512, 1024
+    x = jnp.asarray(rng.normal(size=(m, k)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(k, n)), jnp.float32)
+    q = quantize(w)
+    ref = matmul(x, q)
+    got = int8_matmul(x, q.q, jnp.squeeze(q.scale, axis=-2),
+                      out_dtype=jnp.float32, interpret=True)
+    # same int8 contents, but the kernel multiplies in bf16 on purpose
+    # (that IS the speed path) while the f32 oracle rounds differently:
+    # tolerance sized for bf16 accumulation over K=512
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=5e-2, atol=0.5)
+    # odd M that doesn't tile: a bm that divides it still works
+    got = int8_matmul(x[:64], q.q, jnp.squeeze(q.scale, axis=-2),
+                      out_dtype=jnp.float32, bm=64, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref)[:64],
+                               rtol=5e-2, atol=0.5)
