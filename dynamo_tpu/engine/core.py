@@ -58,6 +58,7 @@ def unified_step(
     slot_idx, last_idx, rng, temp, top_k, top_p, prefix_blocks=None,
     k_cand=K_MAX, exact=False, grammar=None, jrows=None, jstate=None,
     jdepth=None, jstack=None, min_p=None, bias_tokens=None, bias_vals=None,
+    seeds=None, seed_rows=None,
 ):
     """THE jitted serving step: forward over the paged cache, gather each
     row's last hidden state, project to logits, sample.  Shared by the
@@ -77,7 +78,10 @@ def unified_step(
         logits = grammar_mask(logits, grammar, jrows, jstate, jdepth, jstack)
     out = sample_full(logits, rng, temp, top_k, top_p,
                       bias_tokens=bias_tokens, bias_vals=bias_vals,
-                      min_p=min_p, k_cand=k_cand, exact=exact)
+                      min_p=min_p, seeds=seeds, seed_rows=seed_rows,
+                      # fold on the sampled token's absolute position
+                      seed_steps=(seq_lens if seeds is not None else None),
+                      k_cand=k_cand, exact=exact)
     return out, cache
 
 
@@ -87,6 +91,7 @@ def multi_decode_step(
     pen_tokens=None, pen_first=None, pen_cursor=None, freq_pen=None,
     pres_pen=None, grammar=None, jrows=None, jstate=None, jdepth=None,
     jstack=None, min_p=None, bias_tokens=None, bias_vals=None,
+    seeds=None, seed_rows=None,
     *, num_steps: int, block_size: int,
     k_cand: int = K_MAX, exact: bool = False, use_penalties: bool = False,
 ):
@@ -140,9 +145,11 @@ def multi_decode_step(
             pfirst if use_penalties else None,
             freq_pen if use_penalties else None,
             pres_pen if use_penalties else None,
-            # bias/min_p are constant across the burst: closure capture,
-            # no scan carry needed
+            # bias/min_p/seeds are constant across the burst: closure
+            # capture; the seed fold index is the in-scan position
             bias_tokens=bias_tokens, bias_vals=bias_vals, min_p=min_p,
+            seeds=seeds, seed_rows=seed_rows,
+            seed_steps=(pos + 1 if seeds is not None else None),
             k_cand=k_cand, exact=exact,
         )
         # clamp the context length at the limit: past it no KV was written,
@@ -298,13 +305,15 @@ class EngineCore:
     def _step_impl(self, params, cache, *args, prefix_blocks=None,
                    k_cand=K_MAX, exact=False, grammar=None, jrows=None,
                    jstate=None, jdepth=None, jstack=None, min_p=None,
-                   bias_tokens=None, bias_vals=None):
+                   bias_tokens=None, bias_vals=None, seeds=None,
+                   seed_rows=None):
         return unified_step(self.model, params, cache, *args,
                             prefix_blocks=prefix_blocks, k_cand=k_cand,
                             exact=exact, grammar=grammar, jrows=jrows,
                             jstate=jstate, jdepth=jdepth, jstack=jstack,
                             min_p=min_p, bias_tokens=bias_tokens,
-                            bias_vals=bias_vals)
+                            bias_vals=bias_vals, seeds=seeds,
+                            seed_rows=seed_rows)
 
     def _sp_impl(self, params, tokens, positions, last_idx, rng, temp,
                  top_k, top_p, *, nb, k_cand=K_MAX, exact=False):
@@ -353,12 +362,13 @@ class EngineCore:
     def _multi_impl(self, params, cache, *args, num_steps=1, k_cand=K_MAX,
                     exact=False, use_penalties=False, grammar=None,
                     jrows=None, jstate=None, jdepth=None, jstack=None,
-                    min_p=None, bias_tokens=None, bias_vals=None):
+                    min_p=None, bias_tokens=None, bias_vals=None,
+                    seeds=None, seed_rows=None):
         return multi_decode_step(
             self.model, params, cache, *args,
             grammar=grammar, jrows=jrows, jstate=jstate, jdepth=jdepth,
             jstack=jstack, min_p=min_p, bias_tokens=bias_tokens,
-            bias_vals=bias_vals,
+            bias_vals=bias_vals, seeds=seeds, seed_rows=seed_rows,
             num_steps=num_steps,
             block_size=self.config.block_size,
             k_cand=k_cand, exact=exact, use_penalties=use_penalties,
@@ -529,6 +539,16 @@ class EngineCore:
             for i, r in enumerate(reqs):
                 mp[at(i)] = r.sampling.min_p
             kw["min_p"] = jnp.asarray(mp)
+        if any(r.sampling.seed is not None and not r.sampling.greedy
+               for r in reqs):
+            sd = np.zeros(b, np.int32)
+            sr = np.zeros(b, bool)
+            for i, r in enumerate(reqs):
+                if r.sampling.seed is not None and not r.sampling.greedy:
+                    sd[at(i)] = int(r.sampling.seed) & 0x7FFFFFFF
+                    sr[at(i)] = True
+            kw["seeds"] = jnp.asarray(sd)
+            kw["seed_rows"] = jnp.asarray(sr)
         if any(r.sampling.logit_bias for r in reqs):
             longest = max(len(r.sampling.logit_bias or {}) for r in reqs)
             nb = max(8, 1 << (longest - 1).bit_length())  # pow2 buckets
@@ -573,6 +593,11 @@ class EngineCore:
         beyond that carries negligible probability mass."""
         want = max((r.sampling.top_k for r in reqs), default=0)
         exact = bool(self.config.exact_sampling)
+        if any(r.sampling.seed is not None and not r.sampling.greedy
+               for r in reqs):
+            # seeded determinism requires the exact sorted candidate set:
+            # the true top-K_MAX is then batch-composition-independent
+            exact = True
         k_cand = K_MAX
         if want > K_MAX:
             k_cand = min(1 << (want - 1).bit_length(), 1024)
@@ -1032,6 +1057,9 @@ class EngineCore:
             and not req.sampling.guided_regex
             and not req.sampling.logit_bias
             and not req.sampling.min_p
+            # the SP first-token sampler has no per-request seed hook
+            and not (req.sampling.seed is not None
+                     and not req.sampling.greedy)
         )
 
     def _run_sp_prefill(self, req: EngineRequest) -> None:

@@ -65,6 +65,9 @@ def sample_full(
     bias_tokens: jax.Array | None = None,  # [B, Nb] int32 (-1 pad)
     bias_vals: jax.Array | None = None,    # [B, Nb] f32
     min_p: jax.Array | None = None,        # [B] f32; 0 → disabled
+    seeds: jax.Array | None = None,        # [B] int32 per-request seeds
+    seed_rows: jax.Array | None = None,    # [B] bool — row uses its seed
+    seed_steps: jax.Array | None = None,   # [B] int32 fold index (position)
     *,
     k_cand: int = K_MAX,
     exact: bool = False,
@@ -121,8 +124,34 @@ def sample_full(
         # first (max) candidate always survives.
         keep = keep & (probs >= min_p[:, None] * probs[:, :1])
 
+    if seeds is not None:
+        # seeded rows need a batch-independent candidate set: the engine
+        # forces exact top-k whenever seeds are present, and seeded rows
+        # sample from the true top-K_MAX (identical regardless of how
+        # wide a companion request pushed k_cand).  Effective top_k for a
+        # seeded request therefore caps at K_MAX.
+        keep = keep & (~seed_rows[:, None] | (rank < min(K_MAX, k_cand)))
+
     masked = jnp.where(keep, scaled, -jnp.inf)
     gumbel = jax.random.gumbel(rng, (b, k_cand), dtype=jnp.float32)
+    if seeds is not None:
+        # per-request determinism (OpenAI `seed`): a seeded row's noise is
+        # a pure function of (seed, absolute position, TOKEN ID) — keying
+        # by token id (not candidate rank) keeps the stream identical
+        # across runs, burst boundaries, and batch compositions even when
+        # a companion request widens k_cand or flips exact top-k (the
+        # overlapping candidates keep identical scores either way)
+        def row_noise(seed, step, token_ids):
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+
+            def one(tid):
+                return jax.random.gumbel(jax.random.fold_in(key, tid), (),
+                                         dtype=jnp.float32)
+
+            return jax.vmap(one)(token_ids)
+
+        g_row = jax.vmap(row_noise)(seeds, seed_steps, idx)
+        gumbel = jnp.where(seed_rows[:, None], g_row, gumbel)
     choice_sampled = jnp.argmax(masked + gumbel, axis=-1)
     choice = jnp.where(greedy, 0, choice_sampled)  # top_k output is sorted
     sampled = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]

@@ -162,7 +162,19 @@ class HttpService:
             # them share ONE prefill — later admissions join the first
             # request's in-flight blocks and wait on its commits
             # (tests/test_inflight_dedupe.py covers the n=4 case)
-            ctxs = [Context(parsed) for _ in range(parsed.n)]
+            if parsed.n > 1 and parsed.sampling.seed is not None:
+                # per-choice seeds: one seed would make all n choices
+                # identical (seeded noise is position-deterministic)
+                import dataclasses as _dc
+
+                variants = [
+                    _dc.replace(parsed, sampling=_dc.replace(
+                        parsed.sampling, seed=parsed.sampling.seed + i))
+                    for i in range(parsed.n)
+                ]
+                ctxs = [Context(v) for v in variants]
+            else:
+                ctxs = [Context(parsed) for _ in range(parsed.n)]
             streams = [entry.engine.generate(c) for c in ctxs]
             if parsed.stream:
                 return await self._stream_response(request, ctxs, streams, rid, parsed, chat, guard)

@@ -118,6 +118,10 @@ def parse_request(body: dict, chat: bool) -> ParsedRequest:
         raise OpenAIError("'min_p' must be a number")
     _require(0.0 <= min_p <= 1.0, "'min_p' must be in [0, 1]")
     seed = body.get("seed")
+    if seed is not None:
+        _require(isinstance(seed, int) and not isinstance(seed, bool)
+                 and -(2 ** 63) <= seed < 2 ** 63,
+                 "'seed' must be an integer")
     logit_bias = body.get("logit_bias")
     if logit_bias is not None:
         _require(isinstance(logit_bias, dict), "'logit_bias' must be an object")

@@ -120,3 +120,57 @@ def test_parse_request_min_p_logit_bias():
         parse_request({**base, "logit_bias": {"42": 200}}, chat=True)
     with pytest.raises(OpenAIError):
         parse_request({**base, "logit_bias": {"not-an-id": 1}}, chat=True)
+    with pytest.raises(OpenAIError):
+        parse_request({**base, "seed": "abc"}, chat=True)
+    with pytest.raises(OpenAIError):
+        parse_request({**base, "seed": True}, chat=True)
+
+
+def test_seeded_sampling_is_deterministic_across_batches():
+    """OpenAI `seed`: the same seeded request produces identical tokens
+    regardless of runs, batch composition, or burst boundaries; different
+    seeds diverge."""
+    from dynamo_tpu.engine import EngineConfig, EngineCore
+    from dynamo_tpu.engine.request import EngineRequest
+    from dynamo_tpu.llm.protocols import SamplingOptions, StopConditions
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.models.llama import LlamaModel
+
+    cfg = ModelConfig.tiny()
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    def run(seed, decode_steps, companions, engine_seed):
+        core = EngineCore(
+            model, params,
+            EngineConfig(max_batch_size=4, max_model_len=96, block_size=16,
+                         num_blocks=48, decode_steps=decode_steps,
+                         seed=engine_seed),
+        )
+        outs = []
+        core.submit(EngineRequest(
+            request_id="seeded", prompt=[5, 6, 7, 8],
+            sampling=SamplingOptions(temperature=0.9, seed=seed),
+            stops=StopConditions(max_tokens=14, ignore_eos=True),
+            emit=outs.append,
+        ))
+        for j in range(companions):  # unseeded traffic sharing the batch,
+            # including one that widens k_cand / flips exact top-k
+            core.submit(EngineRequest(
+                request_id=f"c{j}", prompt=[20 + j, 21, 22],
+                sampling=SamplingOptions(temperature=1.0,
+                                         top_k=100 if j == 0 else 0),
+                stops=StopConditions(max_tokens=10, ignore_eos=True),
+                emit=lambda o: None,
+            ))
+        for _ in range(200):
+            if not core.step():
+                break
+        return [t for o in outs for t in o.token_ids]
+
+    a = run(seed=1234, decode_steps=4, companions=0, engine_seed=0)
+    b = run(seed=1234, decode_steps=1, companions=2, engine_seed=99)
+    assert len(a) == 14
+    assert a == b  # same seed -> same stream, everything else varied
+    c = run(seed=4321, decode_steps=4, companions=0, engine_seed=0)
+    assert c != a  # different seed diverges (overwhelmingly likely)
