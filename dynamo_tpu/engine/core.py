@@ -410,8 +410,10 @@ class EngineCore:
 
     @staticmethod
     def _grammar_key(req: EngineRequest):
-        """None | "json" | ("choice", choices...) — which grammar (if any)
-        constrains this request.  JSON wins when both are set."""
+        """None | "json" | ("choice", ...) | ("regex", ...) — which
+        grammar (if any) constrains this request.  guided_regex wins over
+        json_mode: schema requests carry both, regex enforcing the shape
+        and json_mode serving as the uncompilable-regex fallback."""
         # regex before json: schema requests carry BOTH (the regex enforces
         # the schema's shape; json_mode is the documented fallback if that
         # regex turns out uncompilable)

@@ -534,7 +534,11 @@ def _regex_escape(text: str) -> str:
 
 
 # regex fragments for JSON primitives (match the JSON grammar's lexing)
-_RX_STRING = r'"([^"\\]|\\.)*"'
+# strings forbid RAW control bytes and restrict escapes to the legal set
+# (matching the JSON pushdown grammar's lexing — the lax `\\.` / [^"\\]
+# form let schema mode emit invalid JSON)
+_RX_STRING = (r'"([^"\\\x00-\x1f]|\\(["\\/bfnrt]|u'
+              + "[0-9a-fA-F]" * 4 + r'))*"')
 _RX_INT = r"-?(0|[1-9][0-9]*)"
 _RX_NUMBER = _RX_INT + r"(\.[0-9]+)?([eE][-+]?[0-9]+)?"
 _RX_BOOL = r"(true|false)"
@@ -657,6 +661,15 @@ def _parse_regex(pattern: str):
             if i + 1 >= n:
                 raise RegexError("trailing backslash in class")
             i += 1
+            if pattern[i] == "x":  # \xNN byte escape (class endpoints)
+                if i + 2 >= n:
+                    raise RegexError("truncated \\x escape")
+                try:
+                    b = int(pattern[i + 1:i + 3], 16)
+                except ValueError:
+                    raise RegexError("bad \\x escape")
+                i += 3
+                return b
             b = _escape_byte(pattern[i])
             if b is None:
                 if pattern[i] in "DWS":
@@ -888,6 +901,10 @@ def compile_regex_vocab(
     choice grammars."""
     eps, edges, start, accept = _parse_regex(pattern)
     n_nfa = len(edges)
+    if n_nfa > 8192:
+        # the closure matrix is O(n_nfa^2): bound it loudly (patterns this
+        # large exceed the DFA cap anyway)
+        raise RegexError(f"regex NFA too large ({n_nfa} nodes)")
 
     # precomputed per-node epsilon closures as a bool matrix: subset states
     # become bool VECTORS (bytes-keyed), and closure-of-set is one OR-
@@ -903,21 +920,21 @@ def compile_regex_vocab(
                     nclo[node, t] = True
                     stack.append(t)
 
-    # per-node outgoing edges, stacked once: masks [E, 256], targets [E]
+    # per-node outgoing edges, stacked once: masks [E, 256], targets [E],
+    # source node per edge [E] (sparse — an [n_nfa, E] ownership matrix
+    # costs hundreds of MB at the size cap)
     edge_masks = []
     edge_targets = []
-    edge_owner = np.zeros((n_nfa, max(1, sum(len(e) for e in edges))), bool)
-    ei = 0
+    edge_src = []
     for s0, elist in enumerate(edges):
         for mask, t in elist:
             edge_masks.append(mask)
             edge_targets.append(t)
-            edge_owner[s0, ei] = True
-            ei += 1
+            edge_src.append(s0)
     edge_masks = (np.stack(edge_masks) if edge_masks
                   else np.zeros((0, 256), bool))
     edge_targets = np.asarray(edge_targets, np.int64)
-    edge_owner = edge_owner[:, :len(edge_targets)]
+    edge_src = np.asarray(edge_src, np.int64)
 
     init_vec = nclo[start].copy()
     dfa_ids: dict[bytes, int] = {init_vec.tobytes(): 1}  # 0 = DEAD
@@ -930,7 +947,7 @@ def compile_regex_vocab(
         qi += 1
         sid = dfa_ids[cur.tobytes()]
         row = delta_rows[sid]
-        live = cur @ edge_owner  # [E] bool: edges leaving this subset
+        live = cur[edge_src]  # [E] bool: edges leaving this subset
         if not live.any():
             continue
         # [256, E_live] per-byte edge activation -> unique target classes

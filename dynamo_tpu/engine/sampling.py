@@ -110,14 +110,13 @@ def sample_full(
 
     rank = jnp.arange(k_cand, dtype=jnp.int32)[None, :]
     k = jnp.where(top_k <= 0, k_cand, jnp.minimum(top_k, k_cand))[:, None]
-    keep = rank < k
+    keep_base = rank < k  # the top-k mask, before top-p/min-p filtering
 
     # top-p over the kept candidates: keep the smallest prefix whose
     # cumulative probability reaches top_p (first token always kept)
-    probs = jax.nn.softmax(jnp.where(keep, scaled, -jnp.inf), axis=-1)
+    probs = jax.nn.softmax(jnp.where(keep_base, scaled, -jnp.inf), axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
-    keep = keep & ((cum - probs) < top_p[:, None])
-
+    keep = keep_base & ((cum - probs) < top_p[:, None])
     if min_p is not None:
         # min-p (vLLM extension, ref protocols/common.rs:293): drop
         # candidates whose probability is below min_p * max_prob.  The
@@ -125,12 +124,20 @@ def sample_full(
         keep = keep & (probs >= min_p[:, None] * probs[:, :1])
 
     if seeds is not None:
-        # seeded rows need a batch-independent candidate set: the engine
-        # forces exact top-k whenever seeds are present, and seeded rows
-        # sample from the true top-K_MAX (identical regardless of how
-        # wide a companion request pushed k_cand).  Effective top_k for a
-        # seeded request therefore caps at K_MAX.
-        keep = keep & (~seed_rows[:, None] | (rank < min(K_MAX, k_cand)))
+        # seeded rows need a fully batch-independent candidate policy:
+        # the engine forces exact top-k whenever seeds are present, and a
+        # seeded row's ENTIRE pipeline (softmax normalization, top-p
+        # cutoff, min-p floor) runs over the true top-K_MAX — so a
+        # companion widening k_cand cannot shift the kept set.  Effective
+        # top_k for a seeded request therefore caps at K_MAX (documented
+        # in docs/guides/serve.md).
+        kb = keep_base & (rank < min(K_MAX, k_cand))
+        probs_s = jax.nn.softmax(jnp.where(kb, scaled, -jnp.inf), axis=-1)
+        cum_s = jnp.cumsum(probs_s, axis=-1)
+        keep_s = kb & ((cum_s - probs_s) < top_p[:, None])
+        if min_p is not None:
+            keep_s = keep_s & (probs_s >= min_p[:, None] * probs_s[:, :1])
+        keep = jnp.where(seed_rows[:, None], keep_s, keep)
 
     masked = jnp.where(keep, scaled, -jnp.inf)
     gumbel = jax.random.gumbel(rng, (b, k_cand), dtype=jnp.float32)

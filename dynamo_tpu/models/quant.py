@@ -133,10 +133,10 @@ def _pallas_int8_matmul(x: jax.Array, w: "QTensor", pet):
     m = 1
     for d in lead:
         m *= d
-    if m == 0:
-        return None  # empty batch: the XLA path handles zero-size fine
-    bm = min(BM, m)
-    if m % bm or n % min(BN, n) or k % min(BK, k):
+    # only route prefill-sized row counts: decode-shaped m (batch <= 64)
+    # would run the MXU with pathological 1..8-row blocks — exactly the
+    # wrong thing to A/B the bandwidth hypothesis with
+    if m == 0 or m % BM or n % min(BN, n) or k % min(BK, k):
         return None
     out = int8_matmul(
         x.reshape(m, k), w.q, jnp.squeeze(w.scale, axis=-2),

@@ -584,3 +584,28 @@ def test_json_schema_regex_rejects_wrong_shape(tables):
     assert not accepts('{"ok": true}')             # missing property
     assert not accepts('{"n": 1, "ok": true}')     # wrong order (canonical)
     assert not accepts('{"ok": "yes", "n": 1}')    # wrong type
+
+
+def test_schema_string_fragment_is_strict_json(tables):
+    """The schema string regex must reject raw control bytes and illegal
+    escapes — exactly like the JSON pushdown grammar's string lexing."""
+    from dynamo_tpu.engine.grammar import _RX_STRING, compile_regex_vocab
+
+    toks = make_vocab()
+    rt = compile_regex_vocab(toks, _RX_STRING, eos_ids=[EOS])
+
+    def accepts(raw: bytes) -> bool:
+        s, d, st = 1, 0, 0
+        for b in raw:
+            if not rt.valid_mask(s, d, st)[1 + b]:
+                return False
+            s, d, st = rt.advance(s, d, st, 1 + b)
+        return bool(rt.valid_mask(s, d, st)[EOS])
+
+    assert accepts(b'"hello"')
+    assert accepts(b'"h\\n i \\u00ff"')
+    assert accepts(b'"q\\""')
+    assert not accepts(b'"h\ni"')      # raw newline
+    assert not accepts(b'"h\x01i"')    # raw control byte
+    assert not accepts(b'"h\\qi"')     # illegal escape
+    assert not accepts(b'"h\\u12"')    # truncated \\u (can't close)

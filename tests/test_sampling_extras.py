@@ -140,7 +140,7 @@ def test_seeded_sampling_is_deterministic_across_batches():
     model = LlamaModel(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
 
-    def run(seed, decode_steps, companions, engine_seed):
+    def run(seed, decode_steps, companions, engine_seed, top_p=1.0):
         core = EngineCore(
             model, params,
             EngineConfig(max_batch_size=4, max_model_len=96, block_size=16,
@@ -150,7 +150,8 @@ def test_seeded_sampling_is_deterministic_across_batches():
         outs = []
         core.submit(EngineRequest(
             request_id="seeded", prompt=[5, 6, 7, 8],
-            sampling=SamplingOptions(temperature=0.9, seed=seed),
+            sampling=SamplingOptions(temperature=0.9, seed=seed,
+                                     top_p=top_p),
             stops=StopConditions(max_tokens=14, ignore_eos=True),
             emit=outs.append,
         ))
@@ -174,3 +175,10 @@ def test_seeded_sampling_is_deterministic_across_batches():
     assert a == b  # same seed -> same stream, everything else varied
     c = run(seed=4321, decode_steps=4, companions=0, engine_seed=0)
     assert c != a  # different seed diverges (overwhelmingly likely)
+    # top_p < 1: the seeded pipeline normalizes over a FIXED candidate
+    # window, so a k_cand-widening companion still cannot shift the stream
+    d = run(seed=1234, decode_steps=4, companions=0, engine_seed=0,
+            top_p=0.9)
+    e = run(seed=1234, decode_steps=1, companions=2, engine_seed=7,
+            top_p=0.9)
+    assert d == e
