@@ -21,7 +21,11 @@ DYNAMO_BENCH_BLOCK_SIZE, DYNAMO_BENCH_DECODE_STEPS,
 DYNAMO_BENCH_PREFILL_CHUNK, DYNAMO_BENCH_TTFT_ISL,
 DYNAMO_BENCH_QUANT (int8|none, weights),
 DYNAMO_BENCH_KV_QUANT (auto|int8|none, KV cache),
-DYNAMO_BENCH_INIT_TIMEOUT (seconds to wait for the TPU backend).
+DYNAMO_BENCH_INIT_TIMEOUT (seconds to wait for the TPU backend;
+default 14400 — the driver runs this once per round, so the bench
+waits out backend outages rather than dying).  The JSON line records
+which optimized kernel paths were live (``kernels``) so a
+probe-degraded run is distinguishable from a healthy one.
 """
 
 from __future__ import annotations
@@ -76,25 +80,101 @@ def _kv_bytes_per_token(cfg: dict, dtype_bytes: int = 2) -> int:
     return 2 * cfg["num_kv_heads"] * hd * cfg["num_layers"] * dtype_bytes
 
 
-def _wait_for_backend(timeout_s: float):
-    """jax.devices() with retry/backoff: the tunneled TPU backend can be
-    slow to come up or transiently UNAVAILABLE right after attach (this
-    killed the round-1 driver bench — BENCH_r01.json rc=1)."""
-    import jax
+_PROBE_OK = False  # a subprocess saw a live backend this run
 
-    deadline = time.monotonic() + timeout_s
-    delay, last = 2.0, None
+
+def _respawn_or_die(reason: str) -> None:
+    """Shared respawn bookkeeping (watchdog + crash handler): bounded by
+    the DYNAMO_BENCH_RESPAWNS counter AND the wall deadline; exits rc=1
+    when out of budget, else execs a fresh process (a dead/hung backend
+    poisons the in-process JAX client — only a new process re-attaches)."""
+    respawns = int(os.environ.get("DYNAMO_BENCH_RESPAWNS", "0"))
+    deadline = float(os.environ.get("DYNAMO_BENCH_DEADLINE", "0"))
+    out_of_budget = respawns >= 3 or (deadline and time.time() > deadline)
+    print(f"# {reason}; "
+          f"{'giving up' if out_of_budget else f'respawning ({respawns + 1}/3)'}",
+          file=sys.stderr)
+    sys.stderr.flush()
+    if out_of_budget:
+        os._exit(1)
+    os.environ["DYNAMO_BENCH_RESPAWNS"] = str(respawns + 1)
+    os.execv(sys.executable, [sys.executable, os.path.abspath(__file__)])
+
+
+def _watchdog(seconds: float, label: str):
+    """Arm a daemon timer that respawns the bench if ``label`` hasn't
+    finished within ``seconds``.  A hung tunnel can block a C call (PJRT
+    attach, executable run) forever — no try/except catches that, and a
+    silently hung bench is strictly worse than the rc=1 death this file
+    guards against.  Returns a cancel() callable."""
+    import threading
+
+    done = threading.Event()
+
+    def fire():
+        if not done.wait(seconds):
+            _respawn_or_die(f"{label} hung for {seconds:.0f}s")
+
+    threading.Thread(target=fire, daemon=True).start()
+    return done.set
+
+
+def _wait_for_backend(deadline: float):
+    """Wait for the TPU backend, probing in SUBPROCESSES.
+
+    jax caches a failed backend init in-process (xla_bridge records the
+    platform error and re-raises it on every later ``jax.devices()``
+    call), so an in-process retry loop stops being a retry after the
+    first failure — this plus a 600s timeout cost round 3 its only
+    scored measurement (BENCH_r03.json rc=1).  Each probe child gets a
+    fresh PJRT client; only after a child attaches do we init jax in
+    this process.  ``deadline`` is a monotonic timestamp shared across
+    respawns via DYNAMO_BENCH_DEADLINE (wall epoch), so the total wait
+    is bounded no matter how often the backend flaps.
+    """
+    import subprocess
+
+    global _PROBE_OK
+    t0 = time.monotonic()
+    delay, attempt = 2.0, 0
     while True:
+        attempt += 1
+        err = ""
         try:
-            return jax.devices()
-        except Exception as e:  # RuntimeError: backend unavailable / UNAVAILABLE
-            last = e
-            if time.monotonic() > deadline:
-                raise
-            print(f"# backend not ready ({type(e).__name__}: {e}); retrying",
-                  file=sys.stderr)
-            time.sleep(delay)
-            delay = min(delay * 1.7, 30.0)
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d=jax.devices(); print(d[0].platform)"],
+                capture_output=True, text=True,
+                timeout=max(60.0, min(600.0, deadline - time.monotonic())),
+            )
+            ok = r.returncode == 0
+            err = (r.stderr or "").strip().splitlines()[-1:] or [""]
+            err = err[0]
+        except subprocess.TimeoutExpired:
+            ok, err = False, "probe timed out (tunnel hung?)"
+        except Exception as e:  # pragma: no cover
+            ok, err = False, f"{type(e).__name__}: {e}"
+        if ok:
+            _PROBE_OK = True
+            break
+        waited = time.monotonic() - t0
+        left = deadline - time.monotonic()
+        if left <= 0:
+            raise RuntimeError(
+                f"TPU backend unavailable for {waited / 60:.1f} min "
+                f"({attempt} probes); last error: {err}")
+        print(f"# backend not ready after {waited / 60:.1f} min "
+              f"(probe {attempt}: {err[:160]}); retrying, "
+              f"{left / 60:.1f} min left", file=sys.stderr)
+        time.sleep(min(delay, max(left, 1.0)))
+        delay = min(delay * 1.7, 60.0)
+    cancel = _watchdog(900.0, "in-process backend attach")
+    try:
+        import jax
+
+        return jax.devices()
+    finally:
+        cancel()
 
 
 def _hbm_limit(dev) -> int:
@@ -140,6 +220,72 @@ def _probe_pallas_prefill() -> None:
         os.environ["DYNAMO_DISABLE_PALLAS_PREFILL"] = "1"
 
 
+def _probe_geometry(mcfg: dict, batch: int, max_len: int, bs: int):
+    """Shared probe geometry: EXACTLY what the engine will run (model
+    heads/head_dim, its block-table width, batch) — a differently-shaped
+    probe could lower while the real executable hits a Mosaic limit
+    mid-measurement.  Returns (h, hk, hd, n, block_tables, seq_lens)."""
+    import jax.numpy as jnp
+
+    hd = mcfg.get("head_dim", mcfg["hidden_size"] // mcfg["num_heads"])
+    h, hk = mcfg["num_heads"], mcfg["num_kv_heads"]
+    m = -(-max_len // bs)  # the engine's block-table width
+    n = min(batch * m + 4, 4096)
+    bt = ((jnp.arange(batch, dtype=jnp.int32)[:, None] * m
+           + jnp.arange(m, dtype=jnp.int32)[None, :]) % n)
+    lens = jnp.full((batch,), min(4 * bs, max_len), jnp.int32)
+    return h, hk, hd, n, bt, lens
+
+
+def _probe_pallas_decode(mcfg: dict, batch: int, max_len: int, bs: int) -> None:
+    """Compile-probe the bf16 flash-decode kernel at the bench geometry;
+    on failure disable it (engine falls back to the XLA gather path)
+    rather than crashing every respawn attempt identically."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        from dynamo_tpu.ops.pallas.decode_attention import paged_decode_attention
+
+        h, hk, hd, n, bt, lens = _probe_geometry(mcfg, batch, max_len, bs)
+        cache = jnp.zeros((1, n, 2, bs, hk * hd), jnp.bfloat16)
+        out = paged_decode_attention(
+            jnp.ones((batch, h, hd), jnp.bfloat16), cache, jnp.int32(0),
+            bt, lens,
+        )
+        jax.block_until_ready(out)
+    except Exception as e:  # pragma: no cover - hardware-specific
+        print(f"# pallas decode probe failed ({type(e).__name__}); "
+              "falling back to XLA decode attention", file=sys.stderr)
+        os.environ["DYNAMO_DISABLE_PALLAS_DECODE"] = "1"
+
+
+def _kernel_report(quant: str, kv_quant: str) -> dict:
+    """Which optimized kernel paths are LIVE for this run — recorded in
+    the JSON line so a degraded (probe-fallback) number is visibly
+    different from a healthy one (VERDICT r3 weak #3).  Gates mirror the
+    dispatch conditions in ops/paged_attention.py exactly (Pallas runs
+    only on a real TPU backend).  The multi-query kernel is omitted: the
+    bench never dispatches it (speculation is off here)."""
+    import jax
+
+    env = os.environ.get
+    pallas = jax.default_backend() == "tpu" and not env("DYNAMO_DISABLE_PALLAS")
+    try:
+        from dynamo_tpu.models.quant import _pallas_int8_matmul_enabled
+
+        int8_mm = quant == "int8" and _pallas_int8_matmul_enabled()
+    except Exception:  # pragma: no cover
+        int8_mm = False
+    return {
+        "pallas_prefill": pallas and not env("DYNAMO_DISABLE_PALLAS_PREFILL"),
+        "pallas_decode": pallas and not env("DYNAMO_DISABLE_PALLAS_DECODE"),
+        "pallas_int8_matmul": bool(int8_mm),
+        "int8_weights": quant == "int8",
+        "int8_kv": kv_quant == "int8",
+    }
+
+
 def _probe_kv_quant(mcfg: dict, batch: int, max_len: int, bs: int,
                     prefill_chunk: int) -> bool:
     """Compile-probe BOTH Pallas kernels against an int8 QuantKvCache at
@@ -155,17 +301,11 @@ def _probe_kv_quant(mcfg: dict, batch: int, max_len: int, bs: int,
         from dynamo_tpu.ops.pallas.decode_attention import paged_decode_attention
         from dynamo_tpu.ops.pallas.prefill_attention import paged_prefill_attention
 
-        hd = mcfg.get("head_dim", mcfg["hidden_size"] // mcfg["num_heads"])
-        h, hk = mcfg["num_heads"], mcfg["num_kv_heads"]
-        m = -(-max_len // bs)  # the engine's block-table width
-        n = min(batch * m + 4, 4096)
+        h, hk, hd, n, bt, lens = _probe_geometry(mcfg, batch, max_len, bs)
         cache = QuantKvCache(
             jnp.zeros((1, n, 2, bs, hk * hd), jnp.int8),
             jnp.ones((1, n, 2, hk, bs), jnp.float32),
         )
-        bt = ((jnp.arange(batch, dtype=jnp.int32)[:, None] * m
-               + jnp.arange(m, dtype=jnp.int32)[None, :]) % n)
-        lens = jnp.full((batch,), min(4 * bs, max_len), jnp.int32)
         out = paged_decode_attention(
             jnp.ones((batch, h, hd), jnp.bfloat16), cache, jnp.int32(0),
             bt, lens,
@@ -188,16 +328,35 @@ def _probe_kv_quant(mcfg: dict, batch: int, max_len: int, bs: int,
 
 
 def main() -> None:
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    cpu_mode = os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+    if cpu_mode:
         # explicit CPU run (CI smoke): the image's sitecustomize pins the
         # TPU plugin via jax.config, so the env var alone is not enough
         from dynamo_tpu.utils import force_cpu_devices
 
         force_cpu_devices(1)
-    init_timeout = float(os.environ.get("DYNAMO_BENCH_INIT_TIMEOUT", "600"))
-    devices = _wait_for_backend(init_timeout)
+    # default = 4 hours: the driver runs this file exactly once per round
+    # and the tunneled backend has flapped for hours during build windows —
+    # a bench that waits beats a bench that dies (VERDICT r3 next #1).
+    # The deadline is wall-clock and shared across respawns via env.
+    init_timeout = float(os.environ.get("DYNAMO_BENCH_INIT_TIMEOUT", "14400"))
+    wall_deadline = float(os.environ.setdefault(
+        "DYNAMO_BENCH_DEADLINE", str(time.time() + init_timeout)))
+    if cpu_mode:
+        import jax
+
+        devices = jax.devices()  # local CPU: no tunnel, no probe needed
+        global _PROBE_OK
+        _PROBE_OK = True
+    else:
+        devices = _wait_for_backend(
+            time.monotonic() + max(wall_deadline - time.time(), 60.0))
     global _BACKEND_READY
     _BACKEND_READY = True
+    # whole-run watchdog: a backend that hangs (rather than raises) after
+    # init would otherwise block the measurement forever
+    run_cancel = _watchdog(
+        float(os.environ.get("DYNAMO_BENCH_RUN_TIMEOUT", "3600")), "bench run")
     import jax
 
     from dynamo_tpu.engine.config import EngineConfig
@@ -255,13 +414,22 @@ def main() -> None:
             mlen //= 2
         return name, mlen
 
+    env = os.environ.get
+    pallas_on = on_accel and not env("DYNAMO_DISABLE_PALLAS")
     kv_quant = "int8" if kv_req in ("auto", "int8") else "none"
     name, max_len = select(kv_quant)
-    if kv_quant == "int8" and kv_req == "auto" and not _probe_kv_quant(
+    if kv_quant == "int8" and pallas_on and not _probe_kv_quant(
         MODELS[name], batch, max_len, block_size, prefill_chunk
     ):
-        kv_quant = "none"
-        name, max_len = select(kv_quant)
+        if kv_req == "auto":
+            kv_quant = "none"
+            name, max_len = select(kv_quant)
+        else:
+            # explicit int8: keep the quantized cache but take the XLA
+            # dequant-slice attention paths — degraded (visible in the
+            # kernels report) beats crashing every respawn identically
+            os.environ["DYNAMO_DISABLE_PALLAS_DECODE"] = "1"
+            os.environ["DYNAMO_DISABLE_PALLAS_PREFILL"] = "1"
     mcfg = MODELS[name]
 
     steps = int(os.environ.get("DYNAMO_BENCH_STEPS", "300" if on_accel else "30"))
@@ -286,8 +454,16 @@ def main() -> None:
         enable_prefix_reuse=False,  # distinct prompts; measure raw decode
         cache_dtype="int8" if kv_quant == "int8" else None,
     )
-    if on_accel:
+    # probe only the paths the run will actually take (the int8 probe
+    # above already covered both kernels against the quantized cache)
+    if pallas_on and not env("DYNAMO_DISABLE_PALLAS_PREFILL") \
+            and kv_quant == "none":
         _probe_pallas_prefill()
+    if pallas_on and not env("DYNAMO_DISABLE_PALLAS_DECODE") \
+            and kv_quant == "none":
+        _probe_pallas_decode(mcfg, batch, max_len, block_size)
+    kernels = _kernel_report(quant, kv_quant)
+    print(f"# kernels: {json.dumps(kernels)}", file=sys.stderr)
 
     model = LlamaModel(cfg)
     t0 = time.perf_counter()
@@ -399,31 +575,33 @@ def main() -> None:
         "itl_ms": round(itl_ms, 2),
         "ttft_p50_ms": ttft_p50 and round(ttft_p50, 1),
         "ttft_isl": ttft_isl,
+        "kernels": kernels,
     }))
+    run_cancel()
 
 
 def _main_with_respawn() -> None:
-    """One self-respawn on a mid-run crash: the tunneled TPU backend can
-    die AFTER init (round-3 build window saw hours-long outages with
-    flapping recovery), and a dead backend poisons the in-process JAX
-    client — only a fresh process can re-attach.  The driver runs this
-    file exactly once per round; a transient blip should cost a retry,
-    not the round's measurement."""
-    if os.environ.get("DYNAMO_BENCH_RESPAWNED"):
-        main()
-        return
+    """Respawn on crashes after a live backend was seen: the tunneled TPU
+    backend can die mid-run (round-3 build window saw hours-long outages
+    with flapping recovery).  The driver runs this file exactly once per
+    round; a transient blip should cost a retry, not the round's
+    measurement.  Respawns are bounded (shared counter + wall deadline in
+    ``_respawn_or_die``), so the worst case is init_timeout + a few
+    measurement attempts."""
     try:
         main()
     except Exception:
         import traceback
 
         traceback.print_exc(file=sys.stderr)
-        if not _BACKEND_READY:
-            raise  # init failure or config error: retrying can't help
-        print("# bench crashed mid-run; respawning once with a fresh "
-              "backend", file=sys.stderr)
-        os.environ["DYNAMO_BENCH_RESPAWNED"] = "1"
-        os.execv(sys.executable, [sys.executable, os.path.abspath(__file__)])
+        if not _BACKEND_READY and not _PROBE_OK:
+            raise  # probe deadline exhausted or config error: can't help
+        # _PROBE_OK but not _BACKEND_READY: a child saw a live backend
+        # but the in-process attach failed — jax has cached the failure,
+        # so only a fresh process can retry.  _BACKEND_READY: mid-run
+        # crash.  Both respawn.
+        _respawn_or_die(
+            f"bench crashed {'mid-run' if _BACKEND_READY else 'at attach'}")
 
 
 if __name__ == "__main__":

@@ -48,6 +48,8 @@ def _pallas_decode_enabled() -> bool:
     """Use the Pallas flash-decoding kernel for S=1 steps on TPU."""
     if os.environ.get("DYNAMO_DISABLE_PALLAS"):
         return False
+    if os.environ.get("DYNAMO_DISABLE_PALLAS_DECODE"):
+        return False
     return jax.default_backend() == "tpu"
 
 
