@@ -231,18 +231,25 @@ class EngineCore:
                 )
 
         cache = model.init_kv_cache(config.num_blocks, config.block_size, cache_dtype)
+        self._cache_specs = None
         if mesh is not None:
             from jax.sharding import NamedSharding
 
-            from dynamo_tpu.models.quant import align_specs
+            from dynamo_tpu.models.quant import align_specs, prune_specs
 
             params = jax.device_put(
                 params,
                 jax.tree.map(
                     lambda s: NamedSharding(mesh, s),
-                    align_specs(params, model.partition_specs()),
+                    align_specs(params, prune_specs(
+                        params, model.partition_specs(), mesh)),
                     is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
                 ),
+            )
+            # cache sharding pruned the same way (a kv-head axis the mesh
+            # doesn't divide replicates rather than failing device_put)
+            self._cache_specs = prune_specs(
+                cache, model.cache_spec(quant=self.cache_quant), mesh
             )
             cache = jax.device_put(cache, self._cache_sharding())
         self.params = params
@@ -257,7 +264,10 @@ class EngineCore:
             self._multi_impl, donate_argnums=(1,),
             static_argnames=("num_steps", "k_cand", "exact", "use_penalties"),
         )
-        self._spec_fn = jax.jit(self._spec_impl, donate_argnums=(1,))
+        self._spec_fn = jax.jit(
+            self._spec_impl, donate_argnums=(1,),
+            static_argnames=("k_cand", "exact"),
+        )
         # sequence-parallel long-prefill (ring attention over the "data"
         # axis): one dispatch computes the whole prompt with the sequence
         # sharded across the mesh — SURVEY §5 long-context path
@@ -349,15 +359,42 @@ class EngineCore:
         return out, blocks
 
     def _spec_impl(self, params, cache, tokens, positions, block_tables,
-                   seq_lens, slot_idx):
+                   seq_lens, slot_idx, rng, temperature, top_k, top_p,
+                   min_p, seeds, seed_rows, *, k_cand=K_MAX, exact=False):
         """Speculative verify: forward S tokens per row against the paged
-        cache (KV scattered like prefill), greedy argmax at EVERY position
-        — the host accepts the proposal prefix that matches."""
+        cache (KV scattered like prefill) and SAMPLE at every position
+        with that position's own noise — the host accepts the proposal
+        prefix the samples agree with.
+
+        This is exact rejection sampling for the n-gram proposer: the
+        proposal is a point mass, so "sample from the target and accept
+        iff it matches" accepts with probability p(x) — the canonical
+        min(1, p/q) rule — and on mismatch the drawn sample is already
+        distributed as the renormalised residual (p restricted to ≠ x).
+        Every emitted token is therefore distributed exactly as plain
+        decoding, at any temperature.  Greedy rows (temp 0) reduce to
+        argmax.  Seeded rows reuse the (seed, position, token-id) noise
+        of engine/sampling.py, so their streams are bit-identical with
+        speculation on or off (tests/test_spec_decode.py)."""
         hidden, cache = self.model.forward(
             params, tokens, positions, cache, block_tables, seq_lens, slot_idx
         )
         logits = self.model.compute_logits(params, hidden)  # [B, S, V]
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+        b, s, v = logits.shape
+        rep = lambda a: jnp.repeat(a, s)
+        sampled, _, _, _ = sample_full(
+            logits.reshape(b * s, v), rng,
+            rep(temperature), rep(top_k), rep(top_p),
+            min_p=rep(min_p), seeds=rep(seeds), seed_rows=rep(seed_rows),
+            # fold index = the sampled token's absolute sequence position,
+            # matching unified_step/multi_decode_step exactly
+            seed_steps=positions.reshape(b * s) + 1,
+            # the caller threads _sampling_mode's (k_cand, exact) through,
+            # so the verify candidate policy matches what the plain decode
+            # path would use for the same batch (seeds force exact there)
+            k_cand=k_cand, exact=exact,
+        )
+        return sampled.reshape(b, s).astype(jnp.int32), cache
 
     def _multi_impl(self, params, cache, *args, num_steps=1, k_cand=K_MAX,
                     exact=False, use_penalties=False, grammar=None,
@@ -376,12 +413,12 @@ class EngineCore:
 
     def _cache_sharding(self):
         """NamedSharding tree matching the cache pytree (bf16 array or
-        QuantKvCache data+scale pair)."""
+        QuantKvCache data+scale pair), mesh-pruned at init."""
         from jax.sharding import NamedSharding, PartitionSpec
 
         return jax.tree.map(
             lambda s: NamedSharding(self.mesh, s),
-            self.model.cache_spec(quant=self.cache_quant),
+            self._cache_specs,
             is_leaf=lambda x: isinstance(x, PartitionSpec),
         )
 
@@ -1120,16 +1157,22 @@ class EngineCore:
     # ----------------------------------------------------------------- decode
     # ----------------------------------------------------- speculative decode
     def _spec_eligible(self, reqs) -> bool:
-        """Speculation is greedy-exact only: every active request must be
-        plain greedy with no feature that needs the real sampler."""
+        """Speculation composes with plain sampling (greedy, temperature,
+        top_k <= K_MAX, top_p, min_p, per-request seeds — the verify pass
+        samples each position with its own noise, see ``_spec_impl``).
+        Still excluded: penalties (the verify forward doesn't thread the
+        generated-token buffers through accepted positions), logprobs
+        (not returned per verified position), logit_bias, and grammar
+        modes (mask state advances once per emitted token on the decode
+        path).  top_k > K_MAX needs the widened exact-candidate dispatch
+        the verify executable doesn't compile."""
         return all(
-            r.sampling.greedy
+            (r.sampling.greedy or r.sampling.top_k <= K_MAX)
             and not r.sampling.frequency_penalty
             and not r.sampling.presence_penalty
             and not r.sampling.logprobs
             and not r.sampling.top_logprobs
             and not r.sampling.logit_bias
-            and not r.sampling.min_p
             and not r.sampling.json_mode
             and not r.sampling.guided_choice
             and not r.sampling.guided_regex
@@ -1188,11 +1231,24 @@ class EngineCore:
         bt = np.zeros((b, m), np.int32)
         seq_lens = np.zeros(b, np.int32)
         limits = np.zeros(b, np.int32)
+        temp = np.zeros(b, np.float32)  # inactive rows: greedy, ignored
+        top_k = np.zeros(b, np.int32)
+        top_p = np.ones(b, np.float32)
+        min_p = np.zeros(b, np.float32)
+        seeds = np.zeros(b, np.int32)
+        seed_rows = np.zeros(b, bool)
         props: dict[int, list[int]] = {}
         rows: list[EngineRequest] = []
         any_prop = False
         for req in active:
             i = req.slot
+            temp[i] = req.sampling.temperature
+            top_k[i] = req.sampling.top_k
+            top_p[i] = req.sampling.top_p
+            min_p[i] = req.sampling.min_p
+            if req.sampling.seed is not None and not req.sampling.greedy:
+                seeds[i] = int(req.sampling.seed) & 0x7FFFFFFF
+                seed_rows[i] = True
             p = req.seq.total_tokens - 1  # position of the uncomputed tail
             limit = self._grow_blocks(req, s)
             if limit is None:
@@ -1231,25 +1287,31 @@ class EngineCore:
         m_used = min(m, 1 << (blocks_used - 1).bit_length())
 
         self._drain_offload()
-        argmax, self.cache = self._spec_fn(
+        self._rng, rng = jax.random.split(self._rng)
+        k_cand, exact = self._sampling_mode(rows)
+        verified, self.cache = self._spec_fn(
             self.params, self.cache,
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(bt[:, :m_used]),
             jnp.asarray(seq_lens), jnp.asarray(slot_idx),
+            rng, jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(min_p), jnp.asarray(seeds), jnp.asarray(seed_rows),
+            k_cand=k_cand, exact=exact,
         )
-        argmax = np.asarray(argmax)
+        verified = np.asarray(verified)
         self.steps += 1
         self.decode_steps += 1
         self.spec_steps += 1
         for req in rows:
             i = req.slot
             prop = props.get(i, [])
-            # accept the proposal prefix the model agrees with, then the
-            # bonus token from the first disagreeing (or final) position
+            # accept the proposal prefix the verify samples agree with,
+            # then the bonus token from the first disagreeing (or final)
+            # position — each emitted token is that position's own sample
             a = 0
-            while a < len(prop) and prop[a] == int(argmax[i, a]):
+            while a < len(prop) and prop[a] == int(verified[i, a]):
                 a += 1
-            emit = [int(argmax[i, j]) for j in range(a + 1)]
+            emit = [int(verified[i, j]) for j in range(a + 1)]
             self.spec_proposed += len(prop)
             self.spec_accepted += a
             allowed = min(len(emit), int(limits[i] - (req.seq.total_tokens - 1)))

@@ -11,11 +11,16 @@ engines' speculative modes).
 
 TPU shape: the verify pass is the engine's existing unified S>1 forward
 against the paged cache — proposed tokens scatter their KV and attend
-causally, argmax at every position comes back, and the host accepts the
-matching prefix.  Rejected positions' KV is simply overwritten when the
-real tokens reach those slots (slots are position-derived).  Greedy-exact:
-accepted output is bit-identical to plain greedy decoding, just fewer
-dispatches.
+causally, a SAMPLE at every position comes back (each with its own
+noise), and the host accepts the matching prefix.  Rejected positions'
+KV is simply overwritten when the real tokens reach those slots (slots
+are position-derived).  Exactness: for a point-mass proposal,
+sample-and-match IS the canonical rejection-sampling rule (accept w.p.
+p(x); a mismatching sample is already the renormalised residual), so
+every emitted token is distributed exactly as plain decoding at any
+temperature; greedy rows reduce to argmax (bit-identical streams), and
+seeded rows are bit-identical with speculation on or off because their
+noise is a pure function of (seed, position, token id).
 
 Engine wiring lives in engine/core.py (`spec_tokens`/`spec_ngram`
 config); this module is the pure host-side proposer.

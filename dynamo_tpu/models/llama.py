@@ -13,10 +13,12 @@ Design (TPU-first, not a port):
   * Tensor parallelism is declarative: :meth:`partition_specs` returns a
     PartitionSpec pytree over mesh axes ("data", "model") and GSPMD inserts
     the collectives (all-gather/psum over ICI) — no NCCL-style plumbing.
-  * MoE (Mixtral-style) uses dense one-hot dispatch: every expert computes
-    all tokens weighted by its gate probability.  Sharding experts over the
-    mesh's "expert"/"model" axis makes this the classic simple
-    expert-parallel layout (each device runs its experts, psum combines).
+  * MoE (Mixtral-style) uses grouped dispatch: token→expert assignments
+    sort by expert and each projection runs as ONE ``lax.ragged_dot``
+    (XLA's grouped matmul) — exactly k experts of FLOPs per token and
+    [T·k, F] intermediates.  Experts shard their FFN dim over "model"
+    (TP-within-experts), so compute/memory balance is routing-independent.
+    A dense one-hot oracle path remains for parity tests (DYNAMO_MOE_DENSE).
 
 The reference has no model code at all (engines are external, SURVEY.md
 §2.4); this module plus engine/ is the "native JAX/XLA engine" the rebuild
@@ -238,11 +240,18 @@ class LlamaModel:
                 post_attn_norm=P(None, None), post_mlp_norm=P(None, None)
             )
         if cfg.is_moe:
+            # TP-within-experts: shard every expert's FFN intermediate dim
+            # F over "model" (same layout as the dense MLP).  Weight memory
+            # AND compute split evenly across devices regardless of routing
+            # skew, and GSPMD partitions the grouped ragged_dot directly on
+            # F.  (Device-EP — sharding the E axis — load-balances only
+            # when routing is uniform; at serving batch sizes it idles
+            # devices whose experts draw no tokens.)
             layers.update(
                 router=P(None, None, None),
-                w_gate=P(None, "model", None, None),
-                w_up=P(None, "model", None, None),
-                w_down=P(None, "model", None, None),
+                w_gate=P(None, None, None, "model"),
+                w_up=P(None, None, None, "model"),
+                w_down=P(None, None, "model", None),
             )
         else:
             layers.update(
@@ -497,40 +506,101 @@ def _qkv_proj(
     return q, k, v.reshape(b, s, hk, dh)
 
 
+def _act(cfg: ModelConfig, gate: jax.Array) -> jax.Array:
+    """Gate activation shared by every MLP path: SiLU (Llama) or
+    tanh-GELU (Gemma GeGLU)."""
+    return (jax.nn.gelu(gate, approximate=True)
+            if cfg.hidden_activation == "gelu_tanh" else jax.nn.silu(gate))
+
+
 def _dense_mlp(cfg: ModelConfig, lp: dict, x: jax.Array) -> jax.Array:
-    """Gated MLP: act(x·Wg) * (x·Wu) · Wd — SiLU (Llama) or tanh-GELU
-    (Gemma GeGLU)."""
-    gate = matmul(x, lp["w_gate"])
-    act = (jax.nn.gelu(gate, approximate=True)
-           if cfg.hidden_activation == "gelu_tanh" else jax.nn.silu(gate))
-    return matmul(act * matmul(x, lp["w_up"]), lp["w_down"])
+    """Gated MLP: act(x·Wg) * (x·Wu) · Wd."""
+    return matmul(
+        _act(cfg, matmul(x, lp["w_gate"])) * matmul(x, lp["w_up"]),
+        lp["w_down"],
+    )
 
 
-def _moe_mlp(cfg: ModelConfig, lp: dict, x: jax.Array) -> jax.Array:
-    """Dense-dispatch MoE: each expert computes all tokens, weighted by its
-    (top-k-normalised) router probability.  With experts sharded over the
-    mesh this is simple expert parallelism; a Pallas grouped-matmul dispatch
-    path is the planned optimisation."""
-    k = cfg.num_experts_per_tok
-    router_logits = (x @ lp["router"]).astype(jnp.float32)  # [B,S,E]
-    topv, topi = jax.lax.top_k(router_logits, k)
+def _moe_router(cfg: ModelConfig, lp: dict, xf: jax.Array):
+    """Shared routing for both dispatch paths: top-k expert ids + weights.
+    xf: [T, Dm] → (weights [T,k] f32, topi [T,k] int32)."""
+    router_logits = (xf @ lp["router"]).astype(jnp.float32)  # [T,E]
+    topv, topi = jax.lax.top_k(router_logits, cfg.num_experts_per_tok)
     if cfg.norm_topk_prob:
         # renormalized top-k == softmax over the top-k logits
-        weights = jax.nn.softmax(topv, axis=-1)  # [B,S,k]
+        weights = jax.nn.softmax(topv, axis=-1)
     else:
         # Qwen3-MoE norm_topk_prob=False: full-softmax probs of the top-k
         probs_all = jax.nn.softmax(router_logits, axis=-1)
         weights = jnp.take_along_axis(probs_all, topi, axis=-1)
+    return weights, topi
+
+
+def _moe_mlp(cfg: ModelConfig, lp: dict, x: jax.Array) -> jax.Array:
+    import os
+
+    if os.environ.get("DYNAMO_MOE_DENSE"):
+        return _moe_mlp_dense(cfg, lp, x)
+    return _moe_mlp_grouped(cfg, lp, x)
+
+
+def _moe_mlp_grouped(cfg: ModelConfig, lp: dict, x: jax.Array) -> jax.Array:
+    """Grouped MoE dispatch: sort token→expert assignments by expert, run
+    ONE ragged (grouped) matmul per projection, unsort, weighted-sum per
+    token.  Intermediates are [T·k, F] — E/k× smaller than the dense
+    path's [T, E, F] — and FLOPs are exactly the k experts each token
+    routed to (the dense path computes all E).
+
+    TPU mapping: ``lax.ragged_dot`` is XLA's grouped matmul and tiles onto
+    the MXU; under the mesh the expert FFN dim F is sharded over "model"
+    (partition_specs), which GSPMD partitions directly — compute and
+    weight memory split evenly across devices REGARDLESS of routing skew
+    (device-EP would idle devices whose experts receive no tokens).
+    Replaces the reference's inherited vLLM fused-MoE CUDA kernels
+    (container/deps/vllm patch, grouped_topk region) with the XLA-native
+    equivalent."""
+    k = cfg.num_experts_per_tok
+    b, s, d = x.shape
+    t = b * s
+    xf = x.reshape(t, d)
+    weights, topi = _moe_router(cfg, lp, xf)
+    flat_e = topi.reshape(t * k)
+    order = jnp.argsort(flat_e)          # stable: ties keep token order
+    token_idx = order // k               # source token of each sorted row
+    xs = xf[token_idx]                   # [T*k, Dm] gather
+    group_sizes = jnp.bincount(flat_e, length=cfg.num_experts).astype(jnp.int32)
+    # quantized experts dequant at the operand: convert fuses into the
+    # grouped dot's operand load, HBM reads stay int8
+    w_gate = dequantize(lp["w_gate"], x.dtype)   # [E, Dm, F]
+    w_up = dequantize(lp["w_up"], x.dtype)
+    w_down = dequantize(lp["w_down"], x.dtype)   # [E, F, Dm]
+    gate = jax.lax.ragged_dot(xs, w_gate, group_sizes)
+    up = jax.lax.ragged_dot(xs, w_up, group_sizes)
+    act = _act(cfg, gate) * up               # [T*k, F]
+    out = jax.lax.ragged_dot(act, w_down, group_sizes)  # [T*k, Dm]
+    out = out * weights.reshape(t * k)[order, None].astype(out.dtype)
+    # unsort (inverse permutation) then reduce the k slots of each token;
+    # gather+reshape-sum keeps the combine deterministic (no scatter-add)
+    out = out[jnp.argsort(order)].reshape(t, k, d).sum(axis=1)
+    return out.reshape(b, s, d)
+
+
+def _moe_mlp_dense(cfg: ModelConfig, lp: dict, x: jax.Array) -> jax.Array:
+    """Dense-dispatch MoE oracle: each expert computes all tokens, weighted
+    by its (top-k-normalised) router probability.  O(E/k) wasted FLOPs and
+    [B,S,E,F] intermediates — kept as the parity oracle for the grouped
+    path (DYNAMO_MOE_DENSE=1) because it contains no permutation logic."""
+    b, s, d = x.shape
+    weights, topi = _moe_router(cfg, lp, x.reshape(b * s, d))
+    weights = weights.reshape(b, s, -1)
+    topi = topi.reshape(b, s, -1)
     onehot = jax.nn.one_hot(topi, cfg.num_experts, dtype=jnp.float32)  # [B,S,k,E]
     gate_probs = jnp.einsum("bske,bsk->bse", onehot, weights)  # [B,S,E]
-    # every expert runs all tokens: [B,S,E,F] intermediates.  Quantized
-    # experts dequant at the einsum operand (convert+mul fuse into the
-    # contraction's operand load; HBM reads stay int8)
     w_up = dequantize(lp["w_up"], x.dtype)
     w_gate = dequantize(lp["w_gate"], x.dtype)
     w_down = dequantize(lp["w_down"], x.dtype)
     up = jnp.einsum("bsd,edf->bsef", x, w_up)
     gate = jnp.einsum("bsd,edf->bsef", x, w_gate)
-    act = jax.nn.silu(gate) * up
+    act = _act(cfg, gate) * up
     out = jnp.einsum("bsef,efd->bsed", act, w_down)
     return jnp.einsum("bsed,bse->bsd", out, gate_probs.astype(out.dtype))

@@ -35,7 +35,8 @@ from jax.sharding import PartitionSpec as P
 
 __all__ = [
     "QTensor", "quantize", "dequantize", "quantize_params", "matmul",
-    "take_rows", "align_specs", "random_qtensor", "stacked_channel_axes",
+    "take_rows", "align_specs", "prune_specs", "random_qtensor",
+    "stacked_channel_axes",
 ]
 
 
@@ -211,6 +212,35 @@ def _scale_spec(spec: P, qt: QTensor) -> P:
         e if qt.scale.shape[i] != 1 else None
         for i, e in enumerate(entries[: qt.q.ndim])
     ])
+
+
+def prune_specs(params, specs, mesh):
+    """Drop mesh axes that don't divide the annotated array dimension.
+
+    ``device_put`` refuses an explicit sharding whose axis doesn't divide
+    the dim (e.g. an MoE expert FFN dim of 128 over tp=3); replicating
+    that axis is always CORRECT — each device just keeps the full dim —
+    so any model runs on any mesh, merely without that one split.  Run
+    BEFORE :func:`align_specs` (operates on the plain spec tree against
+    array/QTensor shapes)."""
+
+    def one(p, s):
+        entries = list(s) + [None] * (p.ndim - len(tuple(s)))
+        out = []
+        for i, e in enumerate(entries[: p.ndim]):
+            if e is None:
+                out.append(None)
+                continue
+            names = e if isinstance(e, tuple) else (e,)
+            size = 1
+            for nm in names:
+                size *= mesh.shape[nm]
+            out.append(e if p.shape[i] % size == 0 else None)
+        return P(*out)
+
+    return jax.tree_util.tree_map(
+        one, params, specs, is_leaf=lambda x: isinstance(x, QTensor)
+    )
 
 
 def align_specs(params, specs):

@@ -205,6 +205,60 @@ def test_moe_forward_runs():
     assert np.isfinite(np.asarray(hidden)).all()
 
 
+@pytest.mark.parametrize("norm_topk", [True, False])
+def test_moe_grouped_matches_dense(norm_topk, monkeypatch):
+    """The grouped ragged_dot dispatch (default) must match the dense
+    one-hot oracle (DYNAMO_MOE_DENSE=1) — same routing, same weighted
+    combine, only the dispatch mechanics differ.  Includes empty experts
+    (E=8, few tokens) so zero-sized groups are exercised."""
+    import jax
+
+    from dynamo_tpu.models.llama import _moe_mlp_dense, _moe_mlp_grouped
+
+    cfg = ModelConfig.tiny(
+        num_experts=8, num_experts_per_tok=2, norm_topk_prob=norm_topk
+    )
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(3))
+    lp = jax.tree.map(lambda a: a[0], params["layers"])  # layer-0 slice
+    x = jax.random.normal(
+        jax.random.PRNGKey(4), (2, 5, cfg.hidden_size), jnp.float32
+    )
+    got = _moe_mlp_grouped(cfg, lp, x)
+    want = _moe_mlp_dense(cfg, lp, x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+    )
+    # and the env switch routes through the dense oracle
+    monkeypatch.setenv("DYNAMO_MOE_DENSE", "1")
+    from dynamo_tpu.models.llama import _moe_mlp
+
+    np.testing.assert_allclose(
+        np.asarray(_moe_mlp(cfg, lp, x)), np.asarray(want), rtol=0, atol=0
+    )
+
+
+def test_moe_grouped_quantized_matches_dense():
+    """Grouped dispatch over int8 QTensor experts matches the dense oracle
+    on the same quantized weights."""
+    import jax
+
+    from dynamo_tpu.models.llama import _moe_mlp_dense, _moe_mlp_grouped
+
+    cfg = ModelConfig.tiny(num_experts=4, num_experts_per_tok=2)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(5), quantized=True)
+    lp = jax.tree.map(lambda a: a[0], params["layers"])
+    x = jax.random.normal(
+        jax.random.PRNGKey(6), (1, 7, cfg.hidden_size), jnp.float32
+    )
+    got = _moe_mlp_grouped(cfg, lp, x)
+    want = _moe_mlp_dense(cfg, lp, x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+    )
+
+
 def test_gemma2_matches_hf():
     """Gemma2 = GeGLU + (1+w) RMSNorm + embed scaling + sandwich norms +
     query_pre_attn_scalar + attn/final logit softcaps, all through the

@@ -1,5 +1,7 @@
-"""Prompt-lookup speculative decoding: proposer, greedy-exactness, and
-acceptance/dispatch-reduction on a deterministic model."""
+"""Prompt-lookup speculative decoding: proposer, greedy-exactness,
+rejection-sampled verify under temperature (incl. seeded-stream
+identity spec on/off), and acceptance/dispatch-reduction on a
+deterministic model."""
 
 import jax
 import jax.numpy as jnp
@@ -47,8 +49,12 @@ class CycleModel:
     CYCLE[p % len(CYCLE)] regardless of input — generation is a known
     repeating stream, so n-gram proposals become perfect after one cycle."""
 
-    def __init__(self, vocab=64):
+    def __init__(self, vocab=64, scale=1.0):
+        # ``scale`` sharpens the one-hot logits: at scale >= 20 sampling
+        # at moderate temperature is effectively deterministic, which the
+        # temperature-speculation tests rely on
         self.config = ModelConfig.tiny(vocab_size=vocab)
+        self.scale = scale
 
     def init_params(self):
         return {"zero": jnp.zeros((1,))}
@@ -72,7 +78,9 @@ class CycleModel:
         pos = hidden[..., 0].astype(jnp.int32)
         cyc = jnp.asarray(CYCLE, jnp.int32)
         nxt = cyc[(pos + 1) % len(CYCLE)]
-        return jax.nn.one_hot(nxt, self.config.vocab_size, dtype=jnp.float32)
+        return jax.nn.one_hot(
+            nxt, self.config.vocab_size, dtype=jnp.float32
+        ) * self.scale
 
 
 def _run(core, prompt, n, rid="s"):
@@ -129,15 +137,17 @@ def test_spec_greedy_exact_on_real_model():
 
 
 def test_spec_defers_to_sampler_features():
-    """A non-greedy (or penalized) request in the batch disables the
-    speculative path for that dispatch — the burst path runs instead."""
+    """A request using a feature the verify pass can't thread (penalties,
+    logprobs, grammar) disables the speculative path for that dispatch —
+    the burst path runs instead.  (Plain temperature no longer defers:
+    the verify pass samples.)"""
     model = CycleModel()
     params = model.init_params()
     core = EngineCore(model, params, _cfg(spec_tokens=4), eos_token_ids=[])
     outs = []
     core.submit(EngineRequest(
         request_id="t", prompt=[11, 12, 13, 14, 11, 12, 13, 14],
-        sampling=SamplingOptions(temperature=1.0),  # not greedy
+        sampling=SamplingOptions(temperature=1.0, frequency_penalty=0.5),
         stops=StopConditions(max_tokens=8, ignore_eos=True),
         emit=outs.append,
     ))
@@ -146,6 +156,64 @@ def test_spec_defers_to_sampler_features():
             break
     assert sum(len(o.token_ids) for o in outs) == 8
     assert core.spec_steps == 0
+
+
+def test_spec_accepts_under_temperature():
+    """Sampled verify: with sharp logits, temperature sampling is
+    effectively deterministic, so proposals accept and the stream is the
+    cycle — speculation must engage (it used to require greedy)."""
+    model = CycleModel(scale=25.0)
+    params = model.init_params()
+    core = EngineCore(model, params, _cfg(spec_tokens=4), eos_token_ids=[])
+    outs = []
+    core.submit(EngineRequest(
+        request_id="t", prompt=[11, 12, 13, 14, 11, 12, 13, 14],
+        sampling=SamplingOptions(temperature=0.7),
+        stops=StopConditions(max_tokens=16, ignore_eos=True),
+        emit=outs.append,
+    ))
+    for _ in range(200):
+        if not core.step():
+            break
+    got = [t for o in outs for t in o.token_ids]
+    assert len(got) == 16
+    # positions 8.. continue the cycle deterministically at scale 25
+    assert got == [CYCLE[(8 + j) % 4] for j in range(16)]
+    assert core.spec_steps > 0
+    assert core.spec_accepted > 0
+
+
+@pytest.mark.parametrize("scale", [1.0, 25.0])
+def test_spec_seeded_stream_identical(scale):
+    """A seeded request's stream is BIT-IDENTICAL with speculation on or
+    off, at any temperature: seeded noise is a pure function of (seed,
+    position, token id), and the verify pass reuses it per position.
+    scale=1.0 makes sampling near-uniform (proposals mostly rejected);
+    scale=25 makes it near-deterministic (mostly accepted) — equality
+    must hold in both regimes."""
+    def run(spec_tokens, rid):
+        model = CycleModel(scale=scale)
+        core = EngineCore(
+            model, model.init_params(),
+            _cfg(spec_tokens=spec_tokens), eos_token_ids=[],
+        )
+        outs = []
+        core.submit(EngineRequest(
+            request_id=rid, prompt=[11, 12, 13, 14, 11, 12, 13, 14],
+            sampling=SamplingOptions(temperature=0.9, seed=1234),
+            stops=StopConditions(max_tokens=24, ignore_eos=True),
+            emit=outs.append,
+        ))
+        for _ in range(400):
+            if not core.step():
+                break
+        return [t for o in outs for t in o.token_ids], core
+
+    base, _ = run(0, "off")
+    spec, core = run(4, "on")
+    assert len(base) == 24
+    assert spec == base
+    assert core.spec_steps > 0
 
 
 def test_spec_respects_block_limits():
