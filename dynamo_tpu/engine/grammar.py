@@ -545,21 +545,158 @@ _RX_BOOL = r"(true|false)"
 _RX_WS = r"[ \n\t]*"
 
 
+def _digits_range_rx(lo: str, hi: str) -> str:
+    """Regex for decimal integers with the SAME digit count in [lo, hi]
+    (recursive digit-prefix construction; no {n} quantifier — the bounded
+    engine supports only * + ?, so fixed repeats are spelled out)."""
+    if lo == hi:
+        return lo
+    if len(lo) == 1:
+        return f"[{lo}-{hi}]"
+    if lo[0] == hi[0]:
+        return lo[0] + _digits_range_rx(lo[1:], hi[1:])
+    n = len(lo) - 1
+    rest_min, rest_max = "0" * n, "9" * n
+    parts = []
+    start = lo[0]
+    if lo[1:] != rest_min:
+        parts.append(lo[0] + _digits_range_rx(lo[1:], rest_max))
+        start = chr(ord(lo[0]) + 1)
+    end = hi[0]
+    if hi[1:] != rest_max:
+        parts.append(hi[0] + _digits_range_rx(rest_min, hi[1:]))
+        end = chr(ord(hi[0]) - 1)
+    if start <= end:
+        first = f"[{start}-{end}]" if start != end else start
+        parts.append(first + "[0-9]" * n)
+    return "(" + "|".join(parts) + ")"
+
+
+def _uint_range_rx(a: int, b: Optional[int]) -> str:
+    """Regex for non-negative integers in [a, b] (b=None → unbounded),
+    canonical JSON form (no leading zeros, no sign)."""
+    alts = []
+    if a == 0:
+        alts.append("0")
+        a = 1
+        if b == 0:
+            return "0"
+    if b is None:
+        la = len(str(a))
+        alts.append(_digits_range_rx(str(a), "9" * la))
+        # any number with MORE digits than a is > a
+        alts.append("[1-9]" + "[0-9]" * (la - 1) + "[0-9]+")
+        return "(" + "|".join(alts) + ")"
+    for length in range(len(str(a)), len(str(b)) + 1):
+        lo = max(a, 10 ** (length - 1))
+        hi = min(b, 10 ** length - 1)
+        if lo <= hi:
+            alts.append(_digits_range_rx(str(lo), str(hi)))
+    return "(" + "|".join(alts) + ")"
+
+
+def _int_range_rx(lo: Optional[int], hi: Optional[int]) -> Optional[str]:
+    """Regex for integers in [lo, hi]; either side may be None
+    (unbounded).  Returns None for an empty range."""
+    if lo is not None and hi is not None and lo > hi:
+        return None
+    parts = []
+    if lo is None or lo < 0:  # negative side: -(magnitude)
+        mag_lo = 1 if hi is None or hi >= 0 else -hi
+        mag_hi = None if lo is None else -lo
+        parts.append("-" + _uint_range_rx(mag_lo, mag_hi))
+    if hi is None or hi >= 0:  # non-negative side
+        parts.append(_uint_range_rx(max(lo or 0, 0), hi))
+    return "(" + "|".join(parts) + ")"
+
+
+_BOUND_KEYS = ("minimum", "maximum", "exclusiveMinimum", "exclusiveMaximum")
+_MAX_BOUND = 10 ** 18  # beyond ~18 digits any range regex blows the 4096 cap
+
+
+def _schema_int_bounds(schema: dict):
+    """(ok, lo, hi): inclusive integer bounds from minimum/maximum/
+    exclusiveMinimum/exclusiveMaximum (numeric draft-2020 form; the
+    draft-4 boolean form adjusts minimum/maximum).  Schemas are UNTRUSTED
+    request bodies: non-numeric, non-finite, or astronomically large
+    bounds return ok=False (caller falls back to the generic grammar)
+    instead of raising — and the magnitude cap also stops a tiny request
+    from provoking a megabyte-sized range regex."""
+    import math
+
+    def num(v):
+        # bool is an int subclass but "minimum: true" is not a bound
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None
+        if isinstance(v, float) and not math.isfinite(v):
+            return None
+        if abs(v) > _MAX_BOUND:
+            return None
+        return v
+
+    lo = schema.get("minimum")
+    hi = schema.get("maximum")
+    xlo = schema.get("exclusiveMinimum")
+    xhi = schema.get("exclusiveMaximum")
+    if isinstance(xlo, bool):  # draft-4: exclusiveMinimum: true + minimum
+        xlo = lo if xlo else None
+        lo = None if xlo is not None else lo
+    if isinstance(xhi, bool):
+        xhi = hi if xhi else None
+        hi = None if xhi is not None else hi
+    for v in (lo, hi, xlo, xhi):
+        if v is not None and num(v) is None:
+            return False, None, None
+    if xlo is not None:
+        v = math.floor(xlo) + 1
+        lo = v if lo is None else max(lo, v)
+    if xhi is not None:
+        v = math.ceil(xhi) - 1
+        hi = v if hi is None else min(hi, v)
+    lo = None if lo is None else math.ceil(lo)
+    hi = None if hi is None else math.floor(hi)
+    return True, lo, hi
+
+
 def json_schema_to_regex(schema: dict, _depth: int = 0) -> Optional[str]:
     """Translate a JSON-Schema SUBSET into a pattern for the bounded regex
     engine, so ``response_format: json_schema`` enforces the schema's
     SHAPE at decode time (not just syntactic JSON + prompt steering).
 
-    Supported: type string/integer/number/boolean/null, enum/const of
-    scalars, object with ``properties`` (required-only emission, declared
-    order), array of a supported item type.  Returns None when the schema
-    uses anything else (caller falls back to the generic JSON grammar).
+    Supported: type string/integer/number/boolean/null (and a list of
+    those), integer minimum/maximum/exclusive* bounds (exact digit-range
+    regex), enum/const of scalars, anyOf/oneOf of supported branches
+    (oneOf is treated as anyOf — branches are assumed disjoint), object
+    with ``properties`` in declared order — required ones mandatory,
+    up to 5 optional ones may be independently omitted (``required``
+    absent keeps the historical all-required emission), array of a
+    supported item type.  Returns None when the schema uses anything
+    else — notably bounds on non-integer numbers, which a regex cannot
+    enforce exactly — and the caller falls back to the generic JSON
+    grammar + prompt steering.
     """
     if _depth > 6 or not isinstance(schema, dict):
         return None
     if "enum" in schema:
+        vals = schema["enum"]
+        if not isinstance(vals, list) or not vals:
+            return None
+        if any(k in schema for k in _BOUND_KEYS):
+            return None  # enum ∩ numeric bounds: conjoin semantics, bail
+        t = schema.get("type")
+        if t is not None:
+            # keywords CONJOIN: a sibling type narrows the enum
+            chk = {"string": str, "boolean": bool, "null": type(None),
+                   "integer": int, "number": (int, float)}.get(t)
+            if chk is None:
+                return None  # enum under object/array types: bail
+            vals = [v for v in vals
+                    if isinstance(v, chk)
+                    and not (chk is not bool and isinstance(v, bool))]
+            if not vals:
+                return None
         alts = []
-        for v in schema["enum"]:
+        for v in vals:
             if isinstance(v, str):
                 # json.dumps first: quotes/backslashes/control chars must
                 # appear ESCAPED in the emitted JSON, not raw
@@ -574,13 +711,48 @@ def json_schema_to_regex(schema: dict, _depth: int = 0) -> Optional[str]:
                 return None
         return "(" + "|".join(alts) + ")"
     if "const" in schema:
-        return json_schema_to_regex({"enum": [schema["const"]]}, _depth)
+        return json_schema_to_regex(
+            {k: v for k, v in schema.items() if k != "const"}
+            | {"enum": [schema["const"]]}, _depth)
+    for key in ("anyOf", "oneOf"):
+        branches = schema.get(key)
+        if branches is not None:
+            # JSON Schema keywords conjoin: a sibling type/enum/bound next
+            # to anyOf would be DROPPED by a plain union — fall back to the
+            # generic grammar rather than emit a false guarantee.
+            # (Annotation-only siblings are harmless.)
+            sib = set(schema) - {key, "title", "description", "default",
+                                 "examples", "$schema", "$id", "$comment"}
+            if sib:
+                return None
+            if not isinstance(branches, list) or not branches:
+                return None
+            subs = [json_schema_to_regex(b, _depth + 1) for b in branches]
+            if any(s is None for s in subs):
+                return None
+            return "(" + "|".join(subs) + ")"
     t = schema.get("type")
+    if isinstance(t, list):  # type union == anyOf of the member types
+        if not t:
+            return None
+        subs = [
+            json_schema_to_regex(dict(schema, type=x), _depth + 1) for x in t
+        ]
+        if any(s is None for s in subs):
+            return None
+        return "(" + "|".join(subs) + ")"
     if t == "string":
         return _RX_STRING
     if t == "integer":
-        return _RX_INT
+        ok, lo, hi = _schema_int_bounds(schema)
+        if not ok:
+            return None
+        if lo is None and hi is None:
+            return _RX_INT
+        return _int_range_rx(lo, hi)
     if t == "number":
+        if any(k in schema for k in _BOUND_KEYS):
+            return None  # real-valued bounds can't be regex-enforced
         return _RX_NUMBER
     if t == "boolean":
         return _RX_BOOL
@@ -597,21 +769,46 @@ def json_schema_to_regex(schema: dict, _depth: int = 0) -> Optional[str]:
         props = schema.get("properties")
         if not isinstance(props, dict) or not props:
             return None
-        required = schema.get("required")
         keys = list(props.keys())
-        if required is not None and set(required) != set(keys):
-            # optional properties explode the alternation; the generic
-            # JSON grammar + prompt steering handles those schemas
+        required = schema.get("required")
+        # historical behaviour: no ``required`` -> emit every property
+        # (always schema-valid, and keeps pre-r4 outputs stable)
+        req_set = set(keys) if required is None else set(required)
+        if not req_set <= set(keys):
+            return None  # a required key with no declared schema
+        if len(keys) - len(req_set) > 5:
+            # the ordered-subsequence expansion below doubles per optional
+            # key; past ~5 the generic JSON grammar is the better tool
             return None
         w = _RX_WS
-        parts = []
+        pats = []
         for k in keys:
             sub = json_schema_to_regex(props[k], _depth + 1)
             if sub is None:
                 return None
-            parts.append(_regex_escape(json.dumps(k)) + w + ":" + w + sub)
-        body = ("," + w).join(p + w for p in parts)
-        return r"\{" + w + body + r"\}"
+            pats.append(_regex_escape(json.dumps(k)) + w + ":" + w + sub + w)
+
+        # ordered-subsequence emission: properties appear in declared
+        # order, required ones always, optional ones independently
+        # omittable, commas only between present ones.  suffix(i, emitted)
+        # = pattern for items i.. given whether anything was emitted yet
+        # ("" = epsilon); memoised so shared suffixes are computed once.
+        from functools import lru_cache
+
+        @lru_cache(maxsize=None)
+        def suffix(i: int, emitted: bool) -> str:
+            if i == len(pats):
+                return ""
+            head = ("," + w if emitted else "") + pats[i]
+            with_i = head + suffix(i + 1, True)
+            if keys[i] in req_set:
+                return with_i
+            without = suffix(i + 1, emitted)
+            if without == "":
+                return "(" + with_i + ")?"
+            return "((" + with_i + ")|(" + without + "))"
+
+        return r"\{" + w + suffix(0, False) + r"\}"
     return None
 
 

@@ -609,3 +609,167 @@ def test_schema_string_fragment_is_strict_json(tables):
     assert not accepts(b'"h\x01i"')    # raw control byte
     assert not accepts(b'"h\\qi"')     # illegal escape
     assert not accepts(b'"h\\u12"')    # truncated \\u (can't close)
+
+
+# ------------------------------------------------ widened schema subset ----
+def test_int_range_regex_matches_bruteforce():
+    """The digit-range construction is checked exhaustively against
+    Python's re over every (lo, hi) window in a probe set, including
+    negatives, zero crossings, and half-open ranges."""
+    import re
+
+    from dynamo_tpu.engine.grammar import _int_range_rx
+
+    probes = list(range(-140, 141)) + [999, 1000, 1001, 99999, -99999]
+    windows = [(-3, 7), (0, 0), (5, 5), (-120, -7), (10, 123), (-1, 1),
+               (7, 100), (0, 99), (1, 100000), (-100000, -1)]
+    for lo, hi in windows:
+        rx = re.compile(_int_range_rx(lo, hi))
+        for v in probes:
+            want = lo <= v <= hi
+            assert bool(rx.fullmatch(str(v))) == want, (lo, hi, v)
+    # half-open
+    rx = re.compile(_int_range_rx(12, None))
+    for v in probes:
+        assert bool(rx.fullmatch(str(v))) == (v >= 12), v
+    rx = re.compile(_int_range_rx(None, -4))
+    for v in probes:
+        assert bool(rx.fullmatch(str(v))) == (v <= -4), v
+    assert _int_range_rx(5, 4) is None  # empty range
+
+
+def test_schema_integer_bounds_and_number_fallback():
+    import re
+
+    from dynamo_tpu.engine.grammar import json_schema_to_regex
+
+    rx = json_schema_to_regex({"type": "integer", "minimum": 1,
+                               "maximum": 10})
+    assert rx is not None
+    p = re.compile(rx)
+    assert p.fullmatch("7") and p.fullmatch("10")
+    assert not p.fullmatch("0") and not p.fullmatch("11")
+    # draft-2020 exclusive bounds
+    rx = json_schema_to_regex({"type": "integer", "exclusiveMinimum": 0,
+                               "exclusiveMaximum": 3})
+    p = re.compile(rx)
+    assert p.fullmatch("1") and p.fullmatch("2")
+    assert not p.fullmatch("0") and not p.fullmatch("3")
+    # real-valued bounds cannot be regex-enforced -> generic fallback
+    assert json_schema_to_regex({"type": "number", "minimum": 0.5}) is None
+
+
+def test_schema_optional_properties(tables):
+    """Optional properties: declared order, required always present,
+    optionals independently omittable, commas only between present
+    members — enforced at decode time."""
+    from dynamo_tpu.engine.grammar import (
+        compile_regex_vocab, json_schema_to_regex,
+    )
+
+    schema = {"type": "object",
+              "properties": {"a": {"type": "integer"},
+                             "b": {"type": "boolean"},
+                             "c": {"enum": ["x", "y"]}},
+              "required": ["b"]}
+    rx = json_schema_to_regex(schema)
+    assert rx is not None
+    toks = make_vocab()
+    rt = compile_regex_vocab(toks, rx, eos_ids=[EOS])
+
+    def accepts(text):
+        s, d, st = 1, 0, 0
+        for b in text.encode():
+            if not rt.valid_mask(s, d, st)[1 + b]:
+                return False
+            s, d, st = rt.advance(s, d, st, 1 + b)
+        return bool(rt.valid_mask(s, d, st)[EOS])
+
+    assert accepts('{"a": 1, "b": true, "c": "x"}')
+    assert accepts('{"b": false}')
+    assert accepts('{"a": -2, "b": true}')
+    assert accepts('{"b": true, "c": "y"}')
+    assert not accepts('{"a": 1, "c": "x"}')         # missing required b
+    assert not accepts('{"b": true,}')               # dangling comma
+    assert not accepts('{"c": "x", "b": true}')      # order violated
+    assert not accepts('{}')                         # required missing
+
+    # fully-optional object admits {}
+    rx = json_schema_to_regex({"type": "object",
+                               "properties": {"a": {"type": "integer"}},
+                               "required": []})
+    rt = compile_regex_vocab(toks, rx, eos_ids=[EOS])
+    s, d, st = 1, 0, 0
+    for b in b"{}":
+        s, d, st = rt.advance(s, d, st, 1 + b)
+    assert rt.valid_mask(s, d, st)[EOS]
+
+    # too many optionals -> generic fallback (alternation would explode)
+    many = {"type": "object",
+            "properties": {f"k{i}": {"type": "boolean"} for i in range(7)},
+            "required": []}
+    assert json_schema_to_regex(many) is None
+
+
+def test_schema_anyof_and_type_union(tables):
+    import re
+
+    from dynamo_tpu.engine.grammar import json_schema_to_regex
+
+    rx = json_schema_to_regex({"anyOf": [
+        {"type": "integer", "minimum": 0},
+        {"enum": ["none"]},
+    ]})
+    p = re.compile(rx)
+    assert p.fullmatch("17") and p.fullmatch('"none"')
+    assert not p.fullmatch("-1") and not p.fullmatch('"other"')
+
+    # oneOf treated as anyOf (disjoint branches)
+    rx = json_schema_to_regex({"oneOf": [{"type": "boolean"},
+                                         {"type": "null"}]})
+    p = re.compile(rx)
+    assert p.fullmatch("true") and p.fullmatch("null")
+    assert not p.fullmatch('"true"')
+
+    # nullable via type union
+    rx = json_schema_to_regex({"type": ["string", "null"]})
+    p = re.compile(rx)
+    assert p.fullmatch('"s"') and p.fullmatch("null")
+    assert not p.fullmatch("0")
+
+    # a branch that can't translate poisons the whole alternation
+    assert json_schema_to_regex({"anyOf": [{"type": "boolean"},
+                                           {"type": "object"}]}) is None
+
+
+def test_schema_untrusted_inputs_never_raise():
+    """Schemas are untrusted request bodies: malformed/adversarial bounds
+    and conjoined keywords must fall back (None), never raise."""
+    from dynamo_tpu.engine.grammar import json_schema_to_regex
+
+    bad = [
+        {"type": "integer", "minimum": "5"},          # string bound
+        {"type": "integer", "minimum": float("inf")},  # non-finite
+        {"type": "integer", "minimum": 1e999},         # inf via literal
+        {"type": "integer", "minimum": True},          # bool bound
+        {"type": "integer", "minimum": 10 ** 500},     # astronomic
+        {"type": "integer", "minimum": 0, "maximum": 10 ** 500},
+        {"type": "integer", "minimum": -(10 ** 4400)},
+    ]
+    for s in bad:
+        assert json_schema_to_regex(s) is None, s
+    # conjoined siblings that a plain union would drop -> fallback
+    assert json_schema_to_regex(
+        {"type": "string", "anyOf": [{"type": "string"},
+                                     {"type": "integer"}]}) is None
+    assert json_schema_to_regex(
+        {"type": "integer", "minimum": 5,
+         "anyOf": [{"type": "integer"}]}) is None
+    assert json_schema_to_regex(
+        {"enum": [1, 2], "minimum": 2}) is None
+    # enum narrowed by sibling type; fully filtered -> fallback
+    import re
+    rx = json_schema_to_regex({"type": "string", "enum": ["a", 1, "b"]})
+    p = re.compile(rx)
+    assert p.fullmatch('"a"') and p.fullmatch('"b"') and not p.fullmatch("1")
+    assert json_schema_to_regex({"type": "string", "enum": [1, 2]}) is None
