@@ -399,10 +399,16 @@ async def _cmd_operator(args) -> None:
     cluster = MemoryCluster() if args.dry_run else KubectlCluster(
         context=args.context
     )
-    op = Operator(cluster, interval_s=args.interval, watch_dir=args.specs_dir)
+    coord = None
+    if args.coordinator:
+        from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient
+
+        coord = await CoordinatorClient(args.coordinator, reconnect=True).connect()
+    op = Operator(cluster, interval_s=args.interval, watch_dir=args.specs_dir,
+                  coordinator=coord)
     op.load_dir(args.specs_dir)
-    log.info("operator watching %s (%d specs, dry_run=%s)",
-             args.specs_dir, len(op.specs), args.dry_run)
+    log.info("operator watching %s (%d specs, dry_run=%s, coordinator=%s)",
+             args.specs_dir, len(op.specs), args.dry_run, args.coordinator)
     await op.run()
 
 
@@ -639,6 +645,10 @@ def _parser() -> argparse.ArgumentParser:
     operator.add_argument("--context", default=None, help="kubectl context")
     operator.add_argument("--dry-run", action="store_true",
                           help="reconcile against an in-memory cluster")
+    operator.add_argument("--coordinator", default=None,
+                          help="coordinator URL: enables truthful phases "
+                               "from live registrations + queue-depth "
+                               "autoscaling")
 
     store = sub.add_parser("api-store", help="versioned graph registry service")
     store.add_argument("--db", default="graphs.db")

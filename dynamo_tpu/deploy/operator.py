@@ -16,6 +16,15 @@ in-memory object store (tests, dry runs).  Specs arrive via
 directory of YAML files (the CRD-watch stand-in), and the loop levels
 actual state toward desired on every tick — create, scale, and delete all
 fall out of the same diff.
+
+With a coordinator connection the operator goes beyond the reference's
+controller: per-deployment ``status`` phases are derived from LIVE worker
+registrations (the dyn:// endpoint each service's command names —
+Pending/Degraded/Ready, Unknown when unobservable), and services with an
+``autoscale`` block scale on remote-prefill queue depth (planner-lite;
+the reference only documents its Planner, docs/architecture.md:47):
+replicas level toward ceil(depth / target_per_replica) within [min, max],
+up immediately, down one step per tick.
 """
 
 from __future__ import annotations
@@ -24,17 +33,32 @@ import asyncio
 import hashlib
 import json
 import logging
+import math
+import re
 import subprocess
 from pathlib import Path
 from typing import Optional, Protocol
 
 import yaml
 
-from dynamo_tpu.deploy.renderer import DeploymentSpec, render_manifests
+from dynamo_tpu.deploy.renderer import DeploymentSpec, ServiceSpec, render_manifests
 
 log = logging.getLogger("dynamo_tpu.operator")
 
 __all__ = ["Operator", "MemoryCluster", "KubectlCluster", "obj_key"]
+
+_DYN_RX = re.compile(r"dyn://([\w-]+)\.([\w-]+)\.([\w-]+)")
+
+
+def _dyn_target(svc: ServiceSpec) -> Optional[tuple[str, str, str]]:
+    """(namespace, component, endpoint) a worker service registers under,
+    parsed from the dyn:// URL in its command — the link between the k8s
+    object and the live coordinator registration."""
+    for arg in svc.command:
+        m = _DYN_RX.search(arg)
+        if m:
+            return m.group(1), m.group(2), m.group(3)
+    return None
 
 OWNER_ANNOTATION = "dynamo-tpu.dev/owned-by"
 HASH_ANNOTATION = "dynamo-tpu.dev/spec-hash"
@@ -123,13 +147,32 @@ class Operator:
     remembered."""
 
     def __init__(self, cluster: Cluster, owner: str = "dynamo-tpu-operator",
-                 interval_s: float = 2.0, watch_dir: Optional[str] = None):
+                 interval_s: float = 2.0, watch_dir: Optional[str] = None,
+                 coordinator=None):
         self.cluster = cluster
         self.owner = owner
         self.interval_s = interval_s
         self.watch_dir = watch_dir  # rescanned every tick when set
+        # optional CoordinatorClient (duck-typed: kv_get_prefix +
+        # queue_len): with it the operator reports TRUTHFUL per-deployment
+        # phases from live worker registrations and runs queue-depth
+        # autoscaling; without it phases are "Unknown" for worker-bearing
+        # deployments (the honest answer — it cannot see them)
+        self.coordinator = coordinator
         self.specs: dict[str, DeploymentSpec] = {}
         self.status: dict[str, dict] = {}
+        # (deployment, service) -> live registered instance count, filled
+        # by observe(); None until the first successful observation
+        self.live: Optional[dict[tuple[str, str], int]] = None
+        self.queue_depth: dict[tuple[str, str], int] = {}
+        # autoscale bookkeeping: the operator's current replica decision
+        # and the SPEC FILE's declared replicas per autoscaled service.
+        # load_dir re-parses files every tick — without re-applying the
+        # decision, each reparse would clobber the scaled value back to
+        # the file's (and the resulting perpetual "spec changed" would
+        # hot-spin the loop).
+        self._scale: dict[tuple[str, str], int] = {}
+        self._declared: dict[tuple[str, str], int] = {}
         # last successfully parsed spec name per watched file: a torn read
         # must keep its previous spec, not delete it (see load_dir)
         self._file_spec: dict[str, str] = {}
@@ -138,9 +181,24 @@ class Operator:
         self._stop = False
 
     # ------------------------------------------------------------ spec admin
+    def _adopt_spec(self, spec: DeploymentSpec) -> None:
+        """Install a freshly parsed spec, re-applying any standing
+        autoscale decision over the file's declared replicas (clamped to
+        the file's current [min, max])."""
+        for svc in spec.services:
+            if not svc.autoscale:
+                continue
+            key = (spec.name, svc.name)
+            self._declared[key] = svc.replicas
+            if key in self._scale:
+                lo = int(svc.autoscale.get("min", 1))
+                hi = int(svc.autoscale.get("max", max(svc.replicas, lo)))
+                svc.replicas = min(hi, max(lo, self._scale[key]))
+        self.specs[spec.name] = spec
+
     def set_spec(self, spec: DeploymentSpec) -> None:
         """Create or update a deployment (CRD upsert analogue)."""
-        self.specs[spec.name] = spec
+        self._adopt_spec(spec)
         self._wake.set()
 
     def delete_spec(self, name: str) -> None:
@@ -174,7 +232,7 @@ class Operator:
                 continue
             seen.add(spec.name)
             self._file_spec[key] = spec.name
-            self.specs[spec.name] = spec
+            self._adopt_spec(spec)
         self._file_spec = {
             k: v for k, v in self._file_spec.items() if Path(k).exists()
         }
@@ -185,6 +243,78 @@ class Operator:
         # interval wait return instantly — a 100%-CPU reconcile hot-spin
         if self.specs != before:
             self._wake.set()
+
+    # ------------------------------------------------------------ observation
+    async def observe(self) -> None:
+        """Refresh live worker counts and queue depths from the
+        coordinator, and level autoscaled services' replicas toward
+        ceil(depth / target_per_replica) within [min, max].
+
+        Scale-up jumps straight to the target (queued work is latency);
+        scale-down steps one replica per tick (cheap hysteresis — a
+        transiently empty queue must not flap the pool).  Changing
+        ``svc.replicas`` changes the rendered Deployment's hash, so the
+        next reconcile applies the scale exactly like any spec edit."""
+        if self.coordinator is None:
+            return
+        live: dict[tuple[str, str], int] = {}
+        depths: dict[tuple[str, str], int] = {}
+        scale: dict[tuple[str, str], int] = {}
+        for dep, spec in list(self.specs.items()):
+            for svc in spec.services:
+                target = _dyn_target(svc)
+                if target is None:
+                    continue
+                ns, comp, ep = target
+                prefix = f"{ns}/components/{comp}/endpoints/{ep}/"
+                insts = await self.coordinator.kv_get_prefix(prefix)
+                live[(dep, svc.name)] = len(insts)
+                auto = svc.autoscale
+                if not auto:
+                    continue
+                key = (dep, svc.name)
+                queue = auto.get("queue") or f"{ns}_prefill_queue"
+                depth = await self.coordinator.queue_len(queue)
+                depths[key] = depth
+                lo = int(auto.get("min", 1))
+                # default cap = the spec FILE's declared replicas — never
+                # the live (possibly scaled-down) value, which would
+                # ratchet the ceiling downward and pin scale-up
+                hi = int(auto.get(
+                    "max", max(self._declared.get(key, svc.replicas), lo)
+                ))
+                per = max(1, int(auto.get("target_per_replica", 4)))
+                want = min(hi, max(lo, math.ceil(depth / per)))
+                if want != svc.replicas:
+                    new = want if want > svc.replicas else svc.replicas - 1
+                    log.info("autoscale %s/%s: queue=%d -> replicas %d -> %d",
+                             dep, svc.name, depth, svc.replicas, new)
+                    svc.replicas = new
+                scale[key] = svc.replicas
+        # fresh maps each pass: deleted deployments / removed autoscale
+        # blocks must not leave stale depths or decisions behind
+        self.live = live
+        self.queue_depth = depths
+        self._scale = scale
+        self._declared = {
+            k: v for k, v in self._declared.items() if k in scale
+        }
+
+    def _phase(self, spec: DeploymentSpec) -> str:
+        """Truthful per-deployment phase from live registrations:
+        Ready (every worker service fully registered), Degraded (some),
+        Pending (none yet), Unknown (no coordinator to ask).  A
+        deployment with no dyn:// worker services has nothing to verify
+        beyond object application — Ready."""
+        workers = [s for s in spec.services if _dyn_target(s) is not None]
+        if not workers:
+            return "Ready"
+        if self.live is None:
+            return "Unknown"
+        counts = [self.live.get((spec.name, s.name), 0) for s in workers]
+        if all(c >= s.replicas for c, s in zip(counts, workers)):
+            return "Ready"
+        return "Pending" if sum(counts) == 0 else "Degraded"
 
     # ------------------------------------------------------------- reconcile
     def desired_objects(self) -> dict[tuple[str, str, str], dict]:
@@ -233,10 +363,23 @@ class Operator:
             inst = o["metadata"].get("labels", {}).get("app.kubernetes.io/instance")
             if inst:
                 counts[inst] = counts.get(inst, 0) + 1
-        for name in self.specs:
-            self.status[name] = {
-                "objects": counts.get(name, 0), "phase": "Ready",
+        for name, spec in self.specs.items():
+            st: dict = {
+                "objects": counts.get(name, 0), "phase": self._phase(spec),
             }
+            workers = {
+                s.name: {
+                    "want": s.replicas,
+                    "live": (self.live or {}).get((name, s.name)),
+                }
+                for s in spec.services if _dyn_target(s) is not None
+            }
+            if workers:
+                st["workers"] = workers
+            qd = {s: d for (n, s), d in self.queue_depth.items() if n == name}
+            if qd:
+                st["queue_depth"] = qd
+            self.status[name] = st
         return summary
 
     # ------------------------------------------------------------------ loop
@@ -247,6 +390,14 @@ class Operator:
             try:
                 if self.watch_dir is not None:
                     self.load_dir(self.watch_dir)
+                try:
+                    await self.observe()
+                except Exception:
+                    # a coordinator outage must NOT halt k8s reconcile:
+                    # degrade to Unknown phases and keep levelling objects
+                    log.warning("observe failed (coordinator unreachable?); "
+                                "phases Unknown this tick", exc_info=True)
+                    self.live = None
                 self.reconcile_once()
             except Exception:
                 log.exception("reconcile failed; retrying next tick")

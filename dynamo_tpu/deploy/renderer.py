@@ -61,6 +61,12 @@ class ServiceSpec:
     tpu_chips: int = 0
     env: dict[str, str] = field(default_factory=dict)
     port: Optional[int] = None
+    # queue-depth autoscale (planner-lite; the reference only documents
+    # its Planner, docs/architecture.md:47): {min, max, target_per_replica,
+    # queue?}.  The operator levels replicas toward
+    # ceil(depth / target_per_replica) within [min, max]; ``queue``
+    # defaults to the service's dyn:// namespace prefill queue.
+    autoscale: Optional[dict] = None
 
 
 @dataclass
@@ -92,6 +98,7 @@ class DeploymentSpec:
                     tpu_chips=int(tpu.get("chips", 0)),
                     env={k: str(v) for k, v in (s.get("env") or {}).items()},
                     port=s.get("port"),
+                    autoscale=s.get("autoscale"),
                 )
             )
         fe = d.get("frontend") or {}

@@ -685,7 +685,11 @@ def json_schema_to_regex(schema: dict, _depth: int = 0) -> Optional[str]:
             return None  # enum ∩ numeric bounds: conjoin semantics, bail
         t = schema.get("type")
         if t is not None:
-            # keywords CONJOIN: a sibling type narrows the enum
+            # keywords CONJOIN: a sibling type narrows the enum.  Only a
+            # plain scalar type name is narrowed here; a type LIST (or
+            # any other shape — schemas are untrusted) falls back.
+            if not isinstance(t, str):
+                return None
             chk = {"string": str, "boolean": bool, "null": type(None),
                    "integer": int, "number": (int, float)}.get(t)
             if chk is None:
@@ -772,7 +776,15 @@ def json_schema_to_regex(schema: dict, _depth: int = 0) -> Optional[str]:
         keys = list(props.keys())
         required = schema.get("required")
         # historical behaviour: no ``required`` -> emit every property
-        # (always schema-valid, and keeps pre-r4 outputs stable)
+        # (always schema-valid, and keeps pre-r4 outputs stable).
+        # ``required`` must be a list of strings — anything else in an
+        # untrusted schema falls back rather than raising (or treating a
+        # string as its characters).
+        if required is not None and (
+            not isinstance(required, list)
+            or not all(isinstance(k, str) for k in required)
+        ):
+            return None
         req_set = set(keys) if required is None else set(required)
         if not req_set <= set(keys):
             return None  # a required key with no declared schema

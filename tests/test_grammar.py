@@ -773,3 +773,21 @@ def test_schema_untrusted_inputs_never_raise():
     p = re.compile(rx)
     assert p.fullmatch('"a"') and p.fullmatch('"b"') and not p.fullmatch("1")
     assert json_schema_to_regex({"type": "string", "enum": [1, 2]}) is None
+
+
+def test_schema_untrusted_structures_never_raise():
+    """More adversarial shapes: list-typed enum siblings and malformed
+    ``required`` fall back instead of raising."""
+    from dynamo_tpu.engine.grammar import json_schema_to_regex
+
+    assert json_schema_to_regex(
+        {"type": ["string", "null"], "enum": ["a", None]}) is None
+    assert json_schema_to_regex(
+        {"type": "object", "properties": {"a": {"type": "integer"}},
+         "required": 5}) is None
+    assert json_schema_to_regex(
+        {"type": "object", "properties": {"a": {"type": "integer"}},
+         "required": "a"}) is None
+    assert json_schema_to_regex(
+        {"type": "object", "properties": {"a": {"type": "integer"}},
+         "required": [1]}) is None

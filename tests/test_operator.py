@@ -12,6 +12,10 @@ import pytest
 from dynamo_tpu.deploy.operator import MemoryCluster, Operator, obj_key
 from dynamo_tpu.deploy.renderer import DeploymentSpec
 
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
 SPEC_YAML = """
 name: llama-disagg
 namespace: serving
@@ -165,3 +169,235 @@ def test_load_dir_unchanged_specs_do_not_wake(tmp_path):
     (tmp_path / "a.yaml").unlink()
     op.load_dir(tmp_path)     # deletion is a change
     assert op._wake.is_set()
+
+
+# ------------------------------------------- truthful status + autoscale ----
+AUTOSCALE_SPEC = """
+name: llm
+namespace: serving
+image: dynamo-tpu:latest
+services:
+  decode:
+    command: [dynamo-tpu, run, "in=dyn://dynamo.decode.generate", "out=tpu"]
+    replicas: 2
+  prefill:
+    command: [dynamo-tpu, run, "in=dyn://dynamo.prefill.generate", "out=tpu"]
+    replicas: 1
+    autoscale: {min: 1, max: 4, target_per_replica: 2}
+"""
+
+
+def test_phase_from_live_registrations():
+    """Phase derives from coordinator registrations, not wishful
+    thinking: Pending (no workers) -> Degraded (some) -> Ready (all),
+    and Unknown without a coordinator to ask."""
+    from dynamo_tpu.runtime.transports.coordinator import (
+        CoordinatorClient, CoordinatorServer,
+    )
+
+    # no coordinator: worker-bearing deployments are honestly Unknown
+    op0 = Operator(MemoryCluster())
+    op0.set_spec(DeploymentSpec.from_yaml(AUTOSCALE_SPEC))
+    op0.reconcile_once()
+    assert op0.status["llm"]["phase"] == "Unknown"
+
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        coord = await CoordinatorClient(srv.url).connect()
+        worker = await CoordinatorClient(srv.url).connect()
+        try:
+            op = Operator(MemoryCluster(), coordinator=coord)
+            op.set_spec(DeploymentSpec.from_yaml(AUTOSCALE_SPEC))
+            await op.observe()
+            op.reconcile_once()
+            assert op.status["llm"]["phase"] == "Pending"
+
+            async def register(comp, n):
+                for i in range(n):
+                    lease = await worker.lease_create(ttl=30.0)
+                    key = (f"dynamo/components/{comp}/endpoints/generate/"
+                           f"{lease:x}")
+                    await worker.kv_put(key, {"instance_id": lease},
+                                        lease_id=lease)
+
+            await register("decode", 1)      # 1 of 2 decode, 0 of 1 prefill
+            await op.observe()
+            op.reconcile_once()
+            st = op.status["llm"]
+            assert st["phase"] == "Degraded"
+            assert st["workers"]["decode"] == {"want": 2, "live": 1}
+
+            await register("decode", 1)
+            await register("prefill", 1)
+            await op.observe()
+            op.reconcile_once()
+            st = op.status["llm"]
+            assert st["phase"] == "Ready"
+            assert st["workers"]["prefill"] == {"want": 1, "live": 1}
+        finally:
+            await worker.close()
+            await coord.close()
+            await srv.stop()
+
+    run(go())
+
+
+def test_autoscale_on_queue_depth():
+    """Queued remote-prefill work scales the prefill service up toward
+    ceil(depth / target_per_replica) (clamped to max) and back down one
+    step per tick once the queue drains — levelled through the same
+    reconcile diff as any spec edit."""
+    from dynamo_tpu.runtime.transports.coordinator import (
+        CoordinatorClient, CoordinatorServer,
+    )
+
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        coord = await CoordinatorClient(srv.url).connect()
+        pusher = await CoordinatorClient(srv.url).connect()
+        try:
+            cluster = MemoryCluster()
+            op = Operator(cluster, coordinator=coord)
+            op.set_spec(DeploymentSpec.from_yaml(AUTOSCALE_SPEC))
+            await op.observe()
+            op.reconcile_once()
+
+            def prefill_replicas():
+                key = ("Deployment", "serving", "llm-prefill")
+                return cluster.objects[key]["spec"]["replicas"]
+
+            assert prefill_replicas() == 1
+            for i in range(6):  # depth 6, per=2 -> want 3
+                await pusher.queue_push("dynamo_prefill_queue", {"i": i})
+            await op.observe()
+            op.reconcile_once()
+            assert prefill_replicas() == 3
+            assert op.status["llm"]["queue_depth"]["prefill"] == 6
+
+            for _ in range(20):  # depth 20 -> want 10, clamped to max 4
+                await pusher.queue_push("dynamo_prefill_queue", {})
+            await op.observe()
+            op.reconcile_once()
+            assert prefill_replicas() == 4
+
+            # drain: scale down one step per tick to min, never below
+            while True:
+                item = await pusher.queue_pull("dynamo_prefill_queue")
+                if item is None:
+                    break
+                await pusher.queue_ack("dynamo_prefill_queue", item[0])
+            for want in (3, 2, 1, 1):
+                await op.observe()
+                op.reconcile_once()
+                assert prefill_replicas() == want
+        finally:
+            await pusher.close()
+            await coord.close()
+            await srv.stop()
+
+    run(go())
+
+
+def test_load_dir_preserves_autoscale_decision(tmp_path):
+    """watch_dir reparses specs every tick; the operator's standing scale
+    decision must survive the reparse (no clobber back to the file's
+    replicas, no perpetual spec-changed wake)."""
+    from dynamo_tpu.runtime.transports.coordinator import (
+        CoordinatorClient, CoordinatorServer,
+    )
+
+    (tmp_path / "llm.yaml").write_text(AUTOSCALE_SPEC)
+
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        coord = await CoordinatorClient(srv.url).connect()
+        try:
+            cluster = MemoryCluster()
+            op = Operator(cluster, coordinator=coord,
+                          watch_dir=str(tmp_path))
+            op.load_dir(tmp_path)
+            for i in range(8):  # depth 8, per=2 -> want 4 (max)
+                await coord.queue_push("dynamo_prefill_queue", {})
+            await op.observe()
+            op.reconcile_once()
+            key = ("Deployment", "serving", "llm-prefill")
+            assert cluster.objects[key]["spec"]["replicas"] == 4
+            # the tick's reparse must keep the scaled value...
+            op._wake.clear()  # drop the initial-load wake
+            op.load_dir(tmp_path)
+            assert op.specs["llm"].services[1].replicas == 4
+            # ...and not signal a spec change (hot-spin guard)
+            assert not op._wake.is_set()
+            s = op.reconcile_once()
+            assert s["updated"] == 0 and s["created"] == 0
+        finally:
+            await coord.close()
+            await srv.stop()
+
+    run(go())
+
+
+def test_autoscale_default_max_is_declared_replicas():
+    """Without an explicit max the cap is the spec FILE's declared
+    replicas — a scale-down must not ratchet the ceiling down with it."""
+    from dynamo_tpu.runtime.transports.coordinator import (
+        CoordinatorClient, CoordinatorServer,
+    )
+
+    spec_yaml = AUTOSCALE_SPEC.replace(
+        "autoscale: {min: 1, max: 4, target_per_replica: 2}",
+        "autoscale: {min: 1, target_per_replica: 2}",
+    ).replace("replicas: 1\n    autoscale", "replicas: 3\n    autoscale")
+
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        coord = await CoordinatorClient(srv.url).connect()
+        try:
+            op = Operator(MemoryCluster(), coordinator=coord)
+            op.set_spec(DeploymentSpec.from_yaml(spec_yaml))
+            svc = op.specs["llm"].services[1]
+            assert svc.replicas == 3
+            await op.observe()  # empty queue -> scale down toward min
+            assert svc.replicas == 2
+            await op.observe()
+            assert svc.replicas == 1
+            for _ in range(10):
+                await coord.queue_push("dynamo_prefill_queue", {})
+            await op.observe()  # cap = declared 3, NOT the ratcheted 1
+            assert svc.replicas == 3
+        finally:
+            await coord.close()
+            await srv.stop()
+
+    run(go())
+
+
+def test_coordinator_outage_does_not_halt_reconcile(tmp_path):
+    """A dead coordinator degrades phases to Unknown but object
+    reconciliation keeps running (the run loop isolates observe)."""
+    from dynamo_tpu.runtime.transports.coordinator import (
+        CoordinatorClient, CoordinatorServer,
+    )
+
+    (tmp_path / "llm.yaml").write_text(AUTOSCALE_SPEC)
+
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        coord = await CoordinatorClient(srv.url).connect()
+        await srv.stop()  # outage before the operator's first tick
+        cluster = MemoryCluster()
+        op = Operator(cluster, coordinator=coord, interval_s=0.05,
+                      watch_dir=str(tmp_path))
+        op.start()
+        try:
+            for _ in range(100):
+                if cluster.objects and "llm" in op.status:
+                    break
+                await asyncio.sleep(0.02)
+            assert cluster.objects, "reconcile halted by coordinator outage"
+            assert op.status["llm"]["phase"] == "Unknown"
+        finally:
+            await op.stop()
+            await coord.close()
+
+    run(go())
