@@ -34,6 +34,31 @@ MODELS_PREFIX = "models/"  # under {namespace}/
 # ------------------------------------------------------------ engine build ----
 
 
+async def _resolve_model_ref(args) -> None:
+    """``--model-path dyn://models/<name>`` → pull from the coordinator
+    blob store into the local cache and rewrite the arg to the local dir
+    (model-artifact distribution: only the pushing host needs the
+    checkpoint on disk)."""
+    mp = getattr(args, "model_path", None)
+    if mp is None:
+        return
+    from dynamo_tpu.llm.model_store import is_model_ref, resolve_model
+
+    if not is_model_ref(mp):
+        return
+    url = getattr(args, "coordinator", None)
+    if not url:
+        raise SystemExit(f"model ref {mp!r} needs --coordinator to pull from")
+    from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient
+
+    c = await CoordinatorClient(url).connect()
+    try:
+        args.model_path = await resolve_model(mp, c)
+        log.info("resolved %s -> %s", mp, args.model_path)
+    finally:
+        await c.close()
+
+
 def _build_local_engine(args) -> tuple[object, object]:
     """out=tpu|echo → (engine, card): the native JAX engine or the echo stub."""
     from dynamo_tpu.llm.model_card import ModelDeploymentCard
@@ -157,6 +182,7 @@ async def _cmd_run(args) -> None:
     from dynamo_tpu.runtime import serde
 
     serde.register_llm_types()
+    await _resolve_model_ref(args)
     needs_runtime = args.out.startswith("dyn://") or args.inp.startswith("dyn://")
     runtime = await DistributedRuntime.connect(_runtime_config(args)) if needs_runtime else None
 
@@ -546,7 +572,10 @@ def _cmd_quantize(args) -> None:
 
 
 async def _cmd_models(args) -> None:
-    """llmctl parity: manage ModelEntry records on the coordinator."""
+    """llmctl parity: manage ModelEntry records on the coordinator — plus
+    ``push``/``pull``: model-artifact distribution through the blob store
+    (ref model.rs:150-199 NATS object store), so remote workers boot from
+    a ``dyn://models/<name>`` ref with the checkpoint on one host only."""
     from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient
 
     ns = args.namespace or "dynamo"
@@ -554,7 +583,24 @@ async def _cmd_models(args) -> None:
         args.coordinator or "tcp://127.0.0.1:6180"
     ).connect()
     try:
-        if args.action == "add":
+        if args.action == "push":
+            from dynamo_tpu.llm.model_store import push_model
+
+            if not args.name or not args.endpoint:
+                raise SystemExit("usage: models push <name> <model-dir>")
+            manifest = await push_model(coord, args.name, args.endpoint)
+            total = sum(f["size"] for f in manifest["files"].values())
+            print(f"pushed {args.name}: {len(manifest['files'])} files, "
+                  f"{total} bytes, digest {manifest['digest'][:12]}")
+        elif args.action == "pull":
+            from dynamo_tpu.llm.model_store import pull_model
+
+            if not args.name:
+                raise SystemExit("usage: models pull <name> [--out DIR]")
+            path = await pull_model(coord, args.name,
+                                    cache_dir=getattr(args, "out", None))
+            print(path)
+        elif args.action == "add":
             entry = {"endpoint": args.endpoint, "model_path": args.model_path}
             await coord.kv_put(f"{ns}/{MODELS_PREFIX}{args.name}", entry)
             print(f"added {args.name} -> {args.endpoint}")
@@ -666,11 +712,21 @@ def _parser() -> argparse.ArgumentParser:
     mock.add_argument("--count", type=int, default=1)
     common(mock)
 
-    models = sub.add_parser("models", help="manage model registrations (llmctl)")
-    models.add_argument("action", choices=["add", "list", "remove"])
+    models = sub.add_parser(
+        "models",
+        help="manage model registrations (llmctl) + artifact push/pull",
+    )
+    models.add_argument(
+        "action", choices=["add", "list", "remove", "push", "pull"]
+    )
     models.add_argument("name", nargs="?")
-    models.add_argument("endpoint", nargs="?", help="dyn://ns.component.endpoint")
+    models.add_argument(
+        "endpoint", nargs="?",
+        help="dyn://ns.component.endpoint (add) | model dir (push)",
+    )
     models.add_argument("--model-path", default=None)
+    models.add_argument("--out", default=None,
+                        help="pull: cache directory override")
     common(models)
 
     quant = sub.add_parser(

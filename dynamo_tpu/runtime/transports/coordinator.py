@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import hashlib
 import itertools
 import json
 import logging
@@ -105,6 +106,16 @@ class CoordinatorServer:
         self._expiry_task: Optional[asyncio.Task] = None
         self._write_locks: dict[int, asyncio.Lock] = {}
         self._conn_writers: dict[int, asyncio.StreamWriter] = {}
+        # blob store (plane 4 — NATS object-store parity, ref
+        # lib/llm/src/model_card/model.rs:150-199 publishing model
+        # artifacts for remote workers): name -> {size, sha256, meta,
+        # file?}.  Payload bytes live on disk under data_dir/blobs
+        # (content-addressed by sha256, WAL-indexed) or in memory without
+        # a data_dir.  Uploads stream in chunks so multi-GB checkpoints
+        # never materialise in one frame or one buffer.
+        self._blobs: dict[str, dict] = {}
+        self._blob_data: dict[str, bytes] = {}
+        self._blob_uploads: dict[int, dict] = {}
 
     @staticmethod
     def _id_epoch() -> int:
@@ -156,6 +167,15 @@ class CoordinatorServer:
                         max_id = max(max_id, rec["mid"])
                     elif t == "qack":
                         queues[rec["q"]].pop(rec["mid"], None)
+                    elif t == "blob":
+                        # re-index only blobs whose payload file survived
+                        if (self._data_dir / "blobs" / rec["file"]).exists():
+                            self._blobs[rec["name"]] = {
+                                k: rec[k]
+                                for k in ("size", "sha256", "meta", "file")
+                            }
+                    elif t == "blobdel":
+                        self._blobs.pop(rec["name"], None)
         for q, items in queues.items():
             for mid, payload in sorted(items.items()):
                 self._queues[q].append(_QueueItem(mid, payload, {"queue": q}))
@@ -172,11 +192,26 @@ class CoordinatorServer:
                         {"t": "qpush", "q": q, "mid": item.msg_id,
                          "p": base64.b64encode(item.payload).decode()},
                         separators=(",", ":")) + "\n")
+            for name, rec in self._blobs.items():
+                f.write(json.dumps({"t": "blob", "name": name, **rec},
+                                   separators=(",", ":")) + "\n")
             # the rewrite must be as durable as the fsynced records it
             # replaces — flush+fsync file, then fsync the dir after rename
             f.flush()
             os.fsync(f.fileno())
         tmp.replace(path)
+        # GC blob-dir litter: temp files from crashed uploads, and payload
+        # files no surviving index record references
+        bdir = self._data_dir / "blobs"
+        if bdir.is_dir():
+            referenced = {r["file"] for r in self._blobs.values()
+                          if "file" in r}
+            for p in bdir.iterdir():
+                if p.name.startswith(".up-") or p.name not in referenced:
+                    try:
+                        p.unlink()
+                    except OSError:
+                        pass
         dir_fd = os.open(self._data_dir, os.O_RDONLY)
         try:
             os.fsync(dir_fd)
@@ -248,6 +283,16 @@ class CoordinatorServer:
                 if item.header.get("conn_id") == conn_id:
                     del self._pending_acks[(queue, msg_id)]
                     self._queue_deliver(queue, item)
+            # abandon this connection's in-flight blob uploads
+            for up_id in [u for u, st in self._blob_uploads.items()
+                          if st.get("conn_id") == conn_id]:
+                st = self._blob_uploads.pop(up_id)
+                if "file" in st:
+                    st["file"].close()
+                    try:
+                        st["path"].unlink()
+                    except OSError:
+                        pass
             self._write_locks.pop(conn_id, None)
             self._conn_writers.pop(conn_id, None)
             writer.close()
@@ -416,6 +461,146 @@ class CoordinatorServer:
                 1 for (q, _) in self._pending_acks if q == h["queue"]
             )
             await self._send(conn_id, writer, {"id": rid, "ok": True, "len": n})
+
+        elif op == "blob_begin":
+            up_id = next(self._ids)
+            st: dict = {"conn_id": conn_id, "size": 0,
+                        "sha": hashlib.sha256()}
+            if self._data_dir is not None:
+                bdir = self._data_dir / "blobs"
+                bdir.mkdir(parents=True, exist_ok=True)
+                st["path"] = bdir / f".up-{up_id}"
+                st["file"] = st["path"].open("wb")
+            else:
+                st["buf"] = bytearray()
+            self._blob_uploads[up_id] = st
+            await self._send(conn_id, writer,
+                             {"id": rid, "ok": True, "upload_id": up_id})
+
+        elif op == "blob_chunk":
+            st = self._blob_uploads.get(h["upload_id"])
+            if st is None:
+                await self._send(conn_id, writer,
+                                 {"id": rid, "error": "no such upload"})
+                return
+            st["sha"].update(payload)
+            st["size"] += len(payload)
+            if "file" in st:
+                # file IO off the event loop: a slow disk must not stall
+                # every connection's dispatch
+                await asyncio.get_running_loop().run_in_executor(
+                    None, st["file"].write, payload
+                )
+            else:
+                st["buf"] += payload
+            await self._send(conn_id, writer, {"id": rid, "ok": True})
+
+        elif op == "blob_commit":
+            st = self._blob_uploads.pop(h["upload_id"], None)
+            if st is None:
+                await self._send(conn_id, writer,
+                                 {"id": rid, "error": "no such upload"})
+                return
+            name = h["name"]
+            sha = st["sha"].hexdigest()
+            rec = {"size": st["size"], "sha256": sha,
+                   "meta": h.get("meta") or {}}
+            if "file" in st:
+                def _finalize(f=st["file"], src=st["path"],
+                              dst=self._data_dir / "blobs" / sha):
+                    # flush+fsync of a multi-GB upload off the event loop
+                    # (a sync fsync here would stall every connection —
+                    # keepalives would miss TTLs behind one big commit);
+                    # content-addressed final name: identical re-pushes
+                    # and same-bytes-different-name blobs share one file
+                    f.flush()
+                    os.fsync(f.fileno())
+                    f.close()
+                    os.replace(src, dst)
+
+                await asyncio.get_running_loop().run_in_executor(
+                    None, _finalize
+                )
+                rec["file"] = sha
+                # durable like queue pushes: the ok reply PROMISES the
+                # blob survives a crash, so the index record must be
+                # fsynced, not merely flushed
+                await self._log_durable({"t": "blob", "name": name, **rec})
+            else:
+                self._blob_data[name] = bytes(st.pop("buf"))
+            old = self._blobs.get(name)
+            self._blobs[name] = rec
+            # GC a superseded payload file nothing references any more
+            if old and "file" in old and old["file"] != rec.get("file") \
+                    and not any(r.get("file") == old["file"]
+                                for r in self._blobs.values()):
+                try:
+                    (self._data_dir / "blobs" / old["file"]).unlink()
+                except OSError:
+                    pass
+            await self._send(conn_id, writer,
+                             {"id": rid, "ok": True, "size": rec["size"],
+                              "sha256": sha})
+
+        elif op == "blob_read":
+            rec = self._blobs.get(h["name"])
+            if rec is None:
+                await self._send(conn_id, writer,
+                                 {"id": rid, "ok": False, "missing": True})
+                return
+            off = max(0, int(h.get("offset", 0)))
+            ln = min(max(1, int(h.get("length", 1 << 20))), 4 << 20)
+            if "file" in rec:
+                path = self._data_dir / "blobs" / rec["file"]
+
+                def _read(path=path, off=off, ln=ln):
+                    with path.open("rb") as f:
+                        f.seek(off)
+                        return f.read(ln)
+
+                data = await asyncio.get_running_loop().run_in_executor(
+                    None, _read
+                )
+            else:
+                data = self._blob_data.get(h["name"], b"")[off:off + ln]
+            await self._send(
+                conn_id, writer,
+                {"id": rid, "ok": True, "size": rec["size"],
+                 "sha256": rec["sha256"], "meta": rec["meta"],
+                 "eof": off + len(data) >= rec["size"]},
+                data,
+            )
+
+        elif op == "blob_stat":
+            rec = self._blobs.get(h["name"])
+            await self._send(conn_id, writer,
+                             {"id": rid, "ok": rec is not None,
+                              **(rec and {k: rec[k] for k in
+                                          ("size", "sha256", "meta")} or {})})
+
+        elif op == "blob_list":
+            prefix = h.get("prefix", "")
+            items = {
+                n: {k: r[k] for k in ("size", "sha256", "meta")}
+                for n, r in self._blobs.items() if n.startswith(prefix)
+            }
+            await self._send(conn_id, writer,
+                             {"id": rid, "ok": True, "items": items})
+
+        elif op == "blob_delete":
+            rec = self._blobs.pop(h["name"], None)
+            self._blob_data.pop(h["name"], None)
+            if rec is not None and "file" in rec:
+                self._log({"t": "blobdel", "name": h["name"]})
+                # drop the payload file only when no other name shares it
+                if not any(r.get("file") == rec["file"]
+                           for r in self._blobs.values()):
+                    try:
+                        (self._data_dir / "blobs" / rec["file"]).unlink()
+                    except OSError:
+                        pass
+            await self._send(conn_id, writer,
+                             {"id": rid, "ok": rec is not None})
 
         elif op == "ping":
             await self._send(conn_id, writer, {"id": rid, "ok": True})
@@ -838,6 +1023,81 @@ class CoordinatorClient:
 
     async def queue_nack(self, queue: str, msg_id: int) -> None:
         await self._call({"op": "queue_nack", "queue": queue, "msg_id": msg_id})
+
+    # ---------------------------------------------------------------- blob API
+    async def blob_put(self, name: str, data, meta: Optional[dict] = None,
+                       chunk_size: int = 1 << 20) -> dict:
+        """Upload a blob: ``data`` is bytes or a filesystem path (streamed
+        in chunks — a multi-GB checkpoint never materialises in memory).
+        Returns {size, sha256}."""
+        resp, _ = await self._call({"op": "blob_begin"})
+        up = resp["upload_id"]
+
+        def chunks():
+            if isinstance(data, (bytes, bytearray, memoryview)):
+                b = bytes(data)
+                for i in range(0, max(len(b), 1), chunk_size):
+                    yield b[i:i + chunk_size]
+            else:
+                with open(data, "rb") as f:
+                    while True:
+                        b = f.read(chunk_size)
+                        if not b:
+                            return
+                        yield b
+
+        for c in chunks():
+            await self._call({"op": "blob_chunk", "upload_id": up}, c)
+        resp, _ = await self._call(
+            {"op": "blob_commit", "upload_id": up, "name": name,
+             "meta": meta or {}}
+        )
+        return {"size": resp["size"], "sha256": resp["sha256"]}
+
+    async def blob_get(self, name: str, dest=None,
+                       chunk_size: int = 1 << 20):
+        """Download a blob.  Returns the bytes, or — with ``dest`` (a
+        path) — streams to that file and returns {size, sha256, meta}."""
+        off = 0
+        sink = None  # opened lazily AFTER the first successful read — a
+        buf = bytearray()  # failed get must not truncate an existing dest
+        try:
+            while True:
+                resp, payload = await self._call(
+                    {"op": "blob_read", "name": name, "offset": off,
+                     "length": chunk_size}
+                )
+                if not resp.get("ok"):
+                    raise KeyError(f"no such blob: {name}")
+                if dest is not None:
+                    if sink is None:
+                        sink = open(dest, "wb")
+                    sink.write(payload)
+                else:
+                    buf += payload
+                off += len(payload)
+                if resp.get("eof") or not payload:
+                    meta = {"size": resp["size"], "sha256": resp["sha256"],
+                            "meta": resp.get("meta", {})}
+                    break
+        finally:
+            if sink is not None:
+                sink.close()
+        return meta if dest is not None else bytes(buf)
+
+    async def blob_stat(self, name: str) -> Optional[dict]:
+        resp, _ = await self._call({"op": "blob_stat", "name": name})
+        if not resp.get("ok"):
+            return None
+        return {k: resp[k] for k in ("size", "sha256", "meta")}
+
+    async def blob_list(self, prefix: str = "") -> dict[str, dict]:
+        resp, _ = await self._call({"op": "blob_list", "prefix": prefix})
+        return resp.get("items", {})
+
+    async def blob_delete(self, name: str) -> bool:
+        resp, _ = await self._call({"op": "blob_delete", "name": name})
+        return bool(resp.get("ok"))
 
     async def ping(self) -> bool:
         resp, _ = await self._call({"op": "ping"})

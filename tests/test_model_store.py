@@ -1,0 +1,266 @@
+"""Coordinator blob plane + model artifact distribution (VERDICT r3
+missing #4): a worker boots from a ``dyn://models/<name>`` ref, pulling
+native checkpoint + tokenizer from the coordinator store — only the
+pushing host needs the files on disk.  Ref: NATS object store publish,
+lib/llm/src/model_card/model.rs:150-199."""
+
+import asyncio
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.llm.model_store import (
+    is_model_ref, pull_model, push_model, resolve_model,
+)
+from dynamo_tpu.runtime.transports.coordinator import (
+    CoordinatorClient,
+    CoordinatorServer,
+)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# ---------------------------------------------------------------- blob plane
+def test_blob_roundtrip_memory():
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        c = await CoordinatorClient(srv.url).connect()
+        try:
+            data = bytes(range(256)) * 5000  # 1.28MB -> multiple chunks
+            info = await c.blob_put("x/a", data, meta={"k": "v"},
+                                    chunk_size=100_000)
+            assert info["size"] == len(data)
+            got = await c.blob_get("x/a", chunk_size=70_000)
+            assert got == data
+            st = await c.blob_stat("x/a")
+            assert st["size"] == len(data) and st["meta"] == {"k": "v"}
+            assert "x/a" in await c.blob_list("x/")
+            assert await c.blob_list("y/") == {}
+            # overwrite
+            await c.blob_put("x/a", b"small")
+            assert await c.blob_get("x/a") == b"small"
+            assert await c.blob_delete("x/a")
+            assert not await c.blob_delete("x/a")
+            with pytest.raises(KeyError):
+                await c.blob_get("x/a")
+        finally:
+            await c.close()
+            await srv.stop()
+
+    run(go())
+
+
+def test_blob_durable_survives_restart(tmp_path):
+    """Durable blobs re-index from the WAL + content-addressed files
+    after a coordinator restart."""
+    async def go():
+        srv = await CoordinatorServer(port=0, data_dir=str(tmp_path)).start()
+        c = await CoordinatorClient(srv.url).connect()
+        payload = np.random.default_rng(0).bytes(300_000)
+        await c.blob_put("m/w.bin", payload, chunk_size=64_000)
+        f = tmp_path / "src.bin"
+        f.write_bytes(b"file-sourced")
+        await c.blob_put("m/f.bin", f)  # path upload
+        await c.close()
+        await srv.stop()
+
+        srv2 = await CoordinatorServer(port=0, data_dir=str(tmp_path)).start()
+        c2 = await CoordinatorClient(srv2.url).connect()
+        try:
+            assert await c2.blob_get("m/w.bin") == payload
+            dest = tmp_path / "out.bin"
+            meta = await c2.blob_get("m/f.bin", dest)
+            assert dest.read_bytes() == b"file-sourced"
+            assert meta["size"] == len(b"file-sourced")
+        finally:
+            await c2.close()
+            await srv2.stop()
+
+    run(go())
+
+
+# ------------------------------------------------------------- model store
+def _make_model_dir(root: Path) -> Path:
+    """A minimal HF-style model dir (config + tokenizer + weights)."""
+    src = root / "hf"
+    src.mkdir()
+    (src / "config.json").write_text(json.dumps(
+        {"architectures": ["LlamaForCausalLM"], "vocab_size": 96,
+         "hidden_size": 32, "intermediate_size": 64,
+         "num_hidden_layers": 2, "num_attention_heads": 2,
+         "num_key_value_heads": 1, "max_position_embeddings": 128}))
+    from tokenizers import Tokenizer, models as tkm
+
+    tok = Tokenizer(tkm.WordLevel(
+        vocab={chr(97 + i): i for i in range(26)}, unk_token="a"))
+    tok.save(str(src / "tokenizer.json"))
+    (src / "model.safetensors").write_bytes(
+        np.random.default_rng(1).bytes(120_000))
+    return src
+
+
+def test_push_pull_only_pusher_has_files(tmp_path):
+    """Worker-host pull: the manifest + every file round-trips through
+    the store into a content-addressed cache dir; a second pull of the
+    same digest downloads nothing (works even after the blobs vanish)."""
+    src = _make_model_dir(tmp_path)
+
+    async def go():
+        srv = await CoordinatorServer(port=0,
+                                      data_dir=str(tmp_path / "coord")).start()
+        pusher = await CoordinatorClient(srv.url).connect()
+        worker = await CoordinatorClient(srv.url).connect()
+        try:
+            manifest = await push_model(pusher, "tiny-llama", src)
+            assert set(manifest["files"]) == {
+                "config.json", "tokenizer.json", "model.safetensors"
+            }
+            # "another host": a cache dir with NO source files anywhere near
+            cache_b = tmp_path / "worker-b-cache"
+            got = await pull_model(worker, "tiny-llama", cache_dir=cache_b)
+            for rel in manifest["files"]:
+                assert (got / rel).read_bytes() == (src / rel).read_bytes()
+            # the pulled dir is a bootable model dir
+            from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+            card = ModelDeploymentCard.from_hf_dir(str(got), name="t")
+            assert card.tokenizer_path
+
+            # cache hit: even with the store emptied, the pull resolves
+            for rel in manifest["files"]:
+                await worker.blob_delete(f"models/tiny-llama/{rel}")
+            again = await pull_model(worker, "tiny-llama", cache_dir=cache_b)
+            assert again == got
+
+            # dyn:// ref resolution (what --model-path accepts)
+            assert is_model_ref("dyn://models/tiny-llama")
+            p = await resolve_model("dyn://models/tiny-llama", worker,
+                                    cache_dir=cache_b)
+            assert Path(p) == got
+            assert await resolve_model("/plain/path") == "/plain/path"
+        finally:
+            await worker.close()
+            await pusher.close()
+            await srv.stop()
+
+    run(go())
+
+
+def test_pull_missing_model_errors(tmp_path):
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        c = await CoordinatorClient(srv.url).connect()
+        try:
+            with pytest.raises(FileNotFoundError):
+                await pull_model(c, "nope", cache_dir=tmp_path)
+        finally:
+            await c.close()
+            await srv.stop()
+
+    run(go())
+
+
+def test_concurrent_pulls_one_wins(tmp_path):
+    """Two workers on one host pulling simultaneously: both succeed, one
+    download wins the atomic rename, no torn cache dir."""
+    src = _make_model_dir(tmp_path)
+
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        a = await CoordinatorClient(srv.url).connect()
+        b = await CoordinatorClient(srv.url).connect()
+        try:
+            await push_model(a, "m", src)
+            cache = tmp_path / "shared-cache"
+            p1, p2 = await asyncio.gather(
+                pull_model(a, "m", cache_dir=cache),
+                pull_model(b, "m", cache_dir=cache),
+            )
+            assert p1 == p2
+            assert (p1 / "config.json").exists()
+            # no leftover temp dirs
+            assert [d for d in cache.iterdir()
+                    if d.name.startswith(".pull-")] == []
+        finally:
+            await a.close()
+            await b.close()
+            await srv.stop()
+
+    run(go())
+
+
+def test_pull_rejects_traversal_manifest(tmp_path):
+    """The manifest is untrusted: '..' or absolute file entries must
+    never write outside the cache."""
+    from dynamo_tpu.llm.model_store import manifest_key
+
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        c = await CoordinatorClient(srv.url).connect()
+        try:
+            for rel in ("../evil.txt", "/abs/evil.txt", "a/../../evil"):
+                await c.kv_put(manifest_key("bad"), {
+                    "name": "bad", "digest": "d" * 64,
+                    "files": {rel: {"size": 1, "sha256": "x"}},
+                })
+                with pytest.raises(IOError):
+                    await pull_model(c, "bad", cache_dir=tmp_path / "cache")
+            assert not (tmp_path / "evil.txt").exists()
+        finally:
+            await c.close()
+            await srv.stop()
+
+    run(go())
+
+
+def test_blob_overwrite_and_restart_gc(tmp_path):
+    """Durable overwrites GC the superseded payload file; restart GC
+    removes crashed-upload temp files and unreferenced payloads."""
+    async def go():
+        srv = await CoordinatorServer(port=0, data_dir=str(tmp_path)).start()
+        c = await CoordinatorClient(srv.url).connect()
+        bdir = tmp_path / "blobs"
+        await c.blob_put("a", b"version-one")
+        assert len(list(bdir.iterdir())) == 1
+        await c.blob_put("a", b"version-two")
+        files = [p.name for p in bdir.iterdir()]
+        assert len(files) == 1  # superseded payload unlinked
+        # litter the dir like a crashed upload + an orphan
+        (bdir / ".up-999").write_bytes(b"partial")
+        (bdir / ("f" * 64)).write_bytes(b"orphan")
+        await c.close()
+        await srv.stop()
+
+        srv2 = await CoordinatorServer(port=0, data_dir=str(tmp_path)).start()
+        c2 = await CoordinatorClient(srv2.url).connect()
+        try:
+            assert await c2.blob_get("a") == b"version-two"
+            names = {p.name for p in bdir.iterdir()}
+            assert ".up-999" not in names and ("f" * 64) not in names
+        finally:
+            await c2.close()
+            await srv2.stop()
+
+    run(go())
+
+
+def test_blob_get_failure_preserves_dest(tmp_path):
+    """A failed blob_get must not truncate an existing destination."""
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        c = await CoordinatorClient(srv.url).connect()
+        try:
+            dest = tmp_path / "precious.bin"
+            dest.write_bytes(b"keep me")
+            with pytest.raises(KeyError):
+                await c.blob_get("missing", dest)
+            assert dest.read_bytes() == b"keep me"
+        finally:
+            await c.close()
+            await srv.stop()
+
+    run(go())
