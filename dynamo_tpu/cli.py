@@ -34,37 +34,28 @@ MODELS_PREFIX = "models/"  # under {namespace}/
 # ------------------------------------------------------------ engine build ----
 
 
-async def _resolve_model_ref(args) -> None:
-    """``--model-path dyn://models/<name>`` → pull from the coordinator
-    blob store into the local cache and rewrite the arg to the local dir
-    (model-artifact distribution: only the pushing host needs the
-    checkpoint on disk)."""
-    mp = getattr(args, "model_path", None)
-    if mp is None:
-        return
-    from dynamo_tpu.llm.model_store import is_model_ref, resolve_model
-
-    if not is_model_ref(mp):
-        return
-    url = getattr(args, "coordinator", None)
-    if not url:
-        raise SystemExit(f"model ref {mp!r} needs --coordinator to pull from")
-    from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient
-
-    c = await CoordinatorClient(url).connect()
-    try:
-        args.model_path = await resolve_model(mp, c)
-        log.info("resolved %s -> %s", mp, args.model_path)
-    finally:
-        await c.close()
-
-
 def _build_local_engine(args) -> tuple[object, object]:
     """out=tpu|echo → (engine, card): the native JAX engine or the echo stub."""
     from dynamo_tpu.llm.model_card import ModelDeploymentCard
 
     if args.model_path is None:
         raise SystemExit(f"out={args.out} needs --model-path (weights + tokenizer)")
+    from dynamo_tpu.llm.model_store import is_model_ref, resolve_model_sync
+
+    if is_model_ref(args.model_path):
+        # dyn://models/<name>: pull from the coordinator blob store into
+        # the local cache (artifact distribution — only the pushing host
+        # needs the checkpoint on disk).  Covers run, serve graphs, and
+        # the colocated worker's two engines, since they all build here.
+        import os as _os
+
+        ref = args.model_path
+        args.model_path = resolve_model_sync(
+            ref,
+            getattr(args, "coordinator", None)
+            or _os.environ.get("DYNTPU_COORDINATOR"),
+        )
+        log.info("resolved %s -> %s", ref, args.model_path)
     is_gguf = args.model_path.endswith(".gguf")
     card = (
         ModelDeploymentCard.from_gguf(args.model_path, name=args.model_name)
@@ -182,7 +173,6 @@ async def _cmd_run(args) -> None:
     from dynamo_tpu.runtime import serde
 
     serde.register_llm_types()
-    await _resolve_model_ref(args)
     needs_runtime = args.out.startswith("dyn://") or args.inp.startswith("dyn://")
     runtime = await DistributedRuntime.connect(_runtime_config(args)) if needs_runtime else None
 

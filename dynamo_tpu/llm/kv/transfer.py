@@ -56,6 +56,14 @@ __all__ = [
 # (vllm patch nixl.py +394) vs its network path.
 _LOCAL_ENDPOINTS: dict[str, "KvTransferServer"] = {}
 
+# live path counters (observability + tests): a colocated deployment can
+# ASSERT its handoffs rode the device path, not host TCP staging —
+# "transfers took the fast path" becomes checkable instead of assumed
+stats = {
+    "local_write_calls": 0, "local_blocks": 0,
+    "tcp_write_calls": 0, "tcp_blocks": 0,
+}
+
 
 def _np_dtype(name: str):
     try:
@@ -198,6 +206,8 @@ class LocalKvTransferClient:
         pass
 
     async def write_blocks(self, block_ids, arr, request_id=None) -> None:
+        stats["local_write_calls"] += 1
+        stats["local_blocks"] += len(block_ids)
         await self._server.write_sink(
             [int(b) for b in block_ids], arr, request_id
         )
@@ -271,6 +281,8 @@ class KvTransferClient:
         """Push blocks into the peer's cache at ``block_ids`` (NIXL WRITE).
         ``request_id`` lets the receiver validate block ownership (a late
         write for an aborted request is dropped, not applied)."""
+        stats["tcp_write_calls"] += 1
+        stats["tcp_blocks"] += len(block_ids)
         meta, data = pack_blocks(arr)
         await self._call(
             {

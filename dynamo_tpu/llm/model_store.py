@@ -49,6 +49,15 @@ def manifest_key(name: str) -> str:
     return f"models/{name}"
 
 
+def _blob_key(name: str, rel: str) -> str:
+    """Blob key with the model name slash-quoted: 'meta/llama' +
+    'config.json' must never collide with model 'meta' + file
+    'llama/config.json'."""
+    from urllib.parse import quote
+
+    return f"models/{quote(name, safe='')}/{rel}"
+
+
 def is_model_ref(ref: str) -> bool:
     return isinstance(ref, str) and ref.startswith(_REF_PREFIX)
 
@@ -79,7 +88,7 @@ async def push_model(coordinator, name: str, model_dir: str | Path) -> dict:
     for p in _iter_files(root):
         rel = p.relative_to(root).as_posix()
         info = await coordinator.blob_put(
-            f"models/{name}/{rel}", p, meta={"model": name, "rel": rel}
+            _blob_key(name, rel), p, meta={"model": name, "rel": rel}
         )
         files[rel] = info
         log.info("pushed %s/%s (%d bytes)", name, rel, info["size"])
@@ -125,7 +134,7 @@ async def pull_model(coordinator, name: str,
                 )
             dest = tmp / rel
             dest.parent.mkdir(parents=True, exist_ok=True)
-            got = await coordinator.blob_get(f"models/{name}/{rel}", dest)
+            got = await coordinator.blob_get(_blob_key(name, rel), dest)
             if got["sha256"] != info["sha256"]:
                 raise IOError(
                     f"blob models/{name}/{rel}: digest mismatch "
@@ -156,3 +165,37 @@ async def resolve_model(ref: str, coordinator=None,
             "(--coordinator) to pull from"
         )
     return str(await pull_model(coordinator, _ref_name(ref), cache_dir))
+
+
+def resolve_model_sync(ref: str, coordinator_url: Optional[str],
+                       cache_dir: Optional[str | Path] = None) -> str:
+    """Blocking :func:`resolve_model` for synchronous callers (the engine
+    builders): the pull runs on a private event loop in a worker thread,
+    safe whether or not a loop is already running in this thread.
+
+    Caveat: this BLOCKS the calling thread — do not call it from the very
+    event loop that serves the target coordinator (an in-process server
+    could never answer the pull; production coordinators are separate
+    processes, so worker engine builders are fine)."""
+    if not is_model_ref(ref):
+        return ref
+    if not coordinator_url:
+        raise ValueError(
+            f"model ref {ref!r} needs a coordinator URL (--coordinator / "
+            "DYNTPU_COORDINATOR) to pull from"
+        )
+
+    async def go() -> str:
+        from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient
+
+        c = await CoordinatorClient(coordinator_url).connect()
+        try:
+            return await resolve_model(ref, c, cache_dir)
+        finally:
+            await c.close()
+
+    import asyncio
+    import concurrent.futures
+
+    with concurrent.futures.ThreadPoolExecutor(1) as ex:
+        return ex.submit(lambda: asyncio.run(go())).result()

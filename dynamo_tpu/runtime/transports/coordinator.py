@@ -1057,10 +1057,16 @@ class CoordinatorClient:
     async def blob_get(self, name: str, dest=None,
                        chunk_size: int = 1 << 20):
         """Download a blob.  Returns the bytes, or — with ``dest`` (a
-        path) — streams to that file and returns {size, sha256, meta}."""
+        path) — streams to ``dest``.part and renames on completion, so a
+        failed or interrupted get never truncates or half-overwrites an
+        existing destination.  Returns {size, sha256, meta}."""
+        import os as _os
+
         off = 0
-        sink = None  # opened lazily AFTER the first successful read — a
-        buf = bytearray()  # failed get must not truncate an existing dest
+        part = f"{dest}.part" if dest is not None else None
+        sink = None  # opened lazily after the first successful read
+        buf = bytearray()
+        ok = False
         try:
             while True:
                 resp, payload = await self._call(
@@ -1071,7 +1077,7 @@ class CoordinatorClient:
                     raise KeyError(f"no such blob: {name}")
                 if dest is not None:
                     if sink is None:
-                        sink = open(dest, "wb")
+                        sink = open(part, "wb")
                     sink.write(payload)
                 else:
                     buf += payload
@@ -1079,10 +1085,21 @@ class CoordinatorClient:
                 if resp.get("eof") or not payload:
                     meta = {"size": resp["size"], "sha256": resp["sha256"],
                             "meta": resp.get("meta", {})}
+                    ok = True
                     break
         finally:
             if sink is not None:
                 sink.close()
+            if dest is not None:
+                if ok:
+                    if sink is None:  # zero-byte blob: still produce dest
+                        open(part, "wb").close()
+                    _os.replace(part, dest)
+                else:
+                    try:
+                        _os.unlink(part)
+                    except OSError:
+                        pass
         return meta if dest is not None else bytes(buf)
 
     async def blob_stat(self, name: str) -> Optional[dict]:

@@ -27,11 +27,11 @@ async def amain(graph: str, service_name: str) -> None:
     mod_name, _, attr = graph.partition(":")
     sys.path.insert(0, os.getcwd())
     entry = getattr(importlib.import_module(mod_name), attr)
-    svc = next(s for s in entry.closure() if s.name == service_name)
+    svc = next(s for s in entry.closure(mod_name) if s.name == service_name)
 
     cfg = RuntimeConfig(coordinator_url=os.environ["DYNTPU_COORDINATOR"])
     runtime = await DistributedRuntime.connect(cfg)
-    await serve_service(svc, runtime, ServiceConfig.from_env())
+    await serve_service(svc, runtime, ServiceConfig.from_env(), graph=mod_name)
     log.info("%s serving (pid %s)", service_name, os.getpid())
 
     stop = asyncio.Event()

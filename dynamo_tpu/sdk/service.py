@@ -131,7 +131,13 @@ class DynamoService:
         self.dependencies: list[Dependency] = [
             m for m in vars(inner).values() if isinstance(m, Dependency)
         ]
-        self._links: list[DynamoService] = []
+        # link edges carry the MODULE that created them: graph modules all
+        # mutate these shared class objects at import, so a process that
+        # imported several graphs holds their UNION — serving one graph
+        # must be able to scope the closure to its own module's edges
+        # (production `dynamo serve` imports one graph per process, but
+        # in-process serving/tests import many)
+        self._links: list[tuple[DynamoService, Optional[str]]] = []
 
     # component name in the runtime (Namespace→Component→Endpoint)
     @property
@@ -148,14 +154,24 @@ class DynamoService:
     def link(self, other: "DynamoService") -> "DynamoService":
         """Add an edge to the serving graph (ref bento.py .link); chainable:
         ``Frontend.link(Processor).link(Worker)`` returns the tail so the
-        conventional one-liner builds a path graph from the entry."""
-        self._links.append(other)
+        conventional one-liner builds a path graph from the entry.  The
+        edge remembers the calling module, so a serve can scope to ONE
+        graph module's edges (see ``closure``)."""
+        import sys
+
+        mod = sys._getframe(1).f_globals.get("__name__")
+        self._links.append((other, mod))
         return other
 
-    def closure(self) -> list["DynamoService"]:
+    def closure(self, graph: Optional[str] = None) -> list["DynamoService"]:
         """Every service reachable from this entry via links and
         dependencies — the set `serve` actually deploys (unlinked services
-        defined in the module are pruned, ref test_link.py)."""
+        defined in the module are pruned, ref test_link.py).
+
+        ``graph``: follow only link edges created by that module.  Graph
+        modules mutate the SHARED component classes at import, so without
+        scoping, a process that imported graphs A and B would deploy
+        their union when serving either."""
         seen: dict[int, DynamoService] = {}
 
         def visit(svc: DynamoService) -> None:
@@ -164,13 +180,14 @@ class DynamoService:
             seen[id(svc)] = svc
             for dep in svc.dependencies:
                 visit(dep.target)
-            for linked in svc._links:
-                visit(linked)
+            for linked, mod in svc._links:
+                if graph is None or mod == graph:
+                    visit(linked)
 
         visit(self)
         return list(seen.values())
 
-    def boot_order(self) -> list["DynamoService"]:
+    def boot_order(self, graph: Optional[str] = None) -> list["DynamoService"]:
         """Closure in reverse-topological order (postorder DFS): every
         service appears after its dependencies/links, so booting in list
         order guarantees endpoints exist before their dependents start."""
@@ -183,8 +200,9 @@ class DynamoService:
             seen.add(id(svc))
             for dep in svc.dependencies:
                 visit(dep.target)
-            for linked in svc._links:
-                visit(linked)
+            for linked, mod in svc._links:
+                if graph is None or mod == graph:
+                    visit(linked)
             order.append(svc)
 
         visit(self)

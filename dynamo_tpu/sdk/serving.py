@@ -63,6 +63,7 @@ async def serve_service(
     runtime: DistributedRuntime,
     config: Optional[ServiceConfig] = None,
     handle: Optional[ServeHandle] = None,
+    graph: Optional[str] = None,
 ):
     """Instantiate one service and register its endpoints (the
     serve_dynamo.py:57 analogue).  Returns the inner instance."""
@@ -75,9 +76,12 @@ async def serve_service(
             handle.clients.append(client)
     # per-service YAML/env args land on the instance before __init__, and
     # the runtime itself so components can build ad-hoc ServiceClients /
-    # reach the coordinator (prefill queue, KV events)
+    # reach the coordinator (prefill queue, KV events); the service object
+    # + graph module let components follow their own link edges
     obj.service_config = (config or ServiceConfig.from_env()).for_service(svc.name)
     obj.dynamo_runtime = runtime
+    obj.dynamo_service = svc
+    obj.dynamo_graph = graph
     obj.__init__()
 
     for hook in svc.on_start_hooks:
@@ -94,16 +98,31 @@ async def serve_graph(
     entry: DynamoService,
     config: Optional[ServiceConfig] = None,
     runtime_config: Optional[RuntimeConfig] = None,
+    graph: Optional[str] = None,
 ) -> ServeHandle:
     """Serve the entry's whole closure in this process (one runtime + lease
     per service, like separate workers would hold) — the test seam the
-    reference gets from its sdk test pipeline (tests/test_e2e.py)."""
+    reference gets from its sdk test pipeline (tests/test_e2e.py).
+
+    ``graph``: the graph MODULE name whose link edges define the closure
+    — pass it whenever this process may have imported other graph modules
+    (they all mutate the shared component classes; see closure())."""
+    if graph is not None and entry._links and len(entry.boot_order(graph)) == 1 \
+            and len(entry.boot_order()) > 1:
+        # a typo'd / mismatched module name would otherwise silently
+        # deploy a one-node graph
+        raise ValueError(
+            f"graph {graph!r} matches no link edges from {entry.name} "
+            f"(edges were created by "
+            f"{sorted({m for _, m in entry._links if m})}); pass the "
+            "module that built this graph's chain"
+        )
     handle = ServeHandle()
     # dependencies first so their endpoints exist when dependents boot
-    for svc in entry.boot_order():
+    for svc in entry.boot_order(graph):
         rt = await DistributedRuntime.connect(runtime_config)
         handle.runtimes.append(rt)
-        obj = await serve_service(svc, rt, config, handle)
+        obj = await serve_service(svc, rt, config, handle, graph=graph)
         handle.instances[svc.name] = obj
     return handle
 
@@ -186,7 +205,7 @@ class ServeSupervisor:
             self._coordinator = await CoordinatorServer(port=0).start()
             self.coordinator_url = self._coordinator.url
         entry = self._load_entry()
-        for svc in entry.boot_order():
+        for svc in entry.boot_order(self.graph.partition(":")[0]):
             for worker_idx in range(svc.workers):
                 # each worker process gets its own exclusive chips
                 self._spawn(svc, worker_idx, self.allocator.allocate(svc))
@@ -213,7 +232,7 @@ class ServeSupervisor:
     async def watch(self) -> None:
         """Restart crashed workers until stop() (watcher loop parity)."""
         entry = self._load_entry()
-        by_name = {s.name: s for s in entry.closure()}
+        by_name = {s.name: s for s in entry.closure(self.graph.partition(":")[0])}
         while self.procs:
             await asyncio.sleep(0.5)
             for key, proc in list(self.procs.items()):

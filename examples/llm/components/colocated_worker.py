@@ -1,0 +1,108 @@
+"""ColocatedWorker — BOTH disagg roles in ONE process (the blessed
+same-slice shape).
+
+On TPU, one process drives one slice.  Splitting prefill and decode into
+separate processes on the SAME slice would force every KV handoff through
+host RAM + TCP; hosting both roles in one process makes the transfer URL
+resolve to the in-process endpoint registry, so the handoff is
+device-array gather → device_put → donated scatter — ICI under a sharded
+mesh, on-chip otherwise, zero host staging (llm/kv/transfer.py
+LocalKvTransferClient; the reference needs NIXL prepped descriptors for
+this, vllm patch nixl.py +394).
+
+What disagg still buys colocated: the decode engine's batches never
+absorb prompt tokens — prompts crunch in a dedicated prefill engine with
+its own cache sizing and batch shape, and decode ITL stays flat.  Use
+separate-process `disagg.py` only ACROSS slices/hosts, where the DCN path
+is the only option anyway.
+
+Config keys: everything TpuWorker takes, plus a ``prefill.`` prefix to
+override the prefill engine's sizing (defaults mirror the decode side).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.sdk import async_on_start, dynamo_endpoint, service
+
+from .worker import NAMESPACE, backend_input, build_engine, wire_output
+
+log = logging.getLogger("examples.colocated_worker")
+
+
+@service(dynamo={"namespace": NAMESPACE}, resources={"tpu": 1})
+class ColocatedWorker:
+    """Decode engine + DecodeWorker + prefill engine + PrefillWorker in
+    one process: the same-slice disaggregated serving unit."""
+
+    def __init__(self):
+        self._cfg = dict(self.service_config)
+        self.engine = None          # DecodeWorker wrapping the decode engine
+        self.prefill = None         # PrefillWorker loop
+        self._prefill_task = None
+
+    @async_on_start
+    async def boot(self):
+        from dynamo_tpu.llm.disagg_router import (
+            DisaggregatedRouter,
+            DisaggRouterConf,
+        )
+        from dynamo_tpu.llm.workers import DecodeWorker
+        from dynamo_tpu.llm.workers import PrefillWorker as EnginePrefillWorker
+
+        cfg = self._cfg
+        rt = self.dynamo_runtime
+        decode_engine, self.card = build_engine(cfg)
+        # prefill engine: same model, its own cache/batch sizing
+        pcfg = dict(cfg)
+        for k, v in list(cfg.items()):
+            if k.startswith("prefill."):
+                pcfg[k[len("prefill."):]] = v
+        prefill_engine, _ = build_engine(pcfg)
+
+        conf = DisaggRouterConf(
+            max_local_prefill_length=int(cfg.get("max-local-prefill-length", 0)),
+        )
+        self.engine = await DecodeWorker(
+            decode_engine,
+            coordinator=rt.coordinator,
+            namespace=NAMESPACE,
+            router=DisaggregatedRouter(conf, namespace=NAMESPACE),
+        ).start()
+        self.prefill = EnginePrefillWorker(
+            prefill_engine, rt.coordinator, NAMESPACE
+        )
+        self._prefill_task = asyncio.ensure_future(self.prefill.run())
+        from dynamo_tpu.cli import _attach_worker_publishers
+
+        _attach_worker_publishers(rt, self.engine, NAMESPACE)
+
+    async def shutdown(self):
+        if self.prefill is not None:
+            self.prefill.request_stop()
+        if self._prefill_task is not None:
+            try:
+                await asyncio.wait_for(self._prefill_task, timeout=2)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                self._prefill_task.cancel()
+        eng = self.engine
+        if hasattr(eng, "stop"):
+            await eng.stop()
+            eng = eng.engine
+        if hasattr(eng, "shutdown"):
+            eng.shutdown()
+        if self.prefill is not None:
+            peng = self.prefill.engine
+            if hasattr(peng, "shutdown"):
+                peng.shutdown()
+
+    @dynamo_endpoint
+    async def generate(self, req: dict):
+        ctx = Context(backend_input(req))
+        async for out in self.engine.generate(ctx):
+            yield wire_output(out)
+            if out.finished:
+                return

@@ -29,7 +29,29 @@ class Processor:
     @async_on_start
     async def boot(self):
         rt = self.dynamo_runtime
-        self.worker_client = ServiceClient(rt, TpuWorker)
+        # the worker this processor targets comes from ITS outgoing link
+        # edge in the serving graph (Frontend.link(Processor).link(X)) —
+        # a YAML `worker:` key overrides for ad-hoc wiring
+        worker_cls = None
+        if self._cfg.get("worker") == "colocated":
+            from .colocated_worker import ColocatedWorker
+
+            worker_cls = ColocatedWorker
+        elif self._cfg.get("worker") in (None, "tpu"):
+            svc = getattr(self, "dynamo_service", None)
+            graph = getattr(self, "dynamo_graph", None)
+            if svc is not None and self._cfg.get("worker") is None:
+                # only generate-serving link targets qualify: in router
+                # graphs the processor's edge goes to the Router (whose
+                # `route` endpoint is consulted separately)
+                linked = [
+                    t for t, m in svc._links
+                    if (graph is None or m == graph)
+                    and any(e.name == "generate" for e in t.endpoints)
+                ]
+                if linked:
+                    worker_cls = linked[0]
+        self.worker_client = ServiceClient(rt, worker_cls or TpuWorker)
         if self._cfg.get("router") == "kv":
             from .kv_router import Router
 

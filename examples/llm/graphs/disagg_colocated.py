@@ -1,0 +1,16 @@
+"""Same-slice disaggregated serving — THE default disagg shape on TPU.
+
+One ColocatedWorker process per slice hosts both roles, so every KV
+handoff is device-to-device (ICI / on-chip), never host TCP.  Use
+``disagg.py`` (separate PrefillWorker processes) only across
+slices/hosts, where DCN staging is the only option anyway.
+
+Run: dynamo serve examples.llm.graphs.disagg_colocated:Frontend \\
+         -f examples/llm/configs/disagg_colocated.yaml
+"""
+
+from examples.llm.components.colocated_worker import ColocatedWorker
+from examples.llm.components.frontend import Frontend
+from examples.llm.components.processor import Processor
+
+Frontend.link(Processor).link(ColocatedWorker)

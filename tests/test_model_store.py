@@ -264,3 +264,60 @@ def test_blob_get_failure_preserves_dest(tmp_path):
             await srv.stop()
 
     run(go())
+
+
+def test_resolve_model_sync(tmp_path):
+    """The blocking resolver used by the (synchronous) engine builders
+    works from inside a running event loop and from plain sync code."""
+    from dynamo_tpu.llm.model_store import resolve_model_sync
+
+    src = _make_model_dir(tmp_path)
+
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        c = await CoordinatorClient(srv.url).connect()
+        try:
+            await push_model(c, "s", src)
+            # production shape: the engine builder blocks ITS thread while
+            # the coordinator lives elsewhere — so call off-loop here (the
+            # in-process server must keep serving while we block)
+            p = await asyncio.to_thread(
+                resolve_model_sync, "dyn://models/s", srv.url,
+                tmp_path / "cache",
+            )
+            assert (Path(p) / "config.json").exists()
+            assert resolve_model_sync("/plain", None) == "/plain"
+            with pytest.raises(ValueError):
+                resolve_model_sync("dyn://models/s", None)
+        finally:
+            await c.close()
+            await srv.stop()
+
+    run(go())
+
+
+def test_blob_key_no_collision_across_slash_names(tmp_path):
+    """Model 'meta/llama' file 'config.json' must not collide with model
+    'meta' file 'llama/config.json'."""
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        c = await CoordinatorClient(srv.url).connect()
+        try:
+            a = tmp_path / "a" / "llama"
+            a.mkdir(parents=True)
+            (a / "config.json").write_text("A")
+            b = tmp_path / "b"
+            b.mkdir()
+            (b / "llama").mkdir()
+            (b / "llama" / "config.json").write_text("B")
+            await push_model(c, "meta/llama", tmp_path / "a" / "llama")
+            await push_model(c, "meta", b)
+            p1 = await pull_model(c, "meta/llama", cache_dir=tmp_path / "c1")
+            p2 = await pull_model(c, "meta", cache_dir=tmp_path / "c2")
+            assert (p1 / "config.json").read_text() == "A"
+            assert (p2 / "llama" / "config.json").read_text() == "B"
+        finally:
+            await c.close()
+            await srv.stop()
+
+    run(go())
