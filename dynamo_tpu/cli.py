@@ -278,10 +278,17 @@ async def _batch(engine, model_name: str, path: Path, args) -> None:
 def _attach_worker_publishers(runtime, engine, namespace: str) -> None:
     """Real-engine worker: publish KV events + ForwardPassMetrics so the
     smart router and metrics component see this worker (publisher.rs
-    parity).  No-op for engines without a core (echo, remote clients)."""
-    core = getattr(engine, "core", None)
-    if core is None and engine is not None and hasattr(engine, "_engine"):
-        core = getattr(engine._engine, "core", None)  # pipeline-wrapped engine
+    parity).  No-op for engines without a core (echo, remote clients).
+    Unwraps pipeline (``._engine``) and DecodeWorker (``.engine``)
+    wrappers until an EngineCore surfaces."""
+    core = None
+    seen = set()
+    while engine is not None and id(engine) not in seen:
+        seen.add(id(engine))
+        core = getattr(engine, "core", None)
+        if core is not None:
+            break
+        engine = getattr(engine, "_engine", None) or getattr(engine, "engine", None)
     if core is None or not hasattr(core, "block_manager"):
         return
     from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, KvMetricsPublisher

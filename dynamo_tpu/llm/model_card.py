@@ -76,6 +76,15 @@ class ModelDeploymentCard:
             chat_template = sep.read_text()
 
         tok = d / "tokenizer.json"
+        if not tok.exists() and (d / "tokenizer.model").exists():
+            # sentencepiece-only checkpoint (older Llama/Mistral exports):
+            # materialise an equivalent tokenizer.json once
+            from dynamo_tpu.llm.sentencepiece import materialize_tokenizer
+
+            try:
+                tok = materialize_tokenizer(d / "tokenizer.model")
+            except Exception:
+                pass  # unparseable/SP-BPE: card carries no tokenizer
         return cls(
             name=name or d.name,
             model_path=str(d),

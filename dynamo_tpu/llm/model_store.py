@@ -134,7 +134,14 @@ async def pull_model(coordinator, name: str,
                 )
             dest = tmp / rel
             dest.parent.mkdir(parents=True, exist_ok=True)
-            got = await coordinator.blob_get(_blob_key(name, rel), dest)
+            try:
+                got = await coordinator.blob_get(_blob_key(name, rel), dest)
+            except KeyError:
+                # stores written before name-quoting used the raw name
+                legacy = f"models/{name}/{rel}"
+                if legacy == _blob_key(name, rel):
+                    raise
+                got = await coordinator.blob_get(legacy, dest)
             if got["sha256"] != info["sha256"]:
                 raise IOError(
                     f"blob models/{name}/{rel}: digest mismatch "

@@ -27,7 +27,19 @@ class TokenizerWrapper:
 
         p = Path(path)
         if p.is_dir():
-            p = p / "tokenizer.json"
+            if not (p / "tokenizer.json").exists() \
+                    and (p / "tokenizer.model").exists():
+                # sentencepiece-only checkpoint: materialise an equivalent
+                # tokenizer.json once (llm/sentencepiece.py)
+                from dynamo_tpu.llm.sentencepiece import materialize_tokenizer
+
+                p = materialize_tokenizer(p / "tokenizer.model")
+            else:
+                p = p / "tokenizer.json"
+        elif p.suffix == ".model":
+            from dynamo_tpu.llm.sentencepiece import materialize_tokenizer
+
+            p = materialize_tokenizer(p)
         return cls(Tokenizer.from_file(str(p)))
 
     def encode(self, text: str, add_special_tokens: bool = True) -> list[int]:

@@ -53,8 +53,10 @@ class ColocatedWorker:
         from dynamo_tpu.llm.workers import DecodeWorker
         from dynamo_tpu.llm.workers import PrefillWorker as EnginePrefillWorker
 
-        cfg = self._cfg
         rt = self.dynamo_runtime
+        from .worker import resolve_cfg_model
+
+        cfg = await resolve_cfg_model(self._cfg, rt)
         decode_engine, self.card = build_engine(cfg)
         # prefill engine: same model, its own cache/batch sizing
         pcfg = dict(cfg)

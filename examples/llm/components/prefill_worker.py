@@ -29,8 +29,10 @@ class PrefillWorker:
     async def boot(self):
         from dynamo_tpu.llm.workers import PrefillWorker as EnginePrefillWorker
 
-        engine, _card = build_engine(self._cfg)
+        from .worker import resolve_cfg_model
+
         rt = self.dynamo_runtime
+        engine, _card = build_engine(await resolve_cfg_model(self._cfg, rt))
         self.worker = EnginePrefillWorker(engine, rt.coordinator, NAMESPACE)
         self._task = asyncio.ensure_future(self.worker.run())
 

@@ -32,6 +32,21 @@ log = logging.getLogger("examples.worker")
 NAMESPACE = "dynamo"
 
 
+async def resolve_cfg_model(cfg: dict, rt) -> dict:
+    """Pre-resolve a ``dyn://models/<name>`` model-path ASYNCHRONOUSLY on
+    the runtime loop: the engine builder's sync resolver would block the
+    loop for the whole pull and starve the coordinator lease keepalives
+    (a multi-GB checkpoint takes longer than a 10s TTL)."""
+    mp = cfg.get("model-path")
+    if mp and rt is not None:
+        from dynamo_tpu.llm.model_store import is_model_ref, resolve_model
+
+        if is_model_ref(mp):
+            cfg = dict(cfg)
+            cfg["model-path"] = await resolve_model(mp, rt.coordinator)
+    return cfg
+
+
 def build_engine(cfg: dict):
     """(engine, card) from a service config dict (shared by TpuWorker and
     PrefillWorker so both sides of a disagg pair agree on the model)."""
@@ -113,9 +128,9 @@ class TpuWorker:
 
     @async_on_start
     async def boot(self):
-        cfg = self._cfg
-        self.engine, self.card = build_engine(cfg)
         rt = getattr(self, "dynamo_runtime", None)
+        cfg = await resolve_cfg_model(self._cfg, rt)
+        self.engine, self.card = build_engine(cfg)
         if cfg.get("remote-prefill") and rt is not None:
             from dynamo_tpu.llm.disagg_router import (
                 DisaggregatedRouter,

@@ -1,0 +1,145 @@
+"""SentencePiece tokenizer.model support (VERDICT r3 missing #6): the
+pure-Python ModelProto parser + Unigram materialisation, without the
+sentencepiece package.  The test builds a ModelProto BY HAND (protobuf
+wire encoding) so the parser is validated against the real format."""
+
+import struct
+from pathlib import Path
+
+import pytest
+
+from dynamo_tpu.llm.sentencepiece import (
+    BYTE,
+    CONTROL,
+    UNKNOWN,
+    build_hf_tokenizer,
+    materialize_tokenizer,
+    parse_model_proto,
+)
+
+
+# -------------------------------------------------- protobuf wire helpers --
+def _vint(v: int) -> bytes:
+    if v < 0:
+        v += 1 << 64
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _key(fnum: int, wt: int) -> bytes:
+    return _vint((fnum << 3) | wt)
+
+
+def _len_field(fnum: int, data: bytes) -> bytes:
+    return _key(fnum, 2) + _vint(len(data)) + data
+
+
+def _piece(text: str, score: float, ptype: int | None = None) -> bytes:
+    body = _len_field(1, text.encode())
+    body += _key(2, 5) + struct.pack("<f", score)
+    if ptype is not None:
+        body += _key(3, 0) + _vint(ptype)
+    return _len_field(1, body)
+
+
+def _make_model(pieces, unk_id=0, add_dummy_prefix=True) -> bytes:
+    data = b"".join(_piece(*p) for p in pieces)
+    trainer = (_key(3, 0) + _vint(1)            # model_type UNIGRAM
+               + _key(40, 0) + _vint(unk_id)
+               + _key(41, 0) + _vint(1)
+               + _key(42, 0) + _vint(2)
+               + _key(43, 0) + _vint(-1))       # pad_id -1 (negative varint)
+    norm = _key(3, 0) + _vint(1 if add_dummy_prefix else 0)
+    return data + _len_field(2, trainer) + _len_field(3, norm)
+
+
+PIECES = [
+    ("<unk>", 0.0, UNKNOWN),
+    ("<s>", 0.0, CONTROL),
+    ("</s>", 0.0, CONTROL),
+    ("▁hello", -1.0, None),
+    ("▁world", -1.5, None),
+    ("▁", -2.0, None),
+    ("hell", -3.0, None),
+    ("o", -3.5, None),
+    ("w", -3.6, None),
+    ("r", -3.7, None),
+    ("l", -3.8, None),
+    ("d", -3.9, None),
+    ("he", -4.0, None),
+]
+
+
+def test_parse_model_proto():
+    sp = parse_model_proto(_make_model(PIECES))
+    assert [p for p, _, _ in sp.pieces][:3] == ["<unk>", "<s>", "</s>"]
+    assert sp.pieces[3][1] == -1.0
+    assert sp.pieces[1][2] == CONTROL
+    assert sp.model_type == 1
+    assert sp.unk_id == 0 and sp.bos_id == 1 and sp.eos_id == 2
+    assert sp.pad_id == -1  # negative varint round-trips
+    assert sp.add_dummy_prefix
+
+
+def test_materialized_tokenizer_encodes_like_sentencepiece():
+    tok = build_hf_tokenizer(parse_model_proto(_make_model(PIECES)))
+    ids = tok.encode("hello world").ids
+    pieces = [p for p, _, _ in PIECES]
+    assert [pieces[i] for i in ids] == ["▁hello", "▁world"]
+    # round-trip decode restores the text (dummy prefix stripped)
+    assert tok.decode(ids) == "hello world"
+    # control pieces are special: skipped on decode
+    ids2 = [1] + ids + [2]
+    assert tok.decode(ids2, skip_special_tokens=True) == "hello world"
+
+
+def test_no_dummy_prefix_variant():
+    tok = build_hf_tokenizer(
+        parse_model_proto(_make_model(PIECES, add_dummy_prefix=False))
+    )
+    ids = tok.encode("hello").ids
+    pieces = [p for p, _, _ in PIECES]
+    # without the dummy prefix, "hello" can't start with "▁hello"
+    assert [pieces[i] for i in ids][0] != "▁hello"
+
+
+def test_sp_bpe_rejected():
+    data = _make_model(PIECES)
+    # flip model_type to BPE inside a fresh trainer spec
+    bad = b"".join(_piece(*p) for p in PIECES) + _len_field(
+        2, _key(3, 0) + _vint(2)
+    )
+    sp = parse_model_proto(bad)
+    with pytest.raises(NotImplementedError):
+        build_hf_tokenizer(sp)
+    assert parse_model_proto(data)  # sanity: unigram still fine
+
+
+def test_materialize_and_wrapper_discovery(tmp_path):
+    (tmp_path / "tokenizer.model").write_bytes(_make_model(PIECES))
+    out = materialize_tokenizer(tmp_path / "tokenizer.model")
+    assert out == tmp_path / "tokenizer.json"
+    # idempotent
+    assert materialize_tokenizer(tmp_path / "tokenizer.model") == out
+
+    from dynamo_tpu.llm.tokenizer import TokenizerWrapper
+
+    tw = TokenizerWrapper.from_file(tmp_path)  # dir with only .model
+    assert tw.decode(tw.encode("hello world", add_special_tokens=False)) \
+        == "hello world"
+
+    # model card discovery
+    import json
+
+    (tmp_path / "config.json").write_text(json.dumps(
+        {"architectures": ["LlamaForCausalLM"], "eos_token_id": 2}))
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+    card = ModelDeploymentCard.from_hf_dir(str(tmp_path), name="sp")
+    assert card.tokenizer_path and card.tokenizer_path.endswith("tokenizer.json")
