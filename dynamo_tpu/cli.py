@@ -130,9 +130,20 @@ def _build_local_engine(args) -> tuple[object, object]:
             "int8" if getattr(args, "kv_cache_dtype", "model") == "int8" else None
         ),
         spec_tokens=int(getattr(args, "spec_tokens", 0) or 0),
+        draft_num_blocks=int(getattr(args, "spec_draft_num_blocks", 0) or 0),
     )
+    draft = None
+    dpath = getattr(args, "spec_draft_model", None)
+    if dpath:
+        if cfg.spec_tokens <= 0:
+            raise SystemExit("--spec-draft-model requires --spec-tokens > 0")
+        # draft-model speculation: a small same-tokenizer model proposes,
+        # the target verifies (engine/draft.py).  Loads bf16, unsharded.
+        dcfg, dparams = load_model_dir(dpath, dtype=dtype or "bfloat16")
+        draft = (LlamaModel(dcfg), dparams)
     core = EngineCore(
-        model, params, cfg, mesh=mesh, eos_token_ids=card.eos_token_ids or None
+        model, params, cfg, mesh=mesh,
+        eos_token_ids=card.eos_token_ids or None, draft=draft,
     )
     return AsyncLLMEngine(core).start(), card
 
@@ -632,8 +643,16 @@ def _parser() -> argparse.ArgumentParser:
                      "native checkpoint's stored dtype)")
     run.add_argument("--max-batch-size", type=int, default=8)
     run.add_argument("--spec-tokens", type=int, default=0,
-                     help="prompt-lookup speculative decoding: verify up to "
-                     "N proposed tokens per dispatch (greedy requests only)")
+                     help="speculative decoding: verify up to N proposed "
+                     "tokens per dispatch (rejection-sampled — exact at "
+                     "any temperature); proposals come from prompt-lookup "
+                     "n-grams, or a draft model with --spec-draft-model")
+    run.add_argument("--spec-draft-model", default=None,
+                     help="small same-tokenizer model dir: draft-model "
+                     "speculation instead of n-gram lookup")
+    run.add_argument("--spec-draft-num-blocks", type=int, default=0,
+                     help="draft cache block count (0 = same as "
+                     "--num-blocks; shrink on HBM-tight deployments)")
     run.add_argument("--kv-cache-dtype", choices=["model", "int8"],
                      default="model",
                      help="model = cache in the model dtype; int8 = "

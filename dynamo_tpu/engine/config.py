@@ -47,6 +47,11 @@ class EngineConfig:
     # penalties/logprobs/bias/min_p/JSON mode).  0 = off.
     spec_tokens: int = 0
     spec_ngram: int = 3
+    # draft-model speculation (engine/draft.py): block count of the
+    # draft's own paged cache.  0 = same count as the target's — shrink
+    # it on HBM-tight deployments (the draft cache costs
+    # L_draft/L_target of the target cache at equal counts).
+    draft_num_blocks: int = 0
     # sequence-parallel (ring attention) prefill: prompts at least this
     # long (with no cached prefix) prefill in ONE dispatch with the
     # sequence sharded over the mesh's "data" axis — context parallelism

@@ -192,10 +192,35 @@ class EngineCore:
         mesh: Optional[jax.sharding.Mesh] = None,
         eos_token_ids: Optional[list[int]] = None,
         grammar: Optional[JsonGrammar] = None,
+        draft: Optional[tuple] = None,
     ):
         self.model = model
         self.config = config
         self.mesh = mesh
+        # draft-model speculation: (draft_model, draft_params) with the
+        # same tokenizer/vocab as the target — proposals come from the
+        # draft (engine/draft.py) instead of n-gram lookup; the verify
+        # pass is unchanged (greedy point-mass proposals keep it exact)
+        self.draft = None
+        if draft is not None:
+            if config.spec_tokens <= 0:
+                # a silently-inactive draft would be a lie to the operator
+                raise ValueError(
+                    "a draft model requires spec_tokens > 0 "
+                    "(--spec-tokens) to ever propose"
+                )
+            from dynamo_tpu.engine.draft import DraftProposer
+
+            dmodel, dparams = draft
+            if dmodel.config.vocab_size != model.config.vocab_size:
+                raise ValueError(
+                    "draft model must share the target's vocab "
+                    f"({dmodel.config.vocab_size} != {model.config.vocab_size})"
+                )
+            self.draft = DraftProposer(
+                dmodel, dparams, config,
+                num_blocks=config.draft_num_blocks or None,
+            )
         self.eos_token_ids = set(eos_token_ids or [])
         # JSON-mode grammar: compiled tables (host) + lazy device upload.
         # attach_grammar_tokenizer defers the ~1s vocab compile to the
@@ -1240,6 +1265,11 @@ class EngineCore:
         props: dict[int, list[int]] = {}
         rows: list[EngineRequest] = []
         any_prop = False
+        # draft-model proposals for the whole batch in one dispatch;
+        # rows the draft can't serve fall back to n-gram lookup below
+        draft_props: dict[int, list[int]] = {}
+        if self.draft is not None:
+            draft_props = self.draft.propose(active, k, m)
         for req in active:
             i = req.slot
             temp[i] = req.sampling.temperature
@@ -1253,7 +1283,9 @@ class EngineCore:
             limit = self._grow_blocks(req, s)
             if limit is None:
                 continue
-            prop = propose_ngram(req.seq.tokens, cfg.spec_ngram, k)
+            prop = draft_props.get(i) or propose_ngram(
+                req.seq.tokens, cfg.spec_ngram, k
+            )
             prop = prop[: max(0, limit - (p + 1))]  # KV positions stay in range
             props[i] = prop
             any_prop = any_prop or bool(prop)
@@ -1537,6 +1569,8 @@ class EngineCore:
     def _finish_slot(self, req: EngineRequest, reason: FinishReason, emitted: bool = False) -> None:
         if req.slot >= 0 and self.slots[req.slot] is req:
             self.slots[req.slot] = None
+            if self.draft is not None:
+                self.draft.release(req.slot)
         # drop unresolved reservations (commit resolved the rest) so any
         # joiners waiting on us take over instead of hanging
         for h, bid in req.reserved_pairs:

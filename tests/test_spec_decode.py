@@ -286,3 +286,87 @@ def test_spec_skips_batch_with_low_proposal_coverage(monkeypatch):
     assert run(marked_rows=1).spec_steps == 0
     # 3 proposing rows of 4: speculation engages
     assert run(marked_rows=3).spec_steps > 0
+
+
+# ------------------------------------------------------ draft-model spec ----
+def _drain_engine(core, prompt, n, rid="d", **samp):
+    outs = []
+    core.submit(EngineRequest(
+        request_id=rid, prompt=list(prompt),
+        sampling=SamplingOptions(**samp),
+        stops=StopConditions(max_tokens=n, ignore_eos=True),
+        emit=outs.append,
+    ))
+    for _ in range(600):
+        if not core.step():
+            break
+    return [t for o in outs for t in o.token_ids]
+
+
+def test_draft_model_identical_to_target_accepts_everything():
+    """Draft == target: every greedy proposal verifies, so the stream is
+    plain greedy decoding at ~1/(k+1) the target dispatches."""
+    cfg = ModelConfig.tiny()
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    prompt = [5, 6, 7, 8, 9]
+
+    base = EngineCore(model, params, _cfg(), eos_token_ids=[])
+    want = _drain_engine(base, prompt, 24, "b", temperature=0.0)
+
+    spec = EngineCore(model, params, _cfg(spec_tokens=4), eos_token_ids=[],
+                      draft=(model, params))
+    got = _drain_engine(spec, prompt, 24, "s", temperature=0.0)
+    assert got == want
+    assert spec.draft is not None and spec.draft.dispatches > 0
+    assert spec.spec_steps > 0
+    accept = spec.spec_accepted / max(spec.spec_proposed, 1)
+    assert accept > 0.9, (spec.spec_accepted, spec.spec_proposed)
+    # dispatch win: ~24/(k+1) verify steps instead of 24 decode steps
+    assert spec.decode_steps < base.decode_steps / 2
+
+
+def test_draft_model_different_weights_still_exact():
+    """A DIFFERENT draft (other random weights) proposes mostly-wrong
+    tokens; acceptance is low but the emitted stream must still equal
+    plain decoding exactly — greedy and seeded."""
+    cfg = ModelConfig.tiny()
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    draft_params = model.init_params(jax.random.PRNGKey(99))
+    prompt = [3, 1, 4, 1, 5]
+
+    for samp in ({"temperature": 0.0}, {"temperature": 0.8, "seed": 42}):
+        base = EngineCore(model, params, _cfg(), eos_token_ids=[])
+        want = _drain_engine(base, prompt, 16, "b", **samp)
+        spec = EngineCore(model, params, _cfg(spec_tokens=3),
+                          eos_token_ids=[], draft=(model, draft_params))
+        got = _drain_engine(spec, prompt, 16, "s", **samp)
+        assert got == want, samp
+        assert spec.spec_steps > 0
+
+
+def test_draft_blocks_released_on_finish():
+    """Draft blocks recycle across requests — a long sequence of short
+    requests must not exhaust the draft pool."""
+    cfg = ModelConfig.tiny()
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    core = EngineCore(model, params, _cfg(spec_tokens=2), eos_token_ids=[],
+                      draft=(model, params))
+    free0 = len(core.draft._free)
+    for j in range(6):
+        out = _drain_engine(core, [7 + j, 8, 9], 4, f"r{j}",
+                            temperature=0.0)
+        assert len(out) == 4
+    assert len(core.draft._free) == free0
+    assert core.draft._blocks == {}
+
+
+def test_draft_vocab_mismatch_rejected():
+    model = LlamaModel(ModelConfig.tiny())
+    other = LlamaModel(ModelConfig.tiny(vocab_size=128))
+    params = model.init_params(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError):
+        EngineCore(model, params, _cfg(spec_tokens=2), eos_token_ids=[],
+                   draft=(other, other.init_params(jax.random.PRNGKey(1))))
