@@ -506,12 +506,23 @@ def main() -> None:
     for _ in range(batch):
         submit(isl, refill=True)
 
-    # ramp: prefill everything + warm the decode executable
+    # ramp: prefill everything + warm the decode executable.  The ramp's
+    # prompt-token rate doubles as a coarse prefill-throughput metric
+    # (first-compile time excluded by measuring from the second dispatch).
     t0 = time.perf_counter()
+    t_after_first = None
+    toks_after_first = 0
     while any(r is not None and r.state.value == "prefill" for r in engine.slots) \
             or engine.has_work() and engine.decode_steps < 3:
         if not engine.step():
             break
+        if t_after_first is None:
+            t_after_first = time.perf_counter()
+            toks_after_first = engine.prompt_tokens_computed
+    prefill_toks = engine.prompt_tokens_computed - toks_after_first
+    prefill_dt = (time.perf_counter() - t_after_first) if t_after_first else 0.0
+    prefill_tok_s = (round(prefill_toks / prefill_dt, 1)
+                     if prefill_dt > 0 and prefill_toks > 0 else None)
     # warm the full-length decode burst executable: num_steps is a static
     # jit arg and every ramp burst ran at interactive length (prefill was
     # pending) — without this the full-burst XLA compile lands inside the
@@ -575,6 +586,7 @@ def main() -> None:
         "itl_ms": round(itl_ms, 2),
         "ttft_p50_ms": ttft_p50 and round(ttft_p50, 1),
         "ttft_isl": ttft_isl,
+        "prefill_tok_s": prefill_tok_s,
         "kernels": kernels,
     }))
     run_cancel()

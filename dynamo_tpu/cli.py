@@ -138,8 +138,17 @@ def _build_local_engine(args) -> tuple[object, object]:
         if cfg.spec_tokens <= 0:
             raise SystemExit("--spec-draft-model requires --spec-tokens > 0")
         # draft-model speculation: a small same-tokenizer model proposes,
-        # the target verifies (engine/draft.py).  Loads bf16, unsharded.
-        dcfg, dparams = load_model_dir(dpath, dtype=dtype or "bfloat16")
+        # the target verifies (engine/draft.py).  Accepts the same
+        # checkpoint formats as --model-path (native / GGUF / HF dir);
+        # loads unsharded.
+        if is_native_checkpoint(dpath):
+            dcfg, dparams, _ = load_checkpoint(dpath)
+        elif dpath.endswith(".gguf"):
+            from dynamo_tpu.llm.gguf import load_gguf_model
+
+            dcfg, dparams = load_gguf_model(dpath, dtype=dtype or "bfloat16")
+        else:
+            dcfg, dparams = load_model_dir(dpath, dtype=dtype or "bfloat16")
         draft = (LlamaModel(dcfg), dparams)
     core = EngineCore(
         model, params, cfg, mesh=mesh,

@@ -105,12 +105,15 @@ class DraftProposer:
 
     # ---------------------------------------------------------------- propose
     def _grow(self, slot: int, want_tokens: int) -> bool:
-        """Ensure the slot's draft block table covers ``want_tokens``."""
+        """Ensure the slot's draft block table covers ``want_tokens``.
+        All-or-nothing: a row that cannot fully grow takes NOTHING —
+        partial grabs would strand pool blocks on rows that can never
+        draft, starving every other row until the hoarders finish."""
         ids = self._blocks.setdefault(slot, [])
         need = (max(want_tokens, 1) - 1) // self.block_size + 1
+        if need - len(ids) > len(self._free):
+            return False
         while len(ids) < need:
-            if not self._free:
-                return False
             ids.append(self._free.pop())
         return True
 
@@ -162,11 +165,15 @@ class DraftProposer:
         (no free blocks / table overflow) is simply absent — the caller
         falls back to the n-gram proposer for it.
 
-        Rows far behind (fresh prompts) are caught up with chunked
-        ingest-only dispatches first (k=1, proposal discarded); the final
-        dispatch both ingests the tail and drafts.
+        Rows far behind (fresh long prompts) catch up via at most ONE
+        batched ingest-only dispatch per call (k=1, proposals discarded,
+        all behind rows in one padded batch) and are skipped for
+        proposals until caught up — a 32k prompt costs one extra
+        dispatch per engine step for a few steps instead of stalling its
+        batch-mates behind ~64 serial dispatches in one step.
         """
         rows = []
+        behind = []
         for req in reqs:
             slot = req.slot
             total = req.seq.total_tokens
@@ -174,13 +181,22 @@ class DraftProposer:
                 continue
             if not self._grow(slot, total + k):
                 continue
-            while total - self._synced.get(slot, 0) > _MAX_INGEST_BUCKET:
-                # chunked catch-up (fresh long prompt)
-                self._dispatch(
-                    [(req, self._synced.get(slot, 0), _MAX_INGEST_BUCKET)],
-                    k=1, draft_active=False,
-                )
-            rows.append(req)
+            if total - self._synced.get(slot, 0) > _MAX_INGEST_BUCKET:
+                behind.append(req)
+            else:
+                rows.append(req)
+        if behind:
+            self._dispatch(
+                [(req, self._synced.get(req.slot, 0), _MAX_INGEST_BUCKET)
+                 for req in behind],
+                k=1, draft_active=False,
+            )
+            # a row fully caught up by that chunk may draft this round
+            rows.extend(
+                req for req in behind
+                if req.seq.total_tokens - self._synced[req.slot]
+                <= _MAX_INGEST_BUCKET
+            )
         if not rows:
             return {}
         entries = [

@@ -370,3 +370,40 @@ def test_draft_vocab_mismatch_rejected():
     with pytest.raises(ValueError):
         EngineCore(model, params, _cfg(spec_tokens=2), eos_token_ids=[],
                    draft=(other, other.init_params(jax.random.PRNGKey(1))))
+
+
+def test_draft_grow_all_or_nothing():
+    """A row that cannot FULLY grow takes nothing — partial grabs would
+    strand pool blocks on rows that can never draft."""
+    from dynamo_tpu.engine.draft import DraftProposer
+
+    model = CycleModel()
+    cfg = EngineConfig(max_batch_size=2, max_model_len=256, block_size=16,
+                       num_blocks=4)
+    d = DraftProposer(model, model.init_params(), cfg)
+    assert d._grow(0, 16 * 3)        # 3 of 4 blocks
+    assert not d._grow(1, 16 * 2)    # needs 2, only 1 free
+    assert len(d._free) == 1         # nothing stranded
+    assert d._blocks.get(1, []) == []
+
+
+def test_draft_long_prompt_catches_up_across_steps():
+    """A prompt longer than the ingest bucket catches up via batched
+    chunked dispatches (at most one per propose call) and then drafts —
+    output still equals plain greedy decoding."""
+    cfg = ModelConfig.tiny(max_position_embeddings=2048)
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    prompt = [(i * 17) % 200 + 1 for i in range(1100)]  # > 2 chunks
+
+    def ecfg(**kw):
+        return EngineConfig(max_batch_size=2, max_model_len=1536,
+                            block_size=16, num_blocks=128, **kw)
+
+    base = EngineCore(model, params, ecfg(), eos_token_ids=[])
+    want = _drain_engine(base, prompt, 10, "b", temperature=0.0)
+    spec = EngineCore(model, params, ecfg(spec_tokens=3), eos_token_ids=[],
+                      draft=(model, params))
+    got = _drain_engine(spec, prompt, 10, "s", temperature=0.0)
+    assert got == want
+    assert spec.spec_steps > 0
