@@ -512,15 +512,24 @@ def main() -> None:
     t0 = time.perf_counter()
     t_after_first = None
     toks_after_first = 0
+    last_tok_t, last_toks = None, 0
     while any(r is not None and r.state.value == "prefill" for r in engine.slots) \
             or engine.has_work() and engine.decode_steps < 3:
         if not engine.step():
             break
+        now = time.perf_counter()
         if t_after_first is None:
-            t_after_first = time.perf_counter()
+            t_after_first = now
             toks_after_first = engine.prompt_tokens_computed
-    prefill_toks = engine.prompt_tokens_computed - toks_after_first
-    prefill_dt = (time.perf_counter() - t_after_first) if t_after_first else 0.0
+            last_tok_t, last_toks = now, toks_after_first
+        elif engine.prompt_tokens_computed > last_toks:
+            # window ends at the LAST dispatch that computed prompt
+            # tokens — the decode-warmup tail of this loop must not
+            # dilute the prefill rate
+            last_tok_t, last_toks = now, engine.prompt_tokens_computed
+    prefill_toks = last_toks - toks_after_first
+    prefill_dt = ((last_tok_t - t_after_first)
+                  if t_after_first is not None else 0.0)
     prefill_tok_s = (round(prefill_toks / prefill_dt, 1)
                      if prefill_dt > 0 and prefill_toks > 0 else None)
     # warm the full-length decode burst executable: num_steps is a static

@@ -35,6 +35,13 @@ MODELS = {
     "8b": dict(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
                num_layers=32, num_heads=32, num_kv_heads=8,
                max_position_embeddings=8192, rope_theta=500000.0),
+    # Mixtral-8x7B architecture scaled to fit one chip at int8 (half the
+    # layers): for A/B-ing grouped ragged_dot dispatch vs the dense
+    # oracle (DYNAMO_MOE_DENSE=1) on the same weights
+    "moe": dict(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                num_layers=16, num_heads=32, num_kv_heads=8,
+                num_experts=8, num_experts_per_tok=2,
+                max_position_embeddings=8192, rope_theta=1000000.0),
 }
 
 
@@ -52,6 +59,12 @@ def timeit(fn, *args, iters=20, warmup=3):
 
 
 def main() -> None:
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # the image's sitecustomize pins the TPU plugin via jax.config;
+        # the env var alone is ignored (see tests/conftest.py)
+        from dynamo_tpu.utils import force_cpu_devices
+
+        force_cpu_devices(1)
     import jax
     import jax.numpy as jnp
 
@@ -83,8 +96,13 @@ def main() -> None:
     h, inter, v_, nl = (cfg.hidden_size, cfg.intermediate_size,
                         cfg.vocab_size, cfg.num_layers)
     hd = cfg.head_dim
+    # MoE: every expert's gate/up/down streams each decode step (all
+    # routed experts at batch >= E/k in practice; count all E — the
+    # bandwidth question the moe config A/Bs is weight-read-bound)
+    mlp_w = 3 * h * inter * (cfg.num_experts if cfg.is_moe else 1)
+    router_w = h * cfg.num_experts if cfg.is_moe else 0
     param_gb = (nl * (h * cfg.num_heads * hd + 2 * h * cfg.num_kv_heads * hd
-                      + cfg.num_heads * hd * h + 3 * h * inter)
+                      + cfg.num_heads * hd * h + mlp_w + router_w)
                 + v_ * h * (1 if cfg.tie_word_embeddings else 2)) * wbytes / 1e9
     kv_gb = (batch * ctx * 2 * cfg.num_kv_heads * hd * nl * 2) / 1e9
 

@@ -34,6 +34,27 @@ MODELS_PREFIX = "models/"  # under {namespace}/
 # ------------------------------------------------------------ engine build ----
 
 
+def _load_any_checkpoint(path: str, dtype):
+    """(cfg, params, quantized) for any supported checkpoint format:
+    native (dynamo-tpu quantize), GGUF, or HF safetensors dir.  ``dtype``
+    None = native checkpoints keep their stored dtype, others bf16."""
+    from dynamo_tpu.models.checkpoint import is_native_checkpoint, load_checkpoint
+
+    if is_native_checkpoint(path):
+        # pre-converted native checkpoint: params load in their serving
+        # dtype — no per-start bf16 load + quantize pass
+        return load_checkpoint(path, dtype=dtype)
+    if path.endswith(".gguf"):
+        from dynamo_tpu.llm.gguf import load_gguf_model
+
+        cfg, params = load_gguf_model(path, dtype=dtype or "bfloat16")
+    else:
+        from dynamo_tpu.models.loader import load_model_dir
+
+        cfg, params = load_model_dir(path, dtype=dtype or "bfloat16")
+    return cfg, params, False
+
+
 def _build_local_engine(args) -> tuple[object, object]:
     """out=tpu|echo → (engine, card): the native JAX engine or the echo stub."""
     from dynamo_tpu.llm.model_card import ModelDeploymentCard
@@ -70,7 +91,6 @@ def _build_local_engine(args) -> tuple[object, object]:
 
     from dynamo_tpu.engine import AsyncLLMEngine, EngineConfig, EngineCore
     from dynamo_tpu.models.llama import LlamaModel
-    from dynamo_tpu.models.loader import load_model_dir
 
     # multi-host: join the jax.distributed mesh BEFORE any JAX array is
     # created — loading/quantizing weights initializes the backend, and
@@ -90,26 +110,10 @@ def _build_local_engine(args) -> tuple[object, object]:
             coordinator_url=getattr(args, "coordinator", None),
         ))
 
-    from dynamo_tpu.models.checkpoint import is_native_checkpoint, load_checkpoint
-
     # --dtype default is None so the native branch can tell "explicitly
     # requested" from "use the checkpoint's stored dtype"
     dtype = getattr(args, "dtype", None)
-    if is_native_checkpoint(args.model_path):
-        # pre-converted native checkpoint (dynamo-tpu quantize): params load
-        # in their serving dtype — no per-start bf16 load + quantize pass
-        model_cfg, params, quantized = load_checkpoint(
-            args.model_path, dtype=dtype
-        )
-    else:
-        dtype = dtype or "bfloat16"
-        quantized = False
-        if is_gguf:
-            from dynamo_tpu.llm.gguf import load_gguf_model
-
-            model_cfg, params = load_gguf_model(args.model_path, dtype=dtype)
-        else:
-            model_cfg, params = load_model_dir(args.model_path, dtype=dtype)
+    model_cfg, params, quantized = _load_any_checkpoint(args.model_path, dtype)
     model = LlamaModel(model_cfg)
     if getattr(args, "quantize", "none") == "int8" and not quantized:
         # int8 weight-only serving (models/quant.py): ~2x HBM headroom
@@ -141,14 +145,7 @@ def _build_local_engine(args) -> tuple[object, object]:
         # the target verifies (engine/draft.py).  Accepts the same
         # checkpoint formats as --model-path (native / GGUF / HF dir);
         # loads unsharded.
-        if is_native_checkpoint(dpath):
-            dcfg, dparams, _ = load_checkpoint(dpath)
-        elif dpath.endswith(".gguf"):
-            from dynamo_tpu.llm.gguf import load_gguf_model
-
-            dcfg, dparams = load_gguf_model(dpath, dtype=dtype or "bfloat16")
-        else:
-            dcfg, dparams = load_model_dir(dpath, dtype=dtype or "bfloat16")
+        dcfg, dparams, _ = _load_any_checkpoint(dpath, dtype)
         draft = (LlamaModel(dcfg), dparams)
     core = EngineCore(
         model, params, cfg, mesh=mesh,
