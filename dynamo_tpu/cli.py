@@ -433,9 +433,17 @@ async def _cmd_router(args) -> None:
 
 async def _cmd_operator(args) -> None:
     """Run the reconcile loop over a watched directory of
-    DynamoTpuDeployment specs (operator-lite; ref deploy/dynamo/operator)."""
-    from dynamo_tpu.deploy.operator import KubectlCluster, MemoryCluster, Operator
+    DynamoTpuDeployment specs and/or the DynamoTpuDeployment custom
+    resources (--crd; ref deploy/dynamo/operator)."""
+    from dynamo_tpu.deploy.operator import (
+        KubectlCluster,
+        KubectlCrSource,
+        MemoryCluster,
+        Operator,
+    )
 
+    if not args.specs_dir and not args.crd:
+        raise SystemExit("operator needs a specs dir and/or --crd")
     cluster = MemoryCluster() if args.dry_run else KubectlCluster(
         context=args.context
     )
@@ -444,11 +452,14 @@ async def _cmd_operator(args) -> None:
         from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient
 
         coord = await CoordinatorClient(args.coordinator, reconnect=True).connect()
+    cr_source = KubectlCrSource(context=args.context) if args.crd else None
     op = Operator(cluster, interval_s=args.interval, watch_dir=args.specs_dir,
-                  coordinator=coord)
-    op.load_dir(args.specs_dir)
-    log.info("operator watching %s (%d specs, dry_run=%s, coordinator=%s)",
-             args.specs_dir, len(op.specs), args.dry_run, args.coordinator)
+                  coordinator=coord, cr_source=cr_source)
+    if args.specs_dir:
+        op.load_dir(args.specs_dir)
+    log.info("operator watching %s (crd=%s, %d specs, dry_run=%s, "
+             "coordinator=%s)", args.specs_dir, args.crd, len(op.specs),
+             args.dry_run, args.coordinator)
     await op.run()
 
 
@@ -708,7 +719,12 @@ def _parser() -> argparse.ArgumentParser:
     operator = sub.add_parser(
         "operator", help="watch a specs dir and reconcile deployments"
     )
-    operator.add_argument("specs_dir", help="directory of DynamoTpuDeployment YAMLs")
+    operator.add_argument("specs_dir", nargs="?", default=None,
+                          help="directory of DynamoTpuDeployment YAMLs")
+    operator.add_argument("--crd", action="store_true",
+                          help="watch DynamoTpuDeployment custom resources "
+                               "(apply deploy/crd/ first) and write .status "
+                               "back via the status subresource")
     operator.add_argument("--interval", type=float, default=5.0)
     operator.add_argument("--context", default=None, help="kubectl context")
     operator.add_argument("--dry-run", action="store_true",

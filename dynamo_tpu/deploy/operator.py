@@ -107,6 +107,17 @@ class MemoryCluster:
         ]
 
 
+def _run_kubectl(base: list[str], args: list[str],
+                 stdin: Optional[str] = None) -> str:
+    """Shared kubectl subprocess wrapper (cluster + CR source)."""
+    proc = subprocess.run(
+        base + args, input=stdin, capture_output=True, text=True
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(f"kubectl {' '.join(args)}: {proc.stderr.strip()}")
+    return proc.stdout
+
+
 class KubectlCluster:
     """Real-cluster backend via kubectl (no k8s client dependency)."""
 
@@ -114,12 +125,7 @@ class KubectlCluster:
         self.base = [kubectl] + (["--context", context] if context else [])
 
     def _run(self, args: list[str], stdin: Optional[str] = None) -> str:
-        proc = subprocess.run(
-            self.base + args, input=stdin, capture_output=True, text=True
-        )
-        if proc.returncode != 0:
-            raise RuntimeError(f"kubectl {' '.join(args)}: {proc.stderr.strip()}")
-        return proc.stdout
+        return _run_kubectl(self.base, args, stdin)
 
     def apply(self, obj: dict) -> None:
         self._run(["apply", "-f", "-"], stdin=yaml.safe_dump(obj))
@@ -140,6 +146,46 @@ class KubectlCluster:
         ]
 
 
+CRD_GROUP = "dynamo-tpu.dev"
+CRD_PLURAL = "dynamotpudeployments"
+
+
+def spec_from_cr(obj: dict) -> DeploymentSpec:
+    """A DynamoTpuDeployment custom resource → DeploymentSpec (name and
+    namespace come from metadata, like the reference CRD)."""
+    md = obj.get("metadata", {})
+    d = dict(obj.get("spec") or {})
+    d.setdefault("name", md.get("name"))
+    d.setdefault("namespace", md.get("namespace", "default"))
+    return DeploymentSpec.from_dict(d)
+
+
+class KubectlCrSource:
+    """Custom-resource spec source over kubectl (no k8s client dep):
+    lists DynamoTpuDeployment objects each tick and writes ``.status``
+    back through the status subresource — the reference operator's
+    CRD-watch + status-conditions surface (dynamodeployment_types.go:31)
+    in poll form."""
+
+    def __init__(self, kubectl: str = "kubectl", context: Optional[str] = None):
+        self.base = [kubectl] + (["--context", context] if context else [])
+
+    def _run(self, args: list[str], stdin: Optional[str] = None) -> str:
+        return _run_kubectl(self.base, args, stdin)
+
+    def list(self) -> list[dict]:
+        out = self._run(["get", f"{CRD_PLURAL}.{CRD_GROUP}",
+                         "--all-namespaces", "-o", "json"])
+        return json.loads(out).get("items", [])
+
+    def patch_status(self, namespace: str, name: str, status: dict) -> None:
+        self._run([
+            "patch", f"{CRD_PLURAL}.{CRD_GROUP}", name, "-n", namespace,
+            "--subresource=status", "--type=merge", "-p",
+            json.dumps({"status": status}),
+        ])
+
+
 class Operator:
     """The reconcile loop.  One operator instance owns every object it
     created (tracked via an owner annotation), so pruning is safe even
@@ -148,7 +194,7 @@ class Operator:
 
     def __init__(self, cluster: Cluster, owner: str = "dynamo-tpu-operator",
                  interval_s: float = 2.0, watch_dir: Optional[str] = None,
-                 coordinator=None):
+                 coordinator=None, cr_source=None):
         self.cluster = cluster
         self.owner = owner
         self.interval_s = interval_s
@@ -159,6 +205,13 @@ class Operator:
         # autoscaling; without it phases are "Unknown" for worker-bearing
         # deployments (the honest answer — it cannot see them)
         self.coordinator = coordinator
+        # optional custom-resource source (duck-typed: list() +
+        # patch_status()): specs come from DynamoTpuDeployment CRs and
+        # the computed status writes back through the status subresource
+        self.cr_source = cr_source
+        # (deployment name) -> (namespace, cr name) for status patches
+        self._cr_ident: dict[str, tuple[str, str]] = {}
+        self._pushed_status: dict[str, dict] = {}  # last status per CR
         self.specs: dict[str, DeploymentSpec] = {}
         self.status: dict[str, dict] = {}
         # (deployment, service) -> live registered instance count, filled
@@ -243,6 +296,78 @@ class Operator:
         # interval wait return instantly — a 100%-CPU reconcile hot-spin
         if self.specs != before:
             self._wake.set()
+
+    def load_crs(self) -> None:
+        """Sync specs from the custom-resource source (CRD watch in poll
+        form): present CRs become specs (autoscale decisions re-applied,
+        like load_dir), vanished ones are deleted.  Torn-read rules match
+        load_dir at BOTH granularities: an unlistable source keeps every
+        current spec, and a CR that transiently fails to PARSE keeps its
+        previous spec (tearing down a live deployment's objects over one
+        bad read would churn every pod).  Only CR-owned specs are pruned
+        — directory-loaded / set_spec specs are never touched."""
+        try:
+            items = self.cr_source.list()
+        except Exception:
+            log.exception("CR list failed; keeping current specs")
+            return
+        before = dict(self.specs)
+        seen = set()
+        idents: dict[str, tuple[str, str]] = {}
+        by_ident = {v: k for k, v in self._cr_ident.items()}
+        claimed_ns: dict[str, str] = {}
+        for obj in items:
+            md = obj.get("metadata", {})
+            ident = (md.get("namespace", "default"), md.get("name", ""))
+            try:
+                spec = spec_from_cr(obj)
+            except Exception:
+                log.exception("bad DynamoTpuDeployment %s/%s skipped "
+                              "(keeping previous spec if any)", *ident)
+                prev = by_ident.get(ident)
+                if prev is not None:
+                    seen.add(prev)
+                    idents[prev] = ident
+                continue
+            if spec.name in claimed_ns and claimed_ns[spec.name] != ident[0]:
+                # deployment names must be unique across namespaces (the
+                # rendered objects are named from spec.name); a silent
+                # last-writer-wins would deploy one and starve the other
+                log.error(
+                    "DynamoTpuDeployment name collision: %r exists in both "
+                    "namespace %s and %s; skipping %s/%s",
+                    spec.name, claimed_ns[spec.name], ident[0], *ident,
+                )
+                continue
+            claimed_ns[spec.name] = ident[0]
+            seen.add(spec.name)
+            idents[spec.name] = ident
+            self._adopt_spec(spec)
+        # prune only specs the CR source OWNS (previously mapped to a CR)
+        for name in [n for n in self._cr_ident
+                     if n not in seen and n in self.specs]:
+            del self.specs[name]
+        self._cr_ident = idents
+        if self.specs != before:
+            self._wake.set()
+
+    def push_status(self) -> None:
+        """Write each CR's computed status through the status subresource
+        (reference parity: status conditions on the CRD).  No-op patches
+        are skipped — a steady cluster costs zero apiserver writes per
+        tick; a failed patch clears the cache entry so it retries."""
+        if self.cr_source is None:
+            return
+        for name, (ns, cr_name) in self._cr_ident.items():
+            st = self.status.get(name)
+            if st is None or self._pushed_status.get(name) == st:
+                continue
+            try:
+                self.cr_source.patch_status(ns, cr_name, st)
+                self._pushed_status[name] = dict(st)
+            except Exception:
+                self._pushed_status.pop(name, None)
+                log.exception("status patch for %s/%s failed", ns, cr_name)
 
     # ------------------------------------------------------------ observation
     async def observe(self) -> None:
@@ -390,6 +515,8 @@ class Operator:
             try:
                 if self.watch_dir is not None:
                     self.load_dir(self.watch_dir)
+                if self.cr_source is not None:
+                    self.load_crs()
                 try:
                     await self.observe()
                 except Exception:
@@ -399,6 +526,7 @@ class Operator:
                                 "phases Unknown this tick", exc_info=True)
                     self.live = None
                 self.reconcile_once()
+                self.push_status()
             except Exception:
                 log.exception("reconcile failed; retrying next tick")
             try:

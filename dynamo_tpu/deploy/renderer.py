@@ -84,7 +84,10 @@ class DeploymentSpec:
     def from_yaml(cls, path_or_text: str | Path) -> "DeploymentSpec":
         p = Path(path_or_text)
         text = p.read_text() if p.exists() else str(path_or_text)
-        d = yaml.safe_load(text)
+        return cls.from_dict(yaml.safe_load(text))
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DeploymentSpec":
         services = []
         for name, s in (d.get("services") or {}).items():
             tpu = s.get("tpu") or {}

@@ -35,8 +35,13 @@ def _soak_grammar(vocab_size):
     return toks, JsonGrammar.from_token_bytes(toks, eos_ids=[EOS])
 
 
-@pytest.mark.parametrize("seed,cache_dtype", [(0, None), (7, None), (3, "int8")])
-def test_engine_soak_invariants(seed, cache_dtype):
+@pytest.mark.parametrize("seed,cache_dtype,draft", [
+    (0, None, False), (7, None, False), (3, "int8", False),
+    # draft-model speculation churning against grammar rows, aborts,
+    # chunked prefill and the tight block pool (draft pool even tighter)
+    (11, None, True),
+])
+def test_engine_soak_invariants(seed, cache_dtype, draft):
     cfg = ModelConfig.tiny()
     model = LlamaModel(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
@@ -49,10 +54,15 @@ def test_engine_soak_invariants(seed, cache_dtype):
         prefill_chunk_tokens=32,
         enable_prefix_reuse=True,
         cache_dtype=cache_dtype,
+        spec_tokens=3 if draft else 0,
+        draft_num_blocks=24 if draft else 0,  # tighter than the target's
     )
     vocab_toks, grammar = _soak_grammar(cfg.vocab_size)
-    engine = EngineCore(model, params, ecfg, eos_token_ids=[EOS],
-                        grammar=grammar)
+    engine = EngineCore(
+        model, params, ecfg, eos_token_ids=[EOS], grammar=grammar,
+        draft=(model, model.init_params(jax.random.PRNGKey(5)))
+        if draft else None,
+    )
     rng = np.random.default_rng(seed)
 
     shared_prefix = list(rng.integers(1, 200, size=48))

@@ -401,3 +401,162 @@ def test_coordinator_outage_does_not_halt_reconcile(tmp_path):
             await coord.close()
 
     run(go())
+
+
+# ------------------------------------------------------------ CRD source ----
+class FakeCrSource:
+    """Test double for KubectlCrSource: CR objects in, status patches out."""
+
+    def __init__(self):
+        self.items: list[dict] = []
+        self.patches: list[tuple] = []
+        self.fail_list = False
+
+    def list(self):
+        if self.fail_list:
+            raise RuntimeError("apiserver away")
+        return [copy.deepcopy(o) for o in self.items]
+
+    def patch_status(self, ns, name, status):
+        self.patches.append((ns, name, copy.deepcopy(status)))
+
+
+def _cr(name, ns="serving", replicas=2):
+    return {
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "image": "dynamo-tpu:latest",
+            "services": {
+                "decode": {
+                    "command": ["dynamo-tpu", "run",
+                                "in=dyn://dynamo.decode.generate", "out=tpu"],
+                    "replicas": replicas,
+                },
+            },
+        },
+    }
+
+
+def test_cr_source_sync_status_and_prune():
+    """CRs become specs, reconcile levels objects, computed status writes
+    back through the subresource, and a deleted CR prunes its objects.
+    A transiently failing list keeps current specs (torn-read rule)."""
+    from dynamo_tpu.runtime.transports.coordinator import (
+        CoordinatorClient, CoordinatorServer,
+    )
+
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        coord = await CoordinatorClient(srv.url).connect()
+        worker = await CoordinatorClient(srv.url).connect()
+        try:
+            cluster = MemoryCluster()
+            src = FakeCrSource()
+            src.items.append(_cr("llm"))
+            op = Operator(cluster, coordinator=coord, cr_source=src)
+            op.load_crs()
+            await op.observe()
+            op.reconcile_once()
+            op.push_status()
+            assert ("Deployment", "serving", "llm-decode") in cluster.objects
+            ns, name, st = src.patches[-1]
+            assert (ns, name) == ("serving", "llm")
+            assert st["phase"] == "Pending"
+            assert st["workers"]["decode"] == {"want": 2, "live": 0}
+
+            # workers register -> Ready lands in the next status patch
+            for _ in range(2):
+                lease = await worker.lease_create(ttl=30.0)
+                await worker.kv_put(
+                    f"dynamo/components/decode/endpoints/generate/{lease:x}",
+                    {"instance_id": lease}, lease_id=lease)
+            op.load_crs()
+            await op.observe()
+            op.reconcile_once()
+            op.push_status()
+            assert src.patches[-1][2]["phase"] == "Ready"
+
+            # apiserver blip: specs survive, reconcile keeps running
+            src.fail_list = True
+            op.load_crs()
+            op.reconcile_once()
+            assert ("Deployment", "serving", "llm-decode") in cluster.objects
+            src.fail_list = False
+
+            # CR deleted -> objects pruned, no more patches for it
+            src.items.clear()
+            op.load_crs()
+            s = op.reconcile_once()
+            op.push_status()
+            assert s["deleted"] > 0
+            assert cluster.list_owned(op.owner) == []
+        finally:
+            await worker.close()
+            await coord.close()
+            await srv.stop()
+
+    run(go())
+
+
+def test_cr_bad_spec_skipped_good_ones_live():
+    cluster = MemoryCluster()
+    src = FakeCrSource()
+    src.items = [
+        {"metadata": {"name": "bad"}, "spec": {}},  # no image: invalid
+        _cr("good"),
+    ]
+    op = Operator(cluster, cr_source=src)
+    op.load_crs()
+    op.reconcile_once()
+    assert "good" in op.specs and "bad" not in op.specs
+    assert ("Deployment", "serving", "good-decode") in cluster.objects
+
+
+def test_cr_source_coexists_with_dir_specs_and_torn_reads(tmp_path):
+    """Combined mode: CR pruning never touches directory-loaded specs; a
+    CR that transiently fails to PARSE keeps its previous spec (no object
+    churn); same-name CRs in two namespaces don't silently clobber; and
+    unchanged statuses are not re-patched."""
+    (tmp_path / "dir.yaml").write_text(SPEC_YAML)  # name: llama-disagg
+    cluster = MemoryCluster()
+    src = FakeCrSource()
+    src.items.append(_cr("llm"))
+    op = Operator(cluster, cr_source=src, watch_dir=str(tmp_path))
+    op.load_dir(tmp_path)
+    op.load_crs()
+    op.reconcile_once()
+    assert "llama-disagg" in op.specs and "llm" in op.specs
+    assert ("Deployment", "serving", "llm-decode") in cluster.objects
+    owned = len(cluster.list_owned(op.owner))
+
+    # another tick: dir spec must survive CR pruning
+    op.load_dir(tmp_path)
+    op.load_crs()
+    op.reconcile_once()
+    assert "llama-disagg" in op.specs
+    assert len(cluster.list_owned(op.owner)) == owned
+
+    # CR becomes unparsable: its spec and objects survive the blip
+    good = src.items[0]
+    src.items[0] = {"metadata": {"name": "llm", "namespace": "serving"},
+                    "spec": {}}  # no image
+    op.load_crs()
+    op.reconcile_once()
+    assert "llm" in op.specs
+    assert ("Deployment", "serving", "llm-decode") in cluster.objects
+    src.items[0] = good
+
+    # namespace collision: first claim wins, the other is skipped loudly
+    src.items.append(_cr("llm", ns="other"))
+    op.load_crs()
+    assert op._cr_ident["llm"][0] == "serving"
+    src.items.pop()
+
+    # no-op status patches are skipped
+    op.load_crs()
+    op.reconcile_once()
+    op.push_status()
+    n = len(src.patches)
+    op.reconcile_once()
+    op.push_status()          # identical status -> no new patch
+    assert len(src.patches) == n
