@@ -19,6 +19,7 @@ Env knobs: DYNAMO_BENCH_MODEL (tiny|1b|8b|auto), DYNAMO_BENCH_BATCH,
 DYNAMO_BENCH_STEPS, DYNAMO_BENCH_ISL, DYNAMO_BENCH_MAX_LEN,
 DYNAMO_BENCH_BLOCK_SIZE, DYNAMO_BENCH_DECODE_STEPS,
 DYNAMO_BENCH_PREFILL_CHUNK, DYNAMO_BENCH_TTFT_ISL,
+DYNAMO_BENCH_TTFT_BATCH (north-star TTFT phase batch, default 8),
 DYNAMO_BENCH_QUANT (int8|none, weights),
 DYNAMO_BENCH_KV_QUANT (auto|int8|none, KV cache),
 DYNAMO_BENCH_INIT_TIMEOUT (seconds to wait for the TPU backend;
@@ -327,6 +328,87 @@ def _probe_kv_quant(mcfg: dict, batch: int, max_len: int, bs: int,
         return False
 
 
+def _northstar_ttft(model, params, kv_quant: str, block_size: int,
+                    prefill_chunk: int, want_isl: int):
+    """Dedicated TTFT phase at the north-star ISL when the throughput
+    config's cache cannot hold it (8B at batch 64 × isl 3000 outgrows a
+    single 16GiB chip — the reference's <300ms@3000 number runs on a
+    sliced disagg deployment).  A smaller-batch engine sized for the ISL
+    measures fresh-prompt TTFT against a busy batch; params are shared
+    with the main engine, whose cache the caller must free first.
+    Returns (p50_ms, batch) or None."""
+    import gc
+
+    import numpy as _np
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.engine.request import EngineRequest
+    from dynamo_tpu.llm.protocols import SamplingOptions, StopConditions
+
+    batch = int(os.environ.get("DYNAMO_BENCH_TTFT_BATCH", "8"))
+    max_len = ((want_isl + 320) // block_size + 1) * block_size
+    ecfg = EngineConfig(
+        max_batch_size=batch, max_model_len=max_len, block_size=block_size,
+        num_blocks=batch * (max_len // block_size) + 64,
+        decode_steps=8,
+        prefill_chunk_tokens=min(prefill_chunk or 512, max_len),
+        enable_prefix_reuse=False,
+        cache_dtype="int8" if kv_quant == "int8" else None,
+    )
+    engine = EngineCore(model, params, ecfg, eos_token_ids=[])
+    rng = _np.random.default_rng(1)
+    counter = [0]
+
+    def submit(plen, on_first=None, refill=False):
+        i, counter[0] = counter[0], counter[0] + 1
+        seen = [False]
+
+        def emit(out):
+            if not seen[0] and out.token_ids:
+                seen[0] = True
+                if on_first is not None:
+                    on_first()
+            if refill and out.finish_reason is not None:
+                submit(plen, refill=True)
+
+        engine.submit(EngineRequest(
+            request_id=f"ns-{i}",
+            prompt=rng.integers(
+                1, model.config.vocab_size - 1, size=plen
+            ).tolist(),
+            sampling=SamplingOptions(temperature=0.0),
+            stops=StopConditions(max_tokens=max_len - plen - 8,
+                                 ignore_eos=True),
+            emit=emit,
+        ))
+
+    for _ in range(batch - 1):
+        submit(256, refill=True)  # busy background decode batch
+    warm = []
+    submit(want_isl, on_first=lambda: warm.append(1))  # compile warmup
+    guard = time.monotonic() + 900
+    while not warm and engine.has_work() and time.monotonic() < guard:
+        engine.step()
+    ttfts: list[float] = []
+    for _ in range(5):
+        running = [r for r in engine.slots if r is not None]
+        if running:
+            engine.abort(running[0].request_id)
+        got = []
+        t0 = time.perf_counter()
+        submit(want_isl,
+               on_first=lambda: got.append(time.perf_counter() - t0))
+        guard = time.monotonic() + 120
+        while not got and engine.has_work() and time.monotonic() < guard:
+            engine.step()
+        if got:
+            ttfts.append(got[0] * 1000)
+    del engine
+    gc.collect()
+    return (float(_np.median(ttfts)), batch) if ttfts else None
+
+
 def main() -> None:
     cpu_mode = os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
     if cpu_mode:
@@ -580,6 +662,33 @@ def main() -> None:
     print(f"# ttft: isl={ttft_isl} p50={ttft_p50 and round(ttft_p50, 1)}ms "
           f"(n={len(ttfts)})", file=sys.stderr)
 
+    # north-star TTFT at the FULL requested ISL when the throughput
+    # config's cache clamped it: rebuild a smaller-batch engine sized for
+    # the ISL (failure keeps the primary numbers — never lose the round)
+    ttft_batch = batch
+    ttft_short_ms = ttft_short_isl = None
+    want_isl = int(os.environ.get("DYNAMO_BENCH_TTFT_ISL", "3000"))
+    if on_accel and ttft_p50 is not None and ttft_isl < want_isl:
+        import gc
+
+        del engine  # free the big cache before sizing the TTFT one
+        gc.collect()
+        try:
+            ns = _northstar_ttft(model, params, kv_quant, block_size,
+                                 prefill_chunk, want_isl)
+        except Exception:
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            ns = None
+        if ns is not None:
+            ttft_short_ms, ttft_short_isl = round(ttft_p50, 1), ttft_isl
+            ttft_p50, ttft_batch = ns[0], ns[1]
+            ttft_isl = want_isl
+            print(f"# ttft(north-star): isl={ttft_isl} "
+                  f"p50={round(ttft_p50, 1)}ms batch={ttft_batch}",
+                  file=sys.stderr)
+
     print(json.dumps({
         "metric": "decode_tok_s_per_chip",
         "value": round(tok_s, 1),
@@ -595,6 +704,9 @@ def main() -> None:
         "itl_ms": round(itl_ms, 2),
         "ttft_p50_ms": ttft_p50 and round(ttft_p50, 1),
         "ttft_isl": ttft_isl,
+        "ttft_batch": ttft_batch,
+        **({"ttft_short_ms": ttft_short_ms, "ttft_short_isl": ttft_short_isl}
+           if ttft_short_ms is not None else {}),
         "prefill_tok_s": prefill_tok_s,
         "kernels": kernels,
     }))

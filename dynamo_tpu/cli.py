@@ -452,7 +452,10 @@ async def _cmd_operator(args) -> None:
         from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient
 
         coord = await CoordinatorClient(args.coordinator, reconnect=True).connect()
-    cr_source = KubectlCrSource(context=args.context) if args.crd else None
+    cr_source = (
+        KubectlCrSource(context=args.context, read_only=args.dry_run)
+        if args.crd else None
+    )
     op = Operator(cluster, interval_s=args.interval, watch_dir=args.specs_dir,
                   coordinator=coord, cr_source=cr_source)
     if args.specs_dir:

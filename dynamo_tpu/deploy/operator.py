@@ -30,6 +30,7 @@ up immediately, down one step per tick.
 from __future__ import annotations
 
 import asyncio
+import copy
 import hashlib
 import json
 import logging
@@ -167,8 +168,11 @@ class KubectlCrSource:
     CRD-watch + status-conditions surface (dynamodeployment_types.go:31)
     in poll form."""
 
-    def __init__(self, kubectl: str = "kubectl", context: Optional[str] = None):
+    def __init__(self, kubectl: str = "kubectl", context: Optional[str] = None,
+                 read_only: bool = False):
         self.base = [kubectl] + (["--context", context] if context else [])
+        # dry runs must never write to live CRs
+        self.read_only = read_only
 
     def _run(self, args: list[str], stdin: Optional[str] = None) -> str:
         return _run_kubectl(self.base, args, stdin)
@@ -179,6 +183,10 @@ class KubectlCrSource:
         return json.loads(out).get("items", [])
 
     def patch_status(self, namespace: str, name: str, status: dict) -> None:
+        if self.read_only:
+            log.info("dry-run: would patch %s/%s status to %s",
+                     namespace, name, status)
+            return
         self._run([
             "patch", f"{CRD_PLURAL}.{CRD_GROUP}", name, "-n", namespace,
             "--subresource=status", "--type=merge", "-p",
@@ -313,18 +321,23 @@ class Operator:
             return
         before = dict(self.specs)
         seen = set()
-        idents: dict[str, tuple[str, str]] = {}
-        by_ident = {v: k for k, v in self._cr_ident.items()}
+        idents: dict[str, tuple[str, str, str]] = {}
+        by_ident = {v[:2]: k for k, v in self._cr_ident.items()}
         claimed_ns: dict[str, str] = {}
         for obj in items:
             md = obj.get("metadata", {})
-            ident = (md.get("namespace", "default"), md.get("name", ""))
+            # uid in the ident: a deleted-and-recreated CR (same ns/name,
+            # fresh uid) must invalidate the pushed-status cache — the new
+            # object's .status starts empty and needs a write even when
+            # the computed status is unchanged
+            ident = (md.get("namespace", "default"), md.get("name", ""),
+                     str(md.get("uid", "")))
             try:
                 spec = spec_from_cr(obj)
             except Exception:
                 log.exception("bad DynamoTpuDeployment %s/%s skipped "
-                              "(keeping previous spec if any)", *ident)
-                prev = by_ident.get(ident)
+                              "(keeping previous spec if any)", *ident[:2])
+                prev = by_ident.get(ident[:2])
                 if prev is not None:
                     seen.add(prev)
                     idents[prev] = ident
@@ -336,7 +349,16 @@ class Operator:
                 log.error(
                     "DynamoTpuDeployment name collision: %r exists in both "
                     "namespace %s and %s; skipping %s/%s",
-                    spec.name, claimed_ns[spec.name], ident[0], *ident,
+                    spec.name, claimed_ns[spec.name], ident[0], *ident[:2],
+                )
+                continue
+            if spec.name in self.specs and spec.name not in self._cr_ident:
+                # the name belongs to a dir/set_spec deployment: adopting
+                # the CR would hijack it now and tear it down on CR delete
+                log.error(
+                    "DynamoTpuDeployment %s/%s collides with a non-CR "
+                    "deployment spec %r; skipping the CR", *ident[:2],
+                    spec.name,
                 )
                 continue
             claimed_ns[spec.name] = ident[0]
@@ -347,6 +369,11 @@ class Operator:
         for name in [n for n in self._cr_ident
                      if n not in seen and n in self.specs]:
             del self.specs[name]
+        # pushed-status cache follows CR identity: vanished or recreated
+        # (uid change) CRs must be re-pushed from scratch
+        for name in list(self._pushed_status):
+            if idents.get(name) != self._cr_ident.get(name):
+                self._pushed_status.pop(name, None)
         self._cr_ident = idents
         if self.specs != before:
             self._wake.set()
@@ -355,16 +382,33 @@ class Operator:
         """Write each CR's computed status through the status subresource
         (reference parity: status conditions on the CRD).  No-op patches
         are skipped — a steady cluster costs zero apiserver writes per
-        tick; a failed patch clears the cache entry so it retries."""
+        tick; a failed patch clears the cache entry so it retries.  The
+        merge patch explicitly nulls keys the previous push set that the
+        new status dropped (JSON merge-patch otherwise leaves them stale
+        on the CR forever); a ``live: None`` (coordinator unobservable)
+        likewise merge-deletes the field on the CR."""
         if self.cr_source is None:
             return
-        for name, (ns, cr_name) in self._cr_ident.items():
+
+        def with_deletes(new, old):
+            out = dict(new)
+            for k, ov in (old or {}).items():
+                if k not in out:
+                    out[k] = None  # merge-patch delete of a dropped key
+                elif isinstance(ov, dict) and isinstance(out[k], dict):
+                    out[k] = with_deletes(out[k], ov)
+            return out
+
+        for name, ident in self._cr_ident.items():
+            ns, cr_name = ident[0], ident[1]
             st = self.status.get(name)
             if st is None or self._pushed_status.get(name) == st:
                 continue
             try:
-                self.cr_source.patch_status(ns, cr_name, st)
-                self._pushed_status[name] = dict(st)
+                self.cr_source.patch_status(
+                    ns, cr_name, with_deletes(st, self._pushed_status.get(name))
+                )
+                self._pushed_status[name] = copy.deepcopy(st)
             except Exception:
                 self._pushed_status.pop(name, None)
                 log.exception("status patch for %s/%s failed", ns, cr_name)

@@ -560,3 +560,51 @@ def test_cr_source_coexists_with_dir_specs_and_torn_reads(tmp_path):
     op.reconcile_once()
     op.push_status()          # identical status -> no new patch
     assert len(src.patches) == n
+
+
+def test_cr_dir_collision_and_recreation_status():
+    """A CR whose name collides with a non-CR spec is rejected (no hijack,
+    no churn on CR delete); a deleted-and-recreated CR (fresh uid) gets
+    its status re-pushed even when unchanged; dropped status keys are
+    merge-deleted."""
+    cluster = MemoryCluster()
+    src = FakeCrSource()
+    op = Operator(cluster, cr_source=src)
+    op.set_spec(DeploymentSpec.from_yaml(SPEC_YAML))  # name: llama-disagg
+
+    cr = _cr("llama-disagg")  # collides with the set_spec deployment
+    cr["metadata"]["uid"] = "u1"
+    src.items.append(cr)
+    op.load_crs()
+    assert "llama-disagg" not in op._cr_ident  # CR rejected, spec kept
+    assert op.specs["llama-disagg"].services[1].name == "prefill"
+
+    # fresh CR name: adopt, reconcile, push
+    ok = _cr("llm")
+    ok["metadata"]["uid"] = "u2"
+    src.items = [ok]
+    op.load_crs()
+    op.reconcile_once()
+    op.push_status()
+    n = len(src.patches)
+    assert n >= 1
+
+    # delete + recreate with the SAME computed status but a new uid:
+    # the new object starts with empty .status and must be re-pushed
+    src.items = []
+    op.load_crs()
+    op.reconcile_once()
+    recreated = _cr("llm")
+    recreated["metadata"]["uid"] = "u3"
+    src.items = [recreated]
+    op.load_crs()
+    op.reconcile_once()
+    op.push_status()
+    assert len(src.patches) > n
+
+    # dropped top-level status keys merge-delete on the next push
+    op._pushed_status["llm"] = {"phase": "Unknown", "objects": 1,
+                                "queue_depth": {"prefill": 9}}
+    op.push_status()
+    last = src.patches[-1][2]
+    assert last.get("queue_depth", "absent") is None  # explicit delete
