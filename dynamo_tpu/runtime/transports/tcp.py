@@ -146,6 +146,15 @@ class EndpointTcpClient(AsyncEngine):
 
     async def connect(self) -> "EndpointTcpClient":
         if not self._connected:
+            # reconnect path: drop the previous socket/read task first so
+            # N endpoint restarts don't leak N transports
+            if self._read_task is not None:
+                self._read_task.cancel()
+            if self._writer is not None:
+                try:
+                    self._writer.close()
+                except Exception:
+                    pass
             self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
             self._read_task = asyncio.ensure_future(self._read_loop())
             self._connected = True
@@ -176,13 +185,25 @@ class EndpointTcpClient(AsyncEngine):
                 elif ftype == "error":
                     q.put_nowait(RuntimeError(header.get("error", "remote error")))
         finally:
+            # mark disconnected so the NEXT generate() dials fresh — a
+            # client whose read loop died must not keep writing into a
+            # dead socket forever (in-flight streams still fail below;
+            # their bytes are gone)
+            self._connected = False
             for q in self._streams.values():
                 q.put_nowait(ConnectionError("endpoint connection lost"))
 
     async def _send(self, header: dict, payload: bytes = b"") -> None:
         async with self._wlock:
-            write_frame(self._writer, header, payload)
-            await self._writer.drain()
+            try:
+                write_frame(self._writer, header, payload)
+                await self._writer.drain()
+            except Exception:
+                # a failed write means THIS socket is dead: mark it so the
+                # next generate() (e.g. the service-layer retry) dials
+                # fresh instead of deterministically reusing the corpse
+                self._connected = False
+                raise
 
     def generate(self, request: Context) -> AsyncIterator[Any]:
         return self._generate(request)
@@ -191,11 +212,18 @@ class EndpointTcpClient(AsyncEngine):
         await self.connect()
         req_id = next(self._ids)
         q: asyncio.Queue = asyncio.Queue()
+        # registered BEFORE the send (a reply must not race the
+        # registration) — but cleaned up if the send itself fails, or the
+        # entry and its queue leak forever
         self._streams[req_id] = q
-        await self._send(
-            {"type": "request", "req_id": req_id, "subject": self.subject},
-            serde.dumps(request.data),
-        )
+        try:
+            await self._send(
+                {"type": "request", "req_id": req_id, "subject": self.subject},
+                serde.dumps(request.data),
+            )
+        except BaseException:
+            self._streams.pop(req_id, None)
+            raise
         cancel_task = asyncio.ensure_future(request.stopped())
         try:
             while True:

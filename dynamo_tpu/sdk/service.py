@@ -251,18 +251,29 @@ class RemoteEndpoint:
 
     async def _stream(self, payload: Any, instance_id: Optional[int]):
         client = await self._factory(self.endpoint)
-        ctx = Context(payload)
-        stream = (
-            client.direct(ctx, instance_id)
-            if instance_id is not None
-            else client.generate(ctx)
-        )
-        async for item in stream:
-            yield item
+        # one retry on a connection that breaks BEFORE the first item —
+        # idempotent at that point (nothing was streamed), and exactly
+        # the window where a stale pooled connection surfaces
+        for attempt in (0, 1):
+            ctx = Context(payload)
+            stream = (
+                client.direct(ctx, instance_id)
+                if instance_id is not None
+                else client.generate(ctx)
+            )
+            got_any = False
+            try:
+                async for item in stream:
+                    got_any = True
+                    yield item
+                return
+            except ConnectionError:
+                if got_any or attempt == 1:
+                    raise
 
     async def instance_ids(self) -> list[int]:
         client = await self._factory(self.endpoint)
-        return client.instance_ids
+        return client.instance_ids()
 
 
 class ServiceClient:

@@ -180,3 +180,45 @@ def test_remote_cancellation():
             await srv.stop()
 
     run(go())
+
+
+def test_tcp_client_reconnects_after_server_restart():
+    """A client whose read loop died (peer closed) marks itself
+    disconnected and dials fresh on the next request — a stale pooled
+    connection must not poison every subsequent request."""
+    from dynamo_tpu.runtime.echo import EchoEngine
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.runtime.transports.tcp import (
+        EndpointTcpClient,
+        EndpointTcpServer,
+    )
+
+    async def go():
+        srv = await EndpointTcpServer().start()
+        srv.register("s", EchoEngine())
+        port = srv.port
+        client = await EndpointTcpClient("127.0.0.1", port, "s").connect()
+
+        async def one():
+            return [o async for o in client.generate(Context([1, 2, 3]))]
+
+        assert await one() == [1, 2, 3]
+        await srv.stop()  # severs the live connection
+        await asyncio.sleep(0.05)
+        # same port, fresh server: the client must reconnect by itself
+        srv2 = await EndpointTcpServer(port=port).start()
+        srv2.register("s", EchoEngine())
+        try:
+            for _ in range(50):
+                try:
+                    assert await one() == [1, 2, 3]
+                    break
+                except ConnectionError:
+                    await asyncio.sleep(0.05)  # first call may hit the race
+            else:
+                raise AssertionError("client never recovered")
+        finally:
+            await srv2.stop()
+        await client.close()
+
+    run(go())
