@@ -142,22 +142,30 @@ class EndpointTcpClient(AsyncEngine):
         self._streams: dict[int, asyncio.Queue] = {}
         self._read_task: Optional[asyncio.Task] = None
         self._wlock = asyncio.Lock()
+        self._connect_lock = asyncio.Lock()
         self._connected = False
 
     async def connect(self) -> "EndpointTcpClient":
-        if not self._connected:
-            # reconnect path: drop the previous socket/read task first so
-            # N endpoint restarts don't leak N transports
-            if self._read_task is not None:
-                self._read_task.cancel()
-            if self._writer is not None:
-                try:
-                    self._writer.close()
-                except Exception:
-                    pass
-            self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
-            self._read_task = asyncio.ensure_future(self._read_loop())
-            self._connected = True
+        # serialized: concurrent reconnects (several in-flight requests
+        # all retrying after a server restart) would otherwise dial twice,
+        # overwrite each other's reader/writer, and leave two read loops
+        # fighting over one StreamReader
+        async with self._connect_lock:
+            if not self._connected:
+                # reconnect path: drop the previous socket/read task first
+                # so N endpoint restarts don't leak N transports
+                if self._read_task is not None:
+                    self._read_task.cancel()
+                if self._writer is not None:
+                    try:
+                        self._writer.close()
+                    except Exception:
+                        pass
+                self._reader, self._writer = await asyncio.open_connection(
+                    self.host, self.port
+                )
+                self._read_task = asyncio.ensure_future(self._read_loop())
+                self._connected = True
         return self
 
     async def close(self) -> None:

@@ -23,3 +23,36 @@ def test_serve_bench_echo_mode():
     assert [l["concurrency"] for l in levels] == [1, 2]
     assert all(l["ttft_p50_ms"] >= 0 for l in levels)
 
+
+
+def test_bench_py_cpu_smoke():
+    """The driver's scored artifact (`bench.py`) runs end-to-end on CPU
+    and emits ONE valid JSON line with the expected fields — a bench
+    regression must fail the suite, not the round's measurement."""
+    import os
+
+    repo = Path(__file__).parent.parent
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=str(repo),
+        DYNAMO_BENCH_STEPS="2",
+        DYNAMO_BENCH_BATCH="2",
+        DYNAMO_BENCH_ISL="16",
+        DYNAMO_BENCH_TTFT_ISL="32",
+        DYNAMO_BENCH_MAX_LEN="256",
+        DYNAMO_BENCH_DECODE_STEPS="2",
+    )
+    r = subprocess.run(
+        [sys.executable, str(repo / "bench.py")],
+        capture_output=True, text=True, timeout=420, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "decode_tok_s_per_chip"
+    assert rec["value"] > 0
+    assert rec["platform"] == "cpu"
+    assert rec["ttft_p50_ms"] is None or rec["ttft_p50_ms"] > 0
+    assert "kernels" in rec and "prefill_tok_s" in rec
