@@ -1,0 +1,522 @@
+"""DeepSeek-V2 family (MLA + DeepSeekMoE) for paged serving.
+
+Multi-head Latent Attention projects hidden states through low-rank
+latents (``kv_a`` → norm → ``kv_b``) and splits queries/keys into a
+no-position part and a small rotary part shared across heads; the MoE
+layers combine softmax-routed experts (optionally group-limited routing)
+scaled by ``routed_scaling_factor`` with always-on shared experts, and
+the first ``first_k_dense_replace`` layers use a plain dense MLP.
+
+TPU mapping in this first landing:
+  * The paged cache stores the EXPANDED per-head K (nope‖rope, width
+    qk_head_dim) and V padded to the same width — it drops straight into
+    the engine's [L, N, 2, Bs, Hk·D] pool and the generic paged
+    attention, at the cost of caching H·qk_head_dim per token instead of
+    MLA's compact latent (kv_lora_rank + rope).  An absorbed-latent
+    cache (the MLA memory win) is the follow-up optimisation; this form
+    is logit-exact vs transformers (tests/test_deepseek.py).
+  * Two ``lax.scan`` stacks — dense-MLP layers then MoE layers — because
+    the two layer kinds carry different parameter pytrees; attention
+    parameters are stacked per group.
+  * Routed experts run the same sort-by-expert + ``lax.ragged_dot``
+    grouped dispatch as the Llama-family MoE (models/llama.py), sharded
+    TP-within-experts.
+  * RoPE is DeepSeek's INTERLEAVED complex-pair form (adjacent element
+    pairs rotate together), unlike the Llama rotate-half layout.
+  * The Pallas attention kernels currently assume lane-friendly head
+    dims; serve this family with DYNAMO_DISABLE_PALLAS=1 until an MLA
+    kernel lands (the pure-JAX paged path is used in tests).
+
+Reference parity: the reference serves DeepSeek through vLLM (its patch
+carries a DeepSeek MoE tweak, container/deps/vllm patch:4074); here the
+family is native.  HF oracle: transformers DeepseekV2ForCausalLM.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dynamo_tpu.models.llama import rms_norm, rope_inv_freq
+from dynamo_tpu.ops.paged_attention import (
+    paged_attention_layer,
+    write_kv_cache_layer,
+)
+
+Params = Any
+
+__all__ = ["DeepseekConfig", "DeepseekModel", "convert_hf_state_dict"]
+
+
+@dataclass
+class DeepseekConfig:
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    qk_nope_head_dim: int
+    qk_rope_head_dim: int
+    v_head_dim: int
+    kv_lora_rank: int
+    q_lora_rank: Optional[int] = None      # None = direct q_proj (V2-Lite)
+    intermediate_size: int = 0             # dense-MLP layers
+    moe_intermediate_size: int = 0
+    n_routed_experts: int = 0
+    num_experts_per_tok: int = 0
+    n_shared_experts: int = 0
+    routed_scaling_factor: float = 1.0
+    topk_method: str = "greedy"            # or "group_limited_greedy"
+    n_group: int = 1
+    topk_group: int = 1
+    first_k_dense_replace: int = 0
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    max_position_embeddings: int = 4096
+    dtype: str = "bfloat16"
+    attention_bias: bool = False
+
+    @property
+    def qk_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    # ---- engine-facing surface (duck-typed like ModelConfig) ----
+    @property
+    def num_kv_heads(self) -> int:
+        return self.num_heads  # expanded-KV cache: one K/V row per head
+
+    @property
+    def head_dim(self) -> int:
+        return self.qk_head_dim  # cache row width (V padded up to it)
+
+    @property
+    def jax_dtype(self):
+        return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[self.dtype]
+
+    @classmethod
+    def from_hf(cls, cfg) -> "DeepseekConfig":
+        """transformers DeepseekV2Config (object or dict) → DeepseekConfig."""
+        g = (lambda k, d=None: cfg.get(k, d)) if isinstance(cfg, dict) \
+            else (lambda k, d=None: getattr(cfg, k, d))
+        # loud rejection of anything this port would get silently WRONG —
+        # same policy as ModelConfig's rope_scaling handling
+        if int(g("moe_layer_freq", 1)) != 1:
+            raise NotImplementedError("moe_layer_freq != 1")
+        if g("rope_scaling") not in (None, {}):
+            raise NotImplementedError(
+                "DeepSeek rope_scaling (yarn + mscale softmax correction) "
+                "is not implemented yet — loading this checkpoint would "
+                "produce silently wrong logits at every position"
+            )
+        if g("topk_method", "greedy") not in ("greedy",
+                                              "group_limited_greedy"):
+            raise NotImplementedError(
+                f"topk_method {g('topk_method')!r} (e.g. V3's noaux_tc) "
+                "is not implemented"
+            )
+        if bool(g("norm_topk_prob", False)):
+            raise NotImplementedError("norm_topk_prob=True routing")
+        if g("scoring_func", "softmax") != "softmax":
+            raise NotImplementedError(
+                f"scoring_func {g('scoring_func')!r}"
+            )
+        return cls(
+            vocab_size=g("vocab_size"),
+            hidden_size=g("hidden_size"),
+            num_layers=g("num_hidden_layers"),
+            num_heads=g("num_attention_heads"),
+            qk_nope_head_dim=g("qk_nope_head_dim"),
+            qk_rope_head_dim=g("qk_rope_head_dim"),
+            v_head_dim=g("v_head_dim"),
+            kv_lora_rank=g("kv_lora_rank"),
+            q_lora_rank=g("q_lora_rank"),
+            intermediate_size=g("intermediate_size"),
+            moe_intermediate_size=g("moe_intermediate_size", 0) or 0,
+            n_routed_experts=g("n_routed_experts", 0) or 0,
+            num_experts_per_tok=g("num_experts_per_tok", 0) or 0,
+            n_shared_experts=g("n_shared_experts", 0) or 0,
+            routed_scaling_factor=float(g("routed_scaling_factor", 1.0)),
+            topk_method=g("topk_method", "greedy"),
+            n_group=g("n_group", 1) or 1,
+            topk_group=g("topk_group", 1) or 1,
+            first_k_dense_replace=g("first_k_dense_replace", 0) or 0,
+            rms_norm_eps=float(g("rms_norm_eps", 1e-6)),
+            rope_theta=float(g("rope_theta", 10000.0)),
+            max_position_embeddings=g("max_position_embeddings", 4096),
+            attention_bias=bool(g("attention_bias", False)),
+        )
+
+
+def apply_rope_interleaved(x: jax.Array, positions: jax.Array,
+                           inv_freq: jax.Array) -> jax.Array:
+    """DeepSeek rotary: adjacent element PAIRS (2i, 2i+1) rotate by
+    pos·inv_freq[i] (the complex ``freqs_cis`` form in transformers),
+    unlike Llama's rotate-half layout.  x: [B,S,H,Dr]."""
+    b, s, h, d = x.shape
+    angles = positions.astype(jnp.float32)[:, :, None] * inv_freq[None, None, :]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B,S,1,d/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    xr = x.astype(jnp.float32).reshape(b, s, h, d // 2, 2)
+    x0, x1 = xr[..., 0], xr[..., 1]
+    out = jnp.stack([x0 * cos - x1 * sin, x0 * sin + x1 * cos], axis=-1)
+    return out.reshape(b, s, h, d).astype(x.dtype)
+
+
+class DeepseekModel:
+    """Engine-facing functional model (same protocol as LlamaModel)."""
+
+    def __init__(self, config: DeepseekConfig):
+        self.config = config
+        self.sm_scale = float(config.qk_head_dim ** -0.5)
+        self.inv_freq = rope_inv_freq(config.qk_rope_head_dim,
+                                      config.rope_theta)
+
+    # ------------------------------------------------------------------ init
+    def _attn_params(self, keys, n_layers: int) -> dict:
+        cfg = self.config
+        dt = cfg.jax_dtype
+        dm, h = cfg.hidden_size, cfg.num_heads
+        qk, rope, v = cfg.qk_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+
+        def dense(key, shape, fan_in):
+            return (jax.random.normal(key, shape, jnp.float32)
+                    / math.sqrt(fan_in)).astype(dt)
+
+        p = {
+            "attn_norm": jnp.ones((n_layers, dm), dt),
+            "mlp_norm": jnp.ones((n_layers, dm), dt),
+            "kv_a": dense(next(keys), (n_layers, dm, cfg.kv_lora_rank + rope), dm),
+            "kv_a_norm": jnp.ones((n_layers, cfg.kv_lora_rank), dt),
+            "kv_b": dense(next(keys),
+                          (n_layers, cfg.kv_lora_rank,
+                           h * (cfg.qk_nope_head_dim + v)), cfg.kv_lora_rank),
+            "wo": dense(next(keys), (n_layers, h * v, dm), h * v),
+        }
+        if cfg.q_lora_rank is None:
+            p["wq"] = dense(next(keys), (n_layers, dm, h * qk), dm)
+        else:
+            p["q_a"] = dense(next(keys), (n_layers, dm, cfg.q_lora_rank), dm)
+            p["q_a_norm"] = jnp.ones((n_layers, cfg.q_lora_rank), dt)
+            p["q_b"] = dense(next(keys), (n_layers, cfg.q_lora_rank, h * qk),
+                             cfg.q_lora_rank)
+        return p
+
+    def init_params(self, rng: jax.Array) -> Params:
+        cfg = self.config
+        dt = cfg.jax_dtype
+        dm = cfg.hidden_size
+        keys = iter(jax.random.split(rng, 32))
+
+        def dense(key, shape, fan_in):
+            return (jax.random.normal(key, shape, jnp.float32)
+                    / math.sqrt(fan_in)).astype(dt)
+
+        ld = cfg.first_k_dense_replace
+        lm = cfg.num_layers - ld
+        dense_layers = self._attn_params(keys, ld)
+        dense_layers.update(
+            w_gate=dense(next(keys), (ld, dm, cfg.intermediate_size), dm),
+            w_up=dense(next(keys), (ld, dm, cfg.intermediate_size), dm),
+            w_down=dense(next(keys), (ld, cfg.intermediate_size, dm),
+                         cfg.intermediate_size),
+        )
+        fm = cfg.moe_intermediate_size
+        fs = fm * cfg.n_shared_experts
+        e = cfg.n_routed_experts
+        moe_layers = self._attn_params(keys, lm)
+        moe_layers.update(
+            router=(jax.random.normal(next(keys), (lm, dm, e), jnp.float32)
+                    / math.sqrt(dm)).astype(dt),
+            w_gate=dense(next(keys), (lm, e, dm, fm), dm),
+            w_up=dense(next(keys), (lm, e, dm, fm), dm),
+            w_down=dense(next(keys), (lm, e, fm, dm), fm),
+            shared_gate=dense(next(keys), (lm, dm, fs), dm),
+            shared_up=dense(next(keys), (lm, dm, fs), dm),
+            shared_down=dense(next(keys), (lm, fs, dm), fs),
+        )
+        return {
+            "embed": dense(next(keys), (cfg.vocab_size, dm), dm),
+            "dense_layers": dense_layers,
+            "moe_layers": moe_layers,
+            "final_norm": jnp.ones((dm,), dt),
+            "lm_head": dense(next(keys), (dm, cfg.vocab_size), dm),
+        }
+
+    # -------------------------------------------------------------- sharding
+    def partition_specs(self) -> Params:
+        """TP over "model": attention heads column-split, wo row-split,
+        MoE experts TP-within-experts (FFN dim), shared experts like a
+        dense MLP.  (Single-host tested; mesh execution follows the same
+        GSPMD path as the Llama family.)"""
+        cfg = self.config
+
+        def attn(n):
+            p = {
+                "attn_norm": P(None, None), "mlp_norm": P(None, None),
+                "kv_a": P(None, None, None),
+                "kv_a_norm": P(None, None),
+                "kv_b": P(None, None, "model"),
+                "wo": P(None, "model", None),
+            }
+            if cfg.q_lora_rank is None:
+                p["wq"] = P(None, None, "model")
+            else:
+                p.update(q_a=P(None, None, None), q_a_norm=P(None, None),
+                         q_b=P(None, None, "model"))
+            return p
+
+        dense_layers = attn(cfg.first_k_dense_replace)
+        dense_layers.update(
+            w_gate=P(None, None, "model"), w_up=P(None, None, "model"),
+            w_down=P(None, "model", None),
+        )
+        moe_layers = attn(cfg.num_layers - cfg.first_k_dense_replace)
+        moe_layers.update(
+            router=P(None, None, None),
+            w_gate=P(None, None, None, "model"),
+            w_up=P(None, None, None, "model"),
+            w_down=P(None, None, "model", None),
+            shared_gate=P(None, None, "model"),
+            shared_up=P(None, None, "model"),
+            shared_down=P(None, "model", None),
+        )
+        return {
+            "embed": P(None, None),
+            "dense_layers": dense_layers,
+            "moe_layers": moe_layers,
+            "final_norm": P(None),
+            "lm_head": P(None, "model"),
+        }
+
+    def cache_spec(self, quant: bool = False):
+        if quant:
+            raise NotImplementedError("int8 KV for MLA lands with the "
+                                      "absorbed-latent cache")
+        return P(None, None, None, None, "model")
+
+    # --------------------------------------------------------------- kv cache
+    def init_kv_cache(self, num_blocks: int, block_size: int, dtype=None):
+        cfg = self.config
+        if dtype is not None and str(dtype) not in (str(cfg.jax_dtype),
+                                                    cfg.dtype):
+            raise NotImplementedError(
+                "MLA cache dtype override (int8) lands with the "
+                "absorbed-latent cache"
+            )
+        return jnp.zeros(
+            (cfg.num_layers, num_blocks, 2, block_size,
+             cfg.num_heads * cfg.qk_head_dim),
+            cfg.jax_dtype,
+        )
+
+    # ---------------------------------------------------------------- forward
+    def _attention(self, lp, li, h_in, positions, cache, block_tables,
+                   seq_lens, slot_idx):
+        cfg = self.config
+        b, s = positions.shape
+        nh = cfg.num_heads
+        nope, rope, vd = (cfg.qk_nope_head_dim, cfg.qk_rope_head_dim,
+                          cfg.v_head_dim)
+        x = rms_norm(h_in, lp["attn_norm"], cfg.rms_norm_eps)
+        if cfg.q_lora_rank is None:
+            q = x @ lp["wq"]
+        else:
+            q = rms_norm(x @ lp["q_a"], lp["q_a_norm"], cfg.rms_norm_eps) \
+                @ lp["q_b"]
+        q = q.reshape(b, s, nh, cfg.qk_head_dim)
+        q_nope, q_pe = q[..., :nope], q[..., nope:]
+
+        ckv = x @ lp["kv_a"]  # [B,S, kv_lora + rope]
+        c_kv, k_pe = ckv[..., :cfg.kv_lora_rank], ckv[..., cfg.kv_lora_rank:]
+        kv = rms_norm(c_kv, lp["kv_a_norm"], cfg.rms_norm_eps) @ lp["kv_b"]
+        kv = kv.reshape(b, s, nh, nope + vd)
+        k_nope, v = kv[..., :nope], kv[..., nope:]
+
+        k_pe = apply_rope_interleaved(
+            k_pe[:, :, None, :], positions, self.inv_freq
+        )  # [B,S,1,rope] — shared across heads
+        q_pe = apply_rope_interleaved(q_pe, positions, self.inv_freq)
+
+        q = jnp.concatenate([q_nope, q_pe], axis=-1)  # [B,S,H,qk_head]
+        k = jnp.concatenate(
+            [k_nope, jnp.broadcast_to(k_pe, (*k_nope.shape[:-1], rope))],
+            axis=-1,
+        )
+        # V padded to the cache row width; sliced back after attention
+        v_pad = jnp.pad(v, ((0, 0), (0, 0), (0, 0),
+                            (0, cfg.qk_head_dim - vd)))
+        cache = write_kv_cache_layer(cache, li, k, v_pad, slot_idx)
+        attn = paged_attention_layer(
+            q, cache, li, block_tables, seq_lens, positions,
+            sm_scale=self.sm_scale,
+        )  # [B,S,H,qk_head]
+        attn = attn[..., :vd].reshape(b, s, nh * vd)
+        return h_in + attn @ lp["wo"], cache
+
+    def _moe_mlp(self, lp, x):
+        """DeepSeekMoE: softmax routing (optionally group-limited) ×
+        routed_scaling_factor through the grouped ragged_dot dispatch,
+        plus the always-on shared experts."""
+        cfg = self.config
+        b, s, d = x.shape
+        t = b * s
+        e, k = cfg.n_routed_experts, cfg.num_experts_per_tok
+        xf = x.reshape(t, d)
+        scores = jax.nn.softmax(
+            (xf @ lp["router"]).astype(jnp.float32), axis=-1
+        )  # [T,E] — HF gates in f32 over the FULL expert set
+        if cfg.topk_method == "group_limited_greedy":
+            gs = scores.reshape(t, cfg.n_group, -1).max(axis=-1)  # [T,G]
+            _, gidx = jax.lax.top_k(gs, cfg.topk_group)
+            gmask = jnp.zeros_like(gs).at[
+                jnp.arange(t)[:, None], gidx
+            ].set(1.0)
+            scores = scores * jnp.repeat(gmask, e // cfg.n_group, axis=-1)
+        weights, topi = jax.lax.top_k(scores, k)  # [T,k]
+        weights = weights * cfg.routed_scaling_factor
+
+        flat_e = topi.reshape(t * k)
+        order = jnp.argsort(flat_e)
+        token_idx = order // k
+        xs = xf[token_idx]
+        group_sizes = jnp.bincount(flat_e, length=e).astype(jnp.int32)
+        gate = jax.lax.ragged_dot(xs, lp["w_gate"], group_sizes)
+        up = jax.lax.ragged_dot(xs, lp["w_up"], group_sizes)
+        out = jax.lax.ragged_dot(jax.nn.silu(gate) * up, lp["w_down"],
+                                 group_sizes)
+        out = out * weights.reshape(t * k)[order, None].astype(out.dtype)
+        routed = out[jnp.argsort(order)].reshape(t, k, d).sum(axis=1)
+
+        shared = (jax.nn.silu(xf @ lp["shared_gate"]) * (xf @ lp["shared_up"])
+                  ) @ lp["shared_down"]
+        return (routed + shared).reshape(b, s, d)
+
+    def forward(self, params, tokens, positions, cache, block_tables,
+                seq_lens, slot_idx, prefix_blocks=None):
+        """(hidden [B,S,Dm], cache).  ``prefix_blocks`` is accepted for
+        engine compatibility; MLA always takes the generic paged path."""
+        cfg = self.config
+        hidden = params["embed"][tokens].astype(cfg.jax_dtype)
+
+        def dense_step(carry, layer_in):
+            h, cache = carry
+            lp, li = layer_in
+            h, cache = self._attention(lp, li, h, positions, cache,
+                                       block_tables, seq_lens, slot_idx)
+            x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+            h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) \
+                @ lp["w_down"]
+            return (h, cache), None
+
+        def moe_step(carry, layer_in):
+            h, cache = carry
+            lp, li = layer_in
+            h, cache = self._attention(lp, li, h, positions, cache,
+                                       block_tables, seq_lens, slot_idx)
+            x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+            h = h + self._moe_mlp(lp, x)
+            return (h, cache), None
+
+        ld = cfg.first_k_dense_replace
+        carry = (hidden, cache)
+        if ld:
+            carry, _ = jax.lax.scan(
+                dense_step, carry,
+                (params["dense_layers"], jnp.arange(ld, dtype=jnp.int32)),
+            )
+        carry, _ = jax.lax.scan(
+            moe_step, carry,
+            (params["moe_layers"],
+             jnp.arange(ld, cfg.num_layers, dtype=jnp.int32)),
+        )
+        hidden, cache = carry
+        hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
+        return hidden, cache
+
+    def compute_logits(self, params, hidden):
+        w = params["lm_head"]
+        return jnp.matmul(hidden.astype(w.dtype), w,
+                          preferred_element_type=jnp.float32)
+
+
+# ------------------------------------------------------------- HF weights ----
+def convert_hf_state_dict(sd: dict, cfg: DeepseekConfig) -> Params:
+    """transformers DeepseekV2ForCausalLM state dict → DeepseekModel
+    params (numpy in, jnp out).  Linear weights transpose to [in, out]."""
+    import numpy as _np
+
+    dt = cfg.jax_dtype
+
+    def w(name):
+        return _np.asarray(sd[name], dtype=_np.float32)
+
+    def lin(name):
+        return w(name).T  # torch [out, in] -> [in, out]
+
+    def stack(fmt, layers, f):
+        return jnp.asarray(_np.stack([f(fmt.format(i)) for i in layers]), dt)
+
+    ld = cfg.first_k_dense_replace
+    dense_idx = list(range(ld))
+    moe_idx = list(range(ld, cfg.num_layers))
+
+    def attn_group(idx):
+        pre = "model.layers.{}."
+        g = {
+            "attn_norm": stack(pre + "input_layernorm.weight", idx, w),
+            "mlp_norm": stack(pre + "post_attention_layernorm.weight", idx, w),
+            "kv_a": stack(pre + "self_attn.kv_a_proj_with_mqa.weight", idx, lin),
+            "kv_a_norm": stack(pre + "self_attn.kv_a_layernorm.weight", idx, w),
+            "kv_b": stack(pre + "self_attn.kv_b_proj.weight", idx, lin),
+            "wo": stack(pre + "self_attn.o_proj.weight", idx, lin),
+        }
+        if cfg.q_lora_rank is None:
+            g["wq"] = stack(pre + "self_attn.q_proj.weight", idx, lin)
+        else:
+            g["q_a"] = stack(pre + "self_attn.q_a_proj.weight", idx, lin)
+            g["q_a_norm"] = stack(pre + "self_attn.q_a_layernorm.weight", idx, w)
+            g["q_b"] = stack(pre + "self_attn.q_b_proj.weight", idx, lin)
+        return g
+
+    dense_layers = attn_group(dense_idx)
+    dense_layers.update(
+        w_gate=stack("model.layers.{}.mlp.gate_proj.weight", dense_idx, lin),
+        w_up=stack("model.layers.{}.mlp.up_proj.weight", dense_idx, lin),
+        w_down=stack("model.layers.{}.mlp.down_proj.weight", dense_idx, lin),
+    )
+
+    def experts(kind):
+        e = cfg.n_routed_experts
+
+        def per_layer(i):
+            return _np.stack([
+                lin(f"model.layers.{i}.mlp.experts.{j}.{kind}.weight")
+                for j in range(e)
+            ])
+
+        return jnp.asarray(_np.stack([per_layer(i) for i in moe_idx]), dt)
+
+    moe_layers = attn_group(moe_idx)
+    moe_layers.update(
+        router=stack("model.layers.{}.mlp.gate.weight", moe_idx, lin),
+        w_gate=experts("gate_proj"),
+        w_up=experts("up_proj"),
+        w_down=experts("down_proj"),
+        shared_gate=stack(
+            "model.layers.{}.mlp.shared_experts.gate_proj.weight", moe_idx, lin),
+        shared_up=stack(
+            "model.layers.{}.mlp.shared_experts.up_proj.weight", moe_idx, lin),
+        shared_down=stack(
+            "model.layers.{}.mlp.shared_experts.down_proj.weight", moe_idx, lin),
+    )
+    return {
+        "embed": jnp.asarray(w("model.embed_tokens.weight"), dt),
+        "dense_layers": dense_layers,
+        "moe_layers": moe_layers,
+        "final_norm": jnp.asarray(w("model.norm.weight"), dt),
+        "lm_head": jnp.asarray(lin("lm_head.weight"), dt),
+    }

@@ -144,6 +144,7 @@ class EndpointTcpClient(AsyncEngine):
         self._wlock = asyncio.Lock()
         self._connect_lock = asyncio.Lock()
         self._connected = False
+        self._closed = False
 
     async def connect(self) -> "EndpointTcpClient":
         # serialized: concurrent reconnects (several in-flight requests
@@ -151,6 +152,8 @@ class EndpointTcpClient(AsyncEngine):
         # overwrite each other's reader/writer, and leave two read loops
         # fighting over one StreamReader
         async with self._connect_lock:
+            if self._closed:
+                raise ConnectionError("endpoint client is closed")
             if not self._connected:
                 # reconnect path: drop the previous socket/read task first
                 # so N endpoint restarts don't leak N transports
@@ -169,11 +172,16 @@ class EndpointTcpClient(AsyncEngine):
         return self
 
     async def close(self) -> None:
-        if self._read_task:
-            self._read_task.cancel()
-        if self._writer:
-            self._writer.close()
-        self._connected = False
+        # under the connect lock + a closed flag: a close() racing a
+        # mid-dial connect() must not be overwritten by the dial landing
+        # afterwards (leaked socket + live read loop on a closed client)
+        self._closed = True
+        async with self._connect_lock:
+            if self._read_task:
+                self._read_task.cancel()
+            if self._writer:
+                self._writer.close()
+            self._connected = False
 
     async def _read_loop(self) -> None:
         try:
