@@ -35,24 +35,37 @@ MODELS_PREFIX = "models/"  # under {namespace}/
 
 
 def _load_any_checkpoint(path: str, dtype):
-    """(cfg, params, quantized) for any supported checkpoint format:
-    native (dynamo-tpu quantize), GGUF, or HF safetensors dir.  ``dtype``
-    None = native checkpoints keep their stored dtype, others bf16."""
+    """(model, params, quantized) for any supported checkpoint format:
+    native (dynamo-tpu quantize), GGUF, or HF safetensors dir (Llama
+    family via the unified decoder; DeepSeek dirs via the MLA model).
+    ``dtype`` None = native checkpoints keep their stored dtype, others
+    bf16."""
     from dynamo_tpu.models.checkpoint import is_native_checkpoint, load_checkpoint
+    from dynamo_tpu.models.llama import LlamaModel
 
     if is_native_checkpoint(path):
         # pre-converted native checkpoint: params load in their serving
         # dtype — no per-start bf16 load + quantize pass
-        return load_checkpoint(path, dtype=dtype)
+        cfg, params, quantized = load_checkpoint(path, dtype=dtype)
+        return LlamaModel(cfg), params, quantized
     if path.endswith(".gguf"):
         from dynamo_tpu.llm.gguf import load_gguf_model
 
         cfg, params = load_gguf_model(path, dtype=dtype or "bfloat16")
-    else:
-        from dynamo_tpu.models.loader import load_model_dir
+        return LlamaModel(cfg), params, False
+    from dynamo_tpu.models.loader import (
+        is_deepseek_dir,
+        load_deepseek_dir,
+        load_model_dir,
+    )
 
-        cfg, params = load_model_dir(path, dtype=dtype or "bfloat16")
-    return cfg, params, False
+    if is_deepseek_dir(path):
+        from dynamo_tpu.models.deepseek import DeepseekModel
+
+        dcfg, params = load_deepseek_dir(path, dtype=dtype or "bfloat16")
+        return DeepseekModel(dcfg), params, False
+    cfg, params = load_model_dir(path, dtype=dtype or "bfloat16")
+    return LlamaModel(cfg), params, False
 
 
 def _build_local_engine(args) -> tuple[object, object]:
@@ -90,7 +103,6 @@ def _build_local_engine(args) -> tuple[object, object]:
         return EchoEngineCore(), card
 
     from dynamo_tpu.engine import AsyncLLMEngine, EngineConfig, EngineCore
-    from dynamo_tpu.models.llama import LlamaModel
 
     # multi-host: join the jax.distributed mesh BEFORE any JAX array is
     # created — loading/quantizing weights initializes the backend, and
@@ -113,9 +125,12 @@ def _build_local_engine(args) -> tuple[object, object]:
     # --dtype default is None so the native branch can tell "explicitly
     # requested" from "use the checkpoint's stored dtype"
     dtype = getattr(args, "dtype", None)
-    model_cfg, params, quantized = _load_any_checkpoint(args.model_path, dtype)
-    model = LlamaModel(model_cfg)
+    model, params, quantized = _load_any_checkpoint(args.model_path, dtype)
     if getattr(args, "quantize", "none") == "int8" and not quantized:
+        if not hasattr(model, "quantize_params"):
+            raise SystemExit(
+                "--quantize int8 is not wired for this model family yet"
+            )
         # int8 weight-only serving (models/quant.py): ~2x HBM headroom
         params = model.quantize_params(params)
 
@@ -145,8 +160,8 @@ def _build_local_engine(args) -> tuple[object, object]:
         # the target verifies (engine/draft.py).  Accepts the same
         # checkpoint formats as --model-path (native / GGUF / HF dir);
         # loads unsharded.
-        dcfg, dparams, _ = _load_any_checkpoint(dpath, dtype)
-        draft = (LlamaModel(dcfg), dparams)
+        dmodel, dparams, _ = _load_any_checkpoint(dpath, dtype)
+        draft = (dmodel, dparams)
     core = EngineCore(
         model, params, cfg, mesh=mesh,
         eos_token_ids=card.eos_token_ids or None, draft=draft,

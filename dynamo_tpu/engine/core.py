@@ -303,6 +303,13 @@ class EngineCore:
             and "data" in mesh.axis_names
             and mesh.shape["data"] > 1
         ):
+            if not hasattr(model, "forward_seq_parallel"):
+                # fail at construction, not mid-serving on the first long
+                # prompt (e.g. the MLA family has no ring-attention path yet)
+                raise ValueError(
+                    f"{type(model).__name__} has no forward_seq_parallel; "
+                    "disable sp_prefill_threshold for this model"
+                )
             self._sp_size = mesh.shape["data"]
             self._sp_fn = jax.jit(
                 self._sp_impl, static_argnames=("nb", "k_cand", "exact")

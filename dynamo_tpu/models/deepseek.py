@@ -7,14 +7,19 @@ layers combine softmax-routed experts (optionally group-limited routing)
 scaled by ``routed_scaling_factor`` with always-on shared experts, and
 the first ``first_k_dense_replace`` layers use a plain dense MLP.
 
-TPU mapping in this first landing:
-  * The paged cache stores the EXPANDED per-head K (nope‖rope, width
-    qk_head_dim) and V padded to the same width — it drops straight into
-    the engine's [L, N, 2, Bs, Hk·D] pool and the generic paged
-    attention, at the cost of caching H·qk_head_dim per token instead of
-    MLA's compact latent (kv_lora_rank + rope).  An absorbed-latent
-    cache (the MLA memory win) is the follow-up optimisation; this form
-    is logit-exact vs transformers (tests/test_deepseek.py).
+TPU mapping:
+  * Default ``attn_impl="absorbed"`` — the MLA deployment shape: the
+    paged cache stores ONE shared latent row per token (c_hat ‖ roped
+    k_pe, width kv_lora_rank+rope), queries absorb kv_b's K-half into
+    latent space, attention runs as GQA with a single KV head, and the
+    attended latent expands per head through kv_b's V-half.  This is the
+    MLA memory win — the generic pool's K/V axis still holds the row
+    twice, so the per-token cost is 2·(kv_lora+rope) (1,152 for
+    DeepSeek-V2 vs 49,152 expanded at 128 heads; collapsing the
+    duplicate plane is a follow-up) — and is logit-exact vs
+    transformers.
+  * ``attn_impl="expanded"`` keeps the per-head K/V oracle (V padded to
+    qk_head_dim) — parity baseline and debugging aid.
   * Two ``lax.scan`` stacks — dense-MLP layers then MoE layers — because
     the two layer kinds carry different parameter pytrees; attention
     parameters are stacked per group.
@@ -42,7 +47,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from dynamo_tpu.models.llama import rms_norm, rope_inv_freq
+from dynamo_tpu.models.llama import (
+    grouped_expert_dispatch,
+    rms_norm,
+    rope_inv_freq,
+)
 from dynamo_tpu.ops.paged_attention import (
     paged_attention_layer,
     write_kv_cache_layer,
@@ -79,6 +88,9 @@ class DeepseekConfig:
     max_position_embeddings: int = 4096
     dtype: str = "bfloat16"
     attention_bias: bool = False
+    # "absorbed" (default, the MLA deployment shape: latent cache, one
+    # shared KV head) or "expanded" (per-head K/V oracle)
+    attn_impl: str = "absorbed"
 
     @property
     def qk_head_dim(self) -> int:
@@ -87,10 +99,12 @@ class DeepseekConfig:
     # ---- engine-facing surface (duck-typed like ModelConfig) ----
     @property
     def num_kv_heads(self) -> int:
-        return self.num_heads  # expanded-KV cache: one K/V row per head
+        return 1 if self.attn_impl == "absorbed" else self.num_heads
 
     @property
     def head_dim(self) -> int:
+        if self.attn_impl == "absorbed":
+            return self.kv_lora_rank + self.qk_rope_head_dim
         return self.qk_head_dim  # cache row width (V padded up to it)
 
     @property
@@ -123,6 +137,10 @@ class DeepseekConfig:
         if g("scoring_func", "softmax") != "softmax":
             raise NotImplementedError(
                 f"scoring_func {g('scoring_func')!r}"
+            )
+        if bool(g("attention_bias", False)):
+            raise NotImplementedError(
+                "attention_bias=True (biases would be silently dropped)"
             )
         return cls(
             vocab_size=g("vocab_size"),
@@ -294,8 +312,11 @@ class DeepseekModel:
 
     def cache_spec(self, quant: bool = False):
         if quant:
-            raise NotImplementedError("int8 KV for MLA lands with the "
-                                      "absorbed-latent cache")
+            raise NotImplementedError("int8 KV for MLA is not wired yet")
+        if self.config.attn_impl == "absorbed":
+            # ONE shared latent row per token: nothing head-sharded to
+            # split — the latent replicates (it is tiny: kv_lora+rope)
+            return P(None, None, None, None, None)
         return P(None, None, None, None, "model")
 
     # --------------------------------------------------------------- kv cache
@@ -303,25 +324,26 @@ class DeepseekModel:
         cfg = self.config
         if dtype is not None and str(dtype) not in (str(cfg.jax_dtype),
                                                     cfg.dtype):
-            raise NotImplementedError(
-                "MLA cache dtype override (int8) lands with the "
-                "absorbed-latent cache"
-            )
+            raise NotImplementedError("MLA cache dtype override (int8)")
+        if cfg.attn_impl == "absorbed":
+            # the MLA memory win: per token a kv_lora+rope row (stored in
+            # both K/V planes of the generic pool — still ~43x smaller
+            # than the expanded form at V2's 128 heads)
+            width = cfg.kv_lora_rank + cfg.qk_rope_head_dim
+        else:
+            width = cfg.num_heads * cfg.qk_head_dim
         return jnp.zeros(
-            (cfg.num_layers, num_blocks, 2, block_size,
-             cfg.num_heads * cfg.qk_head_dim),
+            (cfg.num_layers, num_blocks, 2, block_size, width),
             cfg.jax_dtype,
         )
 
     # ---------------------------------------------------------------- forward
-    def _attention(self, lp, li, h_in, positions, cache, block_tables,
-                   seq_lens, slot_idx):
+    def _qkv_latent(self, lp, x, positions):
+        """Shared front half of both attention forms: per-head queries
+        (nope ‖ roped pe) and the per-token latent pieces."""
         cfg = self.config
-        b, s = positions.shape
-        nh = cfg.num_heads
-        nope, rope, vd = (cfg.qk_nope_head_dim, cfg.qk_rope_head_dim,
-                          cfg.v_head_dim)
-        x = rms_norm(h_in, lp["attn_norm"], cfg.rms_norm_eps)
+        b, s, _ = x.shape
+        nh, nope = cfg.num_heads, cfg.qk_nope_head_dim
         if cfg.q_lora_rank is None:
             q = x @ lp["wq"]
         else:
@@ -329,17 +351,40 @@ class DeepseekModel:
                 @ lp["q_b"]
         q = q.reshape(b, s, nh, cfg.qk_head_dim)
         q_nope, q_pe = q[..., :nope], q[..., nope:]
+        q_pe = apply_rope_interleaved(q_pe, positions, self.inv_freq)
 
         ckv = x @ lp["kv_a"]  # [B,S, kv_lora + rope]
         c_kv, k_pe = ckv[..., :cfg.kv_lora_rank], ckv[..., cfg.kv_lora_rank:]
-        kv = rms_norm(c_kv, lp["kv_a_norm"], cfg.rms_norm_eps) @ lp["kv_b"]
-        kv = kv.reshape(b, s, nh, nope + vd)
-        k_nope, v = kv[..., :nope], kv[..., nope:]
-
+        c_hat = rms_norm(c_kv, lp["kv_a_norm"], cfg.rms_norm_eps)
         k_pe = apply_rope_interleaved(
             k_pe[:, :, None, :], positions, self.inv_freq
         )  # [B,S,1,rope] — shared across heads
-        q_pe = apply_rope_interleaved(q_pe, positions, self.inv_freq)
+        return q_nope, q_pe, c_hat, k_pe
+
+    def _attention(self, lp, li, h_in, positions, cache, block_tables,
+                   seq_lens, slot_idx):
+        if self.config.attn_impl == "absorbed":
+            return self._attention_absorbed(
+                lp, li, h_in, positions, cache, block_tables, seq_lens,
+                slot_idx,
+            )
+        return self._attention_expanded(
+            lp, li, h_in, positions, cache, block_tables, seq_lens, slot_idx,
+        )
+
+    def _attention_expanded(self, lp, li, h_in, positions, cache,
+                            block_tables, seq_lens, slot_idx):
+        """Oracle form: materialise per-head K/V like a GQA model (cache
+        row H·qk_head_dim, V padded).  Logit-exact, memory-hungry."""
+        cfg = self.config
+        b, s = positions.shape
+        nh = cfg.num_heads
+        nope, rope, vd = (cfg.qk_nope_head_dim, cfg.qk_rope_head_dim,
+                          cfg.v_head_dim)
+        x = rms_norm(h_in, lp["attn_norm"], cfg.rms_norm_eps)
+        q_nope, q_pe, c_hat, k_pe = self._qkv_latent(lp, x, positions)
+        kv = (c_hat @ lp["kv_b"]).reshape(b, s, nh, nope + vd)
+        k_nope, v = kv[..., :nope], kv[..., nope:]
 
         q = jnp.concatenate([q_nope, q_pe], axis=-1)  # [B,S,H,qk_head]
         k = jnp.concatenate(
@@ -357,6 +402,44 @@ class DeepseekModel:
         attn = attn[..., :vd].reshape(b, s, nh * vd)
         return h_in + attn @ lp["wo"], cache
 
+    def _attention_absorbed(self, lp, li, h_in, positions, cache,
+                            block_tables, seq_lens, slot_idx):
+        """Absorbed form (the MLA deployment shape): queries project INTO
+        the latent space through kv_b's K-half, attention runs as GQA
+        with ONE shared KV head whose row is the cached latent
+        (c_hat ‖ k_pe), and the attended latent expands per head through
+        kv_b's V-half.  Identical scores/outputs to the expanded form:
+          q_nope[h]·k_nope[h] = q_nope[h]·(Wk[h]ᵀ c_hat)
+                              = (Wk[h] q_nope[h]) · c_hat.
+        Cache cost per token: the latent row (stored twice — the pool's
+        K/V planes) vs 2·H·qk_head_dim expanded."""
+        cfg = self.config
+        b, s = positions.shape
+        nh = cfg.num_heads
+        nope, rope, vd = (cfg.qk_nope_head_dim, cfg.qk_rope_head_dim,
+                          cfg.v_head_dim)
+        r = cfg.kv_lora_rank
+        x = rms_norm(h_in, lp["attn_norm"], cfg.rms_norm_eps)
+        q_nope, q_pe, c_hat, k_pe = self._qkv_latent(lp, x, positions)
+
+        kv_b = lp["kv_b"].reshape(r, nh, nope + vd)
+        w_k = kv_b[..., :nope]            # [r, H, nope]
+        w_v = kv_b[..., nope:]            # [r, H, vd]
+        # absorb: q_eff[h] = Wk[h] @ q_nope[h]  -> latent-space queries
+        q_eff = jnp.einsum("bshn,rhn->bshr", q_nope, w_k)
+        q_lat = jnp.concatenate([q_eff, q_pe], axis=-1)  # [B,S,H,r+rope]
+
+        row = jnp.concatenate(
+            [c_hat[:, :, None, :], k_pe], axis=-1
+        )  # [B,S,1,r+rope] — the ONE shared KV row; K == V == latent
+        cache = write_kv_cache_layer(cache, li, row, row, slot_idx)
+        attn = paged_attention_layer(
+            q_lat, cache, li, block_tables, seq_lens, positions,
+            sm_scale=self.sm_scale,
+        )  # [B,S,H,r+rope] — attended latents per head
+        out = jnp.einsum("bshr,rhv->bshv", attn[..., :r], w_v)
+        return h_in + out.reshape(b, s, nh * vd) @ lp["wo"], cache
+
     def _moe_mlp(self, lp, x):
         """DeepSeekMoE: softmax routing (optionally group-limited) ×
         routed_scaling_factor through the grouped ragged_dot dispatch,
@@ -366,9 +449,12 @@ class DeepseekModel:
         t = b * s
         e, k = cfg.n_routed_experts, cfg.num_experts_per_tok
         xf = x.reshape(t, d)
+        # HF gates fully in f32 (inputs AND weights cast before the
+        # matmul): near-tie logits must resolve to the same experts
         scores = jax.nn.softmax(
-            (xf @ lp["router"]).astype(jnp.float32), axis=-1
-        )  # [T,E] — HF gates in f32 over the FULL expert set
+            xf.astype(jnp.float32) @ lp["router"].astype(jnp.float32),
+            axis=-1,
+        )  # [T,E]
         if cfg.topk_method == "group_limited_greedy":
             gs = scores.reshape(t, cfg.n_group, -1).max(axis=-1)  # [T,G]
             _, gidx = jax.lax.top_k(gs, cfg.topk_group)
@@ -379,17 +465,10 @@ class DeepseekModel:
         weights, topi = jax.lax.top_k(scores, k)  # [T,k]
         weights = weights * cfg.routed_scaling_factor
 
-        flat_e = topi.reshape(t * k)
-        order = jnp.argsort(flat_e)
-        token_idx = order // k
-        xs = xf[token_idx]
-        group_sizes = jnp.bincount(flat_e, length=e).astype(jnp.int32)
-        gate = jax.lax.ragged_dot(xs, lp["w_gate"], group_sizes)
-        up = jax.lax.ragged_dot(xs, lp["w_up"], group_sizes)
-        out = jax.lax.ragged_dot(jax.nn.silu(gate) * up, lp["w_down"],
-                                 group_sizes)
-        out = out * weights.reshape(t * k)[order, None].astype(out.dtype)
-        routed = out[jnp.argsort(order)].reshape(t, k, d).sum(axis=1)
+        routed = grouped_expert_dispatch(
+            xf, weights, topi, e,
+            lp["w_gate"], lp["w_up"], lp["w_down"], jax.nn.silu,
+        )
 
         shared = (jax.nn.silu(xf @ lp["shared_gate"]) * (xf @ lp["shared_up"])
                   ) @ lp["shared_down"]

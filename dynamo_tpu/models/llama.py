@@ -544,6 +544,30 @@ def _moe_mlp(cfg: ModelConfig, lp: dict, x: jax.Array) -> jax.Array:
     return _moe_mlp_grouped(cfg, lp, x)
 
 
+def grouped_expert_dispatch(xf, weights, topi, num_experts,
+                            w_gate, w_up, w_down, act):
+    """The grouped-MoE core, shared across model families (Llama-family
+    MoE here, DeepSeekMoE in models/deepseek.py): sort token→expert
+    assignments by expert, run each projection as ONE ``lax.ragged_dot``
+    (XLA's grouped matmul), then weighted unsort-sum back per token.
+    ``xf`` [T,Dm]; ``weights``/``topi`` [T,k]; ``w_*`` dense [E,Dm,F] /
+    [E,F,Dm]; ``act`` maps the gate activation."""
+    t, d = xf.shape
+    k = topi.shape[1]
+    flat_e = topi.reshape(t * k)
+    order = jnp.argsort(flat_e)          # stable: ties keep token order
+    token_idx = order // k               # source token of each sorted row
+    xs = xf[token_idx]                   # [T*k, Dm] gather
+    group_sizes = jnp.bincount(flat_e, length=num_experts).astype(jnp.int32)
+    gate = jax.lax.ragged_dot(xs, w_gate, group_sizes)
+    up = jax.lax.ragged_dot(xs, w_up, group_sizes)
+    out = jax.lax.ragged_dot(act(gate) * up, w_down, group_sizes)  # [T*k, Dm]
+    out = out * weights.reshape(t * k)[order, None].astype(out.dtype)
+    # unsort (inverse permutation) then reduce the k slots of each token;
+    # gather+reshape-sum keeps the combine deterministic (no scatter-add)
+    return out[jnp.argsort(order)].reshape(t, k, d).sum(axis=1)
+
+
 def _moe_mlp_grouped(cfg: ModelConfig, lp: dict, x: jax.Array) -> jax.Array:
     """Grouped MoE dispatch: sort token→expert assignments by expert, run
     ONE ragged (grouped) matmul per projection, unsort, weighted-sum per
@@ -559,29 +583,18 @@ def _moe_mlp_grouped(cfg: ModelConfig, lp: dict, x: jax.Array) -> jax.Array:
     Replaces the reference's inherited vLLM fused-MoE CUDA kernels
     (container/deps/vllm patch, grouped_topk region) with the XLA-native
     equivalent."""
-    k = cfg.num_experts_per_tok
     b, s, d = x.shape
-    t = b * s
-    xf = x.reshape(t, d)
+    xf = x.reshape(b * s, d)
     weights, topi = _moe_router(cfg, lp, xf)
-    flat_e = topi.reshape(t * k)
-    order = jnp.argsort(flat_e)          # stable: ties keep token order
-    token_idx = order // k               # source token of each sorted row
-    xs = xf[token_idx]                   # [T*k, Dm] gather
-    group_sizes = jnp.bincount(flat_e, length=cfg.num_experts).astype(jnp.int32)
-    # quantized experts dequant at the operand: convert fuses into the
-    # grouped dot's operand load, HBM reads stay int8
-    w_gate = dequantize(lp["w_gate"], x.dtype)   # [E, Dm, F]
-    w_up = dequantize(lp["w_up"], x.dtype)
-    w_down = dequantize(lp["w_down"], x.dtype)   # [E, F, Dm]
-    gate = jax.lax.ragged_dot(xs, w_gate, group_sizes)
-    up = jax.lax.ragged_dot(xs, w_up, group_sizes)
-    act = _act(cfg, gate) * up               # [T*k, F]
-    out = jax.lax.ragged_dot(act, w_down, group_sizes)  # [T*k, Dm]
-    out = out * weights.reshape(t * k)[order, None].astype(out.dtype)
-    # unsort (inverse permutation) then reduce the k slots of each token;
-    # gather+reshape-sum keeps the combine deterministic (no scatter-add)
-    out = out[jnp.argsort(order)].reshape(t, k, d).sum(axis=1)
+    out = grouped_expert_dispatch(
+        xf, weights, topi, cfg.num_experts,
+        # quantized experts dequant at the operand: convert fuses into
+        # the grouped dot's operand load, HBM reads stay int8
+        dequantize(lp["w_gate"], x.dtype),
+        dequantize(lp["w_up"], x.dtype),
+        dequantize(lp["w_down"], x.dtype),
+        lambda g: _act(cfg, g),
+    )
     return out.reshape(b, s, d)
 
 

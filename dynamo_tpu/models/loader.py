@@ -209,3 +209,32 @@ def load_model_dir(model_dir: str | Path, dtype: str = "bfloat16"):
     """Convenience: (ModelConfig, params) from a local HF model directory."""
     cfg = ModelConfig.from_hf_config(model_dir, dtype=dtype)
     return cfg, load_params_from_dir(cfg, model_dir)
+
+
+def is_deepseek_dir(model_dir: str | Path) -> bool:
+    """True when config.json declares a DeepSeek architecture (the MLA
+    family loads through models/deepseek.py, not the unified decoder)."""
+    import json as _json
+
+    p = Path(model_dir) / "config.json"
+    if not p.exists():
+        return False
+    try:
+        archs = _json.loads(p.read_text()).get("architectures") or []
+    except Exception:
+        return False
+    return any(str(a).startswith("Deepseek") for a in archs)
+
+
+def load_deepseek_dir(model_dir: str | Path, dtype: str = "bfloat16"):
+    """(DeepseekConfig, params) from a DeepSeek-V2 HF directory —
+    safetensors stream lazily through the same shard mapping."""
+    import json as _json
+
+    from dynamo_tpu.models.deepseek import DeepseekConfig, convert_hf_state_dict
+
+    cfg = DeepseekConfig.from_hf(
+        _json.loads((Path(model_dir) / "config.json").read_text())
+    )
+    cfg.dtype = dtype
+    return cfg, convert_hf_state_dict(_LazySafetensors(Path(model_dir)), cfg)

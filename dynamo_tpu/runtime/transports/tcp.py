@@ -167,7 +167,9 @@ class EndpointTcpClient(AsyncEngine):
                 self._reader, self._writer = await asyncio.open_connection(
                     self.host, self.port
                 )
-                self._read_task = asyncio.ensure_future(self._read_loop())
+                self._read_task = asyncio.ensure_future(
+                    self._read_loop(self._reader)
+                )
                 self._connected = True
         return self
 
@@ -183,10 +185,10 @@ class EndpointTcpClient(AsyncEngine):
                 self._writer.close()
             self._connected = False
 
-    async def _read_loop(self) -> None:
+    async def _read_loop(self, reader) -> None:
         try:
             while True:
-                frame = await read_frame(self._reader)
+                frame = await read_frame(reader)
                 if frame is None:
                     break
                 header, payload = frame
@@ -201,13 +203,14 @@ class EndpointTcpClient(AsyncEngine):
                 elif ftype == "error":
                     q.put_nowait(RuntimeError(header.get("error", "remote error")))
         finally:
-            # mark disconnected so the NEXT generate() dials fresh — a
-            # client whose read loop died must not keep writing into a
-            # dead socket forever (in-flight streams still fail below;
-            # their bytes are gone)
-            self._connected = False
-            for q in self._streams.values():
-                q.put_nowait(ConnectionError("endpoint connection lost"))
+            # only the CURRENT read loop may do disconnect bookkeeping: a
+            # cancelled stale loop (its connection already replaced by a
+            # reconnect) must not mark the fresh connection dead or error
+            # streams that are healthily served by the new loop
+            if reader is self._reader:
+                self._connected = False
+                for q in self._streams.values():
+                    q.put_nowait(ConnectionError("endpoint connection lost"))
 
     async def _send(self, header: dict, payload: bytes = b"") -> None:
         async with self._wlock:

@@ -15,7 +15,8 @@ from dynamo_tpu.models.deepseek import (
 BLOCK = 16
 
 
-def _hf_model(q_lora=None, topk_method="greedy", n_group=1, topk_group=1):
+def _hf_model(q_lora=None, topk_method="greedy", n_group=1, topk_group=1,
+              attn_impl="absorbed"):
     torch = pytest.importorskip("torch")
     from transformers import DeepseekV2Config, DeepseekV2ForCausalLM
 
@@ -50,6 +51,7 @@ def _hf_model(q_lora=None, topk_method="greedy", n_group=1, topk_group=1):
     hf = DeepseekV2ForCausalLM(hf_cfg).eval()
     cfg = DeepseekConfig.from_hf(hf_cfg)
     cfg.dtype = "float32"
+    cfg.attn_impl = attn_impl
     sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
     return hf, cfg, convert_hf_state_dict(sd, cfg)
 
@@ -71,11 +73,13 @@ def _paged_forward(model, params, token_ids):
 
 
 @pytest.mark.parametrize("q_lora", [None, 24])
-def test_deepseek_v2_matches_hf(q_lora):
-    """MLA (with and without query LoRA) + DeepSeekMoE logits match
-    transformers through the paged path."""
+@pytest.mark.parametrize("attn_impl", ["absorbed", "expanded"])
+def test_deepseek_v2_matches_hf(q_lora, attn_impl):
+    """MLA (with and without query LoRA, absorbed-latent AND expanded
+    cache forms) + DeepSeekMoE logits match transformers through the
+    paged path."""
     torch = pytest.importorskip("torch")
-    hf, cfg, params = _hf_model(q_lora=q_lora)
+    hf, cfg, params = _hf_model(q_lora=q_lora, attn_impl=attn_impl)
     model = DeepseekModel(cfg)
     prompt = [3, 17, 9, 41, 5, 88, 23, 7, 60, 11]
     with torch.no_grad():
@@ -149,3 +153,34 @@ def test_from_hf_rejects_unsupported_configs():
         with pytest.raises(NotImplementedError):
             DeepseekConfig.from_hf({**base, **bad})
     assert DeepseekConfig.from_hf(base).qk_head_dim == 48
+
+
+def test_deepseek_dir_loads_through_cli_builder(tmp_path):
+    """A DeepSeek HF directory is detected by architecture and loads
+    through the standard checkpoint path into a DeepseekModel — the
+    family is reachable from `dynamo-tpu run/serve`, not only from
+    Python."""
+    import json
+
+    from safetensors.numpy import save_file
+
+    from dynamo_tpu.cli import _load_any_checkpoint
+    from dynamo_tpu.models.loader import is_deepseek_dir
+
+    hf, cfg, params_direct = _hf_model()
+    d = tmp_path / "dsv2"
+    d.mkdir()
+    hf_cfg = hf.config.to_dict()
+    hf_cfg["architectures"] = ["DeepseekV2ForCausalLM"]
+    (d / "config.json").write_text(json.dumps(hf_cfg))
+    save_file({k: v.detach().numpy() for k, v in hf.state_dict().items()},
+              str(d / "model.safetensors"))
+
+    assert is_deepseek_dir(d)
+    model, params, quantized = _load_any_checkpoint(str(d), "float32")
+    assert type(model).__name__ == "DeepseekModel"
+    assert not quantized
+    got = _paged_forward(model, params, [3, 17, 9, 41, 5])
+    want = _paged_forward(DeepseekModel(cfg), params_direct,
+                          [3, 17, 9, 41, 5])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
