@@ -193,31 +193,35 @@ def _hbm_limit(dev) -> int:
     return 16 << 30  # conservative default
 
 
-def _probe_pallas_prefill() -> None:
-    """Compile-probe the flash-prefill kernel on the real backend with tiny
-    shapes; on ANY failure fall back to the pure-JAX prefill path for this
-    run rather than dying mid-bench (the kernel is oracle-verified in
-    interpret mode, but a Mosaic lowering surprise on a new runtime must
-    not cost the round's measurement)."""
+def _probe_pallas_prefill(mcfg: dict, max_len: int, bs: int,
+                          prefill_chunk: int) -> None:
+    """Compile-probe the flash-prefill kernel on the real backend AT THE
+    MODEL'S GEOMETRY (heads/head_dim/block size); on ANY failure fall back
+    to the pure-JAX prefill path for this run rather than dying mid-bench.
+    A tiny fixed-shape probe gave a false negative in round 4: its d=64
+    head slicing failed to lower while the real 8B (d=128) kernel was
+    fine — the probe must compile what the run will run."""
     import jax
     import jax.numpy as jnp
 
     try:
         from dynamo_tpu.ops.pallas.prefill_attention import paged_prefill_attention
 
-        b, s, h, hk, d, bs = 1, 128, 8, 4, 64, 16
-        q = jnp.ones((b, s, h, d), jnp.bfloat16)
-        kv = jnp.ones((b, s, hk, d), jnp.bfloat16)
-        cache = jnp.zeros((1, 16, 2, bs, hk * d), jnp.bfloat16)
+        h, hk, hd, n, bt, lens = _probe_geometry(mcfg, 1, max_len, bs)
+        s = min(prefill_chunk or 512, max_len)
+        q = jnp.ones((1, s, h, hd), jnp.bfloat16)
+        kv = jnp.ones((1, s, hk, hd), jnp.bfloat16)
+        cache = jnp.zeros((1, n, 2, bs, hk * hd), jnp.bfloat16)
         out = paged_prefill_attention(
-            q, kv, kv, cache, jnp.int32(0),
-            jnp.zeros((b, 10), jnp.int32),
-            jnp.asarray([s], jnp.int32), jnp.asarray([0], jnp.int32),
+            q, kv, kv, cache, jnp.int32(0), bt[:1],
+            jnp.asarray([min(2 * bs + s, max_len)], jnp.int32),
+            jnp.asarray([min(2 * bs, max_len - s)], jnp.int32),
         )
         jax.block_until_ready(out)
     except Exception as e:  # pragma: no cover - hardware-specific
-        print(f"# pallas prefill probe failed ({type(e).__name__}); "
-              "falling back to pure-JAX prefill", file=sys.stderr)
+        print(f"# pallas prefill probe failed ({type(e).__name__}: "
+              f"{str(e)[:500]}); falling back to pure-JAX prefill",
+              file=sys.stderr)
         os.environ["DYNAMO_DISABLE_PALLAS_PREFILL"] = "1"
 
 
@@ -256,22 +260,28 @@ def _probe_pallas_decode(mcfg: dict, batch: int, max_len: int, bs: int) -> None:
         )
         jax.block_until_ready(out)
     except Exception as e:  # pragma: no cover - hardware-specific
-        print(f"# pallas decode probe failed ({type(e).__name__}); "
-              "falling back to XLA decode attention", file=sys.stderr)
+        print(f"# pallas decode probe failed ({type(e).__name__}: "
+              f"{str(e)[:500]}); falling back to XLA decode attention",
+              file=sys.stderr)
         os.environ["DYNAMO_DISABLE_PALLAS_DECODE"] = "1"
 
 
-def _kernel_report(quant: str, kv_quant: str) -> dict:
+def _kernel_report(quant: str, kv_quant: str, block_size: int) -> dict:
     """Which optimized kernel paths are LIVE for this run — recorded in
     the JSON line so a degraded (probe-fallback) number is visibly
     different from a healthy one (VERDICT r3 weak #3).  Gates mirror the
     dispatch conditions in ops/paged_attention.py exactly (Pallas runs
-    only on a real TPU backend).  The multi-query kernel is omitted: the
-    bench never dispatches it (speculation is off here)."""
+    only on a real TPU backend; a quant cache additionally needs
+    block_size % 32 == 0 — the int8 payload tile).  The multi-query
+    kernel is omitted: the bench never dispatches it (speculation is off
+    here)."""
     import jax
 
     env = os.environ.get
     pallas = jax.default_backend() == "tpu" and not env("DYNAMO_DISABLE_PALLAS")
+    # ops/paged_attention.py kernel_ok: quant caches with a partial int8
+    # tile (Bs % 32) dispatch to the XLA dequant path, not the kernels
+    kernel_ok = kv_quant != "int8" or block_size % 32 == 0
     try:
         from dynamo_tpu.models.quant import _pallas_int8_matmul_enabled
 
@@ -279,8 +289,10 @@ def _kernel_report(quant: str, kv_quant: str) -> dict:
     except Exception:  # pragma: no cover
         int8_mm = False
     return {
-        "pallas_prefill": pallas and not env("DYNAMO_DISABLE_PALLAS_PREFILL"),
-        "pallas_decode": pallas and not env("DYNAMO_DISABLE_PALLAS_DECODE"),
+        "pallas_prefill": pallas and kernel_ok
+        and not env("DYNAMO_DISABLE_PALLAS_PREFILL"),
+        "pallas_decode": pallas and kernel_ok
+        and not env("DYNAMO_DISABLE_PALLAS_DECODE"),
         "pallas_int8_matmul": bool(int8_mm),
         "int8_weights": quant == "int8",
         "int8_kv": kv_quant == "int8",
@@ -297,15 +309,21 @@ def _probe_kv_quant(mcfg: dict, batch: int, max_len: int, bs: int,
     import jax
     import jax.numpy as jnp
 
+    if bs % 32:
+        # ops/paged_attention.py routes partial-int8-tile caches to the
+        # XLA dequant path — int8 KV works there, so don't let a kernel
+        # probe (which the run would never dispatch) veto it
+        return True
     try:
-        from dynamo_tpu.ops.kv_quant import QuantKvCache
+        from dynamo_tpu.ops.kv_quant import QuantKvCache, scale_tile
         from dynamo_tpu.ops.pallas.decode_attention import paged_decode_attention
         from dynamo_tpu.ops.pallas.prefill_attention import paged_prefill_attention
 
         h, hk, hd, n, bt, lens = _probe_geometry(mcfg, batch, max_len, bs)
+        hp, sp = scale_tile(hk, bs)
         cache = QuantKvCache(
             jnp.zeros((1, n, 2, bs, hk * hd), jnp.int8),
-            jnp.ones((1, n, 2, hk, bs), jnp.float32),
+            jnp.ones((1, n, 2, hp, sp), jnp.float32),
         )
         out = paged_decode_attention(
             jnp.ones((batch, h, hd), jnp.bfloat16), cache, jnp.int32(0),
@@ -479,8 +497,16 @@ def main() -> None:
         def fit_bytes(cfg: dict, mlen: int) -> int:
             # ~1GB slack: activations, prefill buffers, XLA workspace
             hd = cfg.get("head_dim", cfg["hidden_size"] // cfg["num_heads"])
-            # int8 payload + one f32 scale per token per kv head per k/v
-            kv_bytes_elem = (1.0 + 4.0 / hd) if kvq == "int8" else 2.0
+            hk = cfg["num_kv_heads"]
+            if kvq == "int8":
+                # int8 payload + the TILE-PADDED f32 scale pool
+                # (ops/kv_quant.scale_tile: (Hp, Sp) per block per k/v —
+                # ~12.5% of payload at Hk=8/Bs=32, NOT the ~3% the raw
+                # per-token scales would cost)
+                hp, sp = -(-hk // 8) * 8, -(-block_size // 128) * 128
+                kv_bytes_elem = 1.0 + (hp * sp * 4.0) / (block_size * hk * hd)
+            else:
+                kv_bytes_elem = 2.0
             per_tok = int(_kv_bytes_per_token(cfg, 1) * kv_bytes_elem)
             return (_param_bytes(cfg, wbytes) + batch * mlen * per_tok
                     + (1 << 30))
@@ -540,11 +566,11 @@ def main() -> None:
     # above already covered both kernels against the quantized cache)
     if pallas_on and not env("DYNAMO_DISABLE_PALLAS_PREFILL") \
             and kv_quant == "none":
-        _probe_pallas_prefill()
+        _probe_pallas_prefill(mcfg, max_len, block_size, prefill_chunk)
     if pallas_on and not env("DYNAMO_DISABLE_PALLAS_DECODE") \
             and kv_quant == "none":
         _probe_pallas_decode(mcfg, batch, max_len, block_size)
-    kernels = _kernel_report(quant, kv_quant)
+    kernels = _kernel_report(quant, kv_quant, block_size)
     print(f"# kernels: {json.dumps(kernels)}", file=sys.stderr)
 
     model = LlamaModel(cfg)

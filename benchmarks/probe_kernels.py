@@ -1,0 +1,95 @@
+"""Standalone Pallas kernel probes on the real backend.
+
+Compiles each kernel variant (bf16 / int8-KV x decode / prefill / mq) at
+a representative serving geometry and prints PASS/FAIL with the full
+Mosaic error — the fast iteration loop for kernel lowering issues that
+interpret-mode tests cannot catch (round 4 found two: partial-tile scale
+DMA slices, and the prefill kernel's sublane-indexed q/out slices).
+
+Usage:  python benchmarks/probe_kernels.py [bf16|int8|all] [8b|1b|probe]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GEOMS = {
+    # h, hk, d, batch, max_len, bs, s_prefill
+    "probe": dict(h=8, hk=4, d=64, batch=1, max_len=160, bs=16, s=128),
+    "1b": dict(h=32, hk=8, d=64, batch=64, max_len=2048, bs=32, s=512),
+    "8b": dict(h=32, hk=8, d=128, batch=64, max_len=1024, bs=32, s=512),
+}
+
+
+def main() -> None:
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    geom = GEOMS[sys.argv[2] if len(sys.argv) > 2 else "8b"]
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops.kv_quant import QuantKvCache, scale_tile
+    from dynamo_tpu.ops.pallas.decode_attention import (
+        paged_decode_attention, paged_decode_attention_mq,
+    )
+    from dynamo_tpu.ops.pallas.prefill_attention import paged_prefill_attention
+
+    h, hk, d, batch, max_len, bs, s = (
+        geom["h"], geom["hk"], geom["d"], geom["batch"], geom["max_len"],
+        geom["bs"], geom["s"])
+    m = -(-max_len // bs)
+    n = min(batch * m + 4, 4096)
+    bt = ((jnp.arange(batch, dtype=jnp.int32)[:, None] * m
+           + jnp.arange(m, dtype=jnp.int32)[None, :]) % n)
+    lens = jnp.full((batch,), min(4 * bs, max_len), jnp.int32)
+
+    def mk_cache(quant: bool):
+        if not quant:
+            return jnp.zeros((1, n, 2, bs, hk * d), jnp.bfloat16)
+        hp, sp = scale_tile(hk, bs)
+        return QuantKvCache(
+            jnp.zeros((1, n, 2, bs, hk * d), jnp.int8),
+            jnp.ones((1, n, 2, hp, sp), jnp.float32),
+        )
+
+    def probe(label, fn):
+        try:
+            out = fn()
+            jax.block_until_ready(out)
+            print(f"PASS {label}")
+            return True
+        except Exception as e:
+            msg = str(e)
+            print(f"FAIL {label}: {type(e).__name__}")
+            print("\n".join(msg.splitlines()[:30]))
+            if os.environ.get("DYNAMO_PROBE_TRACE"):
+                traceback.print_exc()
+            return False
+
+    variants = []
+    for mode in (["bf16", "int8"] if which == "all" else [which]):
+        cache = mk_cache(mode == "int8")
+        variants += [
+            (f"decode/{mode}", lambda cache=cache: paged_decode_attention(
+                jnp.ones((batch, h, d), jnp.bfloat16), cache, jnp.int32(0),
+                bt, lens)),
+            (f"mq/{mode}", lambda cache=cache: paged_decode_attention_mq(
+                jnp.ones((batch, 4, h, d), jnp.bfloat16), cache, jnp.int32(0),
+                bt, lens, jnp.maximum(lens - 4, 0))),
+            (f"prefill/{mode}", lambda cache=cache: paged_prefill_attention(
+                jnp.ones((1, s, h, d), jnp.bfloat16),
+                jnp.ones((1, s, hk, d), jnp.bfloat16),
+                jnp.ones((1, s, hk, d), jnp.bfloat16),
+                cache, jnp.int32(0), bt[:1],
+                jnp.asarray([min(2 * bs + s, max_len)], jnp.int32),
+                jnp.asarray([min(2 * bs, max_len - s)], jnp.int32))),
+        ]
+    ok = all([probe(lbl, fn) for lbl, fn in variants])
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -375,7 +375,9 @@ class EngineCore:
         bs = self.config.block_size
         blocks = kv[:, :, 0].reshape(l, 2, nb, bs, hkd).transpose(0, 2, 1, 3, 4)
         if self.cache_quant:
-            from dynamo_tpu.ops.kv_quant import QuantKvCache, quantize_kv_rows
+            from dynamo_tpu.ops.kv_quant import (
+                QuantKvCache, pad_scales, quantize_kv_rows,
+            )
 
             hk = self.model.config.num_kv_heads
             q8, sc = quantize_kv_rows(
@@ -383,7 +385,8 @@ class EngineCore:
             )  # int8 [..., Bs, Hk, D], scale f32 [..., Bs, Hk]
             blocks = QuantKvCache(
                 q8.reshape(l, nb, 2, bs, hkd),
-                jnp.swapaxes(sc, -1, -2),  # token-minor [L, nb, 2, Hk, Bs]
+                # token-minor [L, nb, 2, Hk, Bs] -> tile-padded [.., Hp, Sp]
+                pad_scales(jnp.swapaxes(sc, -1, -2)),
             )
         blocks = jax.lax.with_sharding_constraint(
             blocks, self._cache_sharding()

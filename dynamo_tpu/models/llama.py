@@ -271,14 +271,19 @@ class LlamaModel:
     def cache_spec(self, quant: bool = False):
         """KV cache [L,N,2,Bs,Hk*D]: the trailing axis is kv-head-major, so
         sharding it over "model" splits whole kv heads across the mesh.
-        For a quantized cache, the scale pool [L,N,2,Hk,Bs] shards its Hk
-        axis the same way (whole kv heads per shard)."""
+        For a quantized cache, the scale pool [L,N,2,Hp,Sp] shards its
+        head axis the same way — but only when Hk is tile-exact (Hk % 8 ==
+        0, so Hp == Hk and shard boundaries land on real head rows); a
+        padded head axis replicates instead, since an even split of the
+        padded axis would put different heads on a shard than the data's
+        head-major lane split does."""
         data = P(None, None, None, None, "model")
         if not quant:
             return data
         from dynamo_tpu.ops.kv_quant import QuantKvCache
 
-        return QuantKvCache(data, P(None, None, None, "model", None))
+        head_axis = "model" if self.config.num_kv_heads % 8 == 0 else None
+        return QuantKvCache(data, P(None, None, None, head_axis, None))
 
     # --------------------------------------------------------------- kv cache
     def init_kv_cache(self, num_blocks: int, block_size: int, dtype=None) -> jax.Array:
@@ -306,14 +311,13 @@ class LlamaModel:
         )
         dt = dtype or cfg.jax_dtype
         if str(dt) in ("int8", "<dtype: int8>") or dt == jnp.int8:
-            from dynamo_tpu.ops.kv_quant import QuantKvCache
+            from dynamo_tpu.ops.kv_quant import QuantKvCache, scale_tile
 
+            hp, sp = scale_tile(cfg.num_kv_heads, block_size)
             return QuantKvCache(
                 jnp.zeros(shape, jnp.int8),
                 jnp.ones(
-                    (cfg.num_layers, num_blocks, 2, cfg.num_kv_heads,
-                     block_size),
-                    jnp.float32,
+                    (cfg.num_layers, num_blocks, 2, hp, sp), jnp.float32,
                 ),
             )
         return jnp.zeros(shape, dt)

@@ -11,11 +11,18 @@ Design:
   * :class:`QuantKvCache` — pytree of ``data`` int8 `[L, N, 2, Bs, Hk*D]`
     (identical layout to the bf16 cache, so block ids, the decode kernel's
     one-DMA-per-block property, and donation all carry over) and ``scale``
-    f32 `[L, N, 2, Hk, Bs]` (one scale per written K/V row per kv head —
-    ~3% extra bytes at D=128).  Scales are stored TOKEN-MINOR (Hk, Bs):
-    the Pallas kernels then build a per-chunk `[Hk, T]` scale tile by
-    concatenating block tiles along lanes — no in-kernel transpose — and
-    fold it into the score/PV products as row/column rescales.
+    f32 `[L, N, 2, Hp, Sp]` where `(Hp, Sp) = scale_tile(Hk, Bs)` pads the
+    per-block scale tile to the f32 TPU tiling (sublane 8, lane 128); the
+    valid region is `[..., :Hk, :Bs]`.  Scales are stored TOKEN-MINOR
+    (head row, token lane): the Pallas kernels DMA a block's `[Hp, Sp]`
+    tile whole (Mosaic rejects partial-tile memref slices — an unpadded
+    `[Hk, Bs]` tile with Bs < 128 cannot be DMA'd from HBM at all, which
+    is why the padding is part of the LAYOUT, not a kernel detail), then
+    build a per-chunk `[Hk, T]` tile by slicing + lane-concat in VMEM and
+    fold it into the score/PV products as row/column rescales.  Padding
+    costs (8·128)/(Hk·Bs)·4B per block — ~12.5% of the int8 payload at
+    Hk=8, Bs=32 — and buys the kernels' DMA path; the pure-JAX paths just
+    ignore the pad lanes.
   * Quantization happens at cache-write time (`write_kv_cache_layer`):
     amax over the head dim of each new K/V row.  Fresh chunk K/V stay
     unquantized in prefill attention (they never round-trip the cache).
@@ -35,14 +42,35 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["QuantKvCache", "is_quant", "quantize_kv_rows", "dequant_layer_slice"]
+__all__ = ["QuantKvCache", "is_quant", "quantize_kv_rows", "dequant_layer_slice",
+           "scale_tile", "pad_scales"]
 
 
 class QuantKvCache(NamedTuple):
     """Paged KV cache with int8 payload + per-row-per-head scales."""
 
     data: jax.Array   # [L, N, 2, Bs, Hk*D] int8
-    scale: jax.Array  # [L, N, 2, Hk, Bs]  f32 (token-minor; see module doc)
+    scale: jax.Array  # [L, N, 2, Hp, Sp]  f32 (token-minor, tile-padded;
+    #                   valid region [..., :Hk, :Bs] — see module doc)
+
+
+def scale_tile(hk: int, bs: int) -> tuple[int, int]:
+    """Physical (sublane, lane) dims of a block's scale tile: (Hk, Bs)
+    rounded up to the f32 TPU tiling (8, 128) so the Pallas kernels can
+    DMA the tile whole (partial-tile memref slices don't lower)."""
+    return (-(-hk // 8) * 8, -(-bs // 128) * 128)
+
+
+def pad_scales(sc: jax.Array) -> jax.Array:
+    """Pad a token-minor scale array [..., Hk, Bs] to the canonical
+    tile-padded layout [..., Hp, Sp] (pad value 1.0 — a neutral scale, so
+    accidentally-read pad lanes dequantize zeros to zeros)."""
+    hk, bs = sc.shape[-2:]
+    hp, sp = scale_tile(hk, bs)
+    if (hp, sp) == (hk, bs):
+        return sc
+    cfg = [(0, 0)] * (sc.ndim - 2) + [(0, hp - hk), (0, sp - bs)]
+    return jnp.pad(sc, cfg, constant_values=1.0)
 
 
 def is_quant(cache) -> bool:
@@ -63,13 +91,14 @@ def quantize_kv_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
 
 def dequant_layer_slice(
     data: jax.Array,   # [..., Bs, Hk*D] int8 (any leading block dims)
-    scale: jax.Array,  # [..., Hk, Bs]  f32 (token-minor)
+    scale: jax.Array,  # [..., Hp, Sp]  f32 (token-minor, tile-padded)
     hk: int,
     dtype=jnp.float32,
 ) -> jax.Array:
     """Rescale an int8 cache slice back to real values (read path)."""
     *lead, bs, hkd = data.shape
     d = hkd // hk
+    sc = scale[..., :hk, :bs]  # drop tile padding
     x = data.astype(jnp.float32).reshape(*lead, bs, hk, d)
-    x = x * jnp.swapaxes(scale, -1, -2)[..., None]
+    x = x * jnp.swapaxes(sc, -1, -2)[..., None]
     return x.reshape(*lead, bs, hkd).astype(dtype)

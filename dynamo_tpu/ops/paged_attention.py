@@ -103,7 +103,11 @@ def paged_attention_layer(
     data = cache.data if quant else cache
     _, n, _, bs, hkd = data.shape
     hk = hkd // d
-    if s == 1 and _pallas_decode_enabled():
+    # int8 payload tiles are (32, 128): a quant cache with Bs % 32 != 0
+    # pads the block's sublane dim, and the kernels' manual per-block DMA
+    # cannot slice a partial tile — take the XLA dequant path instead
+    kernel_ok = not quant or bs % 32 == 0
+    if s == 1 and kernel_ok and _pallas_decode_enabled():
         from dynamo_tpu.ops.pallas.decode_attention import paged_decode_attention
 
         out = paged_decode_attention(
@@ -111,7 +115,7 @@ def paged_attention_layer(
             logit_cap=logit_cap,
         )
         return out[:, None]
-    if 1 < s <= MQ_MAX_S and _pallas_mq_enabled():
+    if 1 < s <= MQ_MAX_S and kernel_ok and _pallas_mq_enabled():
         # speculative-verify shape: a few trailing queries per row — stream
         # only the owned blocks instead of gathering the padded table
         from dynamo_tpu.ops.pallas.decode_attention import (
@@ -168,7 +172,10 @@ def prefill_attention(
     quant = is_quant(cache)
     if sm_scale is None:
         sm_scale = 1.0 / (d**0.5)
-    if s > 1 and _pallas_prefill_enabled():
+    data_ = cache.data if quant else cache
+    # same (32, 128) int8 tile constraint as the decode dispatch
+    kernel_ok = not quant or data_.shape[3] % 32 == 0
+    if s > 1 and kernel_ok and _pallas_prefill_enabled():
         # flash path: online softmax, scores never leave VMEM; the cached
         # prefix streams from HBM by its TRUE length (start), so the
         # static prefix_blocks bucket doesn't even force recompiles here
@@ -265,7 +272,8 @@ def write_kv_cache_layer(
                               vq.reshape(b, s, hk * d),
                               slot_idx, block_aligned),
             _write_layer_scales(cache.scale, layer, ks, vs,
-                                slot_idx, block_aligned),
+                                slot_idx, block_aligned,
+                                bs=cache.data.shape[3]),
         )
     b, s, hk, d = k_new.shape
     return _write_layer_rows(
@@ -327,17 +335,19 @@ def _write_layer_rows(
 
 
 def _write_layer_scales(
-    scale: jax.Array,     # [L, N, 2, Hk, Bs] f32 (token-minor)
+    scale: jax.Array,     # [L, N, 2, Hp, Sp] f32 (token-minor, tile-padded)
     layer: jax.Array,
     ks: jax.Array,        # [B, S, Hk] per-token K scales
     vs: jax.Array,        # [B, S, Hk]
     slot_idx: jax.Array,  # [B, S]
     block_aligned: bool,
+    bs: int,              # block size (tokens) — Sp is padded, so not derivable
 ) -> jax.Array:
     """Scatter per-token scales into the token-minor scale pool (mirrors
-    the data writes in :func:`_write_layer_rows`, index-for-index)."""
-    l, n, two, hk, bs = scale.shape
-    b, s, _ = ks.shape
+    the data writes in :func:`_write_layer_rows`, index-for-index).  Only
+    the valid [:Hk, :Bs] region of each block's padded tile is written."""
+    l, n, two, hp, sp = scale.shape
+    b, s, hk = ks.shape
     ks = ks.astype(scale.dtype)
     vs = vs.astype(scale.dtype)
     if block_aligned and s > 1 and s % bs == 0:
@@ -345,7 +355,7 @@ def _write_layer_scales(
         size = l * n * 2
         first = slot_idx[:, ::bs]
         bid = jnp.where(first >= 0, first // bs, -1)
-        flat = scale.reshape(size, hk, bs)
+        flat = scale.reshape(size, hp, sp)
         base = layer * (n * 2) + bid * 2
         base = jnp.where(bid >= 0, base, size).reshape(-1)
         valid = (slot_idx >= 0).reshape(b * nb, 1, bs)
@@ -354,21 +364,27 @@ def _write_layer_scales(
         gv = jnp.swapaxes(vs.reshape(b * nb, bs, hk), 1, 2)
         cur_k = flat[jnp.minimum(base, size - 1)]
         cur_v = flat[jnp.minimum(base + 1, size - 1)]
-        flat = flat.at[base].set(jnp.where(valid, gk, cur_k), mode="drop")
+        # fold the new tile into the current padded tile: pad lanes/rows
+        # keep their existing bytes, padding tokens keep cur
+        new_k = cur_k.at[:, :hk, :bs].set(
+            jnp.where(valid, gk, cur_k[:, :hk, :bs]))
+        new_v = cur_v.at[:, :hk, :bs].set(
+            jnp.where(valid, gv, cur_v[:, :hk, :bs]))
+        flat = flat.at[base].set(new_k, mode="drop")
         flat = flat.at[jnp.where(base < size, base + 1, size)].set(
-            jnp.where(valid, gv, cur_v), mode="drop"
+            new_v, mode="drop"
         )
         return flat.reshape(scale.shape)
     size = l * n * 2
-    flat = scale.reshape(size, hk, bs)
+    flat = scale.reshape(size, hp, sp)
     idx = slot_idx.reshape(-1)
     valid = idx >= 0
     row = layer * (n * 2) + (idx // bs) * 2
     lane = idx % bs
     row_k = jnp.where(valid, row, size)
     row_v = jnp.where(valid, row + 1, size)
-    flat = flat.at[row_k, :, lane].set(ks.reshape(-1, hk), mode="drop")
-    flat = flat.at[row_v, :, lane].set(vs.reshape(-1, hk), mode="drop")
+    flat = flat.at[row_k, :hk, lane].set(ks.reshape(-1, hk), mode="drop")
+    flat = flat.at[row_v, :hk, lane].set(vs.reshape(-1, hk), mode="drop")
     return flat.reshape(scale.shape)
 
 

@@ -63,7 +63,7 @@ def _kernel(
     # inputs
     q_ref,       # [G, S*H, HkD] VMEM — block-diagonal expanded, pre-scaled f32
     cache_ref,   # [L, N, 2, Bs, HkD] HBM (manual DMA)
-    # (scale_ref [L, N, 2, Hk, Bs] HBM when quant — spliced via *rest)
+    # (scale_ref [L, N, 2, Hp, Sp] HBM when quant — spliced via *rest)
     # outputs
     out_ref,     # [G, S*H, HkD] VMEM
     # scratch
@@ -72,24 +72,27 @@ def _kernel(
     l_ref,       # [G, S*H, 128] f32
     kvbuf,       # [2, G, C, 2, Bs, HkD] cache-dtype (double buffer)
     sems,        # [2, G, C] DMA semaphores
-    # (scbuf [2, G, C, 2, Hk, Bs] f32 + scsems when quant)
+    # (scbuf [2, G, C, 2, Hp, Sp] f32 + scsems when quant)
     *,
     c: int,
     g: int,
     s_q: int,
+    hk: int,
     logit_cap=None,
 ):
     return _kernel_impl(seq_ref, q0_ref, bt_ref, layer_ref, q_ref, cache_ref,
                         None, out_ref, acc_ref, m_ref, l_ref, kvbuf, sems,
-                        None, None, c=c, g=g, s_q=s_q, logit_cap=logit_cap)
+                        None, None, c=c, g=g, s_q=s_q, hk=hk,
+                        logit_cap=logit_cap)
 
 
 def _kernel_quant(seq_ref, q0_ref, bt_ref, layer_ref, q_ref, cache_ref,
                   scale_ref, out_ref, acc_ref, m_ref, l_ref, kvbuf, sems,
-                  scbuf, scsems, *, c: int, g: int, s_q: int, logit_cap=None):
+                  scbuf, scsems, *, c: int, g: int, s_q: int, hk: int,
+                  logit_cap=None):
     return _kernel_impl(seq_ref, q0_ref, bt_ref, layer_ref, q_ref, cache_ref,
                         scale_ref, out_ref, acc_ref, m_ref, l_ref, kvbuf,
-                        sems, scbuf, scsems, c=c, g=g, s_q=s_q,
+                        sems, scbuf, scsems, c=c, g=g, s_q=s_q, hk=hk,
                         logit_cap=logit_cap)
 
 
@@ -100,6 +103,7 @@ def _kernel_impl(
     c: int,
     g: int,
     s_q: int,
+    hk: int,
     logit_cap=None,
 ):
     gi = pl.program_id(0)
@@ -173,18 +177,21 @@ def _kernel_impl(
                 if quant:
                     # int8 KV: k rows carry a per-(token, kv-head) scale.
                     # Column t of s uses k row t whose scale depends on the
-                    # query's kv head — build [H, T] scale tiles by lane-
-                    # concat of the token-minor [Hk, Bs] blocks, then repeat
-                    # each kv head's row for its G query heads (q rows are
+                    # query's kv head — slice each block's padded [Hp, Sp]
+                    # tile down to its valid [Hk, Bs] region (value-level
+                    # slice in VMEM; the DMA moved the whole aligned tile),
+                    # build [H, T] tiles by lane-concat, then repeat each
+                    # kv head's row for its G query heads (q rows are
                     # kv-head-major).  V's scale folds into P before the PV
                     # matmul (not into l: softmax stats use true probs).
-                    hk = scbuf.shape[4]
                     gq = h // hk
                     sck = jnp.concatenate(
-                        [scbuf[slot, j, i, 0] for i in range(c)], axis=-1
+                        [scbuf[slot, j, i, 0][:hk, :bs] for i in range(c)],
+                        axis=-1
                     )  # [Hk, T]
                     scv = jnp.concatenate(
-                        [scbuf[slot, j, i, 1] for i in range(c)], axis=-1
+                        [scbuf[slot, j, i, 1][:hk, :bs] for i in range(c)],
+                        axis=-1
                     )
                     sck = jnp.repeat(sck, gq, axis=0)  # [H, T]
                     scv = jnp.repeat(scv, gq, axis=0)
@@ -319,9 +326,10 @@ def paged_decode_attention_mq(
         data,
     ]
     if quant:
+        hp, sp = scale.shape[-2:]  # tile-padded (scale_tile(hk, bs))
         in_specs.append(pl.BlockSpec(memory_space=pl.ANY))  # scales in HBM
         scratch += [
-            pltpu.VMEM((2, g, c, 2, hk, bs), jnp.float32),
+            pltpu.VMEM((2, g, c, 2, hp, sp), jnp.float32),
             pltpu.SemaphoreType.DMA((2, g, c)),
         ]
         operands.append(scale)
@@ -336,7 +344,7 @@ def paged_decode_attention_mq(
 
     out = pl.pallas_call(
         functools.partial(_kernel_quant if quant else _kernel, c=c, g=g,
-                          s_q=s_q, logit_cap=logit_cap),
+                          s_q=s_q, hk=hk, logit_cap=logit_cap),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, rows, hkd), q.dtype),
         interpret=interpret,

@@ -67,20 +67,25 @@ def _kernel_impl(
     bt_ref,      # [B, M] int32
     layer_ref,   # [1] int32
     # inputs
-    q_ref,       # [1, TQ, Hk, G*D] VMEM — this grid step's query rows
+    q_ref,       # [1, Hk, TQ, G*D] VMEM — this grid step's query rows.
+    #              The kv-head axis LEADS (outside the tiled minor-2 dims):
+    #              per-head reads are then plain leading-index loads —
+    #              `[1, TQ, Hk, G*D]` with h in the sublane slot made
+    #              Mosaic reject the kernel (sublane slices of extent 1
+    #              aren't tile-aligned).
     k_ref,       # [1, S, Hk*D] VMEM — whole fresh K (chunk-resident)
     v_ref,       # [1, S, Hk*D] VMEM
     cache_ref,   # [L, N, 2, Bs, Hk*D] HBM (manual DMA)
-    scale_ref,   # [L, N, 2, Hk, Bs] HBM f32, or None (bf16 cache)
+    scale_ref,   # [L, N, 2, Hp, Sp] HBM f32 (tile-padded), or None (bf16)
     # outputs
-    out_ref,     # [1, TQ, Hk, G*D] VMEM
+    out_ref,     # [1, Hk, TQ, G*D] VMEM (head-leading, as q_ref)
     # scratch
     acc_ref,     # [Hk, TQ*G, D] f32
     m_ref,       # [Hk, TQ*G, 128] f32
     l_ref,       # [Hk, TQ*G, 128] f32
     kvbuf,       # [2, C, 2, Bs, Hk*D] cache-dtype (double buffer)
     sems,        # [2, C] DMA semaphores
-    scbuf,       # [2, C, 2, Hk, Bs] f32, or None
+    scbuf,       # [2, C, 2, Hp, Sp] f32, or None
     scsems,      # [2, C] DMA semaphores, or None
     *,
     c: int,
@@ -123,7 +128,7 @@ def _kernel_impl(
 
     def q_head(h):
         # [TQ, G*D] -> [TQ*G, D], pre-scaled f32
-        return q_ref[0, :, h, :].reshape(tq * g, d).astype(jnp.float32) * sm_scale
+        return q_ref[0, h].reshape(tq * g, d).astype(jnp.float32) * sm_scale
 
     # ---------------------------------------------------- prefix phase (DMA)
     def block_dmas(ci, slot):
@@ -160,12 +165,13 @@ def _kernel_impl(
         kc = kvbuf[slot, :, 0].reshape(t, hk * d).astype(jnp.float32)
         vc = kvbuf[slot, :, 1].reshape(t, hk * d).astype(jnp.float32)
         if quant:
-            # [C, Hk, Bs] tiles -> [Hk, T] by lane concat (token-minor
-            # scale layout exists exactly for this — no transpose)
-            sck = jnp.concatenate([scbuf[slot, i, 0] for i in range(c)],
-                                  axis=-1)
-            scv = jnp.concatenate([scbuf[slot, i, 1] for i in range(c)],
-                                  axis=-1)
+            # padded [Hp, Sp] tiles -> valid [Hk, Bs] -> [Hk, T] by lane
+            # concat (token-minor scale layout exists exactly for this —
+            # no transpose; the slice is value-level in VMEM)
+            sck = jnp.concatenate(
+                [scbuf[slot, i, 0][:hk, :bs] for i in range(c)], axis=-1)
+            scv = jnp.concatenate(
+                [scbuf[slot, i, 1][:hk, :bs] for i in range(c)], axis=-1)
         col = ci * t + jax.lax.broadcasted_iota(jnp.int32, (1, t), 1)
         allow = col < prefix                              # [1, T]
         for h in range(hk):  # static unroll over kv heads
@@ -209,7 +215,7 @@ def _kernel_impl(
 
     for h in range(hk):
         denom = jnp.maximum(l_ref[h, :, :1], 1e-9)  # padding rows → 0
-        out_ref[0, :, h, :] = (
+        out_ref[0, h] = (
             (acc_ref[h] / denom).reshape(tq, g * d).astype(out_ref.dtype)
         )
 
@@ -255,12 +261,13 @@ def paged_prefill_attention(
         tq //= 2
     c = min(blocks_per_chunk, m)
 
-    q_in = q.reshape(b, s, hk, g * d)
+    # head-leading query layout (see kernel docstring): [B, Hk, S, G*D]
+    q_in = q.reshape(b, s, hk, g * d).transpose(0, 2, 1, 3)
     k_in = k_new.reshape(b, s, hkd)
     v_in = v_new.reshape(b, s, hkd)
 
     in_specs = [
-        pl.BlockSpec((1, tq, hk, g * d), lambda bi, ri, *_: (bi, ri, 0, 0)),
+        pl.BlockSpec((1, hk, tq, g * d), lambda bi, ri, *_: (bi, 0, ri, 0)),
         pl.BlockSpec((1, s, hkd), lambda bi, ri, *_: (bi, 0, 0)),
         pl.BlockSpec((1, s, hkd), lambda bi, ri, *_: (bi, 0, 0)),
         pl.BlockSpec(memory_space=pl.ANY),  # cache stays in HBM
@@ -283,9 +290,10 @@ def paged_prefill_attention(
         data,
     ]
     if quant:
+        hp, sp = scale.shape[-2:]  # tile-padded (scale_tile(hk, bs))
         in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
         scratch += [
-            pltpu.VMEM((2, c, 2, hk, bs), jnp.float32),
+            pltpu.VMEM((2, c, 2, hp, sp), jnp.float32),
             pltpu.SemaphoreType.DMA((2, c)),
         ]
         operands.append(scale)
@@ -295,7 +303,7 @@ def paged_prefill_attention(
         grid=(b, s // tq),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(
-            (1, tq, hk, g * d), lambda bi, ri, *_: (bi, ri, 0, 0)
+            (1, hk, tq, g * d), lambda bi, ri, *_: (bi, 0, ri, 0)
         ),
         scratch_shapes=scratch,
     )
@@ -307,7 +315,8 @@ def paged_prefill_attention(
             logit_cap=logit_cap,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, s, hk, g * d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, hk, s, g * d), q.dtype),
         interpret=interpret,
     )(*operands)
-    return out.reshape(b, s, h, d)
+    # [B, Hk, S, G*D] -> [B, S, H, D]
+    return out.transpose(0, 2, 1, 3).reshape(b, s, h, d)

@@ -7,7 +7,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from dynamo_tpu.ops.kv_quant import QuantKvCache, dequant_layer_slice, is_quant
+from dynamo_tpu.ops.kv_quant import (
+    QuantKvCache, dequant_layer_slice, is_quant, pad_scales, scale_tile,
+)
 from dynamo_tpu.ops.paged_attention import (
     paged_attention,
     paged_attention_layer,
@@ -17,9 +19,10 @@ from dynamo_tpu.ops.paged_attention import (
 
 
 def mk_quant_cache(l, n, bs, hk, d):
+    hp, sp = scale_tile(hk, bs)
     return QuantKvCache(
         jnp.zeros((l, n, 2, bs, hk * d), jnp.int8),
-        jnp.ones((l, n, 2, hk, bs), jnp.float32),
+        jnp.ones((l, n, 2, hp, sp), jnp.float32),
     )
 
 
@@ -200,7 +203,7 @@ def test_block_gather_scatter_quant():
     src = QuantKvCache(
         jnp.asarray(rng.integers(-127, 127, size=(l, n, 2, bs, hk * d)),
                     jnp.int8),
-        jnp.asarray(rng.random((l, n, 2, hk, bs)), jnp.float32),
+        pad_scales(jnp.asarray(rng.random((l, n, 2, hk, bs)), jnp.float32)),
     )
     dst = mk_quant_cache(l, n, bs, hk, d)
     blocks = gather_blocks_padded(src, [1, 3, 6])

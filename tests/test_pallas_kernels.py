@@ -285,7 +285,9 @@ def test_mq_decode_kernel_matches_oracle():
 
 def test_mq_decode_kernel_quant_and_softcap():
     """MQ kernel with the int8 cache and a Gemma2-style score softcap."""
-    from dynamo_tpu.ops.kv_quant import QuantKvCache, dequant_layer_slice
+    from dynamo_tpu.ops.kv_quant import (
+        QuantKvCache, dequant_layer_slice, pad_scales,
+    )
     from dynamo_tpu.ops.pallas.decode_attention import (
         paged_decode_attention_mq,
     )
@@ -294,8 +296,8 @@ def test_mq_decode_kernel_quant_and_softcap():
     b, s, h, hk, d, bs, n, m, cap = 2, 3, 4, 2, 32, 16, 16, 4, 30.0
     data = jnp.asarray(
         rng.integers(-127, 127, size=(1, n, 2, bs, hk * d)), jnp.int8)
-    scale = jnp.asarray(rng.random((1, n, 2, hk, bs)) * 0.05 + 0.01,
-                        jnp.float32)
+    scale = pad_scales(jnp.asarray(rng.random((1, n, 2, hk, bs)) * 0.05 + 0.01,
+                                   jnp.float32))
     cache = QuantKvCache(data, scale)
     bt = jnp.asarray(np.arange(b * m).reshape(b, m).astype(np.int32))
     lens = np.asarray([s + 9, m * bs], np.int32)
