@@ -499,11 +499,12 @@ def main() -> None:
             hd = cfg.get("head_dim", cfg["hidden_size"] // cfg["num_heads"])
             hk = cfg["num_kv_heads"]
             if kvq == "int8":
-                # int8 payload + the TILE-PADDED f32 scale pool
-                # (ops/kv_quant.scale_tile: (Hp, Sp) per block per k/v —
-                # ~12.5% of payload at Hk=8/Bs=32, NOT the ~3% the raw
-                # per-token scales would cost)
-                hp, sp = -(-hk // 8) * 8, -(-block_size // 128) * 128
+                from dynamo_tpu.ops.kv_quant import scale_tile
+
+                # int8 payload + the TILE-PADDED f32 scale pool — ~12.5%
+                # of payload at Hk=8/Bs=32, NOT the ~3% raw per-token
+                # scales would cost
+                hp, sp = scale_tile(hk, block_size)
                 kv_bytes_elem = 1.0 + (hp * sp * 4.0) / (block_size * hk * hd)
             else:
                 kv_bytes_elem = 2.0

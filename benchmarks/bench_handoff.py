@@ -1,0 +1,144 @@
+"""Disagg KV-handoff A/B: colocated device path vs host-staged TCP.
+
+Measures the prefill→decode block handoff both ways a same-slice
+deployment can run it (VERDICT r3 next #5):
+
+  * device path — LocalKvTransferClient: gather on the prefill cache,
+    write_sink scatters jax.Arrays straight into the decode cache (ICI
+    under a sharded mesh, on-chip single-chip); zero host staging.
+  * TCP path   — DYN_KV_TRANSFER_FORCE_TCP: jax.device_get → wire
+    serialization → loopback TCP → device_put, the DCN/cross-process
+    shape.
+
+Prints one JSON line per arm: blocks/s, GB/s, and per-request handoff
+latency at the north-star shape (isl 3000 → 94 blocks of 32), which is
+the TTFT the decode side pays before its first step can run.
+
+Run: python benchmarks/bench_handoff.py  (env: DYNAMO_HANDOFF_MODEL
+tiny|1b|8b — geometry only, weights never load; DYNAMO_HANDOFF_KV
+int8|bf16; DYNAMO_HANDOFF_BLOCKS per-request block count, default 94)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.profile_decode import MODELS  # shared model geometries
+
+
+async def run(arm: str, cache_src, make_dst, nblocks: int, iters: int,
+              block_bytes: int):
+    import jax
+
+    from dynamo_tpu.llm.kv import transfer
+    from dynamo_tpu.llm.kv.transfer import KvTransferClient, KvTransferServer
+    from dynamo_tpu.ops.block_copy import (
+        gather_blocks_padded, scatter_blocks_inplace,
+    )
+
+    # fresh destination per arm: scatter_blocks_inplace DONATES the dest
+    # buffer, so a cache shared across arms would be dead for the second
+    state = {"cache": make_dst()}
+    applied = asyncio.Event()
+
+    async def write_sink(block_ids, arr, request_id):
+        state["cache"] = scatter_blocks_inplace(state["cache"], block_ids, arr)
+        jax.block_until_ready(state["cache"])
+        applied.set()
+
+    async def notify_cb(request_id, first_token, error):
+        pass
+
+    server = await KvTransferServer(write_sink, notify_cb).start()
+    if arm == "tcp":
+        os.environ["DYN_KV_TRANSFER_FORCE_TCP"] = "1"
+    else:
+        os.environ.pop("DYN_KV_TRANSFER_FORCE_TCP", None)
+    client = await KvTransferClient.connect(server.url)
+    ids = list(range(nblocks))
+
+    async def one():
+        applied.clear()
+        blocks = gather_blocks_padded(cache_src, ids)
+        await client.write_blocks(ids, blocks, "r")
+        await applied.wait()
+
+    await one()  # warm (compiles gather/scatter executables)
+    before = dict(transfer.stats)  # per-arm deltas, not process totals
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        await one()
+    dt = time.perf_counter() - t0
+    await client.close()
+    await server.stop()
+    total_blocks = nblocks * iters
+    return {
+        "arm": arm,
+        "blocks_s": round(total_blocks / dt, 1),
+        "gb_s": round(total_blocks * block_bytes / dt / 1e9, 3),
+        "handoff_ms_per_req": round(dt / iters * 1000, 1),
+        "local_calls": transfer.stats["local_write_calls"]
+        - before["local_write_calls"],
+        "tcp_calls": transfer.stats["tcp_write_calls"]
+        - before["tcp_write_calls"],
+    }
+
+
+def main() -> None:
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        from dynamo_tpu.utils import force_cpu_devices
+
+        force_cpu_devices(1)
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.models.llama import LlamaModel
+
+    on_accel = jax.default_backend() != "cpu"
+    name = os.environ.get("DYNAMO_HANDOFF_MODEL", "8b" if on_accel else "tiny")
+    kv = os.environ.get("DYNAMO_HANDOFF_KV", "int8" if on_accel else "bf16")
+    nblocks = int(os.environ.get("DYNAMO_HANDOFF_BLOCKS", "94"))
+    iters = int(os.environ.get("DYNAMO_HANDOFF_ITERS", "8" if on_accel else "2"))
+    bs = 32 if on_accel else 16
+
+    cfg = ModelConfig(**MODELS[name], dtype="bfloat16" if on_accel else "float32")
+    model = LlamaModel(cfg)
+    n = nblocks + 8
+    dt = "int8" if kv == "int8" else None
+    src = model.init_kv_cache(n, bs, dtype=dt)
+
+    def make_dst():
+        return model.init_kv_cache(n, bs, dtype=dt)
+
+    if kv == "int8":
+        # non-trivial contents so TCP serialization is honest
+        src = type(src)(
+            jnp.asarray(np.random.default_rng(0).integers(
+                -127, 127, size=src.data.shape), jnp.int8),
+            src.scale,
+        )
+        # all-layer bytes of ONE block: int8 payload + padded f32 scales
+        block_bytes = (int(np.prod(src.data.shape)) // n
+                       + 4 * int(np.prod(src.scale.shape)) // n)
+    else:
+        elt = 2 if on_accel else 4
+        block_bytes = int(np.prod(src.shape)) // n * elt
+    jax.block_until_ready(src)
+    print(f"# model={name} kv={kv} blocks/req={nblocks} "
+          f"block_bytes={block_bytes} iters={iters}", file=sys.stderr)
+    for arm in ("device", "tcp"):
+        out = asyncio.run(run(arm, src, make_dst, nblocks, iters, block_bytes))
+        print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""Speculative-decoding ITL A/B on a repetitive workload (VERDICT r3 #7).
+
+Serves a copy-task batch — prompts whose continuation repeats their own
+content, the workload prompt-lookup speculation exists for — through the
+real engine twice (spec off / spec on) and prints one JSON line per arm:
+
+  {"arm": "spec4", "tok_s": N, "itl_ms": N, "accept_rate": N, ...}
+
+Greedy by default (see main()); with DYNAMO_SPEC_TEMP>0 and per-request
+seeds it exercises the rejection-sampled verify path (round 4) — the
+engine's distribution-equivalence is pinned by tests/test_spec_decode.py,
+this file measures the SPEED side on the real chip.
+
+Run: python benchmarks/bench_spec.py  (env: DYNAMO_SPEC_MODEL tiny|1b|8b,
+DYNAMO_SPEC_BATCH, DYNAMO_SPEC_TOKENS, DYNAMO_SPEC_STEPS,
+DYNAMO_SPEC_TEMP)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.profile_decode import MODELS  # shared model geometries
+
+
+def run_arm(model, params, cfg, spec_tokens: int, batch: int, steps: int,
+            temp: float, seed: int = 0):
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.engine.request import EngineRequest
+    from dynamo_tpu.llm.protocols import SamplingOptions, StopConditions
+
+    max_len = 2048
+    bs = 32
+    ecfg = EngineConfig(
+        max_batch_size=batch, max_model_len=max_len, block_size=bs,
+        num_blocks=batch * (max_len // bs) + 64,
+        decode_steps=8,  # short bursts: speculation replaces burst length
+        prefill_chunk_tokens=512,
+        spec_tokens=spec_tokens,
+        enable_prefix_reuse=False,
+    )
+    engine = EngineCore(model, params, ecfg, eos_token_ids=[])
+    rng = np.random.default_rng(3)
+    done = [0]
+
+    def submit(i):
+        # copy-task prompt: a short random phrase repeated many times —
+        # continuations n-gram-match the prompt, the spec sweet spot
+        phrase = rng.integers(1, cfg.vocab_size - 1, size=24).tolist()
+        prompt = (phrase * 12)[:256]
+
+        def emit(out):
+            if out.finish_reason is not None:
+                done[0] += 1
+                submit(i)
+
+        engine.submit(EngineRequest(
+            request_id=f"s{spec_tokens}-{i}-{done[0]}",
+            prompt=prompt,
+            sampling=SamplingOptions(temperature=temp,
+                                     seed=(seed + i) if temp else None),
+            stops=StopConditions(max_tokens=max_len - 300, ignore_eos=True),
+            emit=emit,
+        ))
+
+    for i in range(batch):
+        submit(i)
+    # ramp: finish prefill + warm executables
+    guard = time.monotonic() + 1200
+    while engine.has_work() and engine.decode_steps < 3 \
+            and time.monotonic() < guard:
+        engine.step()
+    engine.step()
+
+    tok0, t0 = engine.tokens_generated, time.perf_counter()
+    d0, a0 = engine.decode_steps, engine.spec_accepted
+    while engine.decode_steps - d0 < steps and engine.has_work() \
+            and time.monotonic() < guard:
+        engine.step()
+    dt = time.perf_counter() - t0
+    toks = engine.tokens_generated - tok0
+    dsteps = max(engine.decode_steps - d0, 1)
+    accepted = engine.spec_accepted - a0
+    return {
+        "arm": f"spec{spec_tokens}" if spec_tokens else "off",
+        "tok_s": round(toks / dt, 1),
+        "itl_ms": round(dt / dsteps * 1000, 2),
+        "toks_per_dispatch": round(toks / dsteps, 2),
+        "accept_rate": round(accepted / max(toks, 1), 3) if spec_tokens else None,
+    }
+
+
+def main() -> None:
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        from dynamo_tpu.utils import force_cpu_devices
+
+        force_cpu_devices(1)
+    import jax
+
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.models.llama import LlamaModel
+
+    on_accel = jax.default_backend() != "cpu"
+    name = os.environ.get("DYNAMO_SPEC_MODEL", "8b" if on_accel else "tiny")
+    batch = int(os.environ.get("DYNAMO_SPEC_BATCH", "16" if on_accel else "4"))
+    steps = int(os.environ.get("DYNAMO_SPEC_STEPS", "150" if on_accel else "20"))
+    k = int(os.environ.get("DYNAMO_SPEC_TOKENS", "4"))
+    # greedy by default: a RANDOM-weights model at temp>0 rejects nearly
+    # every proposal (it does not actually continue the repetition), so
+    # the sampled arm only measures overhead; greedy decode settles into
+    # a cycle the n-gram proposer can match.  Set DYNAMO_SPEC_TEMP>0 on
+    # real checkpoints to measure the rejection-sampled path.
+    temp = float(os.environ.get("DYNAMO_SPEC_TEMP", "0"))
+    quant = on_accel and name == "8b"
+
+    cfg = ModelConfig(**MODELS[name], dtype="bfloat16" if on_accel else "float32")
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), quantized=quant)
+    jax.block_until_ready(params)
+    print(f"# model={name} batch={batch} steps={steps} quant={quant}",
+          file=sys.stderr)
+    for spec in (0, k):
+        out = run_arm(model, params, cfg, spec, batch, steps, temp)
+        print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

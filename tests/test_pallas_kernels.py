@@ -342,3 +342,73 @@ def test_int8_matmul_kernel_matches_xla_path():
                       out_dtype=jnp.float32, bm=64, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref)[:64],
                                rtol=5e-2, atol=0.5)
+
+
+def test_scale_tile_pad_invariants():
+    """scale_tile rounds to the f32 (8, 128) tiling; pad_scales pads with
+    the neutral scale 1.0 and is a no-op at tile-exact shapes."""
+    from dynamo_tpu.ops.kv_quant import pad_scales, scale_tile
+
+    assert scale_tile(8, 32) == (8, 128)
+    assert scale_tile(4, 16) == (8, 128)
+    assert scale_tile(8, 128) == (8, 128)
+    assert scale_tile(16, 256) == (16, 256)
+    sc = jnp.arange(2 * 3 * 2 * 4 * 16, dtype=jnp.float32).reshape(
+        2, 3, 2, 4, 16)
+    padded = pad_scales(sc)
+    assert padded.shape == (2, 3, 2, 8, 128)
+    np.testing.assert_array_equal(np.asarray(padded[..., :4, :16]),
+                                  np.asarray(sc))
+    assert float(padded[..., 4:, :].min()) == 1.0
+    exact = jnp.ones((1, 2, 2, 8, 128), jnp.float32)
+    assert pad_scales(exact) is exact
+
+
+def test_kernels_at_8b_serving_geometry():
+    """Both kernels at the EXACT 8B bench geometry (hk=8, d=128, bs=32,
+    int8 KV with padded scales) in interpret mode — pins the shape logic
+    the real chip runs; Mosaic-level lowering is covered by
+    benchmarks/probe_kernels.py on hardware."""
+    from dynamo_tpu.ops.kv_quant import QuantKvCache, pad_scales
+    from dynamo_tpu.ops.pallas.prefill_attention import paged_prefill_attention
+    from dynamo_tpu.ops.paged_attention import prefill_attention
+
+    rng = np.random.default_rng(77)
+    l, n, bs, hk, d, h = 1, 12, 32, 8, 128, 32
+    b, m = 2, 3
+    data = jnp.asarray(rng.integers(-127, 127, size=(l, n, 2, bs, hk * d)),
+                       jnp.int8)
+    scale = pad_scales(jnp.asarray(
+        rng.random((l, n, 2, hk, bs)) * 0.05 + 0.01, jnp.float32))
+    cache = QuantKvCache(data, scale)
+    bt = jnp.asarray(np.arange(b * m).reshape(b, m).astype(np.int32))
+
+    # decode at odd lengths
+    lens = jnp.asarray([1, 2 * bs + 7], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
+    layer_kv = __import__("dynamo_tpu.ops.kv_quant", fromlist=["x"]) \
+        .dequant_layer_slice(cache.data[0], cache.scale[0], hk)
+    ref = paged_attention(
+        q, layer_kv[:, 0].reshape(n, bs, hk, d),
+        layer_kv[:, 1].reshape(n, bs, hk, d), bt, lens,
+        (lens - 1)[:, None].astype(jnp.int32))[:, 0]
+    got = paged_decode_attention(
+        q[:, 0], cache, jnp.int32(0), bt, lens,
+        blocks_per_chunk=2, seqs_per_group=2, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=3e-5)
+
+    # prefill: one cached prefix block + 64 fresh rows
+    s, prefix = 64, bs
+    q2 = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    kn = jnp.asarray(rng.normal(size=(b, s, hk, d)), jnp.float32)
+    vn = jnp.asarray(rng.normal(size=(b, s, hk, d)), jnp.float32)
+    seq_lens = jnp.asarray([prefix + s, prefix + s - 9], jnp.int32)
+    start = jnp.full((b,), prefix, jnp.int32)
+    ref2 = prefill_attention(q2, kn, vn, cache, jnp.int32(0), bt, seq_lens,
+                             start, prefix_blocks=1)
+    got2 = paged_prefill_attention(q2, kn, vn, cache, jnp.int32(0), bt,
+                                   seq_lens, start, rows_per_chunk=32,
+                                   blocks_per_chunk=2, interpret=True)
+    for i, f in enumerate([s, s - 9]):
+        np.testing.assert_allclose(np.asarray(got2)[i, :f],
+                                   np.asarray(ref2)[i, :f], atol=3e-5)
