@@ -87,6 +87,19 @@ def main() -> None:
                 jnp.asarray([min(2 * bs + s, max_len)], jnp.int32),
                 jnp.asarray([min(2 * bs, max_len - s)], jnp.int32))),
         ]
+    # dequant-in-kernel int8 matmul at decode and prefill row counts
+    from dynamo_tpu.ops.pallas.int8_matmul import int8_matmul
+
+    wk, wn = hk * d * (h // hk), 14336  # 8B-ish ffn width
+    wq8 = jnp.ones((wk, wn), jnp.int8)
+    sc8 = jnp.ones((wn,), jnp.float32)
+    for rows in (64, 512):
+        variants.append((
+            f"int8_matmul/m{rows}",
+            lambda rows=rows: int8_matmul(
+                jnp.ones((rows, wk), jnp.bfloat16), wq8, sc8,
+                out_dtype=jnp.bfloat16),
+        ))
     ok = all([probe(lbl, fn) for lbl, fn in variants])
     sys.exit(0 if ok else 1)
 

@@ -134,10 +134,13 @@ def _pallas_int8_matmul(x: jax.Array, w: "QTensor", pet):
     m = 1
     for d in lead:
         m *= d
-    # only route prefill-sized row counts: decode-shaped m (batch <= 64)
-    # would run the MXU with pathological 1..8-row blocks — exactly the
-    # wrong thing to A/B the bandwidth hypothesis with
-    if m == 0 or m % BM or n % min(BN, n) or k % min(BK, k):
+    # route full-BM prefill tiles AND decode-shaped row counts (m a bf16
+    # sublane multiple below BM: batch-64 decode runs one [64, bk] block —
+    # underfilled MXU rows, but the decode step is weight-bandwidth-bound,
+    # and in-kernel dequant is exactly the decode bandwidth hypothesis to
+    # A/B).  Row counts that tile neither way fall back to XLA.
+    m_ok = m % BM == 0 or (16 <= m < BM and m % 16 == 0)
+    if m == 0 or not m_ok or n % min(BN, n) or k % min(BK, k):
         return None
     out = int8_matmul(
         x.reshape(m, k), w.q, jnp.squeeze(w.scale, axis=-2),
