@@ -366,11 +366,17 @@ def _northstar_ttft(model, params, kv_quant: str, block_size: int,
 
     batch = int(os.environ.get("DYNAMO_BENCH_TTFT_BATCH", "8"))
     max_len = ((want_isl + 320) // block_size + 1) * block_size
+    # bigger chunks than the throughput config's: at isl 3000 each chunk
+    # dispatch pays a fixed issue cost plus one <=8-step decode interleave
+    # round, so 1024-token chunks roughly third the interleave tax; the
+    # flash kernel holds the chunk's fresh K/V in VMEM either way
+    chunk = int(os.environ.get("DYNAMO_BENCH_TTFT_CHUNK",
+                               str(max(prefill_chunk or 512, 1024))))
     ecfg = EngineConfig(
         max_batch_size=batch, max_model_len=max_len, block_size=block_size,
         num_blocks=batch * (max_len // block_size) + 64,
         decode_steps=8,
-        prefill_chunk_tokens=min(prefill_chunk or 512, max_len),
+        prefill_chunk_tokens=min(chunk, max_len),
         enable_prefix_reuse=False,
         cache_dtype="int8" if kv_quant == "int8" else None,
     )
