@@ -100,6 +100,16 @@ def main() -> None:
                 jnp.ones((rows, wk), jnp.bfloat16), wq8, sc8,
                 out_dtype=jnp.bfloat16),
         ))
+    # grouped-MoE ragged_dot lowering (Mixtral-ish shapes: E=8 experts,
+    # 512 routed token-slots, H=4096, F=14336/4 keeps the probe light)
+    def moe_ragged():
+        e, t, hd_, f = 8, 512, hk * d * (h // hk), 3584
+        xs = jnp.ones((t, hd_), jnp.bfloat16)
+        w = jnp.ones((e, hd_, f), jnp.bfloat16)
+        sizes = jnp.full((e,), t // e, jnp.int32)
+        return jax.lax.ragged_dot(xs, w, sizes)
+
+    variants.append(("moe/ragged_dot", moe_ragged))
     ok = all([probe(lbl, fn) for lbl, fn in variants])
     sys.exit(0 if ok else 1)
 
