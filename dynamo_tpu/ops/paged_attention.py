@@ -110,9 +110,14 @@ def paged_attention_layer(
     if s == 1 and kernel_ok and _pallas_decode_enabled():
         from dynamo_tpu.ops.pallas.decode_attention import paged_decode_attention
 
+        # tuning knobs for on-chip sweeps (benchmarks/profile_decode.py):
+        # group size trades per-grid-step fixed cost against VMEM; the
+        # defaults fit 8B bf16 KV, int8 KV has headroom for larger groups
+        spg = int(os.environ.get("DYNAMO_DECODE_SEQS_PER_GROUP", "8"))
+        bpc = int(os.environ.get("DYNAMO_DECODE_BLOCKS_PER_CHUNK", "4"))
         out = paged_decode_attention(
             q[:, 0], cache, layer, block_tables, seq_lens, sm_scale=sm_scale,
-            logit_cap=logit_cap,
+            logit_cap=logit_cap, seqs_per_group=spg, blocks_per_chunk=bpc,
         )
         return out[:, None]
     if 1 < s <= MQ_MAX_S and kernel_ok and _pallas_mq_enabled():
