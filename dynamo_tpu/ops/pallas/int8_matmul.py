@@ -46,7 +46,7 @@ def _kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, nk: int):
     @pl.when(k == nk - 1)
     def _epilogue():
         o_ref[:] = (
-            acc_ref[:] * s_ref[:].astype(jnp.float32)[None, :]
+            acc_ref[:] * s_ref[0, :].astype(jnp.float32)[None, :]
         ).astype(o_ref.dtype)
 
 
@@ -87,10 +87,13 @@ def int8_matmul(
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
             pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
-            pl.BlockSpec((bn,), lambda i, j, kk: (j,)),
+            # scale rides as [1, N] on the standard f32 (8,128) layout —
+            # a 1-D f32 operand's XLA layout is T(1024)-tiled, which
+            # Mosaic rejects for 512-wide blocks on real TPUs
+            pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         interpret=interpret,
-    )(x, wq, scale)
+    )(x, wq, scale.reshape(1, n))
