@@ -1,0 +1,236 @@
+"""KV-aware routing TTFT A/B over the real serving stack.
+
+Reference headline: KV-aware routing cuts TTFT ~3x vs load-based routing
+on a multi-turn workload (/root/reference/docs/architecture.md:73-83,
+measured there on 100K R1 queries over 2x8xH100).  This bench reproduces
+the *routing* effect end-to-end with this repo's own components — HTTP
+frontend -> Processor -> (Router | random) -> N TpuWorker
+replicas with prefix-caching engines — on CPU with the tiny model, so
+the number measures routing+cache behaviour, not chip compute.
+
+Workload: U users x T turns.  Each turn re-sends the user's whole
+conversation (shared prefix grows every turn) the way OpenAI-API
+multi-turn chat does.  A KV-aware router sends a user's next turn to
+the worker already holding their prefix blocks (prefix-cache hit ->
+prefill only the new tail); the baseline is the client's load-blind
+random routing (prefix hit ~1/N by chance), the analogue of the
+reference's load-based-routing baseline.
+
+Prints one JSON line per mode plus a final comparison line:
+
+  {"metric": "kv_router_ttft_speedup", "value": ..., "unit": "x", ...}
+
+Usage:  python benchmarks/bench_router.py [--users 6] [--turns 4]
+        [--prefix-tokens 640] [--turn-tokens 64] [--workers 3]
+
+The recorded measurement (benchmarks/README.md, docs/kv_cache_routing.md)
+ran: --users 6 --turns 4 --prefix-tokens 512 --workers 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dynamo_tpu.utils import force_cpu_devices
+
+
+def _percentile(xs, p):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(round(p / 100 * (len(xs) - 1))))]
+
+
+async def _ttft_request(session, port: int, token_ids):
+    """POST a streaming 1-token completion; return seconds to its finish
+    chunk.  The tiny pipeline serves token_ids without a detokenizer, so
+    per-token deltas carry no text and the stream's only chunk is the
+    finish — with max_tokens=1 that chunk IS the first token, making
+    finish-time an exact TTFT."""
+    t0 = time.perf_counter()
+    async with session.post(
+        f"http://127.0.0.1:{port}/v1/completions",
+        json={
+            "model": "tiny",
+            "prompt": token_ids,
+            "max_tokens": 1,
+            "temperature": 0.0,
+            "ignore_eos": True,
+            "stream": True,
+        },
+    ) as r:
+        assert r.status == 200, await r.text()
+        async for raw in r.content:
+            line = raw.decode().strip()
+            if not line.startswith("data:") or line == "data: [DONE]":
+                continue
+            choice = json.loads(line[5:])["choices"][0]
+            if choice.get("finish_reason") == "error":
+                raise RuntimeError(f"server error stream: {line[:200]}")
+            ttft = time.perf_counter() - t0
+            async for _ in r.content:  # drain
+                pass
+            return ttft
+    raise RuntimeError("stream ended without a chunk")
+
+
+async def _run_mode(mode: str, args) -> dict:
+    """Boot the agg_router graph with args.workers TpuWorker replicas and
+    replay the multi-turn workload; mode is 'kv' or 'random'."""
+    import importlib
+
+    from aiohttp import ClientSession
+
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.transports.coordinator import CoordinatorServer
+    from dynamo_tpu.sdk import ServiceConfig, serve_graph
+    from dynamo_tpu.sdk.serving import serve_service
+
+    graph_mod = "examples.llm.graphs.agg_router"
+    entry = getattr(importlib.import_module(graph_mod), "Frontend")
+    srv = await CoordinatorServer(port=0).start()
+    conv_tokens = args.prefix_tokens + args.turns * args.turn_tokens + 16
+    # user conversations + one warmup conversation per worker must all
+    # stay cache-resident or LRU churn hides the routing effect
+    blocks_needed = (args.users + args.workers) * conv_tokens // 16
+    cfg = ServiceConfig({
+        "Frontend": {"served_model_name": "tiny", "port": 0},
+        "Processor": {"router": mode} if mode == "kv" else {},
+        "Router": {"block-size": 16},
+        "TpuWorker": {
+            "engine": "tiny",
+            "max-batch-size": max(4, args.users),
+            "max-model-len": args.prefix_tokens
+            + args.turns * args.turn_tokens
+            + 64,
+            "block-size": 16,
+            "num-blocks": blocks_needed + 32,
+        },
+    })
+    rcfg = RuntimeConfig(coordinator_url=srv.url)
+    handle = await serve_graph(entry, config=cfg, runtime_config=rcfg,
+                               graph=graph_mod)
+    extra_rts = []
+    try:
+        from examples.llm.components.worker import TpuWorker, backend_input
+
+        workers = [handle.instances["TpuWorker"]]
+        for _ in range(args.workers - 1):
+            rt = await DistributedRuntime.connect(rcfg)
+            extra_rts.append(rt)
+            workers.append(await serve_service(TpuWorker, rt, cfg,
+                                               graph=graph_mod))
+
+        # warm every engine's executables (full-prompt prefill bucket,
+        # remainder bucket, decode burst) OUTSIDE the timed window —
+        # XLA bucket compiles take seconds and would otherwise swamp the
+        # routing effect.  Direct engine submits so warmup is
+        # deterministic per worker, not routing-dependent.
+        from dynamo_tpu.runtime.engine import Context
+
+        async def _warm(worker, salt):
+            prefix = [1 + (salt * 977 + i) % 2000
+                      for i in range(args.prefix_tokens)]
+            for tail in (0, args.turn_tokens, 2 * args.turn_tokens):
+                req = {
+                    "token_ids": prefix + [3 + (salt + i) % 2000
+                                           for i in range(tail)],
+                    "sampling": {"temperature": 0.0},
+                    # last warmup also compiles the 1-token decode burst
+                    # the measured requests use
+                    "stops": {"max_tokens":
+                              1 if tail == 2 * args.turn_tokens else 8,
+                              "ignore_eos": True},
+                }
+                async for _ in worker.engine.generate(
+                        Context(backend_input(req))):
+                    pass
+
+        for i, w in enumerate(workers):
+            await _warm(w, 7000 + i)
+
+        port = handle.instances["Frontend"].port
+        # conversations: user-distinct prefix + growing turn tail (vocab
+        # ids only; tiny model, content irrelevant)
+        convs = {
+            u: [1 + (u * 131 + i) % 2000 for i in range(args.prefix_tokens)]
+            for u in range(args.users)
+        }
+        ttfts_by_turn: list[list[float]] = []
+        async with ClientSession() as session:
+            for turn in range(args.turns):
+                for u in range(args.users):
+                    convs[u] += [
+                        1 + (u * 31 + turn * 17 + i) % 2000
+                        for i in range(args.turn_tokens)
+                    ]
+                ttfts = await asyncio.gather(*[
+                    _ttft_request(session, port, convs[u])
+                    for u in range(args.users)
+                ])
+                ttfts_by_turn.append([t * 1000 for t in ttfts])
+                # turns arrive paced, not back-to-back: give the KV-event
+                # plane a beat, like real multi-turn traffic has
+                await asyncio.sleep(0.3)
+        warm = [t for turn in ttfts_by_turn[1:] for t in turn]
+        return {
+            "mode": mode,
+            "ttft_p50_ms": round(_percentile(warm, 50), 1),
+            "ttft_p95_ms": round(_percentile(warm, 95), 1),
+            "ttft_mean_ms": round(statistics.mean(warm), 1),
+            "first_turn_p50_ms": round(_percentile(ttfts_by_turn[0], 50), 1),
+            "n_warm": len(warm),
+        }
+    finally:
+        await handle.stop()
+        for rt in extra_rts:
+            await rt.shutdown()
+        await srv.stop()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--users", type=int, default=6)
+    ap.add_argument("--turns", type=int, default=4)
+    ap.add_argument("--prefix-tokens", type=int, default=640)
+    ap.add_argument("--turn-tokens", type=int, default=64)
+    ap.add_argument("--workers", type=int, default=3)
+    args = ap.parse_args()
+
+    # routing-effect bench: CPU is the right platform (the number
+    # measures cache+routing behaviour, not chip compute).  Opt into an
+    # accelerator explicitly with DYNAMO_ROUTER_BENCH_ON_ACCEL=1.
+    if os.environ.get("DYNAMO_ROUTER_BENCH_ON_ACCEL", "") != "1":
+        force_cpu_devices(1)
+
+    results = {}
+    for mode in ("random", "kv"):
+        results[mode] = asyncio.run(_run_mode(mode, args))
+        print(json.dumps(results[mode]), flush=True)
+    # mean is the headline (few dozen samples make percentiles of a
+    # bimodal hit/miss distribution coin-flippy); p95 shown alongside
+    speedup = results["random"]["ttft_mean_ms"] / max(
+        results["kv"]["ttft_mean_ms"], 1e-9
+    )
+    print(json.dumps({
+        "metric": "kv_router_ttft_speedup",
+        "value": round(speedup, 2),
+        "unit": "x (mean TTFT, warm turns)",
+        "p95_speedup": round(results["random"]["ttft_p95_ms"]
+                             / max(results["kv"]["ttft_p95_ms"], 1e-9), 2),
+        "workers": args.workers,
+        "users": args.users,
+        "turns": args.turns,
+        "reference_claim": 3.0,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

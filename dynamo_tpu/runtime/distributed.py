@@ -181,6 +181,7 @@ class Client(AsyncEngine):
         self._rr = 0
         self._watch_id: Optional[int] = None
         self._changed = asyncio.Event()
+        self._removed: set[int] = set()  # seen-then-deleted instance ids
 
     async def start(self) -> None:
         coord = self.endpoint.runtime.coordinator
@@ -206,10 +207,16 @@ class Client(AsyncEngine):
         elif event == "delete":
             iid = int(key.rsplit("/", 1)[-1], 16)
             self._instances.pop(iid, None)
+            self._removed.add(iid)
+            while len(self._removed) > 1024:  # bound long-lived churn
+                self._removed.pop()
             conn = self._conns.pop(iid, None)
             if conn:
                 asyncio.ensure_future(conn.close())
-        self._changed.set()
+        # swap-then-set: waiters hold the OLD event object, so a consumer
+        # can never clear() away a notification another waiter needed
+        ev, self._changed = self._changed, asyncio.Event()
+        ev.set()
 
     def _add(self, info: dict) -> None:
         inst = Instance(
@@ -220,6 +227,7 @@ class Client(AsyncEngine):
             metadata=info.get("metadata"),
         )
         self._instances[inst.instance_id] = inst
+        self._removed.discard(inst.instance_id)
 
     def instance_ids(self) -> list[int]:
         return sorted(self._instances)
@@ -227,20 +235,30 @@ class Client(AsyncEngine):
     def instances(self) -> list[Instance]:
         return [self._instances[i] for i in self.instance_ids()]
 
-    async def wait_for_instances(self, n: int = 1, timeout: float = 30.0) -> list[int]:
-        """Block until >= n instances are live (ref wait_for_endpoints)."""
+    async def _wait_until(self, pred, timeout: float) -> bool:
+        """Await ``pred()`` truth driven by discovery events; False on
+        timeout.  Snapshots the CURRENT change event before re-checking
+        the predicate — the notifier swaps in a fresh event on every
+        change, so a notification between check and wait is never lost."""
         deadline = asyncio.get_running_loop().time() + timeout
-        while len(self._instances) < n:
+        while True:
+            ev = self._changed
+            if pred():
+                return True
             remaining = deadline - asyncio.get_running_loop().time()
             if remaining <= 0:
-                raise TimeoutError(
-                    f"only {len(self._instances)}/{n} instances of {self.endpoint.url}"
-                )
-            self._changed.clear()
+                return False
             try:
-                await asyncio.wait_for(self._changed.wait(), remaining)
+                await asyncio.wait_for(ev.wait(), remaining)
             except asyncio.TimeoutError:
                 pass
+
+    async def wait_for_instances(self, n: int = 1, timeout: float = 30.0) -> list[int]:
+        """Block until >= n instances are live (ref wait_for_endpoints)."""
+        if not await self._wait_until(lambda: len(self._instances) >= n, timeout):
+            raise TimeoutError(
+                f"only {len(self._instances)}/{n} instances of {self.endpoint.url}"
+            )
         return self.instance_ids()
 
     # --------------------------------------------------------------- routing
@@ -268,7 +286,23 @@ class Client(AsyncEngine):
         return ids[self._rr]
 
     def direct(self, request: Context, instance_id: int) -> AsyncIterator[Any]:
-        return self._conn(instance_id).generate(request)
+        return self._direct_stream(request, instance_id)
+
+    async def _direct_stream(self, request: Context, instance_id: int):
+        if instance_id not in self._instances and instance_id not in self._removed:
+            # a KV-aware router can learn a worker (via its event plane)
+            # a beat before this client's discovery watch does — give
+            # discovery a short grace before declaring the id dead.  Ids
+            # this client has seen REGISTER AND THEN DELETE get no grace:
+            # that worker positively died, and stalling a pinned request
+            # 1s per failover would be pure added TTFT.
+            await self._wait_until(
+                lambda: instance_id in self._instances
+                or instance_id in self._removed,
+                1.0,
+            )
+        async for item in self._conn(instance_id).generate(request):
+            yield item
 
     def random(self, request: Context) -> AsyncIterator[Any]:
         return self.direct(request, self.pick_random())

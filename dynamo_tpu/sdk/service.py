@@ -267,7 +267,8 @@ class RemoteEndpoint:
                     got_any = True
                     yield item
                 return
-            except ConnectionError:
+            except OSError:
+                # ConnectionError plus gaierror/unreachable-host failures
                 if got_any or attempt == 1:
                     raise
 

@@ -72,6 +72,29 @@ class Processor:
             log.exception("router unavailable; falling back to round-robin")
         return None
 
+    async def _direct_with_fallback(self, payload: dict, instance: int):
+        """Stream from the router-pinned instance; if the dial fails
+        before ANY output (stale/undiscovered worker id), re-dispatch via
+        default routing — nothing was streamed, so the retry is safe."""
+        started = False
+        try:
+            async for out in self.worker_client.generate.direct(
+                    payload, instance):
+                started = True
+                yield out
+            return
+        except (KeyError, OSError):
+            # dial failures only (OSError covers ConnectionError plus
+            # gaierror/EHOSTUNREACH-class failures from open_connection) —
+            # request-level errors (validation, serialization) would fail
+            # identically on any worker and must surface, not retry
+            if started:
+                raise
+            log.warning("direct dial to %x failed; rerouting", instance,
+                        exc_info=True)
+        async for out in self.worker_client.generate(payload):
+            yield out
+
     @dynamo_endpoint
     async def process(self, req: dict):
         token_ids = req.get("prompt_token_ids")
@@ -90,7 +113,7 @@ class Processor:
         }
         instance = await self._pick_instance(payload["token_ids"])
         stream = (
-            self.worker_client.generate.direct(payload, instance)
+            self._direct_with_fallback(payload, instance)
             if instance is not None
             else self.worker_client.generate(payload)
         )

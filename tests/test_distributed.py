@@ -222,3 +222,64 @@ def test_tcp_client_reconnects_after_server_restart():
         await client.close()
 
     run(go())
+
+
+def test_direct_dial_discovery_grace():
+    """direct() to an instance id the client hasn't discovered yet waits
+    out the discovery watch (a KV-aware router can know a worker before
+    the dialling client's watch does) instead of failing immediately;
+    a never-appearing id still raises."""
+    async def go():
+        srv = await _coordinator()
+        try:
+            worker1 = await _runtime(srv.url)
+            frontend = await _runtime(srv.url)
+            ep1 = worker1.namespace("dyn").component("backend").endpoint("generate")
+            await ep1.serve(EchoEngine())
+            client = await frontend.namespace("dyn").component("backend") \
+                .endpoint("generate").client()
+            await client.wait_for_instances(1)
+
+            # late registration: start the dial BEFORE the worker exists
+            worker2 = await _runtime(srv.url)
+
+            async def dial_then_register():
+                # worker2's endpoint registers ~100ms after the dial starts
+                async def register():
+                    await asyncio.sleep(0.1)
+                    ep2 = worker2.namespace("dyn").component("backend") \
+                        .endpoint("generate")
+                    await ep2.serve(EchoEngine())
+                reg = asyncio.ensure_future(register())
+                out = [x async for x in client.direct(
+                    Context([7]), worker2.instance_id)]
+                await reg
+                return out
+
+            assert await dial_then_register() == [7]
+
+            # an id that never appears exhausts the grace and raises
+            t0 = asyncio.get_running_loop().time()
+            with pytest.raises(KeyError):
+                async for _ in client.direct(Context([1]), 0xdead):
+                    pass
+            assert asyncio.get_running_loop().time() - t0 >= 0.9
+
+            # a seen-then-deleted id gets NO grace: the worker positively
+            # died, so a pinned request fails over immediately
+            await worker2.shutdown()
+            assert await client._wait_until(
+                lambda: worker2.instance_id in client._removed, 5.0)
+            t0 = asyncio.get_running_loop().time()
+            with pytest.raises(KeyError):
+                async for _ in client.direct(Context([1]), worker2.instance_id):
+                    pass
+            assert asyncio.get_running_loop().time() - t0 < 0.5
+
+            await client.close()
+            await frontend.shutdown()
+            await worker1.shutdown()
+        finally:
+            await srv.stop()
+
+    run(go())
