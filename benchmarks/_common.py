@@ -1,0 +1,9 @@
+"""Shared helpers for the benchmark harnesses."""
+
+from __future__ import annotations
+
+
+def percentile(xs, p):
+    """Nearest-rank percentile of a non-empty sequence."""
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(round(p / 100 * (len(xs) - 1))))]

@@ -42,9 +42,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from dynamo_tpu.utils import force_cpu_devices
 
 
-def _percentile(xs, p):
-    xs = sorted(xs)
-    return xs[min(len(xs) - 1, int(round(p / 100 * (len(xs) - 1))))]
+from benchmarks._common import percentile as _percentile
 
 
 async def _ttft_request(session, port: int, token_ids):

@@ -32,12 +32,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from aiohttp import ClientSession
 
 
-def _percentile(xs, p):
-    if not xs:
-        return 0.0
-    xs = sorted(xs)
-    i = min(len(xs) - 1, int(round(p / 100 * (len(xs) - 1))))
-    return xs[i]
+from benchmarks._common import percentile as _percentile
 
 
 async def one_request(session, url, model, prompt, osl):

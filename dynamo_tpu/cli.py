@@ -145,6 +145,7 @@ def _build_local_engine(args) -> tuple[object, object]:
         max_model_len=args.max_model_len,
         block_size=args.block_size,
         num_blocks=args.num_blocks,
+        num_host_blocks=int(getattr(args, "num_host_blocks", 0) or 0),
         cache_dtype=(
             "int8" if getattr(args, "kv_cache_dtype", "model") == "int8" else None
         ),
@@ -703,6 +704,10 @@ def _parser() -> argparse.ArgumentParser:
     run.add_argument("--max-model-len", type=int, default=4096)
     run.add_argument("--block-size", type=int, default=16)
     run.add_argument("--num-blocks", type=int, default=512)
+    run.add_argument("--num-host-blocks", type=int, default=0,
+                     help="host-RAM KV offload tier (0 = disabled): "
+                     "evicted device blocks park in host memory and "
+                     "restore on prefix re-arrival")
     run.add_argument("--max-tokens", type=int, default=128)
     run.add_argument("--host", default="127.0.0.1")
     run.add_argument("--http-port", type=int, default=8080)

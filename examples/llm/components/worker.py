@@ -8,6 +8,7 @@ prefill_worker.py; here the engine is the native JAX EngineCore.  Config
                                  serve-level tests; tpu needs model-path)
   model-path: HF dir or .gguf   quantize: none | int8
   max-batch-size / max-model-len / block-size / num-blocks
+  num-host-blocks               (host-RAM KV offload tier; 0 = off)
   tp / dp                       (sharded engine over a device mesh)
   remote-prefill: true          (disagg decode side: conditional remote
                                  prefill via the coordinator queue)
@@ -72,6 +73,7 @@ def build_engine(cfg: dict):
             max_model_len=int(cfg.get("max-model-len", 256)),
             block_size=int(cfg.get("block-size", 16)),
             num_blocks=int(cfg.get("num-blocks", 64)),
+            num_host_blocks=int(cfg.get("num-host-blocks", 0)),
         )
         return AsyncLLMEngine(EngineCore(model, params, ecfg)).start(), None
     # full path: reuse the CLI's builder (loading, quantize, mesh, multihost)
@@ -86,6 +88,7 @@ def build_engine(cfg: dict):
         max_model_len=int(cfg.get("max-model-len", 4096)),
         block_size=int(cfg.get("block-size", 16)),
         num_blocks=int(cfg.get("num-blocks", 512)),
+        num_host_blocks=int(cfg.get("num-host-blocks", 0)),
         quantize=cfg.get("quantize", "none"),
         tp=int(cfg.get("tp", 1)),
         dp=int(cfg.get("dp", 1)),

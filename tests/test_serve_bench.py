@@ -56,3 +56,40 @@ def test_bench_py_cpu_smoke():
     assert rec["platform"] == "cpu"
     assert rec["ttft_p50_ms"] is None or rec["ttft_p50_ms"] > 0
     assert "kernels" in rec and "prefill_tok_s" in rec
+
+
+def test_bench_router_smoke():
+    """KV-routing A/B harness boots the real graph with 2 replicas and
+    emits its comparison JSON (tiny workload; the ratio itself is
+    hardware-dependent and not asserted)."""
+    repo = Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [sys.executable, "benchmarks/bench_router.py", "--users", "2",
+         "--turns", "2", "--prefix-tokens", "96", "--turn-tokens", "32",
+         "--workers", "2"],
+        capture_output=True, text=True, timeout=420, cwd=str(repo),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [json.loads(l) for l in out.stdout.splitlines() if l.strip()]
+    assert lines[-1]["metric"] == "kv_router_ttft_speedup"
+    assert {l["mode"] for l in lines[:-1]} == {"random", "kv"}
+    assert all(l["ttft_mean_ms"] > 0 for l in lines[:-1])
+
+
+def test_bench_offload_smoke():
+    """Host-offload A/B harness runs and actually exercises the host
+    tier (blocks stored AND restored) on a tiny eviction-pressure
+    workload."""
+    repo = Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [sys.executable, "benchmarks/bench_offload.py", "--users", "4",
+         "--turns", "3", "--prefix-tokens", "96", "--turn-tokens", "32"],
+        capture_output=True, text=True, timeout=420, cwd=str(repo),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [json.loads(l) for l in out.stdout.splitlines() if l.strip()]
+    assert lines[-1]["metric"] == "kv_offload_ttft_speedup"
+    by_mode = {l["mode"]: l for l in lines[:-1]}
+    assert by_mode["host_offload"]["host_blocks_stored"] > 0
+    assert by_mode["host_offload"]["host_blocks_restored"] > 0
+    assert by_mode["device_only"]["host_blocks_restored"] == 0
