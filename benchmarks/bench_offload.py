@@ -107,7 +107,9 @@ def _run_mode(offload: bool, args) -> dict:
     # block-count bucket — one-off costs a long-running server never
     # sees again).  Steady state = turn 3 on, the same slice both modes.
     warm = [t for turn in ttfts_by_turn[2:] for t in turn]
+    core.flush_host_offload()  # queued stores land before stats are read
     stats = core.metrics()
+    core.close()
     return {
         "mode": "host_offload" if offload else "device_only",
         "ttft_p50_ms": round(_percentile(warm, 50), 1),

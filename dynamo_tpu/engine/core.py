@@ -254,6 +254,19 @@ class EngineCore:
                 self.block_manager.offload_sink = (
                     lambda bid, seq_hash, parent: self._pending_offload.append((bid, seq_hash))
                 )
+                # async store: the engine thread only dispatches the
+                # on-device gather (ordered before any overwrite of the
+                # evicted ids); the device→host readback + memcpy runs on
+                # this thread — the CUDA-copy-stream analogue, so a
+                # request never pays another conversation's offload in
+                # its own TTFT.  Bounded queue = HBM backpressure: a full
+                # queue falls back to a synchronous store.
+                self._offload_lock = threading.Lock()
+                self._offload_q: queue.Queue = queue.Queue(maxsize=4)
+                self._offload_thread = threading.Thread(
+                    target=self._offload_worker, name="kv-offload", daemon=True
+                )
+                self._offload_thread.start()
 
         cache = model.init_kv_cache(config.num_blocks, config.block_size, cache_dtype)
         self._cache_specs = None
@@ -1619,24 +1632,76 @@ class EngineCore:
 
     # ------------------------------------------------------ host offload tier
     def _drain_offload(self) -> None:
-        """Offload just-evicted device blocks to the host pool in one
-        batched HBM→host gather (the CopyStream analogue, kv/layer.rs:619).
-        Must run before anything overwrites the evicted block ids."""
+        """Offload just-evicted device blocks to the host pool.
+
+        The on-device gather MUST dispatch before anything overwrites the
+        evicted block ids (single device stream: dispatch order is
+        execution order, so the snapshot wins the race by construction).
+        The expensive half — device→host readback + host memcpy — runs on
+        the kv-offload thread (the CopyStream analogue, kv/layer.rs:619),
+        so a request's TTFT never includes another conversation's store.
+        """
         if self.host_pool is None or not self._pending_offload:
             return
         pending, self._pending_offload = self._pending_offload, []
-        # re-evictions of host-resident content only need an LRU refresh —
-        # skip the HBM gather for them
-        self.host_pool.touch([h for _, h in pending if h in self.host_pool])
-        fresh = [(b, h) for b, h in pending if h not in self.host_pool]
+        with self._offload_lock:
+            # re-evictions of host-resident content only need an LRU
+            # refresh — skip the HBM gather for them
+            self.host_pool.touch(
+                [h for _, h in pending if h in self.host_pool])
+            fresh = [(b, h) for b, h in pending if h not in self.host_pool]
         if not fresh:
             return
         bids = [b for b, _ in fresh]
         hashes = [h for _, h in fresh]
-        arr = self.gather_blocks_np(bids)        # [L, n, 2, Bs, HkD] (pytree)
-        self.host_pool.store(
-            hashes, jax.tree.map(lambda a: np.moveaxis(a, 1, 0), arr)
-        )
+        arr = self.gather_blocks_device(bids)    # on-device snapshot
+        try:
+            self._offload_q.put_nowait((hashes, arr))
+        except queue.Full:
+            # backpressure: the staging arrays pin HBM — store this batch
+            # synchronously rather than let the queue grow unbounded
+            self._store_offload_batch(hashes, arr)
+
+    def _store_offload_batch(self, hashes: list[int], arr) -> None:
+        """Readback a gathered [L,n,2,Bs,HkD] snapshot and store it
+        host-side (runs on the kv-offload thread, or inline under
+        backpressure / flush).  ``store`` itself skips hashes another
+        in-flight batch already landed (LRU-refresh only)."""
+        np_arr = jax.tree.map(lambda a: np.asarray(jax.device_get(a)), arr)
+        with self._offload_lock:
+            self.host_pool.store(
+                hashes, jax.tree.map(lambda a: np.moveaxis(a, 1, 0), np_arr)
+            )
+
+    def _offload_worker(self) -> None:
+        while True:
+            item = self._offload_q.get()
+            try:
+                if item is None:
+                    return
+                self._store_offload_batch(*item)
+            except Exception:  # pragma: no cover - keep the tier alive
+                log.exception("async KV offload store failed")
+            finally:
+                self._offload_q.task_done()
+
+    def flush_host_offload(self) -> None:
+        """Block until every queued offload store has landed (tests and
+        benches that assert on host-pool contents)."""
+        if self.host_pool is None:
+            return
+        self._drain_offload()
+        self._offload_q.join()
+
+    def close(self) -> None:
+        """Stop the kv-offload thread (idempotent).  Without this an
+        abandoned engine's daemon thread would pin the whole instance —
+        params, cache, host pool — for process lifetime."""
+        t = getattr(self, "_offload_thread", None)
+        if t is not None and t.is_alive():
+            self._offload_q.put(None)
+            t.join(timeout=30.0)
+        self._offload_thread = None
 
     def _restore_from_host(self, req: EngineRequest) -> None:
         """Upload host-resident prefix blocks into the request's fresh
@@ -1646,12 +1711,16 @@ class EngineCore:
         bs = self.config.block_size
         dev = req.cached_tokens // bs
         max_blocks = (req.prompt_len - 1) // bs  # >=1 token must remain
-        hit = self.host_pool.match_prefix(
-            [b.sequence_hash for b in req.seq.blocks[dev:max_blocks]]
-        )
-        if not hit:
-            return
-        blocks = self.host_pool.gather(hit)      # [n, L, 2, Bs, HkD] (pytree)
+        with self._offload_lock:
+            # the kv-offload thread stores/evicts concurrently; a block
+            # still in flight to the pool just misses here (re-prefilled
+            # — correct, merely slower)
+            hit = self.host_pool.match_prefix(
+                [b.sequence_hash for b in req.seq.blocks[dev:max_blocks]]
+            )
+            if not hit:
+                return
+            blocks = self.host_pool.gather(hit)  # [n, L, 2, Bs, HkD] (pytree)
         target = req.block_ids[dev : dev + len(hit)]
         self.scatter_external(
             target, jax.tree.map(lambda a: np.moveaxis(a, 0, 1), blocks)

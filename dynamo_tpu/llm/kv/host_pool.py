@@ -11,8 +11,10 @@ row) moved with the native threaded memcpy (native/src/block_copy.cpp),
 plus the hash→block bookkeeping: LRU eviction, chained-sequence-hash
 prefix matching, content-addressed dedupe.
 
-Single-writer: called only from the engine loop (same discipline as
-KvBlockManager).
+Concurrency: NOT internally synchronized.  The engine's kv-offload
+thread calls ``store`` while the engine loop calls
+``match_prefix``/``gather``/``touch`` — every call site must hold
+``EngineCore._offload_lock``.
 """
 
 from __future__ import annotations

@@ -79,6 +79,7 @@ def test_evicted_prefix_restored_from_host(setup):  # noqa: F811
     for i in range(4):
         other = list(rng.randint(1, 128, size=24))
         collect_greedy(core, other, 2, request_id=f"churn{i}")
+    core.flush_host_offload()  # stores land on the kv-offload thread
     assert core.host_pool.stored_blocks > 0, "eviction should have offloaded"
 
     # replay: the prefix must be restored from host, and decode identically

@@ -364,6 +364,7 @@ def test_host_offload_with_int8_cache():
     got1, _ = _collect(core, prompt, 6, "a")
     for i in range(4):  # churn to force eviction
         _collect(core, list(rng.randint(1, 128, size=24)), 2, f"c{i}")
+    core.flush_host_offload()  # stores land on the kv-offload thread
     assert core.host_pool.stored_blocks > 0
     got2, req2 = _collect(core, prompt, 6, "b")
     assert req2.cached_tokens > 0
