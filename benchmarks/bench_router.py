@@ -112,7 +112,10 @@ async def _run_mode(mode: str, args) -> dict:
             "num-blocks": blocks_needed + 32,
         },
     })
-    rcfg = RuntimeConfig(coordinator_url=srv.url)
+    # long lease TTL: XLA bucket compiles can stall this 1-core process
+    # past the 10s default, expiring workers mid-measurement (expiry now
+    # self-heals, but a vanish/reappear mid-turn would still skew TTFTs)
+    rcfg = RuntimeConfig(coordinator_url=srv.url, lease_ttl_s=120.0)
     handle = await serve_graph(entry, config=cfg, runtime_config=rcfg,
                                graph=graph_mod)
     extra_rts = []

@@ -1,0 +1,40 @@
+#!/bin/bash
+# Hardware-window playbook (docs/perf_analysis_r4.md): run the on-chip
+# measurements in priority order the moment the tunnel is live.  Each
+# step logs to benchmarks/logs/ and a step's failure doesn't stop the
+# next.  Usage:  bash benchmarks/hw_window.sh [outdir]
+set -u
+OUT=${1:-benchmarks/logs}
+cd "$(dirname "$0")/.."
+mkdir -p "$OUT"
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 to=$2; shift 2
+  echo "=== $name ($(date +%H:%M:%S)) ==="
+  timeout "$to" "$@" > "$OUT/$name.log" 2>&1
+  echo "    rc=$? -> $OUT/$name.log"
+}
+
+# 0. is the backend even up? (2 min probe, else bail fast)
+run probe_backend 120 python -c "import jax; print(jax.devices())" || true
+grep -qi "tpu" "$OUT/probe_backend.log" || { echo "backend down"; exit 1; }
+
+# 1. every kernel variant compiles+runs at 8B serving geometry
+run probe_kernels 900 python benchmarks/probe_kernels.py all 8b
+
+# 2. the scored number (8B int8, pallas kernels, TTFT phases included)
+run bench 3600 python bench.py
+
+# 3. decode roofline breakdown -> adjudicate perf hypotheses
+run profile_decode 1800 python benchmarks/profile_decode.py 8b
+
+# 4. int8 matmul A/B: dequant-in-kernel vs XLA path
+run bench_int8mm 3600 env DYNAMO_PALLAS_INT8_MATMUL=1 python bench.py
+
+# 5. spec-decode ITL A/B on a repetitive workload
+run bench_spec 1800 python benchmarks/bench_spec.py
+
+# 6. disagg handoff: device path vs host-staged TCP, on chip
+run bench_handoff 1800 python benchmarks/bench_handoff.py
+
+echo "window done: $(date +%H:%M:%S)"

@@ -262,6 +262,7 @@ class EngineCore:
                 # its own TTFT.  Bounded queue = HBM backpressure: a full
                 # queue falls back to a synchronous store.
                 self._offload_lock = threading.Lock()
+                self._offload_closed = False
                 self._offload_q: queue.Queue = queue.Queue(maxsize=4)
                 self._offload_thread = threading.Thread(
                     target=self._offload_worker, name="kv-offload", daemon=True
@@ -1655,23 +1656,45 @@ class EngineCore:
         bids = [b for b, _ in fresh]
         hashes = [h for _, h in fresh]
         arr = self.gather_blocks_device(bids)    # on-device snapshot
-        try:
-            self._offload_q.put_nowait((hashes, arr))
-        except queue.Full:
-            # backpressure: the staging arrays pin HBM — store this batch
-            # synchronously rather than let the queue grow unbounded
+        queued = False
+        with self._offload_lock:
+            # flag check + enqueue are atomic with close()'s flag set, so
+            # a batch can never land behind the shutdown sentinel (where
+            # it would be silently dropped and hang a later flush)
+            if not self._offload_closed:
+                try:
+                    self._offload_q.put_nowait((hashes, arr))
+                    queued = True
+                except queue.Full:
+                    pass  # backpressure: the staging arrays pin HBM
+        if not queued:
+            # closed or full — store synchronously so no batch is lost
             self._store_offload_batch(hashes, arr)
 
     def _store_offload_batch(self, hashes: list[int], arr) -> None:
         """Readback a gathered [L,n,2,Bs,HkD] snapshot and store it
         host-side (runs on the kv-offload thread, or inline under
-        backpressure / flush).  ``store`` itself skips hashes another
-        in-flight batch already landed (LRU-refresh only)."""
+        backpressure / flush).
+
+        Three-phase store: reserve (lock), write (NO lock — the bulk
+        memcpy must not stall the engine thread's drain/restore behind
+        this thread), publish (lock).  ``reserve`` skips hashes another
+        in-flight batch already landed (LRU-refresh only), and
+        ``publish`` frees rows that lost a store race."""
         np_arr = jax.tree.map(lambda a: np.asarray(jax.device_get(a)), arr)
+        blocks = jax.tree.map(lambda a: np.moveaxis(a, 1, 0), np_arr)
         with self._offload_lock:
-            self.host_pool.store(
-                hashes, jax.tree.map(lambda a: np.moveaxis(a, 1, 0), np_arr)
-            )
+            hids, rows = self.host_pool.reserve(hashes, blocks)
+        if not hids:
+            return
+        try:
+            self.host_pool.write_rows(hids, blocks, rows)
+        except BaseException:
+            with self._offload_lock:
+                self.host_pool.abort(hids)  # don't leak reserved capacity
+            raise
+        with self._offload_lock:
+            self.host_pool.publish(hids, [hashes[r] for r in rows])
 
     def _offload_worker(self) -> None:
         while True:
@@ -1699,6 +1722,13 @@ class EngineCore:
         params, cache, host pool — for process lifetime."""
         t = getattr(self, "_offload_thread", None)
         if t is not None and t.is_alive():
+            # flag first (under the lock _drain_offload enqueues under):
+            # after this, drains store inline — nothing can land behind
+            # the sentinel.  The sentinel put happens OUTSIDE the lock:
+            # it may block on a full queue until the worker drains, and
+            # the worker needs the lock for its store phases.
+            with self._offload_lock:
+                self._offload_closed = True
             self._offload_q.put(None)
             t.join(timeout=30.0)
         self._offload_thread = None

@@ -101,12 +101,14 @@ class HostKvPool:
             self.evicted_blocks += 1
         return hid
 
-    def store(self, seq_hashes: Sequence[int], blocks) -> int:
-        """Offload blocks (block-major: blocks[i] belongs to seq_hashes[i];
-        a tuple of block-major arrays for the quantized cache).
+    def reserve(self, seq_hashes: Sequence[int], blocks) -> tuple[list[int], list[int]]:
+        """Store phase 1 (hold the caller's lock): LRU-refresh resident
+        hashes, allocate pool rows for the fresh ones.
 
-        Already-resident hashes are refreshed in LRU order but not
-        re-copied.  Returns how many new blocks were written.
+        Reserved rows sit in neither ``_table`` nor ``_lru``, so readers
+        cannot observe them and eviction cannot reclaim them until
+        :meth:`publish`.  Returns ``(hids, rows)``: the pool row for each
+        fresh hash and its index into ``seq_hashes``/``blocks``.
         """
         import jax
 
@@ -116,25 +118,75 @@ class HostKvPool:
                 f"{len(seq_hashes)} hashes vs {[len(p) for p in parts]} blocks"
             )
         self._ensure_arrs(parts, treedef)
-        new_ids: list[int] = []
-        new_rows: list[int] = []
+        hids: list[int] = []
+        rows: list[int] = []
+        seen: set[int] = set()  # intra-batch dedupe (one row per hash)
+        # reserved rows leave the free list AND the LRU, so a batch can
+        # claim at most free+evictable rows — capping here (instead of
+        # letting _alloc raise on an empty LRU) keeps the pool sane when
+        # one eviction batch exceeds capacity.  Prefix matching walks
+        # from the sequence start, so the EARLIEST blocks are the useful
+        # ones to keep when something must be dropped.
+        cap = len(self._free) + len(self._lru)
         for i, h in enumerate(seq_hashes):
             hid = self._table.get(h)
             if hid is not None:
                 self._lru.move_to_end(hid)
                 continue
-            hid = self._alloc()
+            if h in seen:
+                continue
+            if len(hids) >= cap:
+                break
+            seen.add(h)
+            hids.append(self._alloc())
+            rows.append(i)
+        return hids, rows
+
+    def abort(self, hids: list[int]) -> None:
+        """Return reserved-but-unpublished rows to the free list (the
+        write failed); without this a failed store leaks capacity."""
+        self._free.extend(hids)
+
+    def write_rows(self, hids: list[int], blocks, rows: list[int]) -> None:
+        """Store phase 2 (NO lock needed — the rows are reserved, hence
+        invisible and un-evictable): bulk memcpy into the pool.  This is
+        the expensive part; keeping it outside the lock means a store
+        never stalls the engine thread's drain/restore."""
+        import jax
+
+        parts, _ = jax.tree.flatten(blocks)
+        for arr, p in zip(self._arrs, parts):
+            # fancy indexing already yields a fresh contiguous array
+            native.blocks_scatter(arr, hids, p[rows])
+
+    def publish(self, hids: list[int], seq_hashes: list[int]) -> int:
+        """Store phase 3 (hold the lock): make written rows visible.  A
+        hash a concurrent store landed first frees its row instead."""
+        n = 0
+        for hid, h in zip(hids, seq_hashes):
+            if h in self._table:
+                self._free.append(hid)
+                continue
             self._table[h] = hid
             self._hash_of[hid] = h
             self._lru[hid] = None
-            new_ids.append(hid)
-            new_rows.append(i)
-        if new_ids:
-            for arr, p in zip(self._arrs, parts):
-                # fancy indexing already yields a fresh contiguous array
-                native.blocks_scatter(arr, new_ids, p[new_rows])
-            self.stored_blocks += len(new_ids)
-        return len(new_ids)
+            n += 1
+        self.stored_blocks += n
+        return n
+
+    def store(self, seq_hashes: Sequence[int], blocks) -> int:
+        """Offload blocks (block-major: blocks[i] belongs to seq_hashes[i];
+        a tuple of block-major arrays for the quantized cache).
+
+        Already-resident hashes are refreshed in LRU order but not
+        re-copied.  Returns how many new blocks were written.  This is
+        the single-caller convenience form of reserve/write_rows/publish.
+        """
+        hids, rows = self.reserve(seq_hashes, blocks)
+        if not hids:
+            return 0
+        self.write_rows(hids, blocks, rows)
+        return self.publish(hids, [seq_hashes[r] for r in rows])
 
     def touch(self, seq_hashes: Sequence[int]) -> None:
         """Refresh LRU order for resident hashes (no copy)."""

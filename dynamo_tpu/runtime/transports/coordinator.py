@@ -953,15 +953,38 @@ class CoordinatorClient:
         while True:
             try:
                 await asyncio.sleep(ttl / 2)
-                await self._call({
+                resp, _ = await self._call({
                     "op": "lease_keepalive",
                     "lease_id": self._lease_srv.get(handle, handle),
                 })
+                if not resp.get("ok") and handle in self._lease_reg \
+                        and not self._closing:
+                    # expired while CONNECTED (e.g. the event loop stalled
+                    # past the TTL behind a long compile): the server
+                    # already dropped the lease and deleted its keys.  The
+                    # process is alive, so heal exactly like a reconnect
+                    # does — fresh lease, re-put this lease's keys (the
+                    # discovery watchers see delete→put and re-add us).
+                    await self._heal_expired_lease(handle, ttl)
             except asyncio.CancelledError:
                 return
             except (ConnectionError, RuntimeError, OSError):
                 if not self.reconnect or self._closing:
                     return  # without reconnect, a lost lease stays lost
+
+    async def _heal_expired_lease(self, handle: int, ttl: float) -> None:
+        resp, _ = await self._call({"op": "lease_create", "ttl": ttl})
+        self._lease_srv[handle] = resp["lease_id"]
+        log.warning(
+            "lease %x expired while connected; healed as %x and re-putting keys",
+            handle, resp["lease_id"],
+        )
+        for key, (value, lh) in list(self._leased_kv.items()):
+            if lh == handle:
+                await self._call({
+                    "op": "kv_put", "key": key, "value": value,
+                    "lease_id": resp["lease_id"],
+                })
 
     async def lease_revoke(self, lease_id: int) -> None:
         t = self._keepalive_tasks.pop(lease_id, None)

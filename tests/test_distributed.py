@@ -283,3 +283,34 @@ def test_direct_dial_discovery_grace():
             await srv.stop()
 
     run(go())
+
+
+def test_lease_expiry_while_connected_heals():
+    """A lease that expires while the connection is healthy (event loop
+    stalled past TTL behind a long compile) is re-created on the next
+    keepalive tick and its keys re-put, so a live worker re-appears in
+    discovery instead of staying vanished forever."""
+    async def go():
+        srv = await _coordinator()
+        try:
+            c = await CoordinatorClient(srv.url, reconnect=True).connect()
+            lease = await c.lease_create(0.6)
+            assert await c.kv_create("heal/worker", {"v": 1}, lease_id=lease)
+
+            # simulate server-side TTL expiry: drop the lease (deletes keys)
+            srv._revoke_lease(c._lease_srv.get(lease, lease))
+            reader = await CoordinatorClient(srv.url).connect()
+            assert await reader.kv_get("heal/worker") is None
+
+            # next keepalive tick notices ok=False and heals
+            for _ in range(40):
+                await asyncio.sleep(0.1)
+                if await reader.kv_get("heal/worker") == {"v": 1}:
+                    break
+            assert await reader.kv_get("heal/worker") == {"v": 1}
+            await reader.close()
+            await c.close()
+        finally:
+            await srv.stop()
+
+    run(go())

@@ -101,3 +101,47 @@ def test_offload_disabled_by_default(setup):  # noqa: F811
     prompt = list(np.random.RandomState(3).randint(1, 128, size=16))
     collect_greedy(core, prompt, 4)
     assert "host_blocks_resident" not in core.metrics()
+
+
+def test_pool_overflow_batch_keeps_prefix_and_pool_sane():
+    """One store batch larger than the whole pool keeps the EARLIEST
+    blocks (prefix matching walks from the sequence start) and leaves
+    the pool fully functional — reserving must never brick capacity."""
+    pool = HostKvPool(4)
+    hashes = list(range(100, 106))
+    stored = pool.store(hashes, _blocks(6))
+    assert stored == 4
+    assert pool.match_prefix(hashes) == hashes[:4]
+    # pool still works: store more (evicts LRU), then restore
+    assert pool.store([200], _blocks(1)) == 1
+    assert pool.gather([200]) is not None
+
+
+def test_pool_duplicate_hashes_one_row():
+    pool = HostKvPool(8)
+    assert pool.store([5, 5, 5], _blocks(3)) == 1
+    assert pool.resident == 1
+
+
+def test_pool_abort_returns_capacity():
+    pool = HostKvPool(2)
+    hids, rows = pool.reserve([1, 2], _blocks(2))
+    assert len(hids) == 2
+    pool.abort(hids)
+    assert pool.store([3, 4], _blocks(2)) == 2  # capacity intact
+
+
+def test_engine_close_stops_offload_thread(setup):  # noqa: F811
+    hf, model, params = setup
+    core = _offload_core(model, params)
+    t = core._offload_thread
+    assert t.is_alive()
+    core.close()
+    assert not t.is_alive()
+    core.close()  # idempotent
+    # post-close evictions store inline, nothing hangs
+    rng = np.random.RandomState(3)
+    for i in range(4):
+        collect_greedy(core, list(rng.randint(1, 128, size=24)), 2,
+                       request_id=f"post{i}")
+    core.flush_host_offload()
