@@ -31,7 +31,7 @@ from benchmarks.profile_decode import MODELS  # shared model geometries
 
 
 def run_arm(model, params, cfg, spec_tokens: int, batch: int, steps: int,
-            temp: float, seed: int = 0):
+            temp: float, seed: int = 0, draft=None):
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.core import EngineCore
     from dynamo_tpu.engine.request import EngineRequest
@@ -47,7 +47,7 @@ def run_arm(model, params, cfg, spec_tokens: int, batch: int, steps: int,
         spec_tokens=spec_tokens,
         enable_prefix_reuse=False,
     )
-    engine = EngineCore(model, params, ecfg, eos_token_ids=[])
+    engine = EngineCore(model, params, ecfg, eos_token_ids=[], draft=draft)
     rng = np.random.default_rng(3)
     done = [0]
 
@@ -90,7 +90,8 @@ def run_arm(model, params, cfg, spec_tokens: int, batch: int, steps: int,
     dsteps = max(engine.decode_steps - d0, 1)
     accepted = engine.spec_accepted - a0
     return {
-        "arm": f"spec{spec_tokens}" if spec_tokens else "off",
+        "arm": (f"draft{spec_tokens}" if draft is not None
+                else f"spec{spec_tokens}" if spec_tokens else "off"),
         "tok_s": round(toks / dt, 1),
         "itl_ms": round(dt / dsteps * 1000, 2),
         "toks_per_dispatch": round(toks / dsteps, 2),
@@ -129,6 +130,19 @@ def main() -> None:
           file=sys.stderr)
     for spec in (0, k):
         out = run_arm(model, params, cfg, spec, batch, steps, temp)
+        print(json.dumps(out))
+    # draft == target, forced greedy: every proposal is the target's own
+    # argmax, so acceptance is total by construction and the arm
+    # measures the speculation MACHINERY's amortization ceiling — k+1
+    # tokens for one draft chain + one verify dispatch — independent of
+    # whether random weights happen to repeat.  (At temp>0 the greedy
+    # proposals would face rejection sampling and stop measuring that
+    # ceiling, so the arm pins temp=0.)  Gated to CPU/tiny: on-chip at
+    # 8B a same-size draft doubles KV HBM and burns hardware-window
+    # minutes for a number the small-draft deployment wouldn't match.
+    if k > 0 and not (on_accel and name == "8b"):
+        out = run_arm(model, params, cfg, k, batch, steps, temp=0.0,
+                      draft=(model, params))
         print(json.dumps(out))
 
 
