@@ -139,8 +139,10 @@ def main() -> None:
     # proposals would face rejection sampling and stop measuring that
     # ceiling, so the arm pins temp=0.)  Gated to CPU/tiny: on-chip at
     # 8B a same-size draft doubles KV HBM and burns hardware-window
-    # minutes for a number the small-draft deployment wouldn't match.
-    if k > 0 and not (on_accel and name == "8b"):
+    # minutes for a number the small-draft deployment wouldn't match
+    # (any on-accel model size: the arm is a machinery proof, not a
+    # serving configuration).
+    if k > 0 and not on_accel:
         out = run_arm(model, params, cfg, k, batch, steps, temp=0.0,
                       draft=(model, params))
         print(json.dumps(out))

@@ -708,6 +708,7 @@ class CoordinatorClient:
         self._lease_reg: dict[int, float] = {}        # handle -> ttl
         self._leased_kv: dict[str, tuple[Any, int]] = {}  # key -> (value, lease handle)
         self._reconnect_task: Optional[asyncio.Task] = None
+        self._heal_lock = asyncio.Lock()  # serializes expired-lease heals
         self._reconnecting = False
         self._connected = asyncio.Event()  # socket open (internal sends ok)
         self._ready = asyncio.Event()      # re-registration done (user sends ok)
@@ -877,17 +878,34 @@ class CoordinatorClient:
         return resp, pl
 
     # ----------------------------------------------------------------- KV API
+    async def _lease_call(self, header: dict, lease_handle: Optional[int]):
+        """``_call`` with the lease handle resolved to its live server id,
+        healing an expired-but-keepalive'd lease ONCE on 'no such lease'.
+
+        The keepalive loop heals expiries on its half-TTL tick; a leased
+        write landing INSIDE that window (expiry → next tick) would
+        otherwise fail hard for a process that is demonstrably alive."""
+        try:
+            return await self._call(dict(
+                header, lease_id=self._lease_srv.get(lease_handle, lease_handle)))
+        except RuntimeError as e:
+            if "no such lease" not in str(e) or self._closing \
+                    or lease_handle not in self._lease_reg:
+                raise
+            await self._heal_expired_lease(
+                lease_handle, self._lease_reg[lease_handle])
+            return await self._call(dict(
+                header, lease_id=self._lease_srv.get(lease_handle, lease_handle)))
+
     async def kv_put(self, key: str, value: Any, lease_id: Optional[int] = None) -> None:
-        await self._call({"op": "kv_put", "key": key, "value": value,
-                          "lease_id": self._lease_srv.get(lease_id, lease_id)})
+        await self._lease_call(
+            {"op": "kv_put", "key": key, "value": value}, lease_id)
         if lease_id and self.reconnect:
             self._leased_kv[key] = (value, lease_id)
 
     async def kv_create(self, key: str, value: Any, lease_id: Optional[int] = None) -> bool:
-        resp, _ = await self._call(
-            {"op": "kv_create", "key": key, "value": value,
-             "lease_id": self._lease_srv.get(lease_id, lease_id)}
-        )
+        resp, _ = await self._lease_call(
+            {"op": "kv_create", "key": key, "value": value}, lease_id)
         ok = bool(resp.get("ok"))
         if ok and lease_id and self.reconnect:
             self._leased_kv[key] = (value, lease_id)
@@ -973,18 +991,29 @@ class CoordinatorClient:
                     return  # without reconnect, a lost lease stays lost
 
     async def _heal_expired_lease(self, handle: int, ttl: float) -> None:
-        resp, _ = await self._call({"op": "lease_create", "ttl": ttl})
-        self._lease_srv[handle] = resp["lease_id"]
-        log.warning(
-            "lease %x expired while connected; healed as %x and re-putting keys",
-            handle, resp["lease_id"],
-        )
-        for key, (value, lh) in list(self._leased_kv.items()):
-            if lh == handle:
-                await self._call({
-                    "op": "kv_put", "key": key, "value": value,
-                    "lease_id": resp["lease_id"],
-                })
+        # serialize heals: the keepalive tick and any number of inline
+        # _lease_call heals can race — interleaved lease_create/re-put
+        # would strand keys on an orphaned (un-keepalive'd) lease
+        async with self._heal_lock:
+            probe, _ = await self._call({
+                "op": "lease_keepalive",
+                "lease_id": self._lease_srv.get(handle, handle),
+            })
+            if probe.get("ok"):
+                return  # another heal won while we waited on the lock
+            resp, _ = await self._call({"op": "lease_create", "ttl": ttl})
+            live = resp["lease_id"]
+            self._lease_srv[handle] = live
+            log.warning(
+                "lease %x expired while connected; healed as %x and re-putting keys",
+                handle, live,
+            )
+            for key, (value, lh) in list(self._leased_kv.items()):
+                if lh == handle:
+                    await self._call({
+                        "op": "kv_put", "key": key, "value": value,
+                        "lease_id": live,
+                    })
 
     async def lease_revoke(self, lease_id: int) -> None:
         t = self._keepalive_tasks.pop(lease_id, None)

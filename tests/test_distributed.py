@@ -314,3 +314,28 @@ def test_lease_expiry_while_connected_heals():
             await srv.stop()
 
     run(go())
+
+
+def test_leased_write_inside_expiry_window_heals():
+    """A kv_create landing between a server-side lease expiry and the
+    next keepalive tick heals the lease inline and succeeds, instead of
+    raising 'no such lease' for a live process."""
+    async def go():
+        srv = await _coordinator()
+        try:
+            c = await CoordinatorClient(srv.url, reconnect=True).connect()
+            lease = await c.lease_create(30.0)  # tick far away: forces the
+            # inline heal path, not the keepalive-tick heal
+            assert await c.kv_create("w/a", {"v": 1}, lease_id=lease)
+            srv._revoke_lease(c._lease_srv.get(lease, lease))  # = expiry
+            assert await c.kv_create("w/b", {"v": 2}, lease_id=lease)
+            reader = await CoordinatorClient(srv.url).connect()
+            # healing re-put the old key and the new create landed
+            assert await reader.kv_get("w/a") == {"v": 1}
+            assert await reader.kv_get("w/b") == {"v": 2}
+            await reader.close()
+            await c.close()
+        finally:
+            await srv.stop()
+
+    run(go())
