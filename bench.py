@@ -376,6 +376,12 @@ def _northstar_ttft(model, params, kv_quant: str, block_size: int,
         max_batch_size=batch, max_model_len=max_len, block_size=block_size,
         num_blocks=batch * (max_len // block_size) + 64,
         decode_steps=8,
+        # while a prefill is pending, background bursts cap at TWO steps:
+        # each of the fresh prompt's ~3 chunks waits out one burst, so
+        # burst length lands almost 1:1 in busy TTFT — and the cost is
+        # only background-batch throughput, which this phase doesn't score
+        interactive_decode_steps=int(
+            os.environ.get("DYNAMO_BENCH_TTFT_INTERACTIVE", "2")),
         prefill_chunk_tokens=min(chunk, max_len),
         enable_prefix_reuse=False,
         cache_dtype="int8" if kv_quant == "int8" else None,

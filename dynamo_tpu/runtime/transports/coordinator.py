@@ -890,7 +890,10 @@ class CoordinatorClient:
                 header, lease_id=self._lease_srv.get(lease_handle, lease_handle)))
         except RuntimeError as e:
             if "no such lease" not in str(e) or self._closing \
-                    or lease_handle not in self._lease_reg:
+                    or lease_handle not in self._lease_reg \
+                    or lease_handle not in self._keepalive_tasks:
+                # only keepalive'd leases heal — expiry of an
+                # auto_keepalive=False lease is a deliberate signal
                 raise
             await self._heal_expired_lease(
                 lease_handle, self._lease_reg[lease_handle])
@@ -1003,7 +1006,6 @@ class CoordinatorClient:
                 return  # another heal won while we waited on the lock
             resp, _ = await self._call({"op": "lease_create", "ttl": ttl})
             live = resp["lease_id"]
-            self._lease_srv[handle] = live
             log.warning(
                 "lease %x expired while connected; healed as %x and re-putting keys",
                 handle, live,
@@ -1014,6 +1016,12 @@ class CoordinatorClient:
                         "op": "kv_put", "key": key, "value": value,
                         "lease_id": live,
                     })
+            # publish the mapping only AFTER the re-puts: a concurrent
+            # writer meanwhile resolves the dead id, fails, and queues
+            # behind the heal lock — its retry then lands strictly after
+            # these re-puts, so a fresh value can never be reverted by
+            # the heal's snapshot
+            self._lease_srv[handle] = live
 
     async def lease_revoke(self, lease_id: int) -> None:
         t = self._keepalive_tasks.pop(lease_id, None)
