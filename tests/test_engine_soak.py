@@ -35,13 +35,18 @@ def _soak_grammar(vocab_size):
     return toks, JsonGrammar.from_token_bytes(toks, eos_ids=[EOS])
 
 
-@pytest.mark.parametrize("seed,cache_dtype,draft", [
-    (0, None, False), (7, None, False), (3, "int8", False),
+@pytest.mark.parametrize("seed,cache_dtype,draft,host", [
+    (0, None, False, False), (7, None, False, False),
+    (3, "int8", False, False),
     # draft-model speculation churning against grammar rows, aborts,
     # chunked prefill and the tight block pool (draft pool even tighter)
-    (11, None, True),
+    (11, None, True, False),
+    # host-offload tier ON: the tight device pool evicts constantly, so
+    # the async kv-offload thread's reserve/write/publish races against
+    # the engine thread's drain/restore the whole run — bf16 and int8
+    (5, None, False, True), (13, "int8", False, True),
 ])
-def test_engine_soak_invariants(seed, cache_dtype, draft):
+def test_engine_soak_invariants(seed, cache_dtype, draft, host):
     cfg = ModelConfig.tiny()
     model = LlamaModel(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
@@ -56,6 +61,8 @@ def test_engine_soak_invariants(seed, cache_dtype, draft):
         cache_dtype=cache_dtype,
         spec_tokens=3 if draft else 0,
         draft_num_blocks=24 if draft else 0,  # tighter than the target's
+        # host pool smaller than the eviction traffic: its own LRU churns
+        num_host_blocks=32 if host else 0,
     )
     vocab_toks, grammar = _soak_grammar(cfg.vocab_size)
     engine = EngineCore(
@@ -149,6 +156,15 @@ def test_engine_soak_invariants(seed, cache_dtype, draft):
     for _ in range(500):
         if not engine.step() and not engine.has_work():
             break
+    if host:
+        engine.flush_host_offload()
+        hp = engine.host_pool
+        assert hp.stored_blocks > 0, "offload tier never engaged"
+        # bounded bookkeeping: every pool row is free or hash-mapped
+        assert len(hp._table) + len(hp._free) == hp.num_blocks
+        t = engine._offload_thread
+        engine.close()
+        assert not t.is_alive()
 
     # --- invariants -----------------------------------------------------
     assert submitted == n_requests
