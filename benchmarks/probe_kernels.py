@@ -25,8 +25,40 @@ GEOMS = {
 }
 
 
+
+def time_topk() -> None:
+    """Time the three top-k paths at serving shape [64, 128256] — decides
+    whether the dual approx/exact sampler design can collapse to
+    always-exact (run: probe_kernels.py topk)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.sampling import _exact_top_k_tiled
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 128256), jnp.float32)
+    jax.block_until_ready(x)
+    paths = {
+        "approx_max_k": jax.jit(lambda a: jax.lax.approx_max_k(
+            a, 64, recall_target=0.95)),
+        "exact_tiled": jax.jit(lambda a: _exact_top_k_tiled(a, 64)),
+        "lax_top_k": jax.jit(lambda a: jax.lax.top_k(a, 64)),
+    }
+    for name, fn in paths.items():
+        jax.block_until_ready(fn(x))  # compile
+        t0 = time.perf_counter()
+        for _ in range(20):
+            out = fn(x)
+        jax.block_until_ready(out)
+        print(f"topk/{name}: {(time.perf_counter() - t0) / 20 * 1e3:.3f} ms")
+
+
 def main() -> None:
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which == "topk":
+        time_topk()
+        return
     geom = GEOMS[sys.argv[2] if len(sys.argv) > 2 else "8b"]
     import jax
     import jax.numpy as jnp

@@ -53,6 +53,16 @@ def _apply_penalties(
 
 
 def _exact_top_k(logits: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """Exact top-k, backend-routed: the tile reduce only pays off where
+    ``lax.top_k`` lowers to a full bitonic sort over V (TPU) — CPU's
+    top_k is already selection-based and the tiling measures ~5x SLOWER
+    there (benchmarks/probe_kernels.py topk)."""
+    if jax.default_backend() != "tpu":
+        return jax.lax.top_k(logits, k)
+    return _exact_top_k_tiled(logits, k)
+
+
+def _exact_top_k_tiled(logits: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
     """Exact top-k via per-tile reduce: top-k of each vocab tile, then
     top-k of the [B, nt*k] survivors.  Any global top-k element ranks
     <= k inside its own tile, so the result is exact — but the big sort

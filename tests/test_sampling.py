@@ -125,20 +125,20 @@ def test_engine_sampling_mode():
 
 
 def test_exact_top_k_tiled_matches_lax_top_k():
-    """_exact_top_k's tile reduce must be bit-identical to lax.top_k,
+    """_exact_top_k_tiled's tile reduce must be bit-identical to lax.top_k,
     including lowest-index-first tie-breaking (quantized values force
     many cross-tile ties)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from dynamo_tpu.engine.sampling import _exact_top_k
+    from dynamo_tpu.engine.sampling import _exact_top_k_tiled
 
     rng = np.random.default_rng(7)
     for b, v, k in [(4, 4096, 64), (2, 8192, 64), (3, 2048, 128)]:
         x = jnp.asarray(
             np.round(rng.standard_normal((b, v)) * 4) / 4, jnp.float32)
-        vals, idx = _exact_top_k(x, k)
+        vals, idx = _exact_top_k_tiled(x, k)
         rvals, ridx = jax.lax.top_k(x, k)
         np.testing.assert_array_equal(np.asarray(vals), np.asarray(rvals))
         np.testing.assert_array_equal(np.asarray(idx), np.asarray(ridx))
@@ -149,11 +149,11 @@ def test_exact_top_k_fallback_small_vocab():
     import jax.numpy as jnp
     import numpy as np
 
-    from dynamo_tpu.engine.sampling import _exact_top_k
+    from dynamo_tpu.engine.sampling import _exact_top_k_tiled
 
     x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 100)),
                     jnp.float32)
-    vals, idx = _exact_top_k(x, 64)  # below the 4*k tile floor -> lax.top_k path
+    vals, idx = _exact_top_k_tiled(x, 64)  # below the 4*k tile floor -> lax.top_k path
     rvals, ridx = jax.lax.top_k(x, 64)
     np.testing.assert_array_equal(np.asarray(vals), np.asarray(rvals))
     np.testing.assert_array_equal(np.asarray(idx), np.asarray(ridx))
