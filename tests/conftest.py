@@ -13,3 +13,51 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from dynamo_tpu.utils import force_cpu_devices
 
 force_cpu_devices(8)
+
+
+def make_tiny_hf_checkpoint(dst, *, vocab_size=128, hidden_size=32,
+                            intermediate_size=64, num_hidden_layers=2,
+                            num_attention_heads=4, num_key_value_heads=2,
+                            max_position_embeddings=256, seed=0,
+                            extra_vocab=("hello", "world")):
+    """Shared tiny on-disk HF Llama checkpoint builder (config +
+    safetensors + word-level tokenizer.json).  Several suites still
+    carry inline copies of this block with suite-specific vocabs —
+    prefer this helper for new tests and fold the copies in when their
+    vocab expectations allow."""
+    import json
+
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    from safetensors.torch import save_file
+    from tokenizers import Tokenizer
+    from tokenizers import models as tkm
+    from tokenizers import pre_tokenizers
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    dst.mkdir(parents=True, exist_ok=True)
+    hf_cfg = LlamaConfig(
+        vocab_size=vocab_size, hidden_size=hidden_size,
+        intermediate_size=intermediate_size,
+        num_hidden_layers=num_hidden_layers,
+        num_attention_heads=num_attention_heads,
+        num_key_value_heads=num_key_value_heads,
+        max_position_embeddings=max_position_embeddings,
+    )
+    torch.manual_seed(seed)
+    hf = LlamaForCausalLM(hf_cfg).eval()
+    d = hf_cfg.to_dict()
+    d["architectures"] = ["LlamaForCausalLM"]
+    (dst / "config.json").write_text(json.dumps(d))
+    save_file({k: v.contiguous() for k, v in hf.state_dict().items()},
+              str(dst / "model.safetensors"))
+    n_words = max(vocab_size - 1 - len(extra_vocab), 1)
+    vocab = {f"w{i}": i for i in range(n_words)}
+    for j, w in enumerate(extra_vocab):
+        vocab[w] = n_words + j
+    vocab["[UNK]"] = n_words + len(extra_vocab)
+    tok = Tokenizer(tkm.WordLevel(vocab=vocab, unk_token="[UNK]"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    tok.save(str(dst / "tokenizer.json"))
+    return hf

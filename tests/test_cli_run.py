@@ -1,0 +1,71 @@
+"""CLI `run` end-to-end: the primary user command's non-server inputs
+(text:, stdin, batch:) in a subprocess exactly as a user invokes it,
+against out=echo and the real out=tpu engine on a tiny checkpoint."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    """A tiny on-disk HF checkpoint (config + safetensors + tokenizer)."""
+    from tests.conftest import make_tiny_hf_checkpoint
+
+    src = tmp_path_factory.mktemp("cli_model") / "hf"
+    make_tiny_hf_checkpoint(src)
+    return src
+
+
+def _run(args, input_text=None, timeout=300):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    return subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.cli", *args],
+        capture_output=True, text=True, timeout=timeout, cwd=str(REPO),
+        input=input_text, env=env,
+    )
+
+
+def test_run_text_echo(model_dir):
+    out = _run(["run", "in=text:hello world", "out=echo",
+                "--model-path", str(model_dir), "--max-tokens", "8"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "hello" in out.stdout
+
+
+def test_run_stdin_echo(model_dir):
+    out = _run(["run", "in=stdin", "out=echo",
+                "--model-path", str(model_dir), "--max-tokens", "8"],
+               input_text="hello world\nworld hello\n")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.count("hello") >= 2
+
+
+def test_run_batch_echo(model_dir, tmp_path):
+    f = tmp_path / "prompts.jsonl"
+    f.write_text('{"text": "hello world"}\n{"text": "world hello"}\n')
+    out = _run(["run", f"in=batch:{f}", "out=echo",
+                "--model-path", str(model_dir), "--max-tokens", "8"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["requests"] == 2
+    results = [json.loads(l)
+               for l in Path(summary["results"]).read_text().splitlines()]
+    assert len(results) == 2 and all(r["output_tokens"] > 0 for r in results)
+
+
+def test_run_text_tpu_engine(model_dir):
+    """The flagship path: load a checkpoint, build the native engine,
+    generate — exactly `dynamo-tpu run in=text:... out=tpu`."""
+    out = _run(["run", "in=text:hello world", "out=tpu",
+                "--model-path", str(model_dir), "--max-tokens", "4",
+                "--max-model-len", "64", "--num-blocks", "16",
+                "--max-batch-size", "2"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.strip(), "no generated text on stdout"
