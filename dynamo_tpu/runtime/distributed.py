@@ -182,6 +182,7 @@ class Client(AsyncEngine):
         self._watch_id: Optional[int] = None
         self._changed = asyncio.Event()
         self._removed: set[int] = set()  # seen-then-deleted instance ids
+        self._retiring: set[tuple] = set()  # (conn, drain task) pairs
 
     async def start(self) -> None:
         coord = self.endpoint.runtime.coordinator
@@ -199,6 +200,10 @@ class Client(AsyncEngine):
                 pass
         for conn in self._conns.values():
             await conn.close()
+        for conn, task in list(self._retiring):
+            task.cancel()
+            await conn.close()
+        self._retiring.clear()
 
     # ------------------------------------------------------------- discovery
     def _on_event(self, event: str, key: str, value: Any) -> None:
@@ -212,7 +217,14 @@ class Client(AsyncEngine):
                 self._removed.pop()
             conn = self._conns.pop(iid, None)
             if conn:
-                asyncio.ensure_future(conn.close())
+                # retire, don't kill: the delete may be a false positive
+                # (lease expired behind a stall, worker alive mid-stream).
+                # Tracked so Client.close() can reap drains still pending.
+                task = asyncio.ensure_future(conn.close_when_idle())
+                entry = (conn, task)
+                self._retiring.add(entry)
+                task.add_done_callback(
+                    lambda _t, e=entry: self._retiring.discard(e))
         # swap-then-set: waiters hold the OLD event object, so a consumer
         # can never clear() away a notification another waiter needed
         ev, self._changed = self._changed, asyncio.Event()
@@ -305,10 +317,20 @@ class Client(AsyncEngine):
             yield item
 
     def random(self, request: Context) -> AsyncIterator[Any]:
-        return self.direct(request, self.pick_random())
+        return self._routed_stream(request, self.pick_random)
 
     def round_robin(self, request: Context) -> AsyncIterator[Any]:
-        return self.direct(request, self.pick_round_robin())
+        return self._routed_stream(request, self.pick_round_robin)
+
+    async def _routed_stream(self, request: Context, pick):
+        if not self._instances:
+            # an empty instance map is usually a transient window — a
+            # worker still booting, or the coordinator reconnect replaying
+            # this watch's delete→put churn — not a dead deployment; give
+            # discovery a moment before declaring "no instances"
+            await self._wait_until(lambda: self._instances, 3.0)
+        async for item in self._conn(pick()).generate(request):
+            yield item
 
     # default AsyncEngine surface = random routing
     def generate(self, request: Context) -> AsyncIterator[Any]:

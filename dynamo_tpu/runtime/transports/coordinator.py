@@ -853,14 +853,32 @@ class CoordinatorClient:
 
     async def _call(self, header: dict, payload: bytes = b"",
                     _internal: bool = False) -> tuple[dict, bytes]:
-        # Fail fast during the disconnect window — a write to the stale
-        # half-closed socket would buffer silently and the future would
-        # hang forever.  User calls additionally wait out re-registration
-        # (the lease-handle mappings are stale until it completes); the
-        # _reregister calls themselves ride on _connected alone.
+        # Never write to a stale half-closed socket (the frame would
+        # buffer silently and the future hang forever) — but a
+        # reconnecting client WAITS OUT the redial window instead of
+        # failing every in-flight caller for a transient drop (an event
+        # loop stalled behind an XLA compile is enough to drop the
+        # connection under load).  User calls additionally wait out
+        # re-registration (lease-handle mappings are stale until it
+        # completes); _reregister's own calls ride on _connected alone.
         gate = self._connected if _internal else self._ready
         if not gate.is_set():
-            raise ConnectionError("coordinator disconnected")
+            if self._closing or not self.reconnect:
+                raise ConnectionError("coordinator disconnected")
+            # race the redial against close(): a closing client must not
+            # strand callers for the full grace
+            g = asyncio.ensure_future(gate.wait())
+            c = asyncio.ensure_future(self.closed.wait())
+            try:
+                await asyncio.wait(
+                    {g, c}, return_when=asyncio.FIRST_COMPLETED,
+                    timeout=float(os.environ.get("DYNTPU_RECONNECT_GRACE", "10")),
+                )
+            finally:
+                g.cancel()
+                c.cancel()
+            if not gate.is_set():
+                raise ConnectionError("coordinator disconnected")
         epoch = self._epoch
         rid = next(self._ids)
         header["id"] = rid

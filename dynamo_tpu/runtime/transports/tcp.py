@@ -145,6 +145,8 @@ class EndpointTcpClient(AsyncEngine):
         self._connect_lock = asyncio.Lock()
         self._connected = False
         self._closed = False
+        self._idle = asyncio.Event()  # set while no streams are in flight
+        self._idle.set()
 
     async def connect(self) -> "EndpointTcpClient":
         # serialized: concurrent reconnects (several in-flight requests
@@ -172,6 +174,18 @@ class EndpointTcpClient(AsyncEngine):
                 )
                 self._connected = True
         return self
+
+    async def close_when_idle(self, timeout: float = 60.0) -> None:
+        """Close once in-flight streams finish (bounded).  Discovery
+        deletes can be false positives — a lease that expired behind an
+        event-loop stall while the worker is alive and mid-response;
+        closing immediately would kill healthy streams.  A genuinely
+        dead worker's streams break on their own socket error anyway."""
+        try:
+            await asyncio.wait_for(self._idle.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+        await self.close()
 
     async def close(self) -> None:
         # under the connect lock + a closed flag: a close() racing a
@@ -235,6 +249,7 @@ class EndpointTcpClient(AsyncEngine):
         # registration) — but cleaned up if the send itself fails, or the
         # entry and its queue leak forever
         self._streams[req_id] = q
+        self._idle.clear()
         try:
             await self._send(
                 {"type": "request", "req_id": req_id, "subject": self.subject},
@@ -266,3 +281,5 @@ class EndpointTcpClient(AsyncEngine):
         finally:
             cancel_task.cancel()
             self._streams.pop(req_id, None)
+            if not self._streams:
+                self._idle.set()

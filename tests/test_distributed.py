@@ -339,3 +339,100 @@ def test_leased_write_inside_expiry_window_heals():
             await srv.stop()
 
     run(go())
+
+
+def test_call_waits_out_reconnect_window():
+    """A user call issued while the connection is briefly down waits for
+    the redial + re-registration instead of raising — transient drops
+    (event loop stalls under load) stay invisible to callers."""
+    async def go():
+        srv = await _coordinator()
+        try:
+            c = await CoordinatorClient(srv.url, reconnect=True).connect()
+            await c.kv_put("rw/x", {"v": 1})
+            # force-drop the transport mid-session
+            c._writer.close()
+            await asyncio.sleep(0.05)  # let the read loop notice
+            # issued during the reconnect window: must succeed, not raise
+            await c.kv_put("rw/y", {"v": 2})
+            assert await c.kv_get("rw/y") == {"v": 2}
+            await c.close()
+        finally:
+            await srv.stop()
+
+    run(go())
+
+
+def test_routed_call_waits_for_first_instance():
+    """generate()/random routing issued before any worker registered
+    waits out the boot window instead of raising 'no instances'."""
+    async def go():
+        srv = await _coordinator()
+        try:
+            worker = await _runtime(srv.url)
+            frontend = await _runtime(srv.url)
+            client = await frontend.namespace("dyn").component("backend") \
+                .endpoint("generate").client()
+
+            async def late_register():
+                await asyncio.sleep(0.15)
+                await worker.namespace("dyn").component("backend") \
+                    .endpoint("generate").serve(EchoEngine())
+
+            reg = asyncio.ensure_future(late_register())
+            out = [x async for x in client.generate(Context([4, 5]))]
+            await reg
+            assert out == [4, 5]
+            await client.close()
+            await frontend.shutdown()
+            await worker.shutdown()
+        finally:
+            await srv.stop()
+
+    run(go())
+
+
+def test_discovery_delete_does_not_kill_inflight_stream():
+    """A false-positive discovery delete (lease expired behind a stall,
+    worker alive) must not sever a mid-response stream: the retired
+    connection closes when idle, not immediately."""
+    async def go():
+        srv = await _coordinator()
+        try:
+            worker = await _runtime(srv.url)
+            frontend = await _runtime(srv.url)
+            ep = worker.namespace("dyn").component("backend").endpoint("generate")
+            await ep.serve(SlowEngine())
+            client = await frontend.namespace("dyn").component("backend") \
+                .endpoint("generate").client()
+            await client.wait_for_instances(1)
+
+            got = []
+
+            async def consume():
+                async for x in client.generate(Context(None)):
+                    got.append(x)
+                    if len(got) >= 8:
+                        return
+
+            task = asyncio.ensure_future(consume())
+            await asyncio.sleep(0.03)  # stream underway
+            # simulate the expiry's watcher delete (key vanishes)
+            await srv_delete(srv, worker)
+            await task  # must complete all 8 items, not die mid-stream
+            assert got == list(range(8))
+            await client.close()
+            await frontend.shutdown()
+            await worker.shutdown()
+        finally:
+            await srv.stop()
+
+    async def srv_delete(srv, worker):
+        # drop the worker's discovery key server-side like a TTL expiry
+        prefix = "dyn/components/backend/endpoints/generate/"
+        for key in list(srv._kv):
+            if key.startswith(prefix):
+                srv._kv.pop(key)
+                await srv._notify_watchers("delete", key, None)
+
+    run(go())
