@@ -709,7 +709,7 @@ class EngineCore:
             prefix_blocks=prefix_blocks, k_cand=k_cand, exact=exact, **gkw,
         )
         self.steps += 1
-        return tuple(np.asarray(a) for a in out)
+        return tuple(jax.device_get(out))
 
     def _run_multi_decode_step(self, tokens, positions, block_tables, seq_lens,
                                limits, temp, top_k, top_p, pen=None, gram=None,
@@ -735,7 +735,10 @@ class EngineCore:
             use_penalties=use_pen, **gkw,
         )
         self.steps += 1
-        return tuple(np.asarray(a) for a in out)
+        # ONE batched transfer: per-array np.asarray would issue a
+        # device->host round trip per output (per-array latency is the
+        # cost that matters on a remote-attached chip)
+        return tuple(jax.device_get(out))
 
     # ------------------------------------------------------- cross-thread API
     def submit(self, request: EngineRequest) -> None:
@@ -1182,10 +1185,8 @@ class EngineCore:
             jnp.asarray([req.sampling.top_p], np.float32),
             nb=nb_pad, k_cand=k_cand, exact=exact,
         )
-        sampled, lps, cids, clps = (
-            np.asarray(sampled), np.asarray(lps), np.asarray(cids),
-            np.asarray(clps),
-        )
+        sampled, lps, cids, clps = jax.device_get(
+            (sampled, lps, cids, clps))  # one batched transfer
         nb = -(-req.prompt_len // bs)
         self.cache = scatter_blocks_inplace(
             self.cache, req.block_ids[:nb],
@@ -1681,7 +1682,7 @@ class EngineCore:
         this thread), publish (lock).  ``reserve`` skips hashes another
         in-flight batch already landed (LRU-refresh only), and
         ``publish`` frees rows that lost a store race."""
-        np_arr = jax.tree.map(lambda a: np.asarray(jax.device_get(a)), arr)
+        np_arr = jax.device_get(arr)  # one batched transfer, numpy leaves
         blocks = jax.tree.map(lambda a: np.moveaxis(a, 1, 0), np_arr)
         with self._offload_lock:
             hids, rows = self.host_pool.reserve(hashes, blocks)
@@ -1777,7 +1778,7 @@ class EngineCore:
         TP-resharding the reference needs a Triton kernel for
         (kv_rearrange.py); here the host staging buffer is layout-neutral."""
         out = gather_blocks_padded(self.cache, block_ids)
-        return jax.tree.map(lambda a: np.asarray(jax.device_get(a)), out)
+        return jax.device_get(out)  # one batched transfer, numpy leaves
 
     def scatter_external(
         self,
