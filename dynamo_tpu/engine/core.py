@@ -626,7 +626,7 @@ class EngineCore:
             mp = np.zeros(b, np.float32)
             for i, r in enumerate(reqs):
                 mp[at(i)] = r.sampling.min_p
-            kw["min_p"] = jnp.asarray(mp)
+            kw["min_p"] = mp
         if any(r.sampling.seed is not None and not r.sampling.greedy
                for r in reqs):
             sd = np.zeros(b, np.int32)
@@ -635,8 +635,8 @@ class EngineCore:
                 if r.sampling.seed is not None and not r.sampling.greedy:
                     sd[at(i)] = int(r.sampling.seed) & 0x7FFFFFFF
                     sr[at(i)] = True
-            kw["seeds"] = jnp.asarray(sd)
-            kw["seed_rows"] = jnp.asarray(sr)
+            kw["seeds"] = sd
+            kw["seed_rows"] = sr
         if any(r.sampling.logit_bias for r in reqs):
             longest = max(len(r.sampling.logit_bias or {}) for r in reqs)
             nb = max(8, 1 << (longest - 1).bit_length())  # pow2 buckets
@@ -648,9 +648,9 @@ class EngineCore:
                 ):
                     toks[at(i), j] = int(t)
                     vals[at(i), j] = float(v)
-            kw["bias_tokens"] = jnp.asarray(toks)
-            kw["bias_vals"] = jnp.asarray(vals)
-        return kw
+            kw["bias_tokens"] = toks
+            kw["bias_vals"] = vals
+        return kw  # host arrays: the dispatch sites batch-upload them
 
     def _dispatch_keys(self, reqs) -> tuple:
         """Ordered grammar keys for one dispatch: json first (pushdown
@@ -667,10 +667,12 @@ class EngineCore:
             return {}
         keys, jrows, jstate, jdepth, jstack = gram
         gdev, _ = self._composite_for(keys)
+        # row-state arrays stay host-side here; the dispatch sites fold
+        # them into their single batched device_put
         return dict(
             grammar=gdev,
-            jrows=jnp.asarray(jrows), jstate=jnp.asarray(jstate),
-            jdepth=jnp.asarray(jdepth), jstack=jnp.asarray(jstack),
+            jrows=np.asarray(jrows), jstate=np.asarray(jstate),
+            jdepth=np.asarray(jdepth), jstack=np.asarray(jstack),
         )
 
     def _sampling_mode(self, reqs) -> tuple[int, bool]:
@@ -692,6 +694,20 @@ class EngineCore:
             exact = True
         return k_cand, exact
 
+    @staticmethod
+    def _upload_dispatch(host_args, gkw=None):
+        """ONE batched host->device upload for a dispatch's small
+        operands — positional AND grammar/extras rows (per-array
+        jnp.asarray would issue a transfer round trip each; per-transfer
+        latency is the cost that matters on a remote-attached chip).
+        Returns (device_args tuple, gkw with its host arrays replaced)."""
+        gkw = dict(gkw or {})
+        host_kw = {k: v for k, v in gkw.items() if isinstance(v, np.ndarray)}
+        up, up_kw = jax.device_put(
+            (tuple(np.asarray(a) for a in host_args), host_kw))
+        gkw.update(up_kw)
+        return up, gkw
+
     def _run_step(self, tokens, positions, block_tables, seq_lens, slot_idx,
                   last_idx, temp, top_k, top_p, prefix_blocks=None,
                   k_cand=K_MAX, exact=False, gram=None, extras=None):
@@ -699,13 +715,12 @@ class EngineCore:
         self._rng, rng = jax.random.split(self._rng)
         gkw = self._gram_kwargs(gram)
         gkw.update(extras or {})
+        up, gkw = self._upload_dispatch(
+            (tokens, positions, block_tables, seq_lens, slot_idx, last_idx,
+             temp, top_k, top_p), gkw)
         out, self.cache = self._step_fn(
             self.params, self.cache,
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(block_tables), jnp.asarray(seq_lens),
-            jnp.asarray(slot_idx), jnp.asarray(last_idx),
-            rng,
-            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            *up[:6], rng, *up[6:],
             prefix_blocks=prefix_blocks, k_cand=k_cand, exact=exact, **gkw,
         )
         self.steps += 1
@@ -718,17 +733,14 @@ class EngineCore:
         """Dispatch one multi-step decode; returns (sampled [K,B],
         logprob [K,B], cand_ids [K,B,C], cand_lps [K,B,C])."""
         self._rng, rng = jax.random.split(self._rng)
-        args = [
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(block_tables), jnp.asarray(seq_lens),
-            jnp.asarray(limits), rng,
-            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
-        ]
         use_pen = pen is not None
-        if use_pen:
-            args += [jnp.asarray(a) for a in pen]
+        host = [tokens, positions, block_tables, seq_lens, limits,
+                temp, top_k, top_p] + (list(pen) if use_pen else [])
         gkw = self._gram_kwargs(gram)
         gkw.update(extras or {})
+        up, gkw = self._upload_dispatch(host, gkw)
+        up = list(up)
+        args = up[:5] + [rng] + up[5:]
         out, self.cache = self._multi_fn(
             self.params, self.cache, *args,
             num_steps=num_steps, k_cand=k_cand, exact=exact,
@@ -1177,12 +1189,14 @@ class EngineCore:
         last_idx = np.asarray([req.prompt_len - 1], np.int32)
         self._rng, rng = jax.random.split(self._rng)
         k_cand, exact = self._sampling_mode([req])
+        up, _ = self._upload_dispatch((
+            tokens, positions, last_idx,
+            np.asarray([req.sampling.temperature], np.float32),
+            np.asarray([req.sampling.top_k], np.int32),
+            np.asarray([req.sampling.top_p], np.float32),
+        ))
         (sampled, lps, cids, clps), blocks = self._sp_fn(
-            self.params, jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(last_idx), rng,
-            jnp.asarray([req.sampling.temperature], np.float32),
-            jnp.asarray([req.sampling.top_k], np.int32),
-            jnp.asarray([req.sampling.top_p], np.float32),
+            self.params, up[0], up[1], up[2], rng, up[3], up[4], up[5],
             nb=nb_pad, k_cand=k_cand, exact=exact,
         )
         sampled, lps, cids, clps = jax.device_get(
@@ -1346,16 +1360,15 @@ class EngineCore:
         self._drain_offload()
         self._rng, rng = jax.random.split(self._rng)
         k_cand, exact = self._sampling_mode(rows)
+        up, _ = self._upload_dispatch(
+            (tokens, positions, bt[:, :m_used], seq_lens, slot_idx,
+             temp, top_k, top_p, min_p, seeds, seed_rows))
         verified, self.cache = self._spec_fn(
             self.params, self.cache,
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(bt[:, :m_used]),
-            jnp.asarray(seq_lens), jnp.asarray(slot_idx),
-            rng, jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
-            jnp.asarray(min_p), jnp.asarray(seeds), jnp.asarray(seed_rows),
+            *up[:5], rng, *up[5:],
             k_cand=k_cand, exact=exact,
         )
-        verified = np.asarray(verified)
+        verified = jax.device_get(verified)
         self.steps += 1
         self.decode_steps += 1
         self.spec_steps += 1
@@ -1811,12 +1824,15 @@ class EngineCore:
 
         if self.cache_quant and type(blocks) is tuple and len(blocks) == 2:
             blocks = QuantKvCache(*blocks)  # wire tuples -> cache pytree
-        arr = jax.tree.map(jnp.asarray, blocks)
         if self.mesh is not None:
             # shard the staged blocks like the pool so the donated scatter
             # preserves the cache sharding (no step-fn recompiles) — this IS
-            # the TP-reshard on ingest (each shard keeps only its heads)
-            arr = jax.device_put(arr, self._cache_sharding())
+            # the TP-reshard on ingest (each shard keeps only its heads);
+            # ONE device_put straight from host (uploading to the default
+            # device first would transfer twice)
+            arr = jax.device_put(blocks, self._cache_sharding())
+        else:
+            arr = jax.device_put(blocks)  # one batched upload, all leaves
         self.cache = scatter_blocks_inplace(self.cache, block_ids, arr)
 
     def complete_remote_prefill(
