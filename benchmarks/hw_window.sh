@@ -28,6 +28,14 @@ run bench 3600 python bench.py
 # 3. decode roofline breakdown -> adjudicate perf hypotheses
 run profile_decode 1800 python benchmarks/profile_decode.py 8b
 
+# 3b. decode-kernel geometry sweep: seqs-per-group x blocks-per-chunk
+for spg in 4 8 16; do for bpc in 2 4 8; do
+  run "decode_sweep_g${spg}_c${bpc}" 900 env       DYNAMO_DECODE_SEQS_PER_GROUP=$spg DYNAMO_DECODE_BLOCKS_PER_CHUNK=$bpc       python benchmarks/profile_decode.py 8b
+done; done
+
+# 3c. exact-top-k path timing (collapse-the-dual-sampler decision)
+run probe_topk 600 python benchmarks/probe_kernels.py topk
+
 # 4. int8 matmul A/B: dequant-in-kernel vs XLA path
 run bench_int8mm 3600 env DYNAMO_PALLAS_INT8_MATMUL=1 python bench.py
 
