@@ -7,7 +7,10 @@ between measured ITL and the HBM roofline is attributable, not guessed.
 
 Run on the real chip:  python benchmarks/profile_decode.py [1b|8b]
 Env: DYNAMO_PROF_BATCH (64), DYNAMO_PROF_CTX (512), DYNAMO_PROF_QUANT
-(int8|none), DYNAMO_PROF_STEPS (burst length, 64).
+(int8|none), DYNAMO_PROF_STEPS (burst length, 64), DYNAMO_PROF_PARTS
+(comma list of exact part names to run a subset),
+DYNAMO_DECODE_SEQS_PER_GROUP / DYNAMO_DECODE_BLOCKS_PER_CHUNK (decode
+kernel geometry — also honoured by part 3).
 
 Prints a JSON line per component: {"part", "ms", "hbm_gb", "gbps"}.
 """
@@ -123,53 +126,72 @@ def main() -> None:
             "gbps": round(gb / (ms / 1e3), 1) if ms else None,
         }))
 
+    parts_env = os.environ.get("DYNAMO_PROF_PARTS", "")
+    sel = {w.strip() for w in parts_env.split(",") if w.strip()}
+
+    def want(name: str) -> bool:
+        # exact part names — sweeps re-measure only the env-sensitive
+        # components (substring matching would catch e.g. "attention"
+        # inside "forward_no_attention")
+        return not sel or name in sel
+
     # 1. full multi-step burst (what the engine dispatches).  No donation
     # here: the profiler reuses the same cache buffer across timed calls
     # (the engine's real dispatch donates; in-place vs copy costs show up
     # in single_step_dispatch below anyway)
-    burst = jax.jit(functools.partial(
-        multi_decode_step, model, num_steps=k_steps, block_size=bs,
-    ))
-    ms = timeit(
-        lambda: burst(params, cache, tokens, positions, bt, seq_lens,
-                      limits, rng, temp, topk, topp)[0],
-        iters=5, warmup=2,
-    )
-    emit("burst_total_per_step", ms / k_steps,
-         param_gb + kv_gb / 2)  # avg context grows over the burst
+    if want("burst_total_per_step"):
+        burst = jax.jit(functools.partial(
+            multi_decode_step, model, num_steps=k_steps, block_size=bs,
+        ))
+        ms = timeit(
+            lambda: burst(params, cache, tokens, positions, bt, seq_lens,
+                          limits, rng, temp, topk, topp)[0],
+            iters=5, warmup=2,
+        )
+        emit("burst_total_per_step", ms / k_steps,
+             param_gb + kv_gb / 2)  # avg context grows over the burst
 
     # 2. weights-only: forward with attention output zeroed via 0-len ctx
-    zero_lens = jnp.zeros((batch,), jnp.int32)
-    fwd = jax.jit(lambda p, c, t: model.forward(
-        p, t[:, None], jnp.zeros((batch, 1), jnp.int32), c, bt, zero_lens,
-        jnp.full((batch, 1), -1, jnp.int32))[0])
-    ms = timeit(lambda: fwd(params, cache, tokens))
-    emit("forward_no_attention", ms, param_gb - v_ * h * wbytes / 1e9)
+    if want("forward_no_attention"):
+        zero_lens = jnp.zeros((batch,), jnp.int32)
+        fwd = jax.jit(lambda p, c, t: model.forward(
+            p, t[:, None], jnp.zeros((batch, 1), jnp.int32), c, bt, zero_lens,
+            jnp.full((batch, 1), -1, jnp.int32))[0])
+        ms = timeit(lambda: fwd(params, cache, tokens))
+        emit("forward_no_attention", ms, param_gb - v_ * h * wbytes / 1e9)
 
-    # 3. paged attention kernel alone (per layer x layers)
-    q = jnp.ones((batch, cfg.num_heads, hd), cfg.jax_dtype)
-    att = jax.jit(lambda qq, cc: paged_decode_attention(
-        qq, cc, jnp.int32(0), bt, seq_lens, interpret=not on_accel))
-    ms_layer = timeit(lambda: att(q, cache))
-    emit("attention_all_layers", ms_layer * nl, kv_gb)
+    # 3. paged attention kernel alone (per layer x layers) — honours the
+    # same geometry knobs as the serving path (paged_attention.py), so
+    # the hw_window sweep actually varies this component
+    if want("attention_all_layers"):
+        q = jnp.ones((batch, cfg.num_heads, hd), cfg.jax_dtype)
+        spg = int(os.environ.get("DYNAMO_DECODE_SEQS_PER_GROUP", "8"))
+        bpc = int(os.environ.get("DYNAMO_DECODE_BLOCKS_PER_CHUNK", "4"))
+        att = jax.jit(lambda qq, cc: paged_decode_attention(
+            qq, cc, jnp.int32(0), bt, seq_lens, interpret=not on_accel,
+            seqs_per_group=spg, blocks_per_chunk=bpc))
+        ms_layer = timeit(lambda: att(q, cache))
+        emit("attention_all_layers", ms_layer * nl, kv_gb)
 
     # 4. logits + sampling
-    hidden = jnp.ones((batch, h), cfg.jax_dtype)
-    lg = jax.jit(lambda p, hh: sample_full(
-        model.compute_logits(p, hh), rng, temp, topk, topp))
-    ms = timeit(lambda: lg(params, hidden))
-    emit("logits_sampling", ms, v_ * h * wbytes / 1e9)
+    if want("logits_sampling"):
+        hidden = jnp.ones((batch, h), cfg.jax_dtype)
+        lg = jax.jit(lambda p, hh: sample_full(
+            model.compute_logits(p, hh), rng, temp, topk, topp))
+        ms = timeit(lambda: lg(params, hidden))
+        emit("logits_sampling", ms, v_ * h * wbytes / 1e9)
 
     # 5. dispatch overhead: same burst at K=1 vs K
-    one = jax.jit(functools.partial(
-        multi_decode_step, model, num_steps=1, block_size=bs,
-    ))
-    ms1 = timeit(
-        lambda: one(params, cache, tokens, positions, bt, seq_lens, limits,
-                    rng, temp, topk, topp)[0],
-        iters=10, warmup=2,
-    )
-    emit("single_step_dispatch", ms1, param_gb + kv_gb)
+    if want("single_step_dispatch"):
+        one = jax.jit(functools.partial(
+            multi_decode_step, model, num_steps=1, block_size=bs,
+        ))
+        ms1 = timeit(
+            lambda: one(params, cache, tokens, positions, bt, seq_lens,
+                        limits, rng, temp, topk, topp)[0],
+            iters=10, warmup=2,
+        )
+        emit("single_step_dispatch", ms1, param_gb + kv_gb)
 
 
 if __name__ == "__main__":
