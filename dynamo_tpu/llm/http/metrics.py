@@ -7,10 +7,39 @@ No prometheus client dependency — the text format is trivial to emit.
 
 from __future__ import annotations
 
+import bisect
+import time
 from collections import defaultdict
 from typing import Iterator
 
 PREFIX = "dynamo_tpu_http_service"
+
+# seconds; TTFT and whole-request durations share one ladder
+_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+class Histogram:
+    """Minimal Prometheus histogram (cumulative buckets + sum + count)."""
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(_BUCKETS) + 1)  # last = +Inf
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, v: float) -> None:
+        # first bucket with bound >= v; past the ladder = the +Inf slot
+        self.counts[bisect.bisect_left(_BUCKETS, v)] += 1
+        self.total += v
+        self.n += 1
+
+    def render(self, name: str, labels: str) -> Iterator[str]:
+        cum = 0
+        for b, c in zip(_BUCKETS, self.counts):
+            cum += c
+            yield f'{name}_bucket{{{labels},le="{b}"}} {cum}'
+        yield f'{name}_bucket{{{labels},le="+Inf"}} {self.n}'
+        yield f'{name}_sum{{{labels}}} {round(self.total, 6)}'
+        yield f'{name}_count{{{labels}}} {self.n}'
 
 
 class Metrics:
@@ -20,6 +49,10 @@ class Metrics:
         # model -> inflight
         self.inflight: dict[str, int] = defaultdict(int)
         self.tokens_out: dict[str, int] = defaultdict(int)
+        self.ttft: dict[str, Histogram] = defaultdict(Histogram)
+        # duration keyed by (model, status): near-zero error/disconnect
+        # requests must not pull the success series' percentiles down
+        self.duration: dict[tuple[str, str], Histogram] = defaultdict(Histogram)
 
     def guard(self, model: str, endpoint: str) -> "InflightGuard":
         return InflightGuard(self, model, endpoint)
@@ -38,6 +71,15 @@ class Metrics:
         lines.append(f"# TYPE {PREFIX}_output_tokens_total counter")
         for model, n in sorted(self.tokens_out.items()):
             lines.append(f'{PREFIX}_output_tokens_total{{model="{model}"}} {n}')
+        lines.append(f"# TYPE {PREFIX}_ttft_seconds histogram")
+        for model, h in sorted(self.ttft.items()):
+            lines.extend(h.render(f"{PREFIX}_ttft_seconds",
+                                  f'model="{model}"'))
+        lines.append(f"# TYPE {PREFIX}_request_seconds histogram")
+        for (model, status), h in sorted(self.duration.items()):
+            lines.extend(h.render(
+                f"{PREFIX}_request_seconds",
+                f'model="{model}",status="{status}"'))
         return "\n".join(lines) + "\n"
 
 
@@ -49,7 +91,15 @@ class InflightGuard:
         self.model = model
         self.endpoint = endpoint
         self._status = "error"
+        self._t0 = time.monotonic()
+        self._saw_first = False
         self._m.inflight[model] += 1
+
+    def first_token(self) -> None:
+        """Record TTFT once, at the first generated-token emission."""
+        if not self._saw_first:
+            self._saw_first = True
+            self._m.ttft[self.model].observe(time.monotonic() - self._t0)
 
     def ok(self) -> None:
         self._status = "success"
@@ -60,3 +110,5 @@ class InflightGuard:
     def close(self) -> None:
         self._m.inflight[self.model] -= 1
         self._m.requests[(self.model, self.endpoint, self._status)] += 1
+        self._m.duration[(self.model, self._status)].observe(
+            time.monotonic() - self._t0)

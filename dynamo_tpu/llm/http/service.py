@@ -270,6 +270,8 @@ class HttpService:
                 if out is None:
                     live -= 1
                     continue
+                if out.token_ids:
+                    guard.first_token()
                 n_out += len(out.token_ids)
                 finish_override = None
                 if parsers[i] is not None:
@@ -319,6 +321,8 @@ class HttpService:
 
         async def collect(i: int, s: AsyncIterator[LLMEngineOutput]) -> None:
             async for out in s:
+                if out.token_ids:
+                    guard.first_token()
                 counts[i] += len(out.token_ids)
                 if out.text:
                     texts[i].append(out.text)

@@ -259,3 +259,27 @@ def test_logprobs_surface(card):
             await svc.stop()
 
     run(go())
+
+
+def test_metrics_latency_histograms():
+    """TTFT + request-duration histograms render in Prometheus format
+    with coherent bucket/sum/count after served requests."""
+    from dynamo_tpu.llm.http.metrics import Metrics
+
+    m = Metrics()
+    g = m.guard("m1", "completions")
+    g.first_token()
+    g.first_token()  # idempotent: one TTFT sample per request
+    g.ok()
+    g.close()
+    text = m.render()
+    assert 'dynamo_tpu_http_service_ttft_seconds_count{model="m1"} 1' in text
+    assert ('dynamo_tpu_http_service_request_seconds_count'
+            '{model="m1",status="success"} 1') in text
+    assert 'le="+Inf"' in text
+    # cumulative buckets are monotonically nondecreasing
+    import re
+
+    vals = [int(v) for v in re.findall(
+        r'ttft_seconds_bucket\{model="m1",le="[^"]+"\} (\d+)', text)]
+    assert vals == sorted(vals) and vals[-1] == 1
