@@ -400,7 +400,12 @@ def _northstar_ttft(model, params, kv_quant: str, block_size: int,
                 seen[0] = True
                 if on_first is not None:
                     on_first()
-            if refill and not stop_refill[0] and out.finish_reason is not None:
+            if refill and not stop_refill[0] and out.finish_reason is not None \
+                    and out.finish_reason.value != "cancelled":
+                # natural finishes refill (busy batch); the per-sample
+                # abort must NOT — its refill would FIFO-starve the
+                # fresh sample into waiting out a background's natural
+                # completion (slot luck, not TTFT)
                 submit(plen, refill=True)
 
         engine.submit(EngineRequest(
@@ -635,10 +640,15 @@ def main() -> None:
     req_counter = [0]
 
     def submit(prompt_len: int, on_first=None, refill=False):
-        """Submit one request; with ``refill`` it resubmits a replacement on
-        finish, keeping the batch full — so the steady-state window and the
-        TTFT probes both run against a genuinely busy engine (a drained
-        batch made both numbers meaningless on short max_len configs)."""
+        """Submit one request; with ``refill`` it resubmits a replacement
+        on NATURAL finish, keeping the batch full — the steady-state
+        window and the TTFT probe both run against a busy engine.  A
+        CANCELLED finish never refills: the TTFT probe frees a slot by
+        aborting one background request per sample, and an abort-
+        triggered refill would land in the admission queue AHEAD of the
+        fresh sample (FIFO) — the sample then waits out a background's
+        natural completion for its slot, measuring slot luck (up to
+        max_tokens x ITL) instead of TTFT."""
         i, req_counter[0] = req_counter[0], req_counter[0] + 1
         first_seen = [False]
 
@@ -647,7 +657,8 @@ def main() -> None:
                 first_seen[0] = True
                 if on_first is not None:
                     on_first()
-            if refill and out.finish_reason is not None:
+            if refill and out.finish_reason is not None \
+                    and out.finish_reason.value != "cancelled":
                 submit(prompt_len, refill=True)
 
         engine.submit(EngineRequest(
@@ -718,6 +729,12 @@ def main() -> None:
                    max_len - 64)
     ttfts: list[float] = []
     n_ttft = 5 if on_accel else 2
+    # each sample aborts ONE background (no refill on cancel — see
+    # submit()); fresh samples and natural-finish refills keep the batch
+    # populated across the probe.  Residual bias: in configs where
+    # ttft_isl clamps near max_len the samples finish fast and a round
+    # may briefly run a slot light — still a busy engine, and orders of
+    # magnitude closer to truth than the refill-starvation it replaces.
     for j in range(n_ttft + 1):  # +1 warmup
         # free a slot: finish one running request
         running = [r for r in engine.slots if r is not None]
