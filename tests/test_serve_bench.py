@@ -27,8 +27,8 @@ def test_serve_bench_echo_mode():
 
 def test_bench_py_cpu_smoke():
     """The driver's scored artifact (`bench.py`) runs end-to-end on CPU
-    and emits ONE valid JSON line with the expected fields — a bench
-    regression must fail the suite, not the round's measurement."""
+    and emits a valid JSON line after EVERY phase — a bench regression
+    must fail the suite, not the round's measurement."""
     import os
 
     repo = Path(__file__).parent.parent
@@ -42,6 +42,7 @@ def test_bench_py_cpu_smoke():
         DYNAMO_BENCH_TTFT_ISL="32",
         DYNAMO_BENCH_MAX_LEN="256",
         DYNAMO_BENCH_DECODE_STEPS="2",
+        DYNAMO_BENCH_MOE="1",
     )
     r = subprocess.run(
         [sys.executable, str(repo / "bench.py")],
@@ -49,8 +50,16 @@ def test_bench_py_cpu_smoke():
     )
     assert r.returncode == 0, r.stderr[-2000:]
     lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
-    assert len(lines) == 1, r.stdout
-    rec = json.loads(lines[0])
+    # incremental emission: the decode number is banked BEFORE the TTFT
+    # and MoE phases run, so a mid-run kill still scores (VERDICT r4
+    # missing #1) — the first line must already be a complete record
+    assert len(lines) >= 2, r.stdout
+    first = json.loads(lines[0])
+    assert first["metric"] == "decode_tok_s_per_chip"
+    assert first["value"] > 0
+    assert first["ttft_p50_ms"] is None  # banked before TTFT ran
+    # the driver parses the LAST line: the refined, full record
+    rec = json.loads(lines[-1])
     assert rec["metric"] == "decode_tok_s_per_chip"
     assert rec["value"] > 0
     assert rec["platform"] == "cpu"
@@ -63,6 +72,11 @@ def test_bench_py_cpu_smoke():
     if rec["ttft_p50_ms"] is not None:
         assert rec["ttft_p50_ms"] < 15_000, rec["ttft_p50_ms"]
     assert "kernels" in rec and "prefill_tok_s" in rec
+    # MoE row: grouped-dispatch decode + grouped-vs-dense prefill A/B
+    moe = rec["moe"]
+    assert moe["decode_tok_s"] > 0
+    assert moe["num_experts"] > 0
+    assert moe["prefill_grouped_ms"] is None or moe["prefill_grouped_ms"] > 0
 
 
 def test_bench_router_smoke():
