@@ -62,6 +62,12 @@ class EngineConfig:
     block_size: int = 16
     num_blocks: int = 512             # cache blocks in HBM
     num_host_blocks: int = 0          # host-RAM offload tier (0 = disabled)
+    # async-offload HBM backpressure: total device blocks that may sit in
+    # queued gather snapshots awaiting the device→host readback.  A batch
+    # that would push the outstanding count past this budget stores
+    # synchronously instead (each queued snapshot pins its blocks' HBM —
+    # a burst of large evictions must not pin hundreds of MB)
+    offload_inflight_blocks: int = 256
     # KV cache dtype: None = model dtype; "int8" = quantized cache with
     # per-token-per-head scales (ops/kv_quant.py) — half the KV HBM
     # footprint and decode-step KV traffic

@@ -263,6 +263,12 @@ class EngineCore:
                 # queue falls back to a synchronous store.
                 self._offload_lock = threading.Lock()
                 self._offload_closed = False
+                # each queued entry pins an on-device gather snapshot in
+                # HBM until the worker's device_get, so backpressure is
+                # bounded by total queued BLOCKS (config budget), not
+                # entry count — a large eviction burst falls back to the
+                # synchronous store instead of pinning hundreds of MB
+                self._offload_inflight_blocks = 0
                 self._offload_q: queue.Queue = queue.Queue(maxsize=4)
                 self._offload_thread = threading.Thread(
                     target=self._offload_worker, name="kv-offload", daemon=True
@@ -1675,14 +1681,21 @@ class EngineCore:
             # flag check + enqueue are atomic with close()'s flag set, so
             # a batch can never land behind the shutdown sentinel (where
             # it would be silently dropped and hang a later flush)
-            if not self._offload_closed:
+            budget = self.config.offload_inflight_blocks
+            if not self._offload_closed and (
+                self._offload_inflight_blocks + len(bids) <= budget
+                # never starve: an oversized single batch may queue alone
+                or self._offload_inflight_blocks == 0
+            ):
                 try:
                     self._offload_q.put_nowait((hashes, arr))
+                    self._offload_inflight_blocks += len(bids)
                     queued = True
                 except queue.Full:
                     pass  # backpressure: the staging arrays pin HBM
         if not queued:
-            # closed or full — store synchronously so no batch is lost
+            # closed, full, or over the block budget — store synchronously
+            # so no batch is lost and no further HBM is pinned
             self._store_offload_batch(hashes, arr)
 
     def _store_offload_batch(self, hashes: list[int], arr) -> None:
@@ -1720,6 +1733,13 @@ class EngineCore:
             except Exception:  # pragma: no cover - keep the tier alive
                 log.exception("async KV offload store failed")
             finally:
+                if item is not None:
+                    # the snapshot's HBM is released whether or not the
+                    # store succeeded — retire its blocks from the
+                    # backpressure budget even on failure, else the
+                    # budget leaks and degrades every later store to sync
+                    with self._offload_lock:
+                        self._offload_inflight_blocks -= len(item[0])
                 self._offload_q.task_done()
 
     def flush_host_offload(self) -> None:

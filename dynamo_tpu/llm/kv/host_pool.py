@@ -19,12 +19,15 @@ thread calls ``store`` while the engine loop calls
 
 from __future__ import annotations
 
+import logging
 from collections import OrderedDict, deque
 from typing import Optional, Sequence
 
 import numpy as np
 
 from dynamo_tpu import native
+
+log = logging.getLogger("dynamo_tpu.kv.host_pool")
 
 __all__ = ["HostKvPool"]
 
@@ -54,6 +57,7 @@ class HostKvPool:
         self.stored_blocks = 0
         self.restored_blocks = 0
         self.evicted_blocks = 0
+        self.dropped_blocks = 0  # capacity-cap truncations (see reserve)
 
     # ------------------------------------------------------------------ state
     @property
@@ -136,6 +140,16 @@ class HostKvPool:
             if h in seen:
                 continue
             if len(hids) >= cap:
+                # keeping the drop visible: an undersized num_host_blocks
+                # otherwise shows up only as a mysteriously low hit rate
+                dropped = len(
+                    {x for x in seq_hashes[i:]
+                     if x not in seen and x not in self._table})
+                self.dropped_blocks += dropped
+                log.warning(
+                    "host pool full: dropped %d of %d blocks from a store "
+                    "batch (num_host_blocks=%d undersized?)",
+                    dropped, len(seq_hashes), self.num_blocks)
                 break
             seen.add(h)
             hids.append(self._alloc())
@@ -235,4 +249,5 @@ class HostKvPool:
             "host_blocks_stored": self.stored_blocks,
             "host_blocks_restored": self.restored_blocks,
             "host_blocks_evicted": self.evicted_blocks,
+            "host_blocks_dropped": self.dropped_blocks,
         }

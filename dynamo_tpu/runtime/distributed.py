@@ -181,7 +181,11 @@ class Client(AsyncEngine):
         self._rr = 0
         self._watch_id: Optional[int] = None
         self._changed = asyncio.Event()
-        self._removed: set[int] = set()  # seen-then-deleted instance ids
+        # seen-then-deleted instance ids, insertion-ordered so the churn
+        # bound evicts OLDEST-first (an arbitrary set.pop() could evict a
+        # recently-dead id, handing it back the discovery grace window
+        # and re-adding the failover latency the no-grace rule avoids)
+        self._removed: dict[int, None] = {}
         self._retiring: set[tuple] = set()  # (conn, drain task) pairs
 
     async def start(self) -> None:
@@ -212,9 +216,10 @@ class Client(AsyncEngine):
         elif event == "delete":
             iid = int(key.rsplit("/", 1)[-1], 16)
             self._instances.pop(iid, None)
-            self._removed.add(iid)
+            self._removed.pop(iid, None)  # re-death refreshes recency
+            self._removed[iid] = None
             while len(self._removed) > 1024:  # bound long-lived churn
-                self._removed.pop()
+                del self._removed[next(iter(self._removed))]
             conn = self._conns.pop(iid, None)
             if conn:
                 # retire, don't kill: the delete may be a false positive
@@ -239,7 +244,7 @@ class Client(AsyncEngine):
             metadata=info.get("metadata"),
         )
         self._instances[inst.instance_id] = inst
-        self._removed.discard(inst.instance_id)
+        self._removed.pop(inst.instance_id, None)
 
     def instance_ids(self) -> list[int]:
         return sorted(self._instances)

@@ -706,7 +706,10 @@ class CoordinatorClient:
         self._sub_by_srv: dict[int, int] = {}         # live sub_id -> handle
         self._lease_srv: dict[int, int] = {}          # handle -> live lease_id
         self._lease_reg: dict[int, float] = {}        # handle -> ttl
-        self._leased_kv: dict[str, tuple[Any, int]] = {}  # key -> (value, lease handle)
+        # key -> (value, lease handle, create-exclusive): the flag records
+        # kv_create-established keys so heals re-acquire with kv_create
+        # (never silently overwriting a new owner's claim)
+        self._leased_kv: dict[str, tuple[Any, int, bool]] = {}
         self._reconnect_task: Optional[asyncio.Task] = None
         self._heal_lock = asyncio.Lock()  # serializes expired-lease heals
         self._reconnecting = False
@@ -843,13 +846,41 @@ class CoordinatorClient:
         for handle, ttl in list(self._lease_reg.items()):
             resp, _ = await self._call({"op": "lease_create", "ttl": ttl}, _internal=True)
             self._lease_srv[handle] = resp["lease_id"]
-        for key, (value, lease_handle) in list(self._leased_kv.items()):
+        for key, (value, lease_handle, created) in list(self._leased_kv.items()):
             live = self._lease_srv.get(lease_handle)
             if live is None:
                 continue  # lease was revoked — never resurrect the key
-            await self._call({
-                "op": "kv_put", "key": key, "value": value, "lease_id": live,
-            }, _internal=True)
+            if created:
+                # same race as the connected-expiry heal: the outage may
+                # have outlived the lease TTL, and another process may
+                # have legitimately claimed the key since — re-acquire
+                # with create-exclusivity.  On conflict, an existing key
+                # holding OUR value is the brief-drop case (the server
+                # kept our old binding; its old lease will expire) — take
+                # it over by rebinding to the fresh lease.  A different
+                # value is a new owner: cede.
+                resp, _ = await self._call({
+                    "op": "kv_create", "key": key, "value": value,
+                    "lease_id": live,
+                }, _internal=True)
+                if not resp.get("ok"):
+                    cur, _ = await self._call(
+                        {"op": "kv_get", "key": key}, _internal=True)
+                    if cur.get("ok") and cur.get("value") == value:
+                        await self._call({
+                            "op": "kv_put", "key": key, "value": value,
+                            "lease_id": live,
+                        }, _internal=True)
+                    else:
+                        log.warning(
+                            "reconnect: key %s was claimed by another "
+                            "owner during the outage; ceding it", key)
+                        del self._leased_kv[key]
+            else:
+                await self._call({
+                    "op": "kv_put", "key": key, "value": value,
+                    "lease_id": live,
+                }, _internal=True)
 
     async def _call(self, header: dict, payload: bytes = b"",
                     _internal: bool = False) -> tuple[dict, bytes]:
@@ -922,14 +953,17 @@ class CoordinatorClient:
         await self._lease_call(
             {"op": "kv_put", "key": key, "value": value}, lease_id)
         if lease_id and self.reconnect:
-            self._leased_kv[key] = (value, lease_id)
+            # a value update must not erase the key's create-exclusive
+            # ownership record — heals would revert to blind overwrite
+            prev = self._leased_kv.get(key)
+            self._leased_kv[key] = (value, lease_id, bool(prev and prev[2]))
 
     async def kv_create(self, key: str, value: Any, lease_id: Optional[int] = None) -> bool:
         resp, _ = await self._lease_call(
             {"op": "kv_create", "key": key, "value": value}, lease_id)
         ok = bool(resp.get("ok"))
         if ok and lease_id and self.reconnect:
-            self._leased_kv[key] = (value, lease_id)
+            self._leased_kv[key] = (value, lease_id, True)
         return ok
 
     async def kv_create_or_validate(self, key: str, value: Any) -> bool:
@@ -1028,8 +1062,25 @@ class CoordinatorClient:
                 "lease %x expired while connected; healed as %x and re-putting keys",
                 handle, live,
             )
-            for key, (value, lh) in list(self._leased_kv.items()):
-                if lh == handle:
+            for key, (value, lh, created) in list(self._leased_kv.items()):
+                if lh != handle:
+                    continue
+                if created:
+                    # the server-side expiry DELETED the key, so another
+                    # process may have legitimately claimed it since —
+                    # re-acquire with create-exclusivity and cede on
+                    # conflict instead of silently overwriting the new
+                    # owner's value and rebinding it to the healed lease
+                    resp, _ = await self._call({
+                        "op": "kv_create", "key": key, "value": value,
+                        "lease_id": live,
+                    })
+                    if not resp.get("ok"):
+                        log.warning(
+                            "heal: key %s was claimed by another owner "
+                            "during lease expiry; ceding it", key)
+                        del self._leased_kv[key]
+                else:
                     await self._call({
                         "op": "kv_put", "key": key, "value": value,
                         "lease_id": live,
@@ -1047,7 +1098,7 @@ class CoordinatorClient:
             t.cancel()
         self._lease_reg.pop(lease_id, None)
         # revoked keys must not resurrect through post-reconnect re-puts
-        for key in [k for k, (_, lh) in self._leased_kv.items() if lh == lease_id]:
+        for key in [k for k, v in self._leased_kv.items() if v[1] == lease_id]:
             del self._leased_kv[key]
         live = self._lease_srv.pop(lease_id, lease_id)
         await self._call({"op": "lease_revoke", "lease_id": live})

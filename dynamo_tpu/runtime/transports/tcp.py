@@ -257,6 +257,12 @@ class EndpointTcpClient(AsyncEngine):
             )
         except BaseException:
             self._streams.pop(req_id, None)
+            if not self._streams:
+                # mirror the finally-block bookkeeping: without this a
+                # failed send on the only in-flight stream leaves _idle
+                # cleared and close_when_idle() on a retiring connection
+                # waits out its full timeout on an actually-idle client
+                self._idle.set()
             raise
         cancel_task = asyncio.ensure_future(request.stopped())
         try:

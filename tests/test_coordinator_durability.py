@@ -178,6 +178,133 @@ def test_lease_transitions_do_not_resurrect(tmp_path):
     run(go())
 
 
+def test_heal_cedes_create_exclusive_key_to_new_owner(tmp_path):
+    """A kv_create-established key whose lease expired server-side may
+    have been legitimately claimed by another process before the heal
+    runs — the heal must re-acquire with create-exclusivity and CEDE on
+    conflict, never silently overwrite the new owner's value (while
+    plain kv_put keys still re-put unconditionally)."""
+    async def go():
+        srv = await CoordinatorServer().start()
+        a = await CoordinatorClient(srv.url, reconnect=True).connect()
+        lease = await a.lease_create(ttl=30)
+        assert await a.kv_create("svc/leader", "A", lease_id=lease)
+        await a.kv_put("svc/info", "a-info", lease_id=lease)
+
+        # server-side expiry: revoke through a raw second client so A's
+        # bookkeeping still believes the lease (and its keys) are live
+        raw = await CoordinatorClient(srv.url).connect()
+        await raw._call({"op": "lease_revoke", "lease_id": lease})
+        assert await raw.kv_get("svc/leader") is None
+
+        # another process claims leadership in the expiry window
+        b = await CoordinatorClient(srv.url, reconnect=True).connect()
+        lease_b = await b.lease_create(ttl=30)
+        assert await b.kv_create("svc/leader", "B", lease_id=lease_b)
+
+        await a._heal_expired_lease(lease, 30.0)
+        # the create-exclusive key ceded to B; the put key healed back
+        assert await raw.kv_get("svc/leader") == "B"
+        assert await raw.kv_get("svc/info") == "a-info"
+        assert "svc/leader" not in a._leased_kv  # no re-put on reconnect
+
+        for c in (a, b, raw):
+            await c.close()
+        await srv.stop()
+
+    run(go())
+
+
+def test_heal_reacquires_create_exclusive_key_when_unclaimed(tmp_path):
+    """The common heal case: nobody claimed the expired key, so the
+    create-exclusive re-acquire succeeds and the key stays bound."""
+    async def go():
+        srv = await CoordinatorServer().start()
+        a = await CoordinatorClient(srv.url, reconnect=True).connect()
+        lease = await a.lease_create(ttl=30)
+        assert await a.kv_create("svc/leader", "A", lease_id=lease)
+        raw = await CoordinatorClient(srv.url).connect()
+        await raw._call({"op": "lease_revoke", "lease_id": lease})
+        await a._heal_expired_lease(lease, 30.0)
+        assert await raw.kv_get("svc/leader") == "A"
+        assert "svc/leader" in a._leased_kv
+        await a.close()
+        await raw.close()
+        await srv.stop()
+
+    run(go())
+
+
+def test_reregister_cedes_created_key_to_new_owner(tmp_path):
+    """The reconnect path has the same ownership race as the heal path:
+    if the outage outlived the lease TTL and another process claimed a
+    kv_create-established key, re-registration must cede, not overwrite."""
+    async def go():
+        srv = await CoordinatorServer().start()
+        a = await CoordinatorClient(srv.url, reconnect=True).connect()
+        lease = await a.lease_create(ttl=30)
+        assert await a.kv_create("svc/leader", "A", lease_id=lease)
+        raw = await CoordinatorClient(srv.url).connect()
+        await raw._call({"op": "lease_revoke", "lease_id": lease})
+        b = await CoordinatorClient(srv.url, reconnect=True).connect()
+        lb = await b.lease_create(ttl=30)
+        assert await b.kv_create("svc/leader", "B", lease_id=lb)
+        await a._reregister()
+        assert await raw.kv_get("svc/leader") == "B"
+        assert "svc/leader" not in a._leased_kv
+        for c in (a, b, raw):
+            await c.close()
+        await srv.stop()
+
+    run(go())
+
+
+def test_reregister_takes_over_own_stale_created_key(tmp_path):
+    """Brief-drop case: the server still holds OUR old binding (same
+    value) under the soon-to-expire old lease — re-registration rebinds
+    it to the fresh lease instead of wrongly ceding our own key."""
+    async def go():
+        srv = await CoordinatorServer().start()
+        a = await CoordinatorClient(srv.url, reconnect=True).connect()
+        lease = await a.lease_create(ttl=30)
+        assert await a.kv_create("svc/leader", "A", lease_id=lease)
+        await a._reregister()  # old key still present with our value
+        raw = await CoordinatorClient(srv.url).connect()
+        assert await raw.kv_get("svc/leader") == "A"
+        assert "svc/leader" in a._leased_kv
+        await a.close()
+        await raw.close()
+        await srv.stop()
+
+    run(go())
+
+
+def test_kv_put_update_preserves_create_exclusivity(tmp_path):
+    """Updating a kv_create-established key's value with kv_put must not
+    erase its ownership record — a later heal would otherwise blindly
+    overwrite a new owner."""
+    async def go():
+        srv = await CoordinatorServer().start()
+        a = await CoordinatorClient(srv.url, reconnect=True).connect()
+        lease = await a.lease_create(ttl=30)
+        assert await a.kv_create("svc/leader", "A-v1", lease_id=lease)
+        await a.kv_put("svc/leader", "A-v2", lease_id=lease)
+        assert a._leased_kv["svc/leader"][2] is True
+        # expiry + rival claim: the heal must still cede
+        raw = await CoordinatorClient(srv.url).connect()
+        await raw._call({"op": "lease_revoke", "lease_id": lease})
+        b = await CoordinatorClient(srv.url, reconnect=True).connect()
+        lb = await b.lease_create(ttl=30)
+        assert await b.kv_create("svc/leader", "B", lease_id=lb)
+        await a._heal_expired_lease(lease, 30.0)
+        assert await raw.kv_get("svc/leader") == "B"
+        for c in (a, b, raw):
+            await c.close()
+        await srv.stop()
+
+    run(go())
+
+
 def test_calls_fail_fast_while_disconnected(tmp_path):
     async def go():
         srv = await CoordinatorServer().start()

@@ -112,6 +112,10 @@ def test_pool_overflow_batch_keeps_prefix_and_pool_sane():
     stored = pool.store(hashes, _blocks(6))
     assert stored == 4
     assert pool.match_prefix(hashes) == hashes[:4]
+    # the truncation is visible, not silent: an undersized pool must not
+    # masquerade as a mysteriously low hit rate
+    assert pool.dropped_blocks == 2
+    assert pool.stats()["host_blocks_dropped"] == 2
     # pool still works: store more (evicts LRU), then restore
     assert pool.store([200], _blocks(1)) == 1
     assert pool.gather([200]) is not None
@@ -129,6 +133,31 @@ def test_pool_abort_returns_capacity():
     assert len(hids) == 2
     pool.abort(hids)
     assert pool.store([3, 4], _blocks(2)) == 2  # capacity intact
+
+
+def test_offload_block_budget_falls_back_to_sync(setup):  # noqa: F811
+    """With the async-offload HBM budget forced to one block, eviction
+    bursts exceed it immediately and stores take the synchronous path —
+    nothing is lost and restores still work (the budget bounds pinned
+    HBM, never correctness)."""
+    hf, model, params = setup
+    rng = np.random.RandomState(11)
+    prompt = list(rng.randint(1, 128, size=24))
+    cfg = EngineConfig(
+        max_batch_size=2, max_model_len=64, block_size=8, num_blocks=8,
+        num_host_blocks=32, prefill_buckets=[16, 32, 64],
+        offload_inflight_blocks=1,
+    )
+    core = EngineCore(model, params, cfg)
+    got1, _, _ = collect_greedy(core, prompt, 6, request_id="a")
+    for i in range(4):
+        other = list(rng.randint(1, 128, size=24))
+        collect_greedy(core, other, 2, request_id=f"churn{i}")
+    core.flush_host_offload()
+    assert core.host_pool.stored_blocks > 0
+    assert core._offload_inflight_blocks == 0  # budget fully retired
+    got2, _, req2 = collect_greedy(core, prompt, 6, request_id="b")
+    assert req2.cached_tokens > 0 and got2 == got1
 
 
 def test_engine_close_stops_offload_thread(setup):  # noqa: F811
