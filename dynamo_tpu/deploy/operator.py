@@ -21,10 +21,19 @@ With a coordinator connection the operator goes beyond the reference's
 controller: per-deployment ``status`` phases are derived from LIVE worker
 registrations (the dyn:// endpoint each service's command names —
 Pending/Degraded/Ready, Unknown when unobservable), and services with an
-``autoscale`` block scale on remote-prefill queue depth (planner-lite;
-the reference only documents its Planner, docs/architecture.md:47):
-replicas level toward ceil(depth / target_per_replica) within [min, max],
-up immediately, down one step per tick.
+``autoscale`` block scale on one of two signals (planner-lite; the
+reference only documents its Planner, docs/architecture.md:47):
+
+  * ``signal: queue`` (default) — remote-prefill queue depth: replicas
+    level toward ceil(depth / target_per_replica).
+  * ``signal: decode`` — decode-side saturation from the live metrics
+    plane ({ns}.kv_metrics.*, the same ForwardPassMetrics the KV router
+    schedules on): per-worker max(slot usage, KV-block usage) averaged
+    over the service's registered workers, levelled toward
+    ``target_usage`` (default 0.7) with the HPA-style formula
+    ceil(replicas × usage / target).
+
+Both clamp to [min, max], scale up immediately, down one step per tick.
 """
 
 from __future__ import annotations
@@ -37,6 +46,7 @@ import logging
 import math
 import re
 import subprocess
+import time
 from pathlib import Path
 from typing import Optional, Protocol
 
@@ -226,6 +236,12 @@ class Operator:
         # by observe(); None until the first successful observation
         self.live: Optional[dict[tuple[str, str], int]] = None
         self.queue_depth: dict[tuple[str, str], int] = {}
+        # decode-saturation signal: last ForwardPassMetrics per namespace
+        # per worker id (fed by a lazy {ns}.kv_metrics.* subscription) and
+        # the usage number each decode-autoscaled service last levelled on
+        self._metrics: dict[str, dict[int, dict]] = {}
+        self._metric_subs: dict[str, int] = {}
+        self.decode_usage: dict[tuple[str, str], float] = {}
         # autoscale bookkeeping: the operator's current replica decision
         # and the SPEC FILE's declared replicas per autoscaled service.
         # load_dir re-parses files every tick — without re-applying the
@@ -414,10 +430,73 @@ class Operator:
                 log.exception("status patch for %s/%s failed", ns, cr_name)
 
     # ------------------------------------------------------------ observation
+    async def _ensure_metrics_sub(self, ns: str) -> None:
+        """Lazily subscribe to a namespace's ForwardPassMetrics subject
+        the first time a decode-autoscaled service names it.  The
+        coordinator duck needs ``subscribe`` for this signal (the real
+        client has it); without it the signal degrades to hold."""
+        if ns in self._metric_subs or not hasattr(self.coordinator, "subscribe"):
+            return
+        from dynamo_tpu.llm.kv_router.publisher import metrics_subject
+
+        store = self._metrics.setdefault(ns, {})
+
+        def on_metrics(subject: str, payload: bytes) -> None:
+            try:
+                d = json.loads(payload)
+                d["_rx"] = time.monotonic()
+                store[int(d["worker_id"])] = d
+            except Exception:
+                log.exception("bad kv_metrics payload on %s", subject)
+
+        self._metric_subs[ns] = await self.coordinator.subscribe(
+            metrics_subject(ns), on_metrics
+        )
+
+    def _decode_want(self, ns: str, insts: dict, svc: ServiceSpec,
+                     auto: dict, lo: int, hi: int):
+        """(want, usage) from decode-side saturation: per registered
+        worker, max(active-slot usage, KV-block usage) from its latest
+        fresh ForwardPassMetrics, averaged over the service's workers,
+        levelled with the HPA formula ceil(reporting × usage / target) —
+        the multiplier is the REPORTING worker count, not the desired
+        replicas: during a scale-up the new pods haven't registered yet,
+        and multiplying by the desired count would compound the same
+        saturation into max within two ticks.  No fresh metrics → hold
+        at the clamped current value (scaling on silence would act on
+        absence of evidence, but [min, max] edits still apply)."""
+        target = max(1e-3, float(auto.get("target_usage", 0.7)))
+        stale = float(auto.get("stale_after_s", 15.0))
+        now = time.monotonic()
+        ids = []
+        for k in insts:
+            try:
+                ids.append(int(k.rsplit("/", 1)[-1], 16))
+            except ValueError:
+                continue
+        store = self._metrics.get(ns, {})
+        usages = []
+        for wid in ids:
+            m = store.get(wid)
+            if not m or now - m.get("_rx", 0.0) > stale:
+                continue
+            slot = m.get("request_active_slots", 0) / max(
+                m.get("request_total_slots", 1), 1)
+            kv = m.get("kv_active_blocks", 0) / max(
+                m.get("kv_total_blocks", 1), 1)
+            usages.append(max(slot, kv))
+        if not usages:
+            return min(hi, max(lo, svc.replicas)), None
+        usage = sum(usages) / len(usages)
+        want = min(hi, max(lo, math.ceil(len(usages) * usage / target)))
+        return want, usage
+
     async def observe(self) -> None:
-        """Refresh live worker counts and queue depths from the
-        coordinator, and level autoscaled services' replicas toward
-        ceil(depth / target_per_replica) within [min, max].
+        """Refresh live worker counts and autoscale signals from the
+        coordinator, and level autoscaled services' replicas toward the
+        signal's target within [min, max] — queue depth for prefill
+        (``signal: queue``, the default), slot/KV saturation for decode
+        (``signal: decode``).
 
         Scale-up jumps straight to the target (queued work is latency);
         scale-down steps one replica per tick (cheap hysteresis — a
@@ -428,7 +507,9 @@ class Operator:
             return
         live: dict[tuple[str, str], int] = {}
         depths: dict[tuple[str, str], int] = {}
+        usages: dict[tuple[str, str], float] = {}
         scale: dict[tuple[str, str], int] = {}
+        decode_ns: set[str] = set()
         for dep, spec in list(self.specs.items()):
             for svc in spec.services:
                 target = _dyn_target(svc)
@@ -442,9 +523,6 @@ class Operator:
                 if not auto:
                     continue
                 key = (dep, svc.name)
-                queue = auto.get("queue") or f"{ns}_prefill_queue"
-                depth = await self.coordinator.queue_len(queue)
-                depths[key] = depth
                 lo = int(auto.get("min", 1))
                 # default cap = the spec FILE's declared replicas — never
                 # the live (possibly scaled-down) value, which would
@@ -452,18 +530,49 @@ class Operator:
                 hi = int(auto.get(
                     "max", max(self._declared.get(key, svc.replicas), lo)
                 ))
-                per = max(1, int(auto.get("target_per_replica", 4)))
-                want = min(hi, max(lo, math.ceil(depth / per)))
+                if str(auto.get("signal", "queue")) == "decode":
+                    decode_ns.add(ns)
+                    await self._ensure_metrics_sub(ns)
+                    want, usage = self._decode_want(ns, insts, svc, auto,
+                                                    lo, hi)
+                    if usage is not None:
+                        usages[key] = round(usage, 3)
+                    detail = f"usage={usage and round(usage, 3)}"
+                else:
+                    queue = auto.get("queue") or f"{ns}_prefill_queue"
+                    depth = await self.coordinator.queue_len(queue)
+                    depths[key] = depth
+                    per = max(1, int(auto.get("target_per_replica", 4)))
+                    want = min(hi, max(lo, math.ceil(depth / per)))
+                    detail = f"queue={depth}"
                 if want != svc.replicas:
                     new = want if want > svc.replicas else svc.replicas - 1
-                    log.info("autoscale %s/%s: queue=%d -> replicas %d -> %d",
-                             dep, svc.name, depth, svc.replicas, new)
+                    log.info("autoscale %s/%s: %s -> replicas %d -> %d",
+                             dep, svc.name, detail, svc.replicas, new)
                     svc.replicas = new
                 scale[key] = svc.replicas
         # fresh maps each pass: deleted deployments / removed autoscale
-        # blocks must not leave stale depths or decisions behind
+        # blocks must not leave stale depths or decisions behind.  The
+        # metrics plumbing follows the same rule: subscriptions for
+        # namespaces no decode-autoscaled service names any more are
+        # dropped, and departed workers' stored metrics are evicted once
+        # well past any plausible staleness window.
+        for ns in [n for n in self._metric_subs if n not in decode_ns]:
+            sub = self._metric_subs.pop(ns)
+            self._metrics.pop(ns, None)
+            if hasattr(self.coordinator, "unsubscribe"):
+                try:
+                    await self.coordinator.unsubscribe(sub)
+                except Exception:
+                    log.warning("unsubscribe %s failed", ns, exc_info=True)
+        now = time.monotonic()
+        for store in self._metrics.values():
+            for wid in [w for w, m in store.items()
+                        if now - m.get("_rx", 0.0) > 120.0]:
+                del store[wid]
         self.live = live
         self.queue_depth = depths
+        self.decode_usage = usages
         self._scale = scale
         self._declared = {
             k: v for k, v in self._declared.items() if k in scale
@@ -548,6 +657,9 @@ class Operator:
             qd = {s: d for (n, s), d in self.queue_depth.items() if n == name}
             if qd:
                 st["queue_depth"] = qd
+            du = {s: u for (n, s), u in self.decode_usage.items() if n == name}
+            if du:
+                st["decode_usage"] = du
             self.status[name] = st
         return summary
 

@@ -311,31 +311,53 @@ class DeepseekModel:
         }
 
     def cache_spec(self, quant: bool = False):
-        if quant:
-            raise NotImplementedError("int8 KV for MLA is not wired yet")
         if self.config.attn_impl == "absorbed":
-            # ONE shared latent row per token: nothing head-sharded to
-            # split — the latent replicates (it is tiny: kv_lora+rope)
-            return P(None, None, None, None, None)
-        return P(None, None, None, None, "model")
+            # ONE shared latent row per token (num_kv_heads == 1):
+            # nothing head-sharded to split — the latent replicates (it
+            # is tiny: kv_lora+rope), and so does its one-scale-per-token
+            # pool
+            data = P(None, None, None, None, None)
+            scale_head = None
+        else:
+            data = P(None, None, None, None, "model")
+            # scale-pool head axis shards only when tile-exact (see
+            # LlamaModel.cache_spec for the padded-axis rationale)
+            scale_head = ("model" if self.config.num_kv_heads % 8 == 0
+                          else None)
+        if not quant:
+            return data
+        from dynamo_tpu.ops.kv_quant import QuantKvCache
+
+        return QuantKvCache(data, P(None, None, None, scale_head, None))
 
     # --------------------------------------------------------------- kv cache
     def init_kv_cache(self, num_blocks: int, block_size: int, dtype=None):
         cfg = self.config
-        if dtype is not None and str(dtype) not in (str(cfg.jax_dtype),
-                                                    cfg.dtype):
-            raise NotImplementedError("MLA cache dtype override (int8)")
-        if cfg.attn_impl == "absorbed":
-            # the MLA memory win: per token a kv_lora+rope row (stored in
-            # both K/V planes of the generic pool — still ~43x smaller
-            # than the expanded form at V2's 128 heads)
-            width = cfg.kv_lora_rank + cfg.qk_rope_head_dim
-        else:
-            width = cfg.num_heads * cfg.qk_head_dim
-        return jnp.zeros(
-            (cfg.num_layers, num_blocks, 2, block_size, width),
-            cfg.jax_dtype,
-        )
+        # the engine-facing num_kv_heads/head_dim properties encode the
+        # two cache forms: absorbed = ONE latent row of kv_lora+rope per
+        # token (still ~43x smaller than expanded at V2's 128 heads),
+        # expanded = per-head rows of qk_head_dim (V padded up to it)
+        hk = cfg.num_kv_heads
+        width = hk * cfg.head_dim
+        shape = (cfg.num_layers, num_blocks, 2, block_size, width)
+        dt = dtype or cfg.jax_dtype
+        if str(dt) in ("int8", "<dtype: int8>") or dt == jnp.int8:
+            # int8 on top of the latent cache is what fits real DeepSeek
+            # shapes on 16GiB chips: same QuantKvCache layout as the GQA
+            # models (per-token-per-head scales; ONE scale/token for the
+            # absorbed latent), transparently handled by the write and
+            # attention paths (ops/kv_quant.py)
+            from dynamo_tpu.ops.kv_quant import QuantKvCache, scale_tile
+
+            hp, sp = scale_tile(hk, block_size)
+            return QuantKvCache(
+                jnp.zeros(shape, jnp.int8),
+                jnp.ones((cfg.num_layers, num_blocks, 2, hp, sp),
+                         jnp.float32),
+            )
+        if str(dt) not in (str(cfg.jax_dtype), cfg.dtype):
+            raise NotImplementedError(f"MLA cache dtype {dt!r}")
+        return jnp.zeros(shape, cfg.jax_dtype)
 
     # ---------------------------------------------------------------- forward
     def _qkv_latent(self, lp, x, positions):

@@ -1,0 +1,161 @@
+"""A fake ``kubectl`` speaking exactly the verbs the operator's
+subprocess adapters use (deploy/operator.py KubectlCluster +
+KubectlCrSource), against a JSON state file — the envtest analogue
+(reference: deploy/dynamo/operator/internal/controller/suite_test.go):
+the real adapters run end-to-end, only the apiserver is simulated.
+
+Verbs:
+  apply -f -                                  (YAML on stdin; assigns uid)
+  delete <kind> <name> -n <ns> [--ignore-not-found]
+  get <kinds-csv> --all-namespaces -o json
+  patch <kind> <name> -n <ns> --subresource=status --type=merge -p <json>
+
+State file path comes from $FAKE_KUBECTL_STATE.  $FAKE_KUBECTL_DOWN=1
+simulates an unreachable apiserver (nonzero exit, connection-refused
+stderr) for outage-path tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import yaml
+
+# kubectl resource-name aliases → stored kind
+KINDS = {
+    "deployment": "Deployment", "deployments": "Deployment",
+    "service": "Service", "services": "Service",
+    "configmap": "ConfigMap", "configmaps": "ConfigMap",
+    "dynamotpudeployment.dynamo-tpu.dev": "DynamoTpuDeployment",
+    "dynamotpudeployments.dynamo-tpu.dev": "DynamoTpuDeployment",
+    "dynamotpudeployment": "DynamoTpuDeployment",
+    "dynamotpudeployments": "DynamoTpuDeployment",
+}
+
+
+def _load(path: str) -> dict:
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)
+    return {"objects": {}, "uid_counter": 0}
+
+
+def _save(path: str, state: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1)
+    os.replace(tmp, path)
+
+
+def _key(kind: str, ns: str, name: str) -> str:
+    return f"{kind}|{ns}|{name}"
+
+
+def _merge(dst, patch):
+    """RFC 7386 JSON merge patch: None deletes, dicts recurse."""
+    if not isinstance(patch, dict) or not isinstance(dst, dict):
+        return patch
+    out = dict(dst)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = _merge(out.get(k), v)
+    return out
+
+
+def main(argv: list[str]) -> int:
+    if os.environ.get("FAKE_KUBECTL_DOWN"):
+        print("The connection to the server 127.0.0.1:6443 was refused - "
+              "did you specify the right host or port?", file=sys.stderr)
+        return 1
+    state_path = os.environ["FAKE_KUBECTL_STATE"]
+    state = _load(state_path)
+    objs = state["objects"]
+
+    # strip global flags the adapters may pass
+    args = []
+    skip = False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a == "--context":
+            skip = True
+            continue
+        args.append(a)
+
+    verb = args[0]
+    if verb == "apply":
+        assert args[1:3] == ["-f", "-"], args
+        obj = yaml.safe_load(sys.stdin.read())
+        md = obj.setdefault("metadata", {})
+        md.setdefault("namespace", "default")
+        if "uid" not in md:
+            state["uid_counter"] += 1
+            md["uid"] = f"uid-{state['uid_counter']}"
+        key = _key(obj.get("kind", ""), md["namespace"], md.get("name", ""))
+        prev = objs.get(key)
+        if prev:  # apply preserves uid and status (spec-level update)
+            md["uid"] = prev.get("metadata", {}).get("uid", md["uid"])
+            if "status" in prev and "status" not in obj:
+                obj["status"] = prev["status"]
+        objs[key] = obj
+        _save(state_path, state)
+        print(f"{obj.get('kind', '').lower()}/{md.get('name')} applied")
+        return 0
+
+    if verb == "delete":
+        kind = KINDS.get(args[1].lower(), args[1])
+        name = args[2]
+        ns = "default"
+        ignore_missing = "--ignore-not-found" in args
+        if "-n" in args:
+            ns = args[args.index("-n") + 1]
+        key = _key(kind, ns, name)
+        if key not in objs and not ignore_missing:
+            print(f'Error from server (NotFound): "{name}" not found',
+                  file=sys.stderr)
+            return 1
+        objs.pop(key, None)
+        _save(state_path, state)
+        print(f"{kind.lower()}/{name} deleted")
+        return 0
+
+    if verb == "get":
+        kinds = {KINDS[k.strip().lower()] for k in args[1].split(",")}
+        assert "-o" in args and args[args.index("-o") + 1] == "json", args
+        items = [o for o in objs.values() if o.get("kind") in kinds]
+        if "--all-namespaces" not in args:
+            ns = args[args.index("-n") + 1] if "-n" in args else "default"
+            items = [o for o in items
+                     if o.get("metadata", {}).get("namespace") == ns]
+        print(json.dumps({"apiVersion": "v1", "kind": "List",
+                          "items": items}))
+        return 0
+
+    if verb == "patch":
+        kind = KINDS.get(args[1].lower(), args[1])
+        name = args[2]
+        ns = args[args.index("-n") + 1]
+        assert "--subresource=status" in args and "--type=merge" in args, args
+        patch = json.loads(args[args.index("-p") + 1])
+        key = _key(kind, ns, name)
+        if key not in objs:
+            print(f'Error from server (NotFound): "{name}" not found',
+                  file=sys.stderr)
+            return 1
+        objs[key] = _merge(objs[key], patch)
+        _save(state_path, state)
+        print(f"{kind.lower()}/{name} patched")
+        return 0
+
+    print(f"fake kubectl: unsupported verb {verb!r} (args={args})",
+          file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
