@@ -1,0 +1,117 @@
+"""Worker process for the multi-host disagg rehearsal
+(tests/test_multihost_disagg.py): joins a 2-process jax.distributed
+cluster via the coordinator rendezvous, boots its model from a
+``dyn://models/<name>`` ref (model-store pull — only the parent pushed
+files), and plays one side of a cross-process disagg graph:
+
+  * role=decode — DecodeWorker (remote-prefill router, threshold 0) served
+    as a dyn:// endpoint over the distributed runtime.
+  * role=prefill — PrefillWorker draining the namespace prefill queue; the
+    KV handoff to the decode process rides the TCP transfer plane (the
+    DCN path — different processes cannot take the in-process shortcut).
+
+NOT a pytest module (leading underscore keeps collection away)."""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dynamo_tpu.utils import force_cpu_devices
+
+LOCAL_DEVICES = int(os.environ.get("DYN_MH_LOCAL_DEVICES", "1"))
+force_cpu_devices(LOCAL_DEVICES)
+
+from dynamo_tpu.runtime.multihost import bootstrap, spec_from_env
+
+ROLE = os.environ["DYN_DISAGG_ROLE"]
+MODEL_REF = os.environ["DYN_MODEL_REF"]
+NAMESPACE = "mh"
+
+
+async def main() -> None:
+    spec = spec_from_env()
+    bootstrap(spec, timeout=60.0)
+
+    import jax
+
+    # the cluster formed: every process sees the GLOBAL device list
+    assert len(jax.devices()) == LOCAL_DEVICES * spec.num_processes, \
+        jax.devices()
+
+    from dynamo_tpu.engine import AsyncLLMEngine, EngineConfig, EngineCore
+    from dynamo_tpu.llm.disagg_router import (
+        DisaggregatedRouter,
+        DisaggRouterConf,
+    )
+    from dynamo_tpu.llm.model_store import resolve_model
+    from dynamo_tpu.llm.workers import DecodeWorker, PrefillWorker
+    from dynamo_tpu.models.loader import load_model_dir
+    from dynamo_tpu.runtime import serde
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient
+
+    serde.register_llm_types()
+    coord = await CoordinatorClient(spec.coordinator_url).connect()
+
+    # model-store boot: this process has NO local checkpoint — the pull
+    # materialises the pushed directory into this rank's isolated cache
+    model_dir = await resolve_model(
+        MODEL_REF, coord,
+        cache_dir=os.environ["DYNAMO_MODEL_CACHE"],
+    )
+    # float32 at LOAD time (matches the parent's oracle): bf16 logit
+    # near-ties would make the greedy token-equality assertion flaky
+    cfg, params = load_model_dir(model_dir, dtype="float32")
+    from dynamo_tpu.models.llama import LlamaModel
+
+    model = LlamaModel(cfg)
+    ecfg = EngineConfig(
+        max_batch_size=2, max_model_len=128, block_size=8, num_blocks=48,
+        prefill_buckets=[16, 32, 64, 128],
+    )
+    engine = AsyncLLMEngine(EngineCore(model, params, ecfg)).start()
+
+    async def wait_done() -> None:
+        while not await coord.kv_get("mh/done"):
+            await asyncio.sleep(0.1)
+
+    if ROLE == "decode":
+        worker = DecodeWorker(
+            engine, coordinator=coord, namespace=NAMESPACE,
+            router=DisaggregatedRouter(
+                DisaggRouterConf(max_local_prefill_length=0),
+                namespace=NAMESPACE,
+            ),
+        )
+        await worker.start()
+        runtime = await DistributedRuntime.connect(
+            RuntimeConfig(coordinator_url=spec.coordinator_url,
+                          lease_ttl_s=5.0))
+        ep = runtime.namespace(NAMESPACE).component("backend").endpoint(
+            "generate")
+        await ep.serve(worker)
+        print("DECODE serving", flush=True)
+        await wait_done()
+        await runtime.shutdown()
+        await worker.stop()
+        print("DECODE OK", flush=True)
+    elif ROLE == "prefill":
+        prefill = PrefillWorker(engine, coord, NAMESPACE)
+        task = asyncio.ensure_future(prefill.run())
+        print("PREFILL serving", flush=True)
+        await wait_done()
+        prefill.request_stop()
+        await task
+        print(f"PREFILL OK handled={prefill.handled}", flush=True)
+    else:  # pragma: no cover
+        raise SystemExit(f"unknown role {ROLE!r}")
+
+    engine.shutdown()
+    await coord.close()
+
+
+if __name__ == "__main__":
+    asyncio.new_event_loop().run_until_complete(main())
