@@ -99,6 +99,28 @@ def run_arm(model, params, cfg, spec_tokens: int, batch: int, steps: int,
     }
 
 
+def truncated_draft(cfg, params, n_layers: int):
+    """Self-speculative draft: the target's OWN first n layers (+ its
+    embed / final norm / lm_head) as a smaller model.  A random-weights
+    independent checkpoint would reject essentially every proposal (its
+    distribution is unrelated to the target's), so on synthetic weights
+    the truncated draft is the honest stand-in for the real deployment
+    regime — a distilled/truncated proposer that actually correlates
+    with its target (VERDICT r4 next #7).  At 8B/trunc8 the draft costs
+    ~1/4 of the target per proposed token."""
+    import dataclasses
+
+    import jax
+
+    from dynamo_tpu.models.llama import LlamaModel
+
+    dcfg = dataclasses.replace(cfg, num_layers=n_layers)
+    dparams = dict(params)
+    dparams["layers"] = jax.tree.map(lambda a: a[:n_layers],
+                                     params["layers"])
+    return LlamaModel(dcfg), dparams
+
+
 def main() -> None:
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         from dynamo_tpu.utils import force_cpu_devices
@@ -123,6 +145,17 @@ def main() -> None:
     quant = on_accel and name == "8b"
 
     cfg = ModelConfig(**MODELS[name], dtype="bfloat16" if on_accel else "float32")
+    # validate the draft depth BEFORE the (long) measurement arms run —
+    # a bad env var must not fail after 20 minutes of good work
+    draft_req = os.environ.get("DYNAMO_SPEC_DRAFT", "trunc")
+    draft_n = 0
+    if k > 0 and draft_req.startswith("trunc"):
+        draft_n = int(draft_req[5:] or max(1, cfg.num_layers // 4))
+        if not 1 <= draft_n < cfg.num_layers:
+            raise SystemExit(
+                f"DYNAMO_SPEC_DRAFT={draft_req!r}: depth must be in "
+                f"[1, {cfg.num_layers - 1}] for the {cfg.num_layers}-layer "
+                f"target")
     model = LlamaModel(cfg)
     params = model.init_params(jax.random.PRNGKey(0), quantized=quant)
     jax.block_until_ready(params)
@@ -145,6 +178,18 @@ def main() -> None:
     if k > 0 and not on_accel:
         out = run_arm(model, params, cfg, k, batch, steps, temp=0.0,
                       draft=(model, params))
+        print(json.dumps(out))
+    # REAL smaller draft: the target's first N layers as a proposer
+    # (truncN; default N = layers/4).  This is the serving-configuration
+    # number the draft==target arm deliberately isn't — acceptance is
+    # earned, not total by construction, and the draft genuinely costs
+    # less than the target.  DYNAMO_SPEC_DRAFT=none disables;
+    # DYNAMO_SPEC_DRAFT=trunc<N> picks the depth.
+    if draft_n:
+        dmodel, dparams = truncated_draft(cfg, params, draft_n)
+        out = run_arm(model, params, cfg, k, batch, steps, temp,
+                      draft=(dmodel, dparams))
+        out["arm"] = f"draft-trunc{draft_n}x{k}"
         print(json.dumps(out))
 
 

@@ -22,27 +22,41 @@ grep -qi "tpu" "$OUT/probe_backend.log" || { echo "backend down"; exit 1; }
 # 1. every kernel variant compiles+runs at 8B serving geometry
 run probe_kernels 900 python benchmarks/probe_kernels.py all 8b
 
-# 2. the scored number (8B int8, pallas kernels, TTFT phases included)
-run bench 3600 python bench.py
+# 2. the scored number FIRST (8B int8 decode banks a JSON line minutes
+#    after attach; TTFT phases + the MoE row refine it incrementally)
+run bench 5400 python bench.py
 
-# 3. decode roofline breakdown -> adjudicate perf hypotheses
+# 3. decode roofline breakdown -> adjudicate the r3 hypotheses
+#    (docs/perf_analysis_r3.md:38-65); if the int8-matmul part wins,
+#    flip DYNAMO_PALLAS_INT8_MATMUL and re-bench (step 5)
 run profile_decode 1800 python benchmarks/profile_decode.py 8b
 
-# 3b. decode-kernel geometry sweep: seqs-per-group x blocks-per-chunk
+# 4. the reference's actual benchmark recipe: HTTP-level sweep,
+#    ISL 3000 / OSL 150, concurrency 1..64 (VERDICT r4 next #9)
+run serve_bench 3600 python benchmarks/serve_bench.py --native 8b \
+    --isl 3000 --osl 150 --concurrency 1,4,16,64 --requests-per-conc 4
+
+# 5. int8 matmul A/B: dequant-in-kernel vs XLA path
+run bench_int8mm 3600 env DYNAMO_PALLAS_INT8_MATMUL=1 python bench.py
+
+# 6a. greedy spec A/B: prompt-lookup speculation on the copy workload
+#     (temp>0 on random weights degrades the n-gram arm to overhead-only)
+run bench_spec 1800 python benchmarks/bench_spec.py
+
+# 6b. REAL smaller draft (trunc8 = target's first 8 layers) at
+#     temperature 0.7: rejection-sampled acceptance + ITL (VERDICT #7)
+run bench_spec_t07 1800 env DYNAMO_SPEC_TEMP=0.7 DYNAMO_SPEC_DRAFT=trunc8 \
+    python benchmarks/bench_spec.py
+
+# 7. disagg handoff: device path vs host-staged TCP, on chip
+run bench_handoff 1800 python benchmarks/bench_handoff.py
+
+# 8. decode-kernel geometry sweep: seqs-per-group x blocks-per-chunk
 for spg in 4 8 16; do for bpc in 2 4 8; do
   run "decode_sweep_g${spg}_c${bpc}" 900 env       DYNAMO_DECODE_SEQS_PER_GROUP=$spg DYNAMO_DECODE_BLOCKS_PER_CHUNK=$bpc       python benchmarks/profile_decode.py 8b
 done; done
 
-# 3c. exact-top-k path timing (collapse-the-dual-sampler decision)
+# 9. exact-top-k path timing (collapse-the-dual-sampler decision)
 run probe_topk 600 python benchmarks/probe_kernels.py topk
-
-# 4. int8 matmul A/B: dequant-in-kernel vs XLA path
-run bench_int8mm 3600 env DYNAMO_PALLAS_INT8_MATMUL=1 python bench.py
-
-# 5. spec-decode ITL A/B on a repetitive workload
-run bench_spec 1800 python benchmarks/bench_spec.py
-
-# 6. disagg handoff: device path vs host-staged TCP, on chip
-run bench_handoff 1800 python benchmarks/bench_handoff.py
 
 echo "window done: $(date +%H:%M:%S)"
