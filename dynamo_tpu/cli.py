@@ -21,6 +21,7 @@ import argparse
 import asyncio
 import json
 import logging
+import os
 import sys
 import time
 from pathlib import Path
@@ -339,13 +340,108 @@ async def _cmd_serve(args) -> None:
     from dynamo_tpu.sdk.config import ServiceConfig
     from dynamo_tpu.sdk.serving import ServeSupervisor
 
+    graph = args.graph
+    if getattr(args, "package", None):
+        # packaged-graph deploy (the reference's bento flow): pull the
+        # archive from the api-store, verify + unpack into the cache,
+        # and serve its manifest entry with the package root importable
+        # (sys.path for the supervisor's entry load, PYTHONPATH for the
+        # worker processes it spawns)
+        manifest, src_root = await _pull_package(
+            args.package, args.api_store, args.package_cache)
+        graph = graph if graph not in (None, "-") else manifest["entry"]
+        sys.path.insert(0, str(src_root))
+        prev = os.environ.get("PYTHONPATH")
+        # no trailing separator when PYTHONPATH was unset: an empty
+        # component means cwd, which packaged deploys must not import
+        os.environ["PYTHONPATH"] = (
+            f"{src_root}{os.pathsep}{prev}" if prev else str(src_root))
+        log.info("serving package %s entry %s from %s",
+                 args.package, graph, src_root)
     config = ServiceConfig.from_yaml(args.config) if args.config else ServiceConfig()
-    sup = ServeSupervisor(args.graph, config, coordinator_url=args.coordinator)
+    sup = ServeSupervisor(graph, config, coordinator_url=args.coordinator)
     await sup.start()
     try:
         await sup.watch()
     finally:
         await sup.stop()
+
+
+# ---------------------------------------------------------------- package -----
+
+
+def _split_pkg_ref(ref: str) -> tuple[str, Optional[str]]:
+    name, _, ver = ref.partition(":")
+    return name, (ver or None)
+
+
+async def _pull_package(ref: str, api_store: str, cache_root: str):
+    """Resolve name[:version], reuse the local cache when it already
+    holds that version, else download + unpack.  Returns (manifest,
+    src_root)."""
+    from aiohttp import ClientSession
+
+    from dynamo_tpu.deploy.packaging import cache_lookup, cached_unpack
+
+    name, ver = _split_pkg_ref(ref)
+    async with ClientSession() as s:
+        if ver is None:
+            # cheap metadata GET resolves "latest" BEFORE any archive
+            # transfer, so a cache hit skips the download entirely
+            async with s.get(
+                    f"{api_store}/api/v1/packages/{name}/latest") as resp:
+                if resp.status == 404:
+                    raise SystemExit(
+                        f"package {ref!r} not found in {api_store}")
+                resp.raise_for_status()
+                ver = str((await resp.json())["version"])
+        version = int(ver)
+        hit = cache_lookup(cache_root, name, version)
+        if hit is not None:
+            return hit
+        url = f"{api_store}/api/v1/packages/{name}/{version}/archive"
+        async with s.get(url) as resp:
+            if resp.status == 404:
+                raise SystemExit(f"package {ref!r} not found in {api_store}")
+            resp.raise_for_status()
+            archive = await resp.read()
+    return cached_unpack(archive, cache_root, name, version)
+
+
+async def _cmd_package(args) -> None:
+    from dynamo_tpu.deploy.packaging import build_package, read_manifest
+
+    if args.pkg_cmd == "build":
+        manifest = build_package(args.src, args.entry, args.name, args.out)
+        print(json.dumps({"name": manifest["name"],
+                          "entry": manifest["entry"],
+                          "files": len(manifest["files"]),
+                          "out": args.out}))
+    elif args.pkg_cmd == "push":
+        from aiohttp import ClientSession
+
+        data = open(args.pkg, "rb").read()
+        read_manifest(data)  # fail client-side with a good message
+        async with ClientSession() as s:
+            async with s.post(f"{args.api_store}/api/v1/packages",
+                              data=data) as resp:
+                body = await resp.text()
+                if resp.status != 201:
+                    raise SystemExit(f"push failed ({resp.status}): {body}")
+                print(body)
+    elif args.pkg_cmd == "pull":
+        manifest, src_root = await _pull_package(
+            args.ref, args.api_store, args.out)
+        print(json.dumps({"name": manifest["name"],
+                          "entry": manifest["entry"],
+                          "src": str(src_root)}))
+    elif args.pkg_cmd == "list":
+        from aiohttp import ClientSession
+
+        async with ClientSession() as s:
+            async with s.get(f"{args.api_store}/api/v1/packages") as resp:
+                resp.raise_for_status()
+                print(json.dumps(await resp.json()))
 
 
 # ------------------------------------------------------------------- http -----
@@ -714,9 +810,41 @@ def _parser() -> argparse.ArgumentParser:
     common(run)
 
     serve = sub.add_parser("serve", help="serve a graph of @service components")
-    serve.add_argument("graph", help="module.path:EntryService")
+    serve.add_argument("graph", nargs="?", default="-",
+                       help="module.path:EntryService (optional with "
+                            "--package: defaults to the manifest entry)")
     serve.add_argument("-f", "--config", default=None, help="YAML ServiceConfig")
+    serve.add_argument("--package", default=None, metavar="NAME[:VER]",
+                       help="serve a packaged graph pulled from the api-store")
+    serve.add_argument("--api-store", default="http://127.0.0.1:7180",
+                       dest="api_store")
+    serve.add_argument("--package-cache",
+                       default=os.path.expanduser("~/.cache/dynamo_tpu/packages"),
+                       dest="package_cache")
     common(serve)
+
+    pkg = sub.add_parser("package",
+                         help="build/push/pull packaged serving graphs")
+    pkg_sub = pkg.add_subparsers(dest="pkg_cmd", required=True)
+    pb = pkg_sub.add_parser("build", help="archive a graph source tree")
+    pb.add_argument("src", help="directory of graph sources")
+    pb.add_argument("--entry", required=True,
+                    help="module:Service relative to the package root")
+    pb.add_argument("--name", required=True)
+    pb.add_argument("-o", "--out", required=True, help="output .tar.gz")
+    pp = pkg_sub.add_parser("push", help="upload a package to the api-store")
+    pp.add_argument("pkg", help="package .tar.gz")
+    pp.add_argument("--api-store", default="http://127.0.0.1:7180",
+                    dest="api_store")
+    pl = pkg_sub.add_parser("pull", help="download + unpack a package")
+    pl.add_argument("ref", help="name[:version]")
+    pl.add_argument("--api-store", default="http://127.0.0.1:7180",
+                    dest="api_store")
+    pl.add_argument("-o", "--out",
+                    default=os.path.expanduser("~/.cache/dynamo_tpu/packages"))
+    pls = pkg_sub.add_parser("list", help="list packages in the api-store")
+    pls.add_argument("--api-store", default="http://127.0.0.1:7180",
+                     dest="api_store")
 
     http = sub.add_parser("http", help="standalone OpenAI frontend w/ discovery")
     http.add_argument("--host", default="127.0.0.1")
@@ -815,7 +943,11 @@ def main(argv: Optional[list[str]] = None) -> None:
         args.inp, args.out = kv["in"], kv["out"]
         asyncio.run(_cmd_run(args))
     elif args.cmd == "serve":
+        if args.graph == "-" and not args.package:
+            raise SystemExit("serve needs a graph or --package")
         asyncio.run(_cmd_serve(args))
+    elif args.cmd == "package":
+        asyncio.run(_cmd_package(args))
     elif args.cmd == "http":
         asyncio.run(_cmd_http(args))
     elif args.cmd == "coordinator":

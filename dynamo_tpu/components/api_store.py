@@ -14,6 +14,16 @@ REST surface:
   DELETE /api/v1/graphs/{name}/{ver}
   GET    /api/v1/graphs/{name}/{ver}/manifests   rendered k8s objects (JSON list)
 
+Packaged graphs (the reference's "bento" archives — code + manifest,
+built by ``dynamo-tpu package build``, deploy/packaging.py; weights ride
+the model store instead):
+  POST   /api/v1/packages                   raw tar.gz body -> {name, version}
+  GET    /api/v1/packages                   list packages (latest each)
+  GET    /api/v1/packages/{name}            all versions (manifest metadata)
+  GET    /api/v1/packages/{name}/{ver}      manifest ("latest" ok)
+  GET    /api/v1/packages/{name}/{ver}/archive   the tar.gz bytes
+  DELETE /api/v1/packages/{name}/{ver}
+
 Run via `dynamo-tpu api-store --db graphs.db --port 7180`.
 """
 
@@ -41,6 +51,16 @@ class ApiStore:
                  version INTEGER NOT NULL,
                  spec TEXT NOT NULL,
                  labels TEXT NOT NULL DEFAULT '{}',
+                 created_at REAL NOT NULL,
+                 PRIMARY KEY (name, version)
+               )"""
+        )
+        self.db.execute(
+            """CREATE TABLE IF NOT EXISTS packages (
+                 name TEXT NOT NULL,
+                 version INTEGER NOT NULL,
+                 manifest TEXT NOT NULL,
+                 archive BLOB NOT NULL,
                  created_at REAL NOT NULL,
                  PRIMARY KEY (name, version)
                )"""
@@ -115,6 +135,78 @@ class ApiStore:
     def _to_spec(spec: dict) -> DeploymentSpec:
         return DeploymentSpec.from_yaml(yaml.safe_dump(spec))
 
+    # ------------------------------------------------------------- packages
+    def put_package(self, archive: bytes) -> tuple[str, int]:
+        """Store a package archive; name comes from its own (validated)
+        manifest.  Returns (name, version)."""
+        from dynamo_tpu.deploy.packaging import read_manifest
+
+        manifest = read_manifest(archive)  # raises PackageError if bad
+        name = manifest["name"]
+        cur = self.db.execute(
+            "SELECT COALESCE(MAX(version), 0) FROM packages WHERE name = ?",
+            (name,),
+        )
+        version = cur.fetchone()[0] + 1
+        self.db.execute(
+            "INSERT INTO packages (name, version, manifest, archive, "
+            "created_at) VALUES (?,?,?,?,?)",
+            (name, version, json.dumps(manifest), archive, time.time()),
+        )
+        self.db.commit()
+        return name, version
+
+    def list_packages(self) -> list[dict]:
+        cur = self.db.execute(
+            """SELECT name, MAX(version), created_at FROM packages
+               GROUP BY name ORDER BY name"""
+        )
+        return [
+            {"name": n, "latest_version": v, "created_at": t}
+            for n, v, t in cur.fetchall()
+        ]
+
+    def package_versions(self, name: str) -> list[dict]:
+        cur = self.db.execute(
+            "SELECT version, manifest, created_at FROM packages "
+            "WHERE name = ? ORDER BY version", (name,),
+        )
+        return [
+            {"version": v, "entry": json.loads(m).get("entry"),
+             "created_at": t}
+            for v, m, t in cur.fetchall()
+        ]
+
+    def get_package(self, name: str, version: Optional[int] = None,
+                    with_archive: bool = False) -> Optional[dict]:
+        # fetch the (potentially large) archive blob only when asked —
+        # metadata requests must not materialize it
+        cols = ("version, manifest, created_at, archive" if with_archive
+                else "version, manifest, created_at")
+        q = f"SELECT {cols} FROM packages WHERE name = ?"
+        args: tuple = (name,)
+        if version is None:
+            q += " ORDER BY version DESC LIMIT 1"
+        else:
+            q += " AND version = ?"
+            args = (name, version)
+        row = self.db.execute(q, args).fetchone()
+        if row is None:
+            return None
+        out = {"name": name, "version": row[0],
+               "manifest": json.loads(row[1]), "created_at": row[2]}
+        if with_archive:
+            out["archive"] = row[3]
+        return out
+
+    def delete_package(self, name: str, version: int) -> bool:
+        cur = self.db.execute(
+            "DELETE FROM packages WHERE name = ? AND version = ?",
+            (name, version),
+        )
+        self.db.commit()
+        return cur.rowcount > 0
+
     # ------------------------------------------------------------------ HTTP
     async def _post_graph(self, request: web.Request) -> web.Response:
         body = await request.json()
@@ -163,14 +255,80 @@ class ApiStore:
             raise web.HTTPNotFound
         return web.json_response(render_manifests(self._to_spec(g["spec"])))
 
+    # ------------------------------------------------------- packages HTTP
+    @staticmethod
+    def _ver_arg(request: web.Request) -> Optional[int]:
+        ver = request.match_info["ver"]
+        if ver == "latest":
+            return None
+        try:
+            return int(ver)
+        except ValueError:
+            raise web.HTTPBadRequest(
+                text=f"version must be an integer or 'latest', got {ver!r}"
+            ) from None
+
+    async def _post_package(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.deploy.packaging import PackageError
+
+        archive = await request.read()
+        try:
+            name, version = self.put_package(archive)
+        except PackageError as e:
+            raise web.HTTPUnprocessableEntity(text=str(e))
+        return web.json_response({"name": name, "version": version},
+                                 status=201)
+
+    async def _list_packages(self, request: web.Request) -> web.Response:
+        return web.json_response(self.list_packages())
+
+    async def _package_versions(self, request: web.Request) -> web.Response:
+        versions = self.package_versions(request.match_info["name"])
+        if not versions:
+            raise web.HTTPNotFound
+        return web.json_response(versions)
+
+    async def _get_package(self, request: web.Request) -> web.Response:
+        g = self.get_package(request.match_info["name"],
+                             self._ver_arg(request))
+        if g is None:
+            raise web.HTTPNotFound
+        return web.json_response(g)
+
+    async def _get_archive(self, request: web.Request) -> web.Response:
+        g = self.get_package(request.match_info["name"],
+                             self._ver_arg(request), with_archive=True)
+        if g is None:
+            raise web.HTTPNotFound
+        return web.Response(
+            body=g["archive"], content_type="application/gzip",
+            headers={"X-Package-Version": str(g["version"])},
+        )
+
+    async def _delete_package(self, request: web.Request) -> web.Response:
+        ver = self._ver_arg(request)
+        if ver is None:
+            raise web.HTTPBadRequest(text="delete needs an explicit version")
+        if not self.delete_package(request.match_info["name"], ver):
+            raise web.HTTPNotFound
+        return web.json_response({"deleted": True})
+
     async def start(self) -> "ApiStore":
-        app = web.Application()
+        app = web.Application(client_max_size=256 << 20)  # code archives
         app.router.add_post("/api/v1/graphs", self._post_graph)
         app.router.add_get("/api/v1/graphs", self._list)
         app.router.add_get("/api/v1/graphs/{name}", self._versions)
         app.router.add_get("/api/v1/graphs/{name}/{ver}", self._get)
         app.router.add_delete("/api/v1/graphs/{name}/{ver}", self._delete)
         app.router.add_get("/api/v1/graphs/{name}/{ver}/manifests", self._manifests)
+        app.router.add_post("/api/v1/packages", self._post_package)
+        app.router.add_get("/api/v1/packages", self._list_packages)
+        app.router.add_get("/api/v1/packages/{name}", self._package_versions)
+        app.router.add_get("/api/v1/packages/{name}/{ver}", self._get_package)
+        app.router.add_get("/api/v1/packages/{name}/{ver}/archive",
+                           self._get_archive)
+        app.router.add_delete("/api/v1/packages/{name}/{ver}",
+                              self._delete_package)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)

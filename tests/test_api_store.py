@@ -63,3 +63,193 @@ async def _roundtrip():
             assert r.status == 404
     finally:
         await store.stop()
+
+
+# ------------------------------------------------------- packaged graphs ----
+# VERDICT r4 missing #5: the reference's "bento" build/store/deploy flow.
+
+def _write_graph_tree(root):
+    """A minimal but REAL servable graph source tree (hello_world shape)."""
+    (root / "graphs").mkdir(parents=True)
+    (root / "graphs" / "__init__.py").write_text("")
+    (root / "graphs" / "hello.py").write_text('''
+from dynamo_tpu.sdk import depends, dynamo_endpoint, service
+
+
+@service(dynamo={"namespace": "pkg"})
+class Backend:
+    @dynamo_endpoint
+    async def generate(self, text: str):
+        for word in str(text).split("-"):
+            yield f"{word}!"
+
+
+@service(dynamo={"namespace": "pkg"})
+class Frontend:
+    backend = depends(Backend)
+
+    @dynamo_endpoint
+    async def generate(self, text: str):
+        async for w in self.backend.generate(str(text).upper()):
+            yield w
+''')
+    (root / "config.yaml").write_text("defaults: {}\n")
+    return root
+
+
+def test_package_build_push_pull_roundtrip(tmp_path):
+    """build -> push (validated server-side) -> list/versions -> pull the
+    archive back byte-identical; malformed uploads are rejected."""
+    from dynamo_tpu.deploy.packaging import (
+        PackageError, build_package, read_manifest, unpack_package,
+    )
+
+    src = _write_graph_tree(tmp_path / "tree")
+    pkg = tmp_path / "hello.tgz"
+    manifest = build_package(src, "graphs.hello:Frontend", "hello", pkg)
+    assert set(manifest["files"]) == {
+        "graphs/__init__.py", "graphs/hello.py", "config.yaml"}
+    assert read_manifest(pkg)["entry"] == "graphs.hello:Frontend"
+
+    # determinism: same sources -> byte-identical archives (zeroed gzip
+    # mtime + sorted members + no build timestamp in the manifest)
+    pkg2 = tmp_path / "hello2.tgz"
+    build_package(src, "graphs.hello:Frontend", "hello", pkg2)
+    assert pkg.read_bytes() == pkg2.read_bytes()
+
+    # entry must exist in the tree
+    try:
+        build_package(src, "graphs.nope:X", "hello", tmp_path / "x.tgz")
+        raise AssertionError("bad entry accepted")
+    except PackageError:
+        pass
+
+    async def go():
+        store = await ApiStore(db_path=":memory:", port=0).start()
+        base = f"http://127.0.0.1:{store.port}/api/v1"
+        try:
+            async with ClientSession() as s:
+                data = pkg.read_bytes()
+                r = await s.post(f"{base}/packages", data=data)
+                assert r.status == 201, await r.text()
+                assert await r.json() == {"name": "hello", "version": 1}
+                r = await s.post(f"{base}/packages", data=data)
+                assert (await r.json())["version"] == 2
+
+                r = await s.post(f"{base}/packages", data=b"not a tarball")
+                assert r.status == 422
+
+                r = await s.get(f"{base}/packages")
+                assert (await r.json())[0]["latest_version"] == 2
+                r = await s.get(f"{base}/packages/hello")
+                assert [v["version"] for v in await r.json()] == [1, 2]
+                r = await s.get(f"{base}/packages/hello/latest")
+                got = await r.json()
+                assert got["version"] == 2
+                assert got["manifest"]["entry"] == "graphs.hello:Frontend"
+
+                r = await s.get(f"{base}/packages/hello/1/archive")
+                assert r.status == 200
+                assert r.headers["X-Package-Version"] == "1"
+                fetched = await r.read()
+                assert fetched == data
+
+                r = await s.delete(f"{base}/packages/hello/1")
+                assert (await r.json())["deleted"] is True
+                r = await s.get(f"{base}/packages/hello/1/archive")
+                assert r.status == 404
+        finally:
+            await store.stop()
+
+        # the fetched archive unpacks verified and is importable+servable
+        manifest2, src_root = unpack_package(fetched, tmp_path / "unpacked")
+        assert (src_root / "graphs" / "hello.py").exists()
+        import sys as _sys
+
+        _sys.path.insert(0, str(src_root))
+        from dynamo_tpu.runtime.config import RuntimeConfig
+        from dynamo_tpu.runtime.transports.coordinator import CoordinatorServer
+
+        coord = await CoordinatorServer(port=0).start()
+        try:
+            import importlib
+
+            mod = importlib.import_module("graphs.hello")
+            from dynamo_tpu.sdk.serving import serve_graph
+
+            handle = await serve_graph(
+                mod.Frontend, graph="graphs.hello",
+                runtime_config=RuntimeConfig(coordinator_url=coord.url))
+            try:
+                from dynamo_tpu.runtime.engine import Context
+
+                rt = handle.runtimes[0]
+                client = await (rt.namespace("pkg").component("frontend")
+                                .endpoint("generate").client())
+                out = [w async for w in client.generate(Context("a-b"))]
+                assert out == ["A!", "B!"]
+                await client.close()
+            finally:
+                await handle.stop()
+        finally:
+            await coord.stop()
+            _sys.path.remove(str(src_root))
+            _sys.modules.pop("graphs.hello", None)
+            _sys.modules.pop("graphs", None)
+
+    asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_package_tamper_detection(tmp_path):
+    """A tampered archive (hash mismatch / traversal path) refuses to
+    unpack — packages are a code-execution surface."""
+    import io
+    import json as _json
+    import tarfile
+
+    from dynamo_tpu.deploy.packaging import (
+        PackageError, build_package, unpack_package,
+    )
+
+    src = _write_graph_tree(tmp_path / "tree")
+    pkg = tmp_path / "hello.tgz"
+    build_package(src, "graphs.hello:Frontend", "hello", pkg)
+
+    def rewrite(mutate):
+        buf = io.BytesIO()
+        with tarfile.open(pkg, "r:gz") as tin, \
+                tarfile.open(fileobj=buf, mode="w:gz") as tout:
+            for m in tin.getmembers():
+                data = tin.extractfile(m).read()
+                m2, d2 = mutate(m, data)
+                if m2 is None:
+                    continue
+                m2.size = len(d2)
+                tout.addfile(m2, io.BytesIO(d2))
+        return buf.getvalue()
+
+    # payload swap: hash check trips
+    def swap(m, data):
+        if m.name == "src/graphs/hello.py":
+            return m, b"import os  # evil"
+        return m, data
+
+    try:
+        unpack_package(rewrite(swap), tmp_path / "u1")
+        raise AssertionError("tampered payload unpacked")
+    except PackageError as e:
+        assert "hash mismatch" in str(e)
+
+    # traversal path in the manifest: rejected before any write
+    def traverse(m, data):
+        if m.name == "manifest.json":
+            mf = _json.loads(data)
+            mf["files"]["../evil.py"] = "0" * 64
+            return m, _json.dumps(mf).encode()
+        return m, data
+
+    try:
+        unpack_package(rewrite(traverse), tmp_path / "u2")
+        raise AssertionError("traversal manifest unpacked")
+    except PackageError as e:
+        assert "escapes" in str(e)
