@@ -323,12 +323,16 @@ class EngineCore:
             and "data" in mesh.axis_names
             and mesh.shape["data"] > 1
         ):
-            if not hasattr(model, "forward_seq_parallel"):
+            if not hasattr(model, "forward_seq_parallel") or not getattr(
+                    model, "supports_seq_parallel", True):
                 # fail at construction, not mid-serving on the first long
-                # prompt (e.g. the MLA family has no ring-attention path yet)
+                # prompt (Llama-family and absorbed-MLA DeepSeek have the
+                # ring path; expanded-MLA and future families without one
+                # land here — supports_seq_parallel lets a model veto SP
+                # for specific configs even though the method exists)
                 raise ValueError(
-                    f"{type(model).__name__} has no forward_seq_parallel; "
-                    "disable sp_prefill_threshold for this model"
+                    f"{type(model).__name__} does not support seq-parallel "
+                    "prefill (this config); disable sp_prefill_threshold"
                 )
             self._sp_size = mesh.shape["data"]
             self._sp_fn = jax.jit(

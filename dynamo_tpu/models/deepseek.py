@@ -424,43 +424,54 @@ class DeepseekModel:
         attn = attn[..., :vd].reshape(b, s, nh * vd)
         return h_in + attn @ lp["wo"], cache
 
-    def _attention_absorbed(self, lp, li, h_in, positions, cache,
-                            block_tables, seq_lens, slot_idx):
-        """Absorbed form (the MLA deployment shape): queries project INTO
-        the latent space through kv_b's K-half, attention runs as GQA
-        with ONE shared KV head whose row is the cached latent
-        (c_hat ‖ k_pe), and the attended latent expands per head through
-        kv_b's V-half.  Identical scores/outputs to the expanded form:
+    def _absorbed_qkv(self, lp, h_in, positions):
+        """Shared absorption front-end (paged `_attention_absorbed` AND
+        the ring `forward_seq_parallel`): queries projected INTO the
+        latent space through kv_b's K-half, and the one shared KV row.
+        Returns (q_lat [B,S,H,r+rope], row [B,S,1,r+rope], w_v).  The
+        absorption identity:
           q_nope[h]·k_nope[h] = q_nope[h]·(Wk[h]ᵀ c_hat)
-                              = (Wk[h] q_nope[h]) · c_hat.
-        Cache cost per token: the latent row (stored twice — the pool's
-        K/V planes) vs 2·H·qk_head_dim expanded."""
+                              = (Wk[h] q_nope[h]) · c_hat."""
         cfg = self.config
-        b, s = positions.shape
         nh = cfg.num_heads
-        nope, rope, vd = (cfg.qk_nope_head_dim, cfg.qk_rope_head_dim,
-                          cfg.v_head_dim)
-        r = cfg.kv_lora_rank
+        nope, vd, r = (cfg.qk_nope_head_dim, cfg.v_head_dim,
+                       cfg.kv_lora_rank)
         x = rms_norm(h_in, lp["attn_norm"], cfg.rms_norm_eps)
         q_nope, q_pe, c_hat, k_pe = self._qkv_latent(lp, x, positions)
-
         kv_b = lp["kv_b"].reshape(r, nh, nope + vd)
         w_k = kv_b[..., :nope]            # [r, H, nope]
         w_v = kv_b[..., nope:]            # [r, H, vd]
-        # absorb: q_eff[h] = Wk[h] @ q_nope[h]  -> latent-space queries
         q_eff = jnp.einsum("bshn,rhn->bshr", q_nope, w_k)
-        q_lat = jnp.concatenate([q_eff, q_pe], axis=-1)  # [B,S,H,r+rope]
-
+        q_lat = jnp.concatenate([q_eff, q_pe], axis=-1)
         row = jnp.concatenate(
             [c_hat[:, :, None, :], k_pe], axis=-1
-        )  # [B,S,1,r+rope] — the ONE shared KV row; K == V == latent
+        )  # the ONE shared KV row; K == V == latent
+        return q_lat, row, w_v
+
+    def _absorbed_out(self, lp, h_in, attn, w_v):
+        """Shared absorption back-end: expand attended latents per head
+        through kv_b's V-half and project out."""
+        cfg = self.config
+        b, s = h_in.shape[:2]
+        out = jnp.einsum("bshr,rhv->bshv",
+                         attn[..., :cfg.kv_lora_rank], w_v)
+        return h_in + out.reshape(b, s, cfg.num_heads * cfg.v_head_dim) \
+            @ lp["wo"]
+
+    def _attention_absorbed(self, lp, li, h_in, positions, cache,
+                            block_tables, seq_lens, slot_idx):
+        """Absorbed form (the MLA deployment shape): attention runs as
+        GQA with ONE shared KV head whose row is the cached latent
+        (c_hat ‖ k_pe) — see `_absorbed_qkv` for the identity.  Cache
+        cost per token: the latent row (stored twice — the pool's K/V
+        planes) vs 2·H·qk_head_dim expanded."""
+        q_lat, row, w_v = self._absorbed_qkv(lp, h_in, positions)
         cache = write_kv_cache_layer(cache, li, row, row, slot_idx)
         attn = paged_attention_layer(
             q_lat, cache, li, block_tables, seq_lens, positions,
             sm_scale=self.sm_scale,
         )  # [B,S,H,r+rope] — attended latents per head
-        out = jnp.einsum("bshr,rhv->bshv", attn[..., :r], w_v)
-        return h_in + out.reshape(b, s, nh * vd) @ lp["wo"], cache
+        return self._absorbed_out(lp, h_in, attn, w_v), cache
 
     def _moe_mlp(self, lp, x):
         """DeepSeekMoE: softmax routing (optionally group-limited) ×
@@ -537,6 +548,74 @@ class DeepseekModel:
         hidden, cache = carry
         hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
         return hidden, cache
+
+    @property
+    def supports_seq_parallel(self) -> bool:
+        """Ring-attention prefill exists only for the absorbed cache form
+        (the expanded oracle is not a deployment shape) — the engine's
+        construction-time guard reads this so an unsupported config fails
+        at startup, not on the first long prompt."""
+        return self.config.attn_impl == "absorbed"
+
+    def forward_seq_parallel(self, params, tokens, positions, mesh,
+                             sp_axis: str = "sp"):
+        """Long-context MLA prefill with ring attention (context
+        parallelism), the engine's SP path for prompts beyond one chip's
+        comfort (EngineConfig.sp_prefill_threshold).
+
+        The absorbed form is ring-friendly: each device's sequence chunk
+        computes its latent rows (c_hat ‖ k_pe) and latent-space queries;
+        attention runs as GQA with ONE shared KV head whose rows rotate
+        over ICI (ops/ring_attention.py — hq/hk=H broadcast fuses into
+        the matmuls), and the attended latent expands per head through
+        kv_b's V-half — the same absorption identity as the paged form
+        (`_attention_absorbed`), so results match it exactly.
+
+        Returns (hidden [B,S,Dm], kv [L,2,B,S,width]) with the sequence
+        sharding kept; the kv output is the latent row duplicated into
+        the generic pool's K/V planes, exactly what the engine scatters
+        into paged-cache blocks after a long prefill.
+        """
+        from dynamo_tpu.ops.ring_attention import ring_attention
+
+        cfg = self.config
+        if cfg.attn_impl != "absorbed":
+            raise NotImplementedError(
+                "seq-parallel MLA prefill needs attn_impl='absorbed' "
+                "(the expanded oracle is not a deployment shape)")
+        hidden = params["embed"][tokens].astype(cfg.jax_dtype)
+
+        def attn_sp(lp, h_in):
+            q_lat, row, w_v = self._absorbed_qkv(lp, h_in, positions)
+            attn = ring_attention(
+                q_lat, row, row, positions, positions, mesh=mesh,
+                axis=sp_axis, sm_scale=self.sm_scale,
+            )  # [B,S,H,r+rope] attended latents per head
+            return self._absorbed_out(lp, h_in, attn, w_v), row[:, :, 0]
+
+        def dense_step(h, lp):
+            h, row = attn_sp(lp, h)
+            x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+            h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) \
+                @ lp["w_down"]
+            return h, jnp.stack([row, row], axis=0)  # K == V == latent
+
+        def moe_step(h, lp):
+            h, row = attn_sp(lp, h)
+            x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+            h = h + self._moe_mlp(lp, x)
+            return h, jnp.stack([row, row], axis=0)
+
+        h = hidden
+        kvs = []
+        if cfg.first_k_dense_replace:
+            h, kv_d = jax.lax.scan(dense_step, h, params["dense_layers"])
+            kvs.append(kv_d)
+        h, kv_m = jax.lax.scan(moe_step, h, params["moe_layers"])
+        kvs.append(kv_m)
+        kv = jnp.concatenate(kvs, axis=0) if len(kvs) > 1 else kvs[0]
+        hidden = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+        return hidden, kv  # kv: [L, 2, B, S, kv_lora+rope]
 
     def compute_logits(self, params, hidden):
         w = params["lm_head"]

@@ -177,3 +177,115 @@ def test_engine_sp_prefill_matches_plain_engine():
     assert sp_eng.sp_prefills == 1
     assert len(sp_toks) == 6
     assert sp_toks == plain_toks
+
+
+def _tiny_deepseek():
+    from dynamo_tpu.models.deepseek import DeepseekConfig, DeepseekModel
+
+    cfg = DeepseekConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+        kv_lora_rank=16, intermediate_size=64, moe_intermediate_size=32,
+        n_routed_experts=4, num_experts_per_tok=2, n_shared_experts=1,
+        first_k_dense_replace=1, max_position_embeddings=512,
+        dtype="float32",
+    )
+    model = DeepseekModel(cfg)
+    return cfg, model, model.init_params(jax.random.PRNGKey(0))
+
+
+def test_deepseek_mla_seq_parallel_matches_paged(mesh):
+    """DeepSeek MLA long-context: forward_seq_parallel (ring attention
+    over the shared latent row) == the paged absorbed forward, hidden AND
+    cache contents — long MLA prefills hand their latent KV straight to
+    the paged decode path."""
+    cfg, model, params = _tiny_deepseek()
+    s, bs = 64, 8
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, s), 0, 128)
+    positions = jnp.arange(s, dtype=jnp.int32)[None, :]
+
+    hidden_sp, kv_sp = model.forward_seq_parallel(
+        params, tokens, positions, mesh)
+
+    n_blocks = s // bs
+    cache = model.init_kv_cache(num_blocks=n_blocks + 1, block_size=bs)
+    block_tables = jnp.arange(n_blocks, dtype=jnp.int32)[None, :]
+    hidden_paged, cache = model.forward(
+        params, tokens, positions, cache, block_tables,
+        jnp.asarray([s], jnp.int32), positions,
+    )
+    np.testing.assert_allclose(
+        np.asarray(hidden_sp), np.asarray(hidden_paged), rtol=2e-4,
+        atol=2e-4)
+    # kv_sp [L,2,1,S,width] vs cache blocks [L,n,2,Bs,width]
+    got = np.asarray(kv_sp).reshape(cfg.num_layers, 2, n_blocks, bs, -1)
+    got = got.transpose(0, 2, 1, 3, 4)
+    np.testing.assert_allclose(got, np.asarray(cache)[:, :n_blocks],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_deepseek_engine_sp_prefill_matches_plain_engine():
+    """Engine-level MLA SP prefill: a long DeepSeek prompt prefills in one
+    ring dispatch and greedy decode afterwards matches the plain engine."""
+    import jax
+    from jax.sharding import Mesh
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.engine.request import EngineRequest
+    from dynamo_tpu.llm.protocols import SamplingOptions, StopConditions
+
+    cfg, model, params = _tiny_deepseek()
+    mesh = Mesh(
+        np.array(jax.devices()[:4]).reshape(2, 2), ("data", "model")
+    )
+
+    def run_engine(sp_threshold):
+        ecfg = EngineConfig(
+            max_batch_size=2, max_model_len=256, block_size=16,
+            num_blocks=32, sp_prefill_threshold=sp_threshold,
+        )
+        engine = EngineCore(model, params, ecfg, mesh=mesh, eos_token_ids=[])
+        toks = []
+        engine.submit(EngineRequest(
+            request_id="sp", prompt=list(range(1, 101)),
+            sampling=SamplingOptions(temperature=0.0),
+            stops=StopConditions(max_tokens=6, ignore_eos=True),
+            emit=lambda out: toks.extend(out.token_ids),
+        ))
+        for _ in range(64):
+            if not engine.step():
+                break
+        return toks, engine
+
+    plain_toks, plain_eng = run_engine(sp_threshold=0)
+    sp_toks, sp_eng = run_engine(sp_threshold=64)
+    assert plain_eng.sp_prefills == 0
+    assert sp_eng.sp_prefills == 1
+    assert len(sp_toks) == 6
+    assert sp_toks == plain_toks
+
+
+def test_deepseek_expanded_rejects_sp_at_construction():
+    """The expanded MLA oracle has no ring path: an SP-configured engine
+    must fail at CONSTRUCTION (supports_seq_parallel veto), never on the
+    first long prompt mid-serving."""
+    import jax
+    import pytest
+    from jax.sharding import Mesh
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import EngineCore
+
+    cfg, model, params = _tiny_deepseek()
+    cfg.attn_impl = "expanded"
+    model = type(model)(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("data", "model"))
+    with pytest.raises(ValueError, match="seq-parallel"):
+        EngineCore(model, params,
+                   EngineConfig(max_batch_size=2, max_model_len=256,
+                                block_size=16, num_blocks=32,
+                                sp_prefill_threshold=64),
+                   mesh=mesh, eos_token_ids=[])
