@@ -152,6 +152,10 @@ def _build_local_engine(args) -> tuple[object, object]:
         ),
         spec_tokens=int(getattr(args, "spec_tokens", 0) or 0),
         draft_num_blocks=int(getattr(args, "spec_draft_num_blocks", 0) or 0),
+        # ring-attention context parallelism for long prompts (needs a
+        # mesh whose "data" axis is > 1)
+        sp_prefill_threshold=int(
+            getattr(args, "sp_prefill_threshold", 0) or 0),
     )
     draft = None
     dpath = getattr(args, "spec_draft_model", None)
@@ -794,6 +798,10 @@ def _parser() -> argparse.ArgumentParser:
                      help="int8 weight-only quantization (halves weight HBM)")
     run.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
     run.add_argument("--dp", type=int, default=1, help="data-parallel size")
+    run.add_argument("--sp-prefill-threshold", type=int, default=0,
+                     help="prompts at least this long prefill with the "
+                     "sequence sharded over the mesh data axis (ring "
+                     "attention context parallelism); 0 = off, needs dp>1")
     run.add_argument("--nnodes", type=int, default=1,
                      help="worker processes forming ONE mesh (multi-host)")
     run.add_argument("--node-rank", type=int, default=0)

@@ -9,7 +9,9 @@ prefill_worker.py; here the engine is the native JAX EngineCore.  Config
   model-path: HF dir or .gguf   quantize: none | int8
   max-batch-size / max-model-len / block-size / num-blocks
   num-host-blocks               (host-RAM KV offload tier; 0 = off)
+  kv-quant: int8                (int8 KV cache; default = model dtype)
   tp / dp                       (sharded engine over a device mesh)
+  sp-prefill-threshold          (ring-attention long prefill; needs dp>1)
   remote-prefill: true          (disagg decode side: conditional remote
                                  prefill via the coordinator queue)
   max-local-prefill-length      (disagg router threshold)
@@ -46,6 +48,17 @@ async def resolve_cfg_model(cfg: dict, rt) -> dict:
             cfg = dict(cfg)
             cfg["model-path"] = await resolve_model(mp, rt.coordinator)
     return cfg
+
+
+def _kv_quant(cfg: dict) -> str:
+    """Validated ``kv-quant`` key: a typo'd value must fail the boot, not
+    silently build a full-precision cache into an int8-sized num_blocks
+    budget (OOM at load instead of a config error)."""
+    kvq = str(cfg.get("kv-quant", "model"))
+    if kvq not in ("model", "int8"):
+        raise ValueError(
+            f"kv-quant must be 'model' or 'int8', got {kvq!r}")
+    return kvq
 
 
 def build_engine(cfg: dict):
@@ -90,6 +103,8 @@ def build_engine(cfg: dict):
         num_blocks=int(cfg.get("num-blocks", 512)),
         num_host_blocks=int(cfg.get("num-host-blocks", 0)),
         quantize=cfg.get("quantize", "none"),
+        kv_cache_dtype=_kv_quant(cfg),
+        sp_prefill_threshold=int(cfg.get("sp-prefill-threshold", 0)),
         tp=int(cfg.get("tp", 1)),
         dp=int(cfg.get("dp", 1)),
         nnodes=int(cfg.get("nnodes", 1)),

@@ -69,3 +69,25 @@ def test_run_text_tpu_engine(model_dir):
                 "--max-batch-size", "2"])
     assert out.returncode == 0, out.stderr[-2000:]
     assert out.stdout.strip(), "no generated text on stdout"
+
+
+def test_worker_config_kv_quant_and_sp_reach_engine(model_dir):
+    """The example-graph worker config keys `kv-quant` and
+    `sp-prefill-threshold` (multinode-70b/moe.yaml) flow through
+    build_engine -> _build_local_engine into the EngineCore."""
+    from examples.llm.components.worker import build_engine
+    from dynamo_tpu.ops.kv_quant import is_quant
+
+    engine, card = build_engine({
+        "engine": "tpu", "model-path": str(model_dir),
+        "max-batch-size": 2, "max-model-len": 128, "block-size": 16,
+        "num-blocks": 24, "kv-quant": "int8",
+        "sp-prefill-threshold": 64, "dp": 2, "tp": 2,
+    })
+    try:
+        core = engine.core
+        assert is_quant(core.cache)
+        assert core._sp_size == 2  # ring path armed over mesh["data"]
+        assert core.config.sp_prefill_threshold == 64
+    finally:
+        engine.shutdown()
