@@ -31,7 +31,7 @@ from benchmarks.profile_decode import MODELS  # shared model geometries
 
 
 def run_arm(model, params, cfg, spec_tokens: int, batch: int, steps: int,
-            temp: float, seed: int = 0, draft=None):
+            temp: float, seed: int = 0, draft=None, cache_dtype=None):
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.core import EngineCore
     from dynamo_tpu.engine.request import EngineRequest
@@ -46,6 +46,7 @@ def run_arm(model, params, cfg, spec_tokens: int, batch: int, steps: int,
         prefill_chunk_tokens=512,
         spec_tokens=spec_tokens,
         enable_prefix_reuse=False,
+        cache_dtype=cache_dtype,
     )
     engine = EngineCore(model, params, ecfg, eos_token_ids=[], draft=draft)
     rng = np.random.default_rng(3)
@@ -159,10 +160,12 @@ def main() -> None:
     model = LlamaModel(cfg)
     params = model.init_params(jax.random.PRNGKey(0), quantized=quant)
     jax.block_until_ready(params)
-    print(f"# model={name} batch={batch} steps={steps} quant={quant}",
-          file=sys.stderr)
+    cache_dtype = "int8" if quant else None
+    print(f"# model={name} batch={batch} steps={steps} quant={quant} "
+          f"kv={cache_dtype or cfg.dtype}", file=sys.stderr)
     for spec in (0, k):
-        out = run_arm(model, params, cfg, spec, batch, steps, temp)
+        out = run_arm(model, params, cfg, spec, batch, steps, temp,
+                      cache_dtype=cache_dtype)
         print(json.dumps(out))
     # draft == target, forced greedy: every proposal is the target's own
     # argmax, so acceptance is total by construction and the arm
@@ -177,7 +180,7 @@ def main() -> None:
     # serving configuration).
     if k > 0 and not on_accel:
         out = run_arm(model, params, cfg, k, batch, steps, temp=0.0,
-                      draft=(model, params))
+                      draft=(model, params), cache_dtype=cache_dtype)
         print(json.dumps(out))
     # REAL smaller draft: the target's first N layers as a proposer
     # (truncN; default N = layers/4).  This is the serving-configuration
@@ -187,8 +190,10 @@ def main() -> None:
     # DYNAMO_SPEC_DRAFT=trunc<N> picks the depth.
     if draft_n:
         dmodel, dparams = truncated_draft(cfg, params, draft_n)
+        # int8 target AND draft caches: what fits 8B + its trunc draft
+        # (weights 8+1.9GB, caches 2.2+0.6GB) on one 16GiB chip
         out = run_arm(model, params, cfg, k, batch, steps, temp,
-                      draft=(dmodel, dparams))
+                      draft=(dmodel, dparams), cache_dtype=cache_dtype)
         out["arm"] = f"draft-trunc{draft_n}x{k}"
         print(json.dumps(out))
 

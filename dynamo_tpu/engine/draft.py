@@ -45,7 +45,13 @@ class DraftProposer:
         self.config = config
         self.block_size = config.block_size
         nb = num_blocks or config.num_blocks
-        self.cache = model.init_kv_cache(nb, config.block_size)
+        # the draft cache follows the engine's cache_dtype: on HBM-tight
+        # deployments (8B target + draft on one 16GiB chip) the int8
+        # draft cache is part of what makes the pair fit — quantization
+        # error only shifts PROPOSALS; the target's verification stays
+        # exact either way
+        self.cache = model.init_kv_cache(
+            nb, config.block_size, config.cache_dtype)
         self._free = list(range(nb))
         self._blocks: dict[int, list[int]] = {}   # slot -> draft block ids
         self._synced: dict[int, int] = {}         # slot -> tokens ingested

@@ -407,3 +407,28 @@ def test_draft_long_prompt_catches_up_across_steps():
     got = _drain_engine(spec, prompt, 10, "s", temperature=0.0)
     assert got == want
     assert spec.spec_steps > 0
+
+
+def test_draft_model_with_int8_caches_still_exact():
+    """Draft speculation with int8 TARGET and DRAFT caches (the
+    HBM-tight 8B-on-one-chip shape, engine/draft.py): the quantized
+    draft cache only shifts PROPOSALS; the stream must equal the plain
+    int8-cache engine exactly — greedy and seeded."""
+    from dynamo_tpu.ops.kv_quant import is_quant
+
+    cfg = ModelConfig.tiny()
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    prompt = [3, 1, 4, 1, 5]
+
+    for samp in ({"temperature": 0.0}, {"temperature": 0.8, "seed": 7}):
+        base = EngineCore(model, params, _cfg(cache_dtype="int8"),
+                          eos_token_ids=[])
+        want = _drain_engine(base, prompt, 16, "b", **samp)
+        spec = EngineCore(model, params,
+                          _cfg(spec_tokens=3, cache_dtype="int8"),
+                          eos_token_ids=[], draft=(model, params))
+        assert is_quant(spec.cache) and is_quant(spec.draft.cache)
+        got = _drain_engine(spec, prompt, 16, "s", **samp)
+        assert got == want, samp
+        assert spec.spec_steps > 0
