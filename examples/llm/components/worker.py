@@ -87,6 +87,7 @@ def build_engine(cfg: dict):
             block_size=int(cfg.get("block-size", 16)),
             num_blocks=int(cfg.get("num-blocks", 64)),
             num_host_blocks=int(cfg.get("num-host-blocks", 0)),
+            cache_dtype=("int8" if _kv_quant(cfg) == "int8" else None),
         )
         return AsyncLLMEngine(EngineCore(model, params, ecfg)).start(), None
     # full path: reuse the CLI's builder (loading, quantize, mesh, multihost)

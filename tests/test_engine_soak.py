@@ -35,21 +35,43 @@ def _soak_grammar(vocab_size):
     return toks, JsonGrammar.from_token_bytes(toks, eos_ids=[EOS])
 
 
-@pytest.mark.parametrize("seed,cache_dtype,draft,host", [
-    (0, None, False, False), (7, None, False, False),
-    (3, "int8", False, False),
+def _soak_model(family: str):
+    if family == "mla":
+        # DeepSeek absorbed-MLA: ONE shared latent KV row per token —
+        # the soak churns its cache wiring (incl. int8 latent) through
+        # the same interaction surface as the GQA models
+        from dynamo_tpu.models.deepseek import DeepseekConfig, DeepseekModel
+
+        cfg = DeepseekConfig(
+            vocab_size=2048, hidden_size=64, num_layers=2, num_heads=4,
+            qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+            kv_lora_rank=16, intermediate_size=64, moe_intermediate_size=32,
+            n_routed_experts=4, num_experts_per_tok=2, n_shared_experts=1,
+            first_k_dense_replace=1, max_position_embeddings=512,
+            dtype="float32",
+        )
+        model = DeepseekModel(cfg)
+        return cfg, model, model.init_params(jax.random.PRNGKey(0))
+    cfg = ModelConfig.tiny()
+    model = LlamaModel(cfg)
+    return cfg, model, model.init_params(jax.random.PRNGKey(0))
+
+
+@pytest.mark.parametrize("seed,cache_dtype,draft,host,family", [
+    (0, None, False, False, "llama"), (7, None, False, False, "llama"),
+    (3, "int8", False, False, "llama"),
     # draft-model speculation churning against grammar rows, aborts,
     # chunked prefill and the tight block pool (draft pool even tighter)
-    (11, None, True, False),
+    (11, None, True, False, "llama"),
     # host-offload tier ON: the tight device pool evicts constantly, so
     # the async kv-offload thread's reserve/write/publish races against
     # the engine thread's drain/restore the whole run — bf16 and int8
-    (5, None, False, True), (13, "int8", False, True),
+    (5, None, False, True, "llama"), (13, "int8", False, True, "llama"),
+    # MLA latent cache under the same churn, bf16 and int8+host-offload
+    (17, None, False, False, "mla"), (19, "int8", False, True, "mla"),
 ])
-def test_engine_soak_invariants(seed, cache_dtype, draft, host):
-    cfg = ModelConfig.tiny()
-    model = LlamaModel(cfg)
-    params = model.init_params(jax.random.PRNGKey(0))
+def test_engine_soak_invariants(seed, cache_dtype, draft, host, family):
+    cfg, model, params = _soak_model(family)
     ecfg = EngineConfig(
         max_batch_size=4,
         max_model_len=192,
