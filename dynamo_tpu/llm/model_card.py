@@ -26,6 +26,11 @@ class ModelDeploymentCard:
     context_length: int = 4096
     eos_token_ids: list[int] = field(default_factory=list)
     bos_token_id: Optional[int] = None
+    # token STRINGS for chat-template rendering: real templates (Llama-3,
+    # Mistral) reference {{ bos_token }}/{{ eos_token }} — without these
+    # every chat prompt silently loses its BOS marker
+    bos_token: Optional[str] = None
+    eos_token: Optional[str] = None
     chat_template: Optional[str] = None     # jinja source
     extra: dict[str, Any] = field(default_factory=dict)
 
@@ -44,6 +49,8 @@ class ModelDeploymentCard:
             "context_length": self.context_length,
             "eos_token_ids": self.eos_token_ids,
             "bos_token_id": self.bos_token_id,
+            "bos_token": self.bos_token,
+            "eos_token": self.eos_token,
             "chat_template": self.chat_template,
             "extra": self.extra,
         }
@@ -64,13 +71,23 @@ class ModelDeploymentCard:
             eos = [eos]
         bos = cfg.get("bos_token_id")
 
+        def _tok_str(v) -> Optional[str]:
+            # tokenizer_config.json stores special tokens as plain strings
+            # or AddedToken dicts ({"content": "<s>", ...})
+            if isinstance(v, str):
+                return v
+            if isinstance(v, dict) and isinstance(v.get("content"), str):
+                return v["content"]
+            return None
+
         chat_template = None
+        bos_str = eos_str = None
         gen_cfg_path = d / "tokenizer_config.json"
         if gen_cfg_path.exists():
             tk_cfg = json.loads(gen_cfg_path.read_text())
             chat_template = tk_cfg.get("chat_template")
-            if eos == [] and isinstance(tk_cfg.get("eos_token"), str):
-                pass  # token string → id resolution needs the tokenizer; left to caller
+            bos_str = _tok_str(tk_cfg.get("bos_token"))
+            eos_str = _tok_str(tk_cfg.get("eos_token"))
         sep = d / "chat_template.jinja"
         if chat_template is None and sep.exists():
             chat_template = sep.read_text()
@@ -85,6 +102,18 @@ class ModelDeploymentCard:
                 tok = materialize_tokenizer(d / "tokenizer.model")
             except Exception:
                 pass  # unparseable/SP-BPE: card carries no tokenizer
+        if not eos and eos_str and tok.exists():
+            # config.json had no eos_token_id but tokenizer_config names
+            # the token: resolve it here or the engine never receives an
+            # EOS stop id (every generation would run to max_tokens)
+            try:
+                from tokenizers import Tokenizer
+
+                tid = Tokenizer.from_file(str(tok)).token_to_id(eos_str)
+                if tid is not None:
+                    eos = [tid]
+            except Exception:
+                pass
         return cls(
             name=name or d.name,
             model_path=str(d),
@@ -92,6 +121,8 @@ class ModelDeploymentCard:
             context_length=cfg.get("max_position_embeddings", 4096),
             eos_token_ids=list(eos),
             bos_token_id=bos,
+            bos_token=bos_str,
+            eos_token=eos_str,
             chat_template=chat_template,
         )
 

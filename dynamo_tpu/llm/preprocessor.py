@@ -45,13 +45,31 @@ class PromptFormatter:
         # substring probe misfires on templates merely mentioning the word;
         # the AST check is exact.)
         try:
-            self.supports_tools = "tools" in meta.find_undeclared_variables(
-                env.parse(src)
-            )
+            free = meta.find_undeclared_variables(env.parse(src))
+            self.supports_tools = "tools" in free
         except Exception:
             self.supports_tools = False
         self._bos = bos_token
         self._eos = eos_token
+        # Templates that emit BOS themselves must not ALSO get the
+        # tokenizer's special-token insertion (double-BOS corrupts real
+        # models).  Decided by a probe RENDER, not source inspection — a
+        # substring test would misfire on '<s>' inside a hardcoded
+        # '</s>', and a bare variable reference with an EMPTY bos string
+        # renders nothing (the tokenizer must then keep inserting BOS).
+        self.renders_bos = False
+        if bos_token:
+            sentinel = "\x00BOS\x00"
+            try:
+                probe = self._template.render(
+                    messages=[{"role": "user", "content": "x"}],
+                    add_generation_prompt=True,
+                    bos_token=sentinel, eos_token=eos_token, tools=None,
+                )
+                self.renders_bos = (sentinel in probe
+                                    or probe.startswith(bos_token))
+            except Exception:
+                pass  # template needs richer inputs: keep tokenizer BOS
 
     @staticmethod
     def _raise(msg: str):
@@ -82,7 +100,18 @@ class OpenAIPreprocessor(Operator):
                 raise ValueError(f"model card {card.name} has no tokenizer")
             tokenizer = TokenizerWrapper.from_file(card.tokenizer_path)
         self.tokenizer = tokenizer
-        self.formatter = PromptFormatter(card.chat_template)
+        # token STRINGS reach the template: real templates interpolate
+        # {{ bos_token }}/{{ eos_token }}.  Card strings (from
+        # tokenizer_config.json) win; ids resolve through the tokenizer
+        # as fallback (GGUF cards carry only ids)
+        bos = card.bos_token
+        if bos is None and card.bos_token_id is not None:
+            bos = self.tokenizer.id_to_token(card.bos_token_id)
+        eos = card.eos_token
+        if eos is None and card.eos_token_ids:
+            eos = self.tokenizer.id_to_token(card.eos_token_ids[0])
+        self.formatter = PromptFormatter(
+            card.chat_template, bos_token=bos or "", eos_token=eos or "")
 
     async def forward(self, request: Context[ParsedRequest]) -> Context[BackendInput]:
         parsed = request.data
@@ -120,7 +149,12 @@ class OpenAIPreprocessor(Operator):
                     }
                 ] + list(messages)
             prompt = self.formatter.render(messages, tools=tools)
-            token_ids = self.tokenizer.encode(prompt)
+            # a template that already emitted BOS must not get a second
+            # one from the tokenizer's special-token post-processor
+            token_ids = self.tokenizer.encode(
+                prompt,
+                add_special_tokens=not self.formatter.renders_bos,
+            )
         elif parsed.prompt_token_ids is not None:
             prompt = None
             token_ids = list(parsed.prompt_token_ids)

@@ -51,6 +51,9 @@ class TokenizerWrapper:
     def token_to_id(self, token: str) -> Optional[int]:
         return self._tk.token_to_id(token)
 
+    def id_to_token(self, token_id: int) -> Optional[str]:
+        return self._tk.id_to_token(token_id)
+
     @property
     def vocab_size(self) -> int:
         return self._tk.get_vocab_size()
