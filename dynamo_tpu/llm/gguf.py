@@ -47,9 +47,13 @@ _SCALAR_FMT = {
 # ggml tensor dtypes we understand
 GGML_F32, GGML_F16 = 0, 1
 GGML_Q4_0, GGML_Q8_0 = 2, 8
+GGML_Q4_K, GGML_Q5_K, GGML_Q6_K = 12, 13, 14
 GGML_BF16 = 30
 
 _Q4_BLOCK, _Q8_BLOCK = 32, 32
+_QK_K = 256  # K-quant super-block size
+# K-quant super-block byte sizes (ggml block_q{4,5,6}_K layouts)
+_Q4K_BYTES, _Q5K_BYTES, _Q6K_BYTES = 144, 176, 210
 
 
 @dataclass
@@ -140,6 +144,12 @@ class GGUFFile:
             arr = _dequant_q8_0(raw, info.n_elements)
         elif t == GGML_Q4_0:
             arr = _dequant_q4_0(raw, info.n_elements)
+        elif t == GGML_Q4_K:
+            arr = _dequant_q4_k(raw, info.n_elements)
+        elif t == GGML_Q5_K:
+            arr = _dequant_q5_k(raw, info.n_elements)
+        elif t == GGML_Q6_K:
+            arr = _dequant_q6_k(raw, info.n_elements)
         else:
             raise NotImplementedError(f"ggml tensor type {t} ({name})")
         return arr.reshape(info.shape).astype(dtype)
@@ -222,6 +232,12 @@ def _tensor_nbytes(info: GGUFTensorInfo) -> int:
         return n // _Q8_BLOCK * 34  # f16 scale + 32×i8
     if t == GGML_Q4_0:
         return n // _Q4_BLOCK * 18  # f16 scale + 16 nibble bytes
+    if t == GGML_Q4_K:
+        return n // _QK_K * _Q4K_BYTES
+    if t == GGML_Q5_K:
+        return n // _QK_K * _Q5K_BYTES
+    if t == GGML_Q6_K:
+        return n // _QK_K * _Q6K_BYTES
     raise NotImplementedError(f"ggml tensor type {t}")
 
 
@@ -240,6 +256,89 @@ def _dequant_q4_0(raw: bytes, n: int) -> np.ndarray:
     hi = (rec["qs"] >> 4).astype(np.int8) - 8
     q = np.concatenate([lo, hi], axis=1).astype(np.float32)  # [blocks, 32]
     return (rec["d"].astype(np.float32)[:, None] * q).reshape(-1)
+
+
+def _k_scale_min(scales: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Unpack the shared K-quant 6-bit (scale, min) encoding: 12 bytes →
+    8 sub-block scales + 8 mins per super-block (ggml get_scale_min_k4).
+    ``scales`` [B, 12] uint8 → (sc [B, 8], mn [B, 8]) float32."""
+    q = scales.astype(np.uint8)
+    sc = np.empty(q.shape[:-1] + (8,), np.uint8)
+    mn = np.empty_like(sc)
+    sc[..., :4] = q[..., 0:4] & 63
+    mn[..., :4] = q[..., 4:8] & 63
+    sc[..., 4:] = (q[..., 8:12] & 0x0F) | ((q[..., 0:4] >> 6) << 4)
+    mn[..., 4:] = (q[..., 8:12] >> 4) | ((q[..., 4:8] >> 6) << 4)
+    return sc.astype(np.float32), mn.astype(np.float32)
+
+
+def _dequant_q4_k(raw: bytes, n: int) -> np.ndarray:
+    """block_q4_K: {f16 d, f16 dmin, u8 scales[12], u8 qs[128]} per 256
+    values — 8 sub-blocks of 32, value = d·sc·q − dmin·mn, with each
+    32-byte qs chunk holding sub-block 2j in low nibbles and 2j+1 in
+    high nibbles."""
+    blocks = n // _QK_K
+    rec = np.frombuffer(raw, dtype=np.dtype(
+        [("d", "<f2"), ("dmin", "<f2"), ("scales", "u1", 12),
+         ("qs", "u1", 128)]), count=blocks)
+    sc, mn = _k_scale_min(rec["scales"])               # [B, 8]
+    d = rec["d"].astype(np.float32)[:, None, None]     # [B, 1, 1]
+    dmin = rec["dmin"].astype(np.float32)[:, None, None]
+    qs = rec["qs"].reshape(blocks, 4, 32)              # 4 chunks of 32B
+    lo = (qs & 0x0F).astype(np.float32)                # sub-blocks 0,2,4,6
+    hi = (qs >> 4).astype(np.float32)                  # sub-blocks 1,3,5,7
+    q = np.stack([lo, hi], axis=2).reshape(blocks, 8, 32)
+    out = d * sc[:, :, None] * q - dmin * mn[:, :, None]
+    return out.reshape(-1)
+
+
+def _dequant_q5_k(raw: bytes, n: int) -> np.ndarray:
+    """block_q5_K: Q4_K plus qh[32] carrying each value's 5th bit — the
+    bit for sub-block j lives at qh bit j (shifting mask per 64-value
+    chunk in the scalar code = bit index per sub-block here)."""
+    blocks = n // _QK_K
+    rec = np.frombuffer(raw, dtype=np.dtype(
+        [("d", "<f2"), ("dmin", "<f2"), ("scales", "u1", 12),
+         ("qh", "u1", 32), ("qs", "u1", 128)]), count=blocks)
+    sc, mn = _k_scale_min(rec["scales"])
+    d = rec["d"].astype(np.float32)[:, None, None]
+    dmin = rec["dmin"].astype(np.float32)[:, None, None]
+    qs = rec["qs"].reshape(blocks, 4, 32)
+    lo = (qs & 0x0F).astype(np.float32)
+    hi = (qs >> 4).astype(np.float32)
+    q = np.stack([lo, hi], axis=2).reshape(blocks, 8, 32)
+    qh = rec["qh"]                                     # [B, 32]
+    bits = (qh[:, None, :] >> np.arange(8, dtype=np.uint8)[None, :, None]) & 1
+    out = d * sc[:, :, None] * (q + bits.astype(np.float32) * 16.0) \
+        - dmin * mn[:, :, None]
+    return out.reshape(-1)
+
+
+def _dequant_q6_k(raw: bytes, n: int) -> np.ndarray:
+    """block_q6_K: {u8 ql[128], u8 qh[64], i8 scales[16], f16 d} per 256
+    values — 16 sub-blocks of 16, q = ((ql nibble) | (qh 2 bits << 4))
+    − 32, value = d·scales[sub]·q.  Laid out in two 128-value halves;
+    within a half, position l∈[0,32) of quarter k reads ql[l + 32·(k&1)]
+    nibble (k<2 low, k≥2 high) and qh[l] bits (2k, 2k+1)."""
+    blocks = n // _QK_K
+    rec = np.frombuffer(raw, dtype=np.dtype(
+        [("ql", "u1", 128), ("qh", "u1", 64), ("scales", "i1", 16),
+         ("d", "<f2")]), count=blocks)
+    d = rec["d"].astype(np.float32)
+    scales = rec["scales"].astype(np.float32)          # [B, 16]
+    ql = rec["ql"].reshape(blocks, 2, 2, 32)           # [B, half, lohalf, 32]
+    qh = rec["qh"].reshape(blocks, 2, 32)              # [B, half, 32]
+    out = np.empty((blocks, 2, 4, 32), np.float32)     # [B, half, quarter, 32]
+    for k in range(4):                                 # quarter within a half
+        nib = ql[:, :, k & 1]                          # [B, half, 32]
+        nib = (nib & 0x0F) if k < 2 else (nib >> 4)
+        high = (qh >> (2 * k)) & 3
+        out[:, :, k] = (nib | (high << 4)).astype(np.float32) - 32.0
+    # scale index: sub-block of 16 → scales[(half·128 + quarter·32 + l)//16]
+    idx = (np.arange(_QK_K) // 16).reshape(2, 4, 32)
+    out *= scales[:, idx]
+    out *= d[:, None, None, None]
+    return out.reshape(-1)
 
 
 # ----------------------------------------------------------- HF weight maps --
@@ -379,14 +478,23 @@ def write_gguf(
     metadata: dict[str, Any],
     tensors: dict[str, np.ndarray],
     quantize: Optional[dict[str, int]] = None,
+    raw: Optional[dict[str, tuple[int, tuple[int, ...], bytes]]] = None,
 ) -> None:
     """Minimal GGUF v3 writer (tests + export).  ``quantize`` maps tensor
-    name → ggml type (default F32)."""
+    name → ggml type (default F32).  ``raw`` carries PRE-QUANTIZED
+    tensors verbatim as name → (ggml_type, shape, payload bytes) —
+    repacking K-quant tensors this writer cannot produce itself."""
     quantize = quantize or {}
+    raw = raw or {}
+    overlap = set(tensors) & set(raw)
+    if overlap:
+        # strict readers (llama.cpp) reject duplicate tensor names —
+        # fail at write time, not at someone else's load time
+        raise ValueError(f"tensor names in both tensors and raw: {overlap}")
     with open(path, "wb") as f:
         f.write(GGUF_MAGIC)
         f.write(struct.pack("<I", GGUF_VERSION))
-        f.write(struct.pack("<QQ", len(tensors), len(metadata)))
+        f.write(struct.pack("<QQ", len(tensors) + len(raw), len(metadata)))
         for k, v in metadata.items():
             _write_string(f, k)
             if isinstance(v, list):
@@ -401,6 +509,18 @@ def write_gguf(
 
         payloads: list[bytes] = []
         offset = 0
+
+        def emit_info(name: str, shape: tuple[int, ...], t: int,
+                      data: bytes) -> None:
+            nonlocal offset
+            _write_string(f, name)
+            dims = tuple(reversed(shape))
+            f.write(struct.pack("<I", len(dims)))
+            f.write(struct.pack(f"<{len(dims)}Q", *dims))
+            f.write(struct.pack("<IQ", t, offset))
+            payloads.append(data)
+            offset += (len(data) + ALIGNMENT - 1) // ALIGNMENT * ALIGNMENT
+
         for name, arr in tensors.items():
             t = quantize.get(name, GGML_F32)
             if t == GGML_F32:
@@ -411,13 +531,9 @@ def write_gguf(
                 data = _quant_q8_0(arr)
             else:
                 raise NotImplementedError(f"write type {t}")
-            _write_string(f, name)
-            dims = tuple(reversed(arr.shape))
-            f.write(struct.pack("<I", len(dims)))
-            f.write(struct.pack(f"<{len(dims)}Q", *dims))
-            f.write(struct.pack("<IQ", t, offset))
-            payloads.append(data)
-            offset += (len(data) + ALIGNMENT - 1) // ALIGNMENT * ALIGNMENT
+            emit_info(name, arr.shape, t, data)
+        for name, (t, shape, data) in raw.items():
+            emit_info(name, tuple(shape), t, bytes(data))
 
         pos = f.tell()
         f.write(b"\x00" * ((pos + ALIGNMENT - 1) // ALIGNMENT * ALIGNMENT - pos))

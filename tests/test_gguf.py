@@ -178,3 +178,141 @@ def test_model_card_from_gguf(tiny_hf, tmp_path):
     assert card.context_length == 256
     assert card.eos_token_ids == [2]
     assert card.tokenizer_path and card.tokenizer_path.endswith(".tokenizer.json")
+
+
+# ------------------------------------------------------------- K-quants ----
+# Vectorized K-quant dequants vs independent SCALAR translations of the
+# ggml layouts (block_q{4,5,6}_K) on randomized blocks.
+
+def _ksm(scales, j):
+    """get_scale_min_k4: shared 6-bit (scale, min) unpacking."""
+    if j < 4:
+        return scales[j] & 63, scales[j + 4] & 63
+    return ((scales[j + 4] & 0x0F) | ((scales[j - 4] >> 6) << 4),
+            (scales[j + 4] >> 4) | ((scales[j] >> 6) << 4))
+
+
+def _mk_blocks(rng, nblocks, fields):
+    dt = np.dtype(fields)
+    rec = np.zeros(nblocks, dt)
+    for name, kind, *_ in fields:
+        shape = rec[name].shape
+        if kind == "<f2":
+            rec[name] = rng.uniform(-0.5, 0.5, size=shape).astype(np.float16)
+        elif kind == "u1":
+            rec[name] = rng.integers(0, 256, size=shape, dtype=np.int64
+                                     ).astype(np.uint8)
+        elif kind == "i1":
+            rec[name] = rng.integers(-128, 128, size=shape, dtype=np.int64
+                                     ).astype(np.int8)
+    return rec
+
+
+def test_q4_k_matches_scalar_reference():
+    from dynamo_tpu.llm.gguf import _dequant_q4_k
+
+    rng = np.random.default_rng(0)
+    nb = 3
+    rec = _mk_blocks(rng, nb, [("d", "<f2"), ("dmin", "<f2"),
+                               ("scales", "u1", (12,)), ("qs", "u1", (128,))])
+    got = _dequant_q4_k(rec.tobytes(), nb * 256)
+    want = []
+    for b in rec:
+        d, dmin = float(b["d"]), float(b["dmin"])
+        q, is_ = b["qs"], 0
+        qpos = 0
+        for j in range(0, 256, 64):
+            sc1, m1 = _ksm(b["scales"], is_)
+            sc2, m2 = _ksm(b["scales"], is_ + 1)
+            for l in range(32):
+                want.append(d * sc1 * (q[qpos + l] & 0xF) - dmin * m1)
+            for l in range(32):
+                want.append(d * sc2 * (q[qpos + l] >> 4) - dmin * m2)
+            qpos += 32
+            is_ += 2
+    np.testing.assert_allclose(got, np.asarray(want, np.float32), rtol=1e-6)
+
+
+def test_q5_k_matches_scalar_reference():
+    from dynamo_tpu.llm.gguf import _dequant_q5_k
+
+    rng = np.random.default_rng(1)
+    nb = 3
+    rec = _mk_blocks(rng, nb, [("d", "<f2"), ("dmin", "<f2"),
+                               ("scales", "u1", (12,)), ("qh", "u1", (32,)),
+                               ("qs", "u1", (128,))])
+    got = _dequant_q5_k(rec.tobytes(), nb * 256)
+    want = []
+    for b in rec:
+        d, dmin = float(b["d"]), float(b["dmin"])
+        ql, qh = b["qs"], b["qh"]
+        is_, u1, u2, qpos = 0, 1, 2, 0
+        for j in range(0, 256, 64):
+            sc1, m1 = _ksm(b["scales"], is_)
+            sc2, m2 = _ksm(b["scales"], is_ + 1)
+            for l in range(32):
+                want.append(d * sc1 * ((ql[qpos + l] & 0xF)
+                                       + (16 if qh[l] & u1 else 0))
+                            - dmin * m1)
+            for l in range(32):
+                want.append(d * sc2 * ((ql[qpos + l] >> 4)
+                                       + (16 if qh[l] & u2 else 0))
+                            - dmin * m2)
+            qpos += 32
+            is_ += 2
+            u1 <<= 2
+            u2 <<= 2
+    np.testing.assert_allclose(got, np.asarray(want, np.float32), rtol=1e-6)
+
+
+def test_q6_k_matches_scalar_reference():
+    from dynamo_tpu.llm.gguf import _dequant_q6_k
+
+    rng = np.random.default_rng(2)
+    nb = 3
+    rec = _mk_blocks(rng, nb, [("ql", "u1", (128,)), ("qh", "u1", (64,)),
+                               ("scales", "i1", (16,)), ("d", "<f2")])
+    got = _dequant_q6_k(rec.tobytes(), nb * 256)
+    want = np.empty(nb * 256, np.float32)
+    pos = 0
+    for b in rec:
+        d = float(b["d"])
+        ql, qh, sc = b["ql"], b["qh"], b["scales"]
+        for half in range(2):
+            qlh, qhh = ql[64 * half:], qh[32 * half:]
+            sch = sc[8 * half:]
+            for l in range(32):
+                is_ = l // 16
+                lo0, lo1 = int(qlh[l]), int(qlh[l + 32])
+                h = int(qhh[l])
+                q1 = ((lo0 & 0xF) | (((h >> 0) & 3) << 4)) - 32
+                q2 = ((lo1 & 0xF) | (((h >> 2) & 3) << 4)) - 32
+                q3 = ((lo0 >> 4) | (((h >> 4) & 3) << 4)) - 32
+                q4 = ((lo1 >> 4) | (((h >> 6) & 3) << 4)) - 32
+                base = pos + 128 * half
+                want[base + l] = d * sch[is_] * q1
+                want[base + l + 32] = d * sch[is_ + 2] * q2
+                want[base + l + 64] = d * sch[is_ + 4] * q3
+                want[base + l + 96] = d * sch[is_ + 6] * q4
+        pos += 256
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_k_quant_tensor_loads_through_reader(tmp_path):
+    """A GGUF file carrying a Q6_K tensor round-trips through the reader
+    (type plumbing: nbytes, offsets, reshape)."""
+    from dynamo_tpu.llm.gguf import GGML_Q6_K, GGUFFile, write_gguf
+
+    rng = np.random.default_rng(3)
+    rec = _mk_blocks(rng, 2, [("ql", "u1", (128,)), ("qh", "u1", (64,)),
+                              ("scales", "i1", (16,)), ("d", "<f2")])
+    path = tmp_path / "k.gguf"
+    write_gguf(path, {"general.architecture": "llama"}, {},
+               raw={"t": (GGML_Q6_K, (2, 256), rec.tobytes())})
+    r = GGUFFile(path)
+    out = r.load_tensor("t")
+    assert out.shape == (2, 256)
+    from dynamo_tpu.llm.gguf import _dequant_q6_k
+
+    np.testing.assert_allclose(
+        out.reshape(-1), _dequant_q6_k(rec.tobytes(), 512), rtol=1e-6)

@@ -134,3 +134,41 @@ def test_bench_offload_smoke():
     assert by_mode["host_offload"]["host_blocks_stored"] > 0
     assert by_mode["host_offload"]["host_blocks_restored"] > 0
     assert by_mode["device_only"]["host_blocks_restored"] == 0
+
+
+def test_bench_emit_backfill_rules(monkeypatch):
+    """The scored artifact's merge logic in isolation: null fields
+    backfill from a carried partial of the SAME configuration; a
+    different configuration never inherits numbers."""
+    import importlib
+    import io
+    from contextlib import redirect_stdout
+
+    import bench
+
+    # _emit persists its line in DYNAMO_BENCH_PARTIAL; without the
+    # monkeypatch that would leak into the other tests' subprocess envs
+    monkeypatch.delenv("DYNAMO_BENCH_PARTIAL", raising=False)
+    monkeypatch.setenv("DYNAMO_BENCH_PARTIAL", "")
+    importlib.reload(bench)  # fresh _PARTIAL_BASE between tests
+    bench._PARTIAL_BASE.update({
+        "model": "8b", "quant": "int8", "kv_quant": "int8",
+        "value": 99.0, "ttft_p50_ms": 42.0, "moe": {"decode_tok_s": 5.0},
+    })
+
+    def emit(res):
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            bench._emit(dict(res))
+        return json.loads(buf.getvalue())
+
+    same = emit({"model": "8b", "quant": "int8", "kv_quant": "int8",
+                 "value": 120.0, "ttft_p50_ms": None})
+    assert same["value"] == 120.0          # fresh measurement wins
+    assert same["ttft_p50_ms"] == 42.0     # null backfills
+    assert same["moe"] == {"decode_tok_s": 5.0}
+
+    other = emit({"model": "1b", "quant": "none", "kv_quant": "none",
+                  "value": 50.0, "ttft_p50_ms": None})
+    assert other["ttft_p50_ms"] is None    # different config: no inherit
+    assert "moe" not in other
