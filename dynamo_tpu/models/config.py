@@ -47,8 +47,13 @@ class ModelConfig:
     attention_bias: bool = False
     # Qwen3-style per-head RMSNorm on q and k (over head_dim, before RoPE)
     qk_norm: bool = False
-    # Mistral sliding-window size (metadata; full attention is a superset —
-    # exact up to window length, the common serving regime)
+    # Uniform sliding-window size (Mistral/Phi3): attention masks keys
+    # older than `window` positions — EXACT HF semantics.  The attention
+    # dispatch applies it only when the static context bound can exceed
+    # the window (ops/paged_attention.py); deployments whose max_model_len
+    # fits inside the window keep the flash kernels (full == windowed
+    # there).  Gemma2's interleaved local/global windows are NOT this
+    # field — from_hf_config nulls it for Gemma2 with a warning.
     sliding_window: Optional[int] = None
     # MoE (Mixtral-style); num_experts == 0 → dense MLP
     num_experts: int = 0
@@ -158,21 +163,41 @@ class ModelConfig:
                 f"unsupported hidden activation {act!r} for {arch}; "
                 f"supported: {sorted(act_map)}"
             )
-        if cfg.get("sliding_window") and (
-            cfg.get("sliding_window") < cfg.get("max_position_embeddings", 0)
-        ):
+        sliding = cfg.get("sliding_window")
+        if sliding and arch in ("Qwen2ForCausalLM", "Qwen3ForCausalLM",
+                                "Qwen3MoeForCausalLM"):
+            if not cfg.get("use_sliding_window"):
+                # HF Qwen configs carry sliding_window but gate it behind
+                # use_sliding_window (default False) — honoring the number
+                # without the gate would wrongly window full-attention models
+                sliding = None
+            elif cfg.get("max_window_layers", 0):
+                import logging
+
+                # HF windows only layers >= max_window_layers; a uniform
+                # window over the scan-over-layers decoder would corrupt
+                # the full-attention lower layers — same treatment as
+                # Gemma2's interleave: full attention + a loud warning
+                logging.getLogger("dynamo_tpu.models").warning(
+                    "%s use_sliding_window with max_window_layers=%d "
+                    "(non-uniform layer windows): served with full "
+                    "attention — outputs match HF only for contexts "
+                    "within the window", arch, cfg["max_window_layers"],
+                )
+                sliding = None
+        if sliding and arch == "Gemma2ForCausalLM":
             import logging
 
-            # windowed attention (Mistral, Phi3, Gemma2's interleaved local
-            # layers) is served as full attention — a superset: exact for
-            # contexts up to the window, divergent beyond it
+            # Gemma2 interleaves LOCAL and GLOBAL layers; a uniform window
+            # over the scan-over-layers decoder would corrupt the global
+            # layers, so Gemma2 keeps full attention — exact for contexts
+            # within the window, divergent beyond it
             logging.getLogger("dynamo_tpu.models").warning(
-                "%s sliding_window=%d < max_position_embeddings=%d: served "
-                "with full attention — outputs match HF only for contexts "
-                "within the window",
-                arch, cfg["sliding_window"],
-                cfg.get("max_position_embeddings", 0),
+                "%s sliding_window=%d: interleaved local/global layers are "
+                "served with full attention — outputs match HF only for "
+                "contexts within the window", arch, sliding,
             )
+            sliding = None
         return cls(
             vocab_size=cfg["vocab_size"],
             hidden_size=cfg["hidden_size"],
@@ -194,7 +219,7 @@ class ModelConfig:
             # explicit attention_bias flag (default False)
             attention_bias=cfg.get("attention_bias", arch == "Qwen2ForCausalLM"),
             qk_norm=arch in ("Qwen3ForCausalLM", "Qwen3MoeForCausalLM"),
-            sliding_window=cfg.get("sliding_window"),
+            sliding_window=sliding,
             num_experts=cfg.get("num_local_experts",
                                 cfg.get("num_experts", 0) if qwen3_moe else 0),
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),

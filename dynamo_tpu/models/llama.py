@@ -390,11 +390,13 @@ class LlamaModel:
                     q, k, v, cache, li, block_tables, seq_lens,
                     positions[:, 0], prefix_blocks,
                     sm_scale=self.sm_scale, logit_cap=cfg.attn_logit_softcap,
+                    window=cfg.sliding_window,
                 )
             else:
                 attn = paged_attention_layer(
                     q, cache, li, block_tables, seq_lens, positions,
                     sm_scale=self.sm_scale, logit_cap=cfg.attn_logit_softcap,
+                    window=cfg.sliding_window,
                 )
             attn_out = matmul(attn.reshape(b, s, hq * dh), lp["wo"])
             if cfg.post_norms:  # Gemma2 sandwich: norm the residual branch
@@ -460,6 +462,7 @@ class LlamaModel:
             attn = ring_attention(
                 q, k, v, positions, positions, mesh=mesh, axis=sp_axis,
                 sm_scale=self.sm_scale, logit_cap=cfg.attn_logit_softcap,
+                window=cfg.sliding_window,
             )
             attn_out = matmul(attn.reshape(b, s, hq * dh), lp["wo"])
             if cfg.post_norms:

@@ -85,6 +85,7 @@ def paged_attention_layer(
     positions: jax.Array,     # [B, S] int32
     sm_scale: float | None = None,
     logit_cap: float | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Attention for layer ``layer`` against the full paged cache.
 
@@ -97,16 +98,24 @@ def paged_attention_layer(
     DYNAMO_DISABLE_PALLAS_MQ) to get the position-exact oracle, which also
     serves S > MQ_MAX_S and non-TPU backends by materialising the layer
     slice.
+
+    ``window`` (Mistral/Phi3 sliding window) routes to the position-exact
+    oracle ONLY when the STATIC context bound (M·Bs) can actually exceed
+    the window — a deployment whose max_model_len fits inside the window
+    is mathematically full attention and keeps the flash kernels.
     """
     b, s, h, d = q.shape
     quant = is_quant(cache)
     data = cache.data if quant else cache
     _, n, _, bs, hkd = data.shape
     hk = hkd // d
+    windowed = window is not None and block_tables.shape[1] * bs > window
+    if not windowed:
+        window = None  # static no-op: full attention is exact here
     # int8 payload tiles are (32, 128): a quant cache with Bs % 32 != 0
     # pads the block's sublane dim, and the kernels' manual per-block DMA
     # cannot slice a partial tile — take the XLA dequant path instead
-    kernel_ok = not quant or bs % 32 == 0
+    kernel_ok = (not quant or bs % 32 == 0) and not windowed
     if s == 1 and kernel_ok and _pallas_decode_enabled():
         from dynamo_tpu.ops.pallas.decode_attention import paged_decode_attention
 
@@ -142,7 +151,7 @@ def paged_attention_layer(
     v_cache = layer_kv[:, 1].reshape(n, bs, hk, d)
     return paged_attention(
         q, k_cache, v_cache, block_tables, seq_lens, positions, sm_scale,
-        logit_cap,
+        logit_cap, window=window,
     )
 
 
@@ -158,6 +167,7 @@ def prefill_attention(
     prefix_blocks: int,       # STATIC: cache blocks holding the cached prefix (bucketed)
     sm_scale: float | None = None,
     logit_cap: float | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Prefill attention without gathering the sequence's whole block table.
 
@@ -178,8 +188,15 @@ def prefill_attention(
     if sm_scale is None:
         sm_scale = 1.0 / (d**0.5)
     data_ = cache.data if quant else cache
+    bs_ = data_.shape[3]
+    # sliding window matters only when the STATIC attended span (visible
+    # prefix + this chunk) can exceed it; otherwise full attention is
+    # exact and the flash kernel stays in play
+    windowed = window is not None and prefix_blocks * bs_ + s > window
+    if not windowed:
+        window = None
     # same (32, 128) int8 tile constraint as the decode dispatch
-    kernel_ok = not quant or data_.shape[3] % 32 == 0
+    kernel_ok = (not quant or bs_ % 32 == 0) and not windowed
     if s > 1 and kernel_ok and _pallas_prefill_enabled():
         # flash path: online softmax, scores never leave VMEM; the cached
         # prefix streams from HBM by its TRUE length (start), so the
@@ -200,6 +217,10 @@ def prefill_attention(
         sf = softcap(sf, logit_cap)
     i = jnp.arange(s, dtype=jnp.int32)
     allow_f = (i[None, :, None] >= i[None, None, :]) & (i[None, None, :] < fresh)
+    if window is not None:
+        # fresh-fresh distance is the chunk-index gap (both offsets from
+        # the same block-aligned start)
+        allow_f &= (i[None, :, None] - i[None, None, :]) < window
     sf = jnp.where(allow_f[:, None, None], sf, -jnp.inf)
 
     if prefix_blocks == 0:
@@ -224,6 +245,11 @@ def prefill_attention(
         sp = softcap(sp, logit_cap)
     slot = jnp.arange(t, dtype=jnp.int32)
     allow_p = slot[None, None, :] < start[:, None, None]
+    if window is not None:
+        # prefix slot t IS absolute position t (the fast path's identity
+        # block layout); query i sits at absolute start + i
+        q_pos = start[:, None, None] + i[None, :, None]
+        allow_p &= (q_pos - slot[None, None, :]) < window
     sp = jnp.where(allow_p[:, None, None], sp, -jnp.inf)
 
     scores = jnp.concatenate([sp, sf], axis=-1)  # [B, Hk, G, S, T+S]
@@ -425,12 +451,15 @@ def paged_attention(
     positions: jax.Array,    # [B, S] int32 — absolute position of each query token
     sm_scale: float | None = None,
     logit_cap: float | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Attention of S new tokens against their sequence's paged context.
 
     Causal by absolute position: query at position p sees cache slots
     0..p (the new tokens' K/V must already be in the cache — call
-    :func:`write_kv_cache` first).  Returns [B, S, H, D].
+    :func:`write_kv_cache` first).  ``window`` adds sliding-window
+    masking (Mistral/Phi3): slot j additionally needs p − j < window.
+    Returns [B, S, H, D].
     """
     b, s, h, d = q.shape
     _, bs, hk, _ = k_cache.shape
@@ -455,6 +484,10 @@ def paged_attention(
     visible = (slot[None, None, :] <= positions[:, :, None]) & (
         slot[None, None, :] < lens[:, None, None]
     )  # [B, S, T]
+    if window is not None:
+        # sliding window: the last `window` positions only (HF semantics:
+        # attend iff q_pos − k_pos < window)
+        visible &= (positions[:, :, None] - slot[None, None, :]) < window
     scores = jnp.where(visible[:, None, None, :, :], scores, -jnp.inf)
 
     probs = jax.nn.softmax(scores, axis=-1)

@@ -43,13 +43,19 @@ def ring_attention_inner(
     causal: bool = True,
     sm_scale: Optional[float] = None,
     logit_cap: Optional[float] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Per-device ring attention body (call under shard_map).
 
     Returns [B, Sq, Hq, D] in q.dtype.  GQA handled by repeating kv heads.
     Masking is position-based (q_pos >= kv_pos), so ragged/padded chunks
-    work: give padding keys a position larger than any query.
+    work: give padding keys a position larger than any query.  ``window``
+    adds sliding-window masking (q_pos − kv_pos < window).
     """
+    if window is not None and not causal:
+        # the window mask lives inside the causal branch; silently
+        # ignoring it for bidirectional callers would be a wrong answer
+        raise ValueError("window requires causal=True")
     n = jax.lax.psum(1, axis_name)
     b, sq, hq, d = q.shape
     hk = k.shape[2]
@@ -71,6 +77,9 @@ def ring_attention_inner(
             s = softcap(s, logit_cap)
         if causal:
             mask = q_pos[:, None, None, :, None] >= kv_pos_c[:, None, None, None, :]
+            if window is not None:
+                mask &= (q_pos[:, None, None, :, None]
+                         - kv_pos_c[:, None, None, None, :]) < window
             s = jnp.where(mask, s, _NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1))
         p = jnp.exp(s - m_new[..., None])
@@ -110,13 +119,14 @@ def ring_attention(
     causal: bool = True,
     sm_scale: Optional[float] = None,
     logit_cap: Optional[float] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Sequence-parallel attention: inputs sharded on their seq axis over
     ``mesh[axis]``; output keeps that sharding.  q/k/v: [B, S, H, D] global;
     q_pos/kv_pos: [B, S] global positions."""
     inner = functools.partial(
         ring_attention_inner, axis_name=axis, causal=causal,
-        sm_scale=sm_scale, logit_cap=logit_cap,
+        sm_scale=sm_scale, logit_cap=logit_cap, window=window,
     )
     seq = P(None, axis, None, None)
     pos = P(None, axis)

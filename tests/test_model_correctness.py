@@ -535,3 +535,127 @@ def test_qwen3_moe_matches_hf():
     with pytest.raises(ValueError, match="sparse"):
         ModelConfig.from_hf_config({**d, "mlp_only_layers": [0]},
                                    dtype="float32")
+
+
+def test_mistral_sliding_window_matches_hf():
+    """EXACT sliding-window attention (Mistral): a window SMALLER than
+    the prompt must mask old keys exactly like HF's eager implementation
+    — full prefill, chunked prefill, and token-by-token decode through
+    the paged cache all agree."""
+    torch = pytest.importorskip("torch")
+    from transformers import MistralConfig, MistralForCausalLM
+
+    torch.manual_seed(3)
+    hf_cfg = MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, sliding_window=16,
+        attn_implementation="eager",
+    )
+    hf = MistralForCausalLM(hf_cfg).eval()
+    cfg = ModelConfig.from_hf_config(hf_cfg.to_dict(), dtype="float32")
+    assert cfg.sliding_window == 16
+    model = LlamaModel(cfg)
+    params = load_params_from_state_dict(cfg, hf.state_dict())
+
+    tokens = list(np.random.RandomState(5).randint(0, 128, size=40))
+    with torch.no_grad():
+        ref = hf(torch.tensor([tokens])).logits[0].float().numpy()
+    # HF must actually be windowing, or this test proves nothing: the
+    # full-attention run must DIFFER on positions past the window
+    with torch.no_grad():
+        hf_cfg_full = MistralConfig(**{**hf_cfg.to_dict(),
+                                       "sliding_window": None})
+        hf_full = MistralForCausalLM(hf_cfg_full).eval()
+        hf_full.load_state_dict(hf.state_dict())
+        ref_full = hf_full(torch.tensor([tokens])).logits[0].float().numpy()
+    assert np.abs(ref[20:] - ref_full[20:]).max() > 1e-4, \
+        "HF did not apply the sliding window; test is vacuous"
+
+    got = _run_ours(model, params, tokens, chunks=[40])
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=5e-3)
+    got2 = _run_ours(model, params, tokens, chunks=[9, 7] + [1] * 24)
+    np.testing.assert_allclose(got2, ref, rtol=2e-2, atol=5e-3)
+
+
+def test_sliding_window_noop_when_context_fits(monkeypatch):
+    """The static no-op gate: when the context bound (M·Bs) fits inside
+    the window, the dispatch must treat the call as FULL attention
+    (window=None reaches the oracle — the property that keeps the flash
+    kernels in play on TPU); when it can exceed the window, the window
+    must reach the oracle."""
+    import importlib
+
+    import jax as _jax
+
+    pa = importlib.import_module("dynamo_tpu.ops.paged_attention")
+    seen = []
+    real = pa.paged_attention
+
+    def spy(*args, **kw):
+        seen.append(kw.get("window"))
+        return real(*args, **kw)
+
+    monkeypatch.setattr(pa, "paged_attention", spy)
+    cfg = ModelConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, num_kv_heads=2,
+                      max_position_embeddings=256, dtype="float32",
+                      sliding_window=512)
+    model = LlamaModel(cfg)
+    params = model.init_params(_jax.random.PRNGKey(4))
+    tokens = list(np.random.RandomState(6).randint(0, 128, size=24))
+    # MAX_BLOCKS*BLOCK = 384 < 512: gate fires, oracle sees window=None
+    _run_ours(model, params, tokens, chunks=[24])
+    assert seen and set(seen) == {None}, seen
+
+    seen.clear()
+    cfg2 = ModelConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                       num_layers=2, num_heads=4, num_kv_heads=2,
+                       max_position_embeddings=256, dtype="float32",
+                       sliding_window=16)
+    model2 = LlamaModel(cfg2)
+    _run_ours(model2, params, tokens, chunks=[24])
+    assert seen and set(seen) == {16}, seen
+
+
+def test_mistral_sliding_window_engine_fast_prefill_matches_hf():
+    """The ENGINE's chunked prefill takes the fast-prefill path
+    (prefix_blocks buckets) — its fresh/prefix window masks are the
+    subtlest code in the windowing diff and must match HF generate."""
+    torch = pytest.importorskip("torch")
+    from transformers import MistralConfig, MistralForCausalLM
+
+    from dynamo_tpu.engine import EngineConfig, EngineCore
+    from dynamo_tpu.engine.request import EngineRequest
+    from dynamo_tpu.llm.protocols import SamplingOptions, StopConditions
+
+    torch.manual_seed(11)
+    hf_cfg = MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, sliding_window=16,
+        attn_implementation="eager",
+    )
+    hf = MistralForCausalLM(hf_cfg).eval()
+    cfg = ModelConfig.from_hf_config(hf_cfg.to_dict(), dtype="float32")
+    model = LlamaModel(cfg)
+    params = load_params_from_state_dict(cfg, hf.state_dict())
+    prompt = list(np.random.RandomState(8).randint(1, 128, size=30))
+    n = 10
+    with torch.no_grad():
+        want = hf.generate(torch.tensor([prompt]), max_new_tokens=n,
+                           do_sample=False,
+                           use_cache=True)[0][len(prompt):].tolist()
+    engine = EngineCore(model, params, EngineConfig(
+        max_batch_size=2, max_model_len=128, block_size=16, num_blocks=24,
+        prefill_chunk_tokens=16), eos_token_ids=[])
+    toks = []
+    engine.submit(EngineRequest(
+        request_id="w", prompt=prompt,
+        sampling=SamplingOptions(temperature=0.0),
+        stops=StopConditions(max_tokens=n, ignore_eos=True),
+        emit=lambda o: toks.extend(o.token_ids)))
+    for _ in range(100):
+        if not engine.step():
+            break
+    assert toks == want, (toks, want)

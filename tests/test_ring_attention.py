@@ -289,3 +289,29 @@ def test_deepseek_expanded_rejects_sp_at_construction():
                                 block_size=16, num_blocks=32,
                                 sp_prefill_threshold=64),
                    mesh=mesh, eos_token_ids=[])
+
+
+def test_seq_parallel_sliding_window_matches_paged(mesh):
+    """Sliding-window masking rides the ring too: SP prefill of a
+    windowed model equals the paged windowed forward."""
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_position_embeddings=256,
+        sliding_window=16, dtype="float32",
+    )
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(2))
+    s, bs = 64, 8
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (1, s), 0, 128)
+    positions = jnp.arange(s, dtype=jnp.int32)[None, :]
+    hidden_sp, _ = model.forward_seq_parallel(params, tokens, positions, mesh)
+    n_blocks = s // bs
+    cache = model.init_kv_cache(num_blocks=n_blocks + 1, block_size=bs)
+    hidden_paged, _ = model.forward(
+        params, tokens, positions, cache,
+        jnp.arange(n_blocks, dtype=jnp.int32)[None, :],
+        jnp.asarray([s], jnp.int32), positions,
+    )
+    np.testing.assert_allclose(np.asarray(hidden_sp),
+                               np.asarray(hidden_paged),
+                               rtol=2e-4, atol=2e-4)
