@@ -757,6 +757,12 @@ def main() -> None:
             time.monotonic() + max(wall_deadline - time.time(), 60.0))
     global _BACKEND_READY
     _BACKEND_READY = True
+    # persistent XLA compilation cache (VERDICT r5 next #1): a respawned
+    # or second-window bench starts warm — compiles become disk hits,
+    # logged hit/miss by the jax cache loggers
+    from dynamo_tpu.utils.compilation_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     # whole-run watchdog: a backend that hangs (rather than raises) after
     # init would otherwise block the measurement forever
     run_timeout = float(os.environ.get("DYNAMO_BENCH_RUN_TIMEOUT", "3600"))

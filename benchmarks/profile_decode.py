@@ -71,6 +71,9 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    from dynamo_tpu.utils.compilation_cache import enable_persistent_cache
+
+    enable_persistent_cache()  # warm-start respawns (VERDICT r5 next #1)
     from dynamo_tpu.engine.core import multi_decode_step
     from dynamo_tpu.engine.sampling import sample_full
     from dynamo_tpu.models.config import ModelConfig

@@ -92,10 +92,18 @@ async def sweep_level(url, model, prompt, osl, concurrency, requests_per_conc):
 
 
 async def run(args):
-    # WordLevel + WhitespaceSplit: ONE token per repetition, so the
-    # prompt really is args.isl input tokens (a former //2 halved the
-    # claimed ISL — not comparable to reference genai-perf numbers)
-    prompt = "benchmark " * max(1, args.isl)
+    # Per-mode ISL calibration (ADVICE r5): the in-process modes
+    # (--spawn-echo/--native) detokenize with WordLevel + WhitespaceSplit
+    # — ONE token per "benchmark " repetition, so repetitions == tokens.
+    # Plain --url mode talks to a real server whose BPE tokenizer splits
+    # the same word into ~2 tokens; repeating it args.isl times would
+    # DOUBLE the actual ISL vs the claimed one.  --tokens-per-word
+    # overrides the mode default (1.0 in-process, 2.0 url) when the
+    # target tokenizer is known to differ.
+    tpw = args.tokens_per_word
+    if tpw is None:
+        tpw = 1.0 if getattr(args, "_in_process", False) else 2.0
+    prompt = "benchmark " * max(1, round(args.isl / tpw))
     rows = []
     for conc in args.concurrency:
         row = await sweep_level(
@@ -158,6 +166,9 @@ async def run_with_native(args):
     import jax
 
     from benchmarks.profile_decode import MODELS
+    from dynamo_tpu.utils.compilation_cache import enable_persistent_cache
+
+    enable_persistent_cache()  # warm-start respawns (VERDICT r5 next #1)
     from dynamo_tpu.engine import AsyncLLMEngine, EngineConfig, EngineCore
     from dynamo_tpu.models.config import ModelConfig
     from dynamo_tpu.models.llama import LlamaModel
@@ -215,12 +226,18 @@ def main(argv=None):
     p.add_argument("--concurrency", type=lambda s: [int(x) for x in s.split(",")],
                    default=[1, 2, 4, 8, 16])
     p.add_argument("--requests-per-conc", type=int, default=4)
+    p.add_argument("--tokens-per-word", type=float, default=None,
+                   help="tokens the target tokenizer produces per "
+                        "'benchmark ' repetition (default: 1.0 for "
+                        "--spawn-echo/--native WordLevel, 2.0 for --url "
+                        "BPE servers) — keeps claimed ISL honest")
     p.add_argument("--spawn-echo", action="store_true",
                    help="boot an in-process echo-engine server (harness test)")
     p.add_argument("--native", default=None, metavar="MODEL",
                    help="boot the real engine at this geometry "
                         "(tiny|1b|8b|moe) behind an in-process server")
     args = p.parse_args(argv)
+    args._in_process = bool(args.native or args.spawn_echo)
     if args.native:
         if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
             # the image's sitecustomize pins the TPU plugin through

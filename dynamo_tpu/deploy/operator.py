@@ -21,19 +21,24 @@ With a coordinator connection the operator goes beyond the reference's
 controller: per-deployment ``status`` phases are derived from LIVE worker
 registrations (the dyn:// endpoint each service's command names —
 Pending/Degraded/Ready, Unknown when unobservable), and services with an
-``autoscale`` block scale on one of two signals (planner-lite; the
-reference only documents its Planner, docs/architecture.md:47):
+``autoscale`` block scale on one of two signals, BOTH delegated to the
+shared planner policy (dynamo_tpu/planner/policy.py — the reference
+Planner's decision kernel, docs/architecture.md:47; the sdk supervisor
+actuates the same functions locally):
 
   * ``signal: queue`` (default) — remote-prefill queue depth: replicas
-    level toward ceil(depth / target_per_replica).
+    level toward ceil(depth / target_per_replica)
+    (planner.policy.prefill_replica_target).
   * ``signal: decode`` — decode-side saturation from the live metrics
     plane ({ns}.kv_metrics.*, the same ForwardPassMetrics the KV router
     schedules on): per-worker max(slot usage, KV-block usage) averaged
-    over the service's registered workers, levelled toward
-    ``target_usage`` (default 0.7) with the HPA-style formula
-    ceil(replicas × usage / target).
+    over the REPORTING workers, levelled toward ``target_usage``
+    (default 0.7) with the HPA formula ceil(reporting × usage / target);
+    reporting < registered holds current replicas
+    (planner.policy.decode_replica_target).
 
-Both clamp to [min, max], scale up immediately, down one step per tick.
+Both clamp to [min, max]; levelling is planner.policy.step_replicas —
+scale up immediately, down one step per tick.
 """
 
 from __future__ import annotations
@@ -43,7 +48,6 @@ import copy
 import hashlib
 import json
 import logging
-import math
 import re
 import subprocess
 import time
@@ -53,6 +57,7 @@ from typing import Optional, Protocol
 import yaml
 
 from dynamo_tpu.deploy.renderer import DeploymentSpec, ServiceSpec, render_manifests
+from dynamo_tpu.planner import policy as planner_policy
 
 log = logging.getLogger("dynamo_tpu.operator")
 
@@ -455,17 +460,18 @@ class Operator:
 
     def _decode_want(self, ns: str, insts: dict, svc: ServiceSpec,
                      auto: dict, lo: int, hi: int):
-        """(want, usage) from decode-side saturation: per registered
-        worker, max(active-slot usage, KV-block usage) from its latest
-        fresh ForwardPassMetrics, averaged over the service's workers,
-        levelled with the HPA formula ceil(reporting × usage / target) —
-        the multiplier is the REPORTING worker count, not the desired
-        replicas: during a scale-up the new pods haven't registered yet,
-        and multiplying by the desired count would compound the same
-        saturation into max within two ticks.  No fresh metrics → hold
-        at the clamped current value (scaling on silence would act on
-        absence of evidence, but [min, max] edits still apply)."""
-        target = max(1e-3, float(auto.get("target_usage", 0.7)))
+        """(want, usage) from decode-side saturation, delegated to the
+        SHARED planner policy (planner/policy.py decode_replica_target —
+        the same formula the planner loop and supervisor actuate on).
+        Per registered worker, max(active-slot usage, KV-block usage)
+        from its latest fresh ForwardPassMetrics feeds the HPA formula
+        ceil(reporting × usage / target).  The policy holds current
+        replicas whenever the reporting count falls short of the
+        REGISTERED count — no metrics at all, or some workers silent
+        (stale publisher, startup lag): scaling on a fresh-only subset
+        would shrink the product and act on absence of evidence
+        (ADVICE r5).  [min, max] edits still apply on hold."""
+        target = float(auto.get("target_usage", 0.7))
         stale = float(auto.get("stale_after_s", 15.0))
         now = time.monotonic()
         ids = []
@@ -480,16 +486,15 @@ class Operator:
             m = store.get(wid)
             if not m or now - m.get("_rx", 0.0) > stale:
                 continue
-            slot = m.get("request_active_slots", 0) / max(
-                m.get("request_total_slots", 1), 1)
-            kv = m.get("kv_active_blocks", 0) / max(
-                m.get("kv_total_blocks", 1), 1)
-            usages.append(max(slot, kv))
-        if not usages:
-            return min(hi, max(lo, svc.replicas)), None
-        usage = sum(usages) / len(usages)
-        want = min(hi, max(lo, math.ceil(len(usages) * usage / target)))
-        return want, usage
+            usages.append(planner_policy.WorkerSample(
+                worker_id=wid,
+                request_active_slots=m.get("request_active_slots", 0),
+                request_total_slots=m.get("request_total_slots", 1),
+                kv_active_blocks=m.get("kv_active_blocks", 0),
+                kv_total_blocks=m.get("kv_total_blocks", 1),
+            ).usage)
+        return planner_policy.decode_replica_target(
+            svc.replicas, len(ids), usages, target, lo, hi)
 
     async def observe(self) -> None:
         """Refresh live worker counts and autoscale signals from the
@@ -542,11 +547,12 @@ class Operator:
                     queue = auto.get("queue") or f"{ns}_prefill_queue"
                     depth = await self.coordinator.queue_len(queue)
                     depths[key] = depth
-                    per = max(1, int(auto.get("target_per_replica", 4)))
-                    want = min(hi, max(lo, math.ceil(depth / per)))
+                    want = planner_policy.prefill_replica_target(
+                        depth, svc.replicas,
+                        int(auto.get("target_per_replica", 4)), lo, hi)
                     detail = f"queue={depth}"
-                if want != svc.replicas:
-                    new = want if want > svc.replicas else svc.replicas - 1
+                new = planner_policy.step_replicas(svc.replicas, want)
+                if new != svc.replicas:
                     log.info("autoscale %s/%s: %s -> replicas %d -> %d",
                              dep, svc.name, detail, svc.replicas, new)
                     svc.replicas = new

@@ -53,6 +53,11 @@ class Metrics:
         # duration keyed by (model, status): near-zero error/disconnect
         # requests must not pull the success series' percentiles down
         self.duration: dict[tuple[str, str], Histogram] = defaultdict(Histogram)
+        # (model, priority) -> requests shed by admission control (429)
+        self.shed: dict[tuple[str, str], int] = defaultdict(int)
+        # live TTFT taps (seconds) — the admission controller subscribes
+        # here so its deadline estimates track the serving latency plane
+        self.ttft_listeners: list = []
 
     def guard(self, model: str, endpoint: str) -> "InflightGuard":
         return InflightGuard(self, model, endpoint)
@@ -71,6 +76,11 @@ class Metrics:
         lines.append(f"# TYPE {PREFIX}_output_tokens_total counter")
         for model, n in sorted(self.tokens_out.items()):
             lines.append(f'{PREFIX}_output_tokens_total{{model="{model}"}} {n}')
+        lines.append(f"# TYPE {PREFIX}_admission_shed_total counter")
+        for (model, priority), n in sorted(self.shed.items()):
+            lines.append(
+                f'{PREFIX}_admission_shed_total{{model="{model}",priority="{priority}"}} {n}'
+            )
         lines.append(f"# TYPE {PREFIX}_ttft_seconds histogram")
         for model, h in sorted(self.ttft.items()):
             lines.extend(h.render(f"{PREFIX}_ttft_seconds",
@@ -99,7 +109,10 @@ class InflightGuard:
         """Record TTFT once, at the first generated-token emission."""
         if not self._saw_first:
             self._saw_first = True
-            self._m.ttft[self.model].observe(time.monotonic() - self._t0)
+            dt = time.monotonic() - self._t0
+            self._m.ttft[self.model].observe(dt)
+            for listener in self._m.ttft_listeners:
+                listener(dt)
 
     def ok(self) -> None:
         self._status = "success"

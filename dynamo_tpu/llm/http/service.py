@@ -88,9 +88,16 @@ class ModelManager:
 
 
 class HttpService:
-    def __init__(self, manager: Optional[ModelManager] = None, host: str = "127.0.0.1", port: int = 8080):
+    def __init__(self, manager: Optional[ModelManager] = None, host: str = "127.0.0.1", port: int = 8080,
+                 admission=None):
         self.manager = manager or ModelManager()
         self.metrics = Metrics()
+        # optional planner AdmissionController: per-tenant rate limits,
+        # priority classes, deadline-aware shedding (429 + Retry-After).
+        # Its wait estimates feed off this service's live TTFT plane.
+        self.admission = admission
+        if admission is not None:
+            self.metrics.ttft_listeners.append(admission.observe_ttft)
         self.host = host
         self.port = port
         self._runner: Optional[web.AppRunner] = None
@@ -152,9 +159,29 @@ class HttpService:
             return web.json_response(err.body(), status=err.status)
 
         guard = None
+        ticket = None
         try:
             parsed = parse_request(body, chat=chat)
             entry = self.manager.get(parsed.model)
+            if self.admission is not None:
+                priority = (request.headers.get("x-priority")
+                            or body.get("priority"))
+                tenant = (request.headers.get("x-tenant")
+                          or request.headers.get("authorization")
+                          or "default")
+                from dynamo_tpu.planner.admission import AdmissionRejected
+
+                try:
+                    ticket = await self.admission.acquire(tenant, priority)
+                except AdmissionRejected as e:
+                    # shed: the SLA-preserving no.  Retry-After tells the
+                    # client when capacity is likely (ref 429 semantics)
+                    self.metrics.shed[(parsed.model, priority or "normal")] += 1
+                    self.metrics.requests[(parsed.model, endpoint, "shed")] += 1
+                    err = OpenAIError(str(e), status=429, err_type="overloaded")
+                    return web.json_response(
+                        err.body(), status=429,
+                        headers={"Retry-After": str(e.retry_after_s)})
             guard = self.metrics.guard(parsed.model, endpoint)
             rid = new_id("chatcmpl" if chat else "cmpl")
             # n>1: fan out independent generations of the same prompt; the
@@ -188,6 +215,8 @@ class HttpService:
             err = OpenAIError("internal error", status=500, err_type="internal_error")
             return web.json_response(err.body(), status=err.status)
         finally:
+            if ticket is not None:
+                ticket.release()
             if guard:
                 guard.close()
 

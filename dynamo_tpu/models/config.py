@@ -171,18 +171,22 @@ class ModelConfig:
                 # use_sliding_window (default False) — honoring the number
                 # without the gate would wrongly window full-attention models
                 sliding = None
-            elif cfg.get("max_window_layers", 0):
+            elif cfg.get("max_window_layers", None) != 0:
                 import logging
 
                 # HF windows only layers >= max_window_layers; a uniform
                 # window over the scan-over-layers decoder would corrupt
                 # the full-attention lower layers — same treatment as
-                # Gemma2's interleave: full attention + a loud warning
+                # Gemma2's interleave: full attention + a loud warning.
+                # An ABSENT key means the HF default, which is nonzero
+                # (e.g. 28 for Qwen2) — also non-uniform, NOT a uniform
+                # window over all layers (ADVICE r5)
                 logging.getLogger("dynamo_tpu.models").warning(
-                    "%s use_sliding_window with max_window_layers=%d "
+                    "%s use_sliding_window with max_window_layers=%s "
                     "(non-uniform layer windows): served with full "
                     "attention — outputs match HF only for contexts "
-                    "within the window", arch, cfg["max_window_layers"],
+                    "within the window", arch,
+                    cfg.get("max_window_layers", "absent (HF default)"),
                 )
                 sliding = None
         if sliding and arch == "Gemma2ForCausalLM":

@@ -1,0 +1,56 @@
+"""SLA-driven planner subsystem (reference Planner parity,
+docs/architecture.md:47): admission control for the HTTP frontend, a
+pure planning policy over live ForwardPassMetrics, and pluggable
+actuation backends (sdk supervisor, k8s operator).
+
+See docs/planner.md for the policy's inputs/outputs, admission
+semantics, and the role-flip state machine.
+"""
+
+from dynamo_tpu.planner.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    AdmissionRejected,
+    PriorityClass,
+    TokenBucket,
+)
+from dynamo_tpu.planner.core import (
+    LogActuator,
+    PlannerLoop,
+    SupervisorActuator,
+)
+from dynamo_tpu.planner.policy import (
+    MetricsSnapshot,
+    Plan,
+    PlannerConfig,
+    PlannerPolicy,
+    PolicyState,
+    PoolSnapshot,
+    WorkerSample,
+    decode_replica_target,
+    plan,
+    prefill_replica_target,
+    step_replicas,
+)
+
+__all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "AdmissionRejected",
+    "PriorityClass",
+    "TokenBucket",
+    "LogActuator",
+    "PlannerLoop",
+    "SupervisorActuator",
+    "MetricsSnapshot",
+    "Plan",
+    "PlannerConfig",
+    "PlannerPolicy",
+    "PolicyState",
+    "PoolSnapshot",
+    "WorkerSample",
+    "decode_replica_target",
+    "plan",
+    "prefill_replica_target",
+    "step_replicas",
+]

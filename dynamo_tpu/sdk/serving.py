@@ -185,6 +185,9 @@ class ServeSupervisor:
         self.restart = restart
         self.procs: dict[str, subprocess.Popen] = {}
         self._envs: dict[str, dict[str, str]] = {}  # per-worker env_extra for respawn
+        # planner-adjusted worker counts per service (scale()); absent =
+        # the graph's declared svc.workers
+        self._desired: dict[str, int] = {}
         self._coordinator = None
         self.allocator = TpuAllocator()
 
@@ -228,6 +231,48 @@ class ServeSupervisor:
             env=env,
         )
         log.info("spawned %s (pid %s)", key, self.procs[key].pid)
+
+    def _stop_worker(self, key: str) -> None:
+        """Terminate one worker and return its chips; popped from procs
+        FIRST so watch() can never mistake the exit for a crash."""
+        proc = self.procs.pop(key, None)
+        if proc is None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        self.allocator.release(self._envs.pop(key, {}))
+        log.info("stopped %s", key)
+
+    async def scale(self, service_name: str, replicas: int) -> int:
+        """Level one service's worker-process count toward ``replicas``
+        (the planner's SupervisorActuator calls this; a prefill↔decode
+        role flip is one pool scaling down while the other scales up,
+        chips flowing through the allocator).  Returns the new count."""
+        replicas = max(0, int(replicas))
+        entry = self._load_entry()
+        by_name = {s.name: s for s in entry.closure(self.graph.partition(":")[0])}
+        svc = by_name.get(service_name)
+        if svc is None:
+            raise KeyError(f"service {service_name!r} not in graph {self.graph}")
+        self._desired[service_name] = replicas
+        mine = sorted(
+            (k for k in self.procs if k.rsplit(":", 1)[0] == service_name),
+            key=lambda k: int(k.rsplit(":", 1)[1]),
+        )
+        # scale down: stop highest worker indices first
+        for key in mine[replicas:][::-1]:
+            self._stop_worker(key)
+        # scale up: fill the missing indices
+        have = {int(k.rsplit(":", 1)[1]) for k in self.procs
+                if k.rsplit(":", 1)[0] == service_name}
+        for idx in range(replicas):
+            if idx not in have:
+                self._spawn(svc, idx, self.allocator.allocate(svc))
+        return sum(1 for k in self.procs
+                   if k.rsplit(":", 1)[0] == service_name)
 
     async def watch(self) -> None:
         """Restart crashed workers until stop() (watcher loop parity)."""

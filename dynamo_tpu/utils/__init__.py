@@ -1,5 +1,6 @@
-"""Shared utilities (platform forcing, misc helpers)."""
+"""Shared utilities (platform forcing, compilation cache, misc helpers)."""
 
+from dynamo_tpu.utils.compilation_cache import enable_persistent_cache
 from dynamo_tpu.utils.platform import force_cpu_devices
 
-__all__ = ["force_cpu_devices"]
+__all__ = ["force_cpu_devices", "enable_persistent_cache"]

@@ -81,10 +81,20 @@ class Frontend:
             self._cfg.get("served_model_name", "dynamo-tpu"),
             _ProcessorEngine(client),
         )
+        # SLA admission control (docs/planner.md): an `admission:` block
+        # in the config enables rate limits, priority classes, and
+        # deadline-aware 429 shedding on this frontend
+        admission = None
+        adm = self._cfg.get("admission")
+        if adm:
+            from dynamo_tpu.planner import AdmissionConfig, AdmissionController
+
+            admission = AdmissionController(AdmissionConfig.from_dict(adm))
         self.http = HttpService(
             manager,
             host=self._cfg.get("host", "127.0.0.1"),
             port=int(self._cfg.get("port", 8000)),
+            admission=admission,
         )
         await self.http.start()
         self.port = self.http.port

@@ -1,0 +1,53 @@
+"""Persistent XLA compilation cache is configured (VERDICT r5 next #1):
+the bench/serve entrypoints call enable_persistent_cache() so respawned
+processes warm-start from disk instead of recompiling."""
+
+import os
+
+
+def test_enable_persistent_cache_configures_jax(tmp_path, monkeypatch):
+    import jax
+
+    from dynamo_tpu.utils.compilation_cache import enable_persistent_cache
+
+    target = str(tmp_path / "xla-cache")
+    try:
+        got = enable_persistent_cache(target)
+        assert got == target
+        assert os.path.isdir(target)
+        assert jax.config.jax_compilation_cache_dir == target
+        # sub-second compiles must be cached too: a serving boot is dozens
+        # of small jits, not one big one
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.0
+
+        # env var override wins when no explicit path is given
+        alt = str(tmp_path / "alt")
+        monkeypatch.setenv("DYNAMO_XLA_CACHE_DIR", alt)
+        assert enable_persistent_cache() == alt
+        assert jax.config.jax_compilation_cache_dir == alt
+    finally:
+        # the config is process-global: a tmp dir must not outlive the
+        # test as the suite's cache location
+        jax.config.update("jax_compilation_cache_dir", None)
+
+
+def test_unwritable_cache_dir_degrades_to_cold(tmp_path):
+    from dynamo_tpu.utils.compilation_cache import enable_persistent_cache
+
+    blocker = tmp_path / "file"
+    blocker.write_text("not a dir")
+    # a path that cannot become a directory: run cold, do not die
+    assert enable_persistent_cache(str(blocker / "nested")) is None
+
+
+def test_entrypoints_call_enable(tmp_path):
+    """The wiring itself: every entrypoint named by VERDICT r5 #1 routes
+    through enable_persistent_cache (source-level check — the call sites
+    run on-accelerator paths a CPU test cannot reach end-to-end)."""
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    for rel in ("bench.py", "benchmarks/serve_bench.py",
+                "benchmarks/profile_decode.py", "dynamo_tpu/cli.py"):
+        text = (repo / rel).read_text()
+        assert "enable_persistent_cache" in text, rel

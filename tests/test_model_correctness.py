@@ -537,6 +537,33 @@ def test_qwen3_moe_matches_hf():
                                    dtype="float32")
 
 
+def test_qwen_max_window_layers_gate():
+    """Qwen sliding-window gating (ADVICE r5): HF windows only layers >=
+    max_window_layers, and the HF DEFAULT for an absent key is nonzero
+    (e.g. 28 for Qwen2) — so use_sliding_window without the key must take
+    the warn-and-full-attention path, NOT a uniform window.  Only an
+    EXPLICIT max_window_layers: 0 means every layer is windowed."""
+    base = dict(
+        architectures=["Qwen2ForCausalLM"], vocab_size=128, hidden_size=64,
+        intermediate_size=128, num_hidden_layers=4, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=256,
+        sliding_window=16, use_sliding_window=True,
+    )
+    # key absent → HF default (nonzero): full attention, window dropped
+    assert ModelConfig.from_hf_config(dict(base),
+                                      dtype="float32").sliding_window is None
+    # nonzero boundary → same non-uniform treatment
+    assert ModelConfig.from_hf_config({**base, "max_window_layers": 2},
+                                      dtype="float32").sliding_window is None
+    # explicit 0 → uniform window over all layers: honored exactly
+    assert ModelConfig.from_hf_config({**base, "max_window_layers": 0},
+                                      dtype="float32").sliding_window == 16
+    # gate off → window ignored regardless
+    assert ModelConfig.from_hf_config(
+        {**base, "use_sliding_window": False, "max_window_layers": 0},
+        dtype="float32").sliding_window is None
+
+
 def test_mistral_sliding_window_matches_hf():
     """EXACT sliding-window attention (Mistral): a window SMALLER than
     the prompt must mask old keys exactly like HF's eager implementation
