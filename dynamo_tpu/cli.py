@@ -520,14 +520,24 @@ async def _cmd_coordinator(args) -> None:
 
 
 async def start_router_service(runtime, namespace: str = "default",
-                               block_size: int = 16):
+                               block_size: int = 16,
+                               workers_endpoint: str | None = None):
     """Wire a live KvRouter behind `dyn://{ns}.router.generate` (shared by
-    the CLI command and tests).  Returns the router."""
+    the CLI command and tests).  Returns the router.
+
+    ``workers_endpoint`` ("component/endpoint", e.g. "backend/generate")
+    watches that endpoint's discovery prefix so a dead worker's delete
+    event evicts it from the router's candidate set immediately."""
     from dynamo_tpu.llm.kv_router.metrics_aggregator import KvRouterSubscriber
     from dynamo_tpu.llm.kv_router.router import KvRouter
 
+    workers_prefix = None
+    if workers_endpoint:
+        comp, _, ep = workers_endpoint.partition("/")
+        workers_prefix = f"{namespace}/components/{comp}/endpoints/{ep or 'generate'}/"
     router = KvRouter(block_size=block_size)
-    await KvRouterSubscriber(router, runtime.coordinator, namespace).start()
+    await KvRouterSubscriber(router, runtime.coordinator, namespace,
+                             workers_prefix=workers_prefix).start()
     # KvRouter IS the endpoint engine: its generate() yields one
     # wire-serializable decision dict per request
     ep = runtime.namespace(namespace).component("router").endpoint("generate")
@@ -544,7 +554,8 @@ async def _cmd_router(args) -> None:
 
     runtime = await DistributedRuntime.connect(_runtime_config(args))
     ns = args.namespace or "default"
-    await start_router_service(runtime, ns, args.block_size)
+    await start_router_service(runtime, ns, args.block_size,
+                               workers_endpoint=args.workers_endpoint)
     log.info("router service up: dyn://%s.router.generate", ns)
     await asyncio.Event().wait()
 
@@ -907,6 +918,9 @@ def _parser() -> argparse.ArgumentParser:
         "router", help="standalone KV-aware router service"
     )
     router.add_argument("--block-size", type=int, default=16)
+    router.add_argument("--workers-endpoint", default="backend/generate",
+                        help="component/endpoint whose discovery deletes "
+                             "evict workers from the router")
     common(router)
 
     operator = sub.add_parser(

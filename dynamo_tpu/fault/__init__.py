@@ -1,0 +1,32 @@
+"""Fault-tolerance plane: request migration, health probes, graceful drain.
+
+The reference Dynamo treats worker death as routine — lib/llm/src/
+migration.rs re-seeds an interrupted stream onto a surviving worker with
+the tokens generated so far, and workers deregister-then-drain on
+shutdown.  This package is that plane for the TPU runtime:
+
+  * :class:`MigratingClient`  — mid-stream request migration + connect
+    retry over a runtime.distributed Client (migration.py)
+  * :class:`HealthMonitor`    — active ping probes over the TCP request
+    plane; marks instances *suspect* seconds before their coordinator
+    lease would expire (health.py)
+  * ``Endpoint.drain()`` / ``DistributedRuntime.drain_all()`` — the
+    graceful-drain lifecycle lives on runtime.distributed; this package
+    carries its counters
+  * :class:`FaultInjector`    — deterministic fault injection for tests
+    and the soak harness (injector.py)
+"""
+
+from dynamo_tpu.fault.counters import FaultCounters, counters
+from dynamo_tpu.fault.health import HealthMonitor
+from dynamo_tpu.fault.injector import FaultInjector
+from dynamo_tpu.fault.migration import MigrationExhausted, MigratingClient
+
+__all__ = [
+    "MigratingClient",
+    "MigrationExhausted",
+    "HealthMonitor",
+    "FaultInjector",
+    "FaultCounters",
+    "counters",
+]

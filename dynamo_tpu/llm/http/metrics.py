@@ -12,7 +12,10 @@ import time
 from collections import defaultdict
 from typing import Iterator
 
+from dynamo_tpu.fault.counters import counters as fault_counters
+
 PREFIX = "dynamo_tpu_http_service"
+FAULT_PREFIX = "dynamo_tpu_fault"
 
 # seconds; TTFT and whole-request durations share one ladder
 _BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
@@ -90,6 +93,17 @@ class Metrics:
             lines.extend(h.render(
                 f"{PREFIX}_request_seconds",
                 f'model="{model}",status="{status}"'))
+        # fault plane (process-global): migrations performed, drains live,
+        # instances currently suspect per the health probes
+        lines.append(f"# TYPE {FAULT_PREFIX}_migrations_total counter")
+        lines.append(f"{FAULT_PREFIX}_migrations_total "
+                     f"{fault_counters.migrations_total}")
+        lines.append(f"# TYPE {FAULT_PREFIX}_drains_in_progress gauge")
+        lines.append(f"{FAULT_PREFIX}_drains_in_progress "
+                     f"{fault_counters.drains_in_progress}")
+        lines.append(f"# TYPE {FAULT_PREFIX}_suspect_instances gauge")
+        lines.append(f"{FAULT_PREFIX}_suspect_instances "
+                     f"{fault_counters.suspect_instances()}")
         return "\n".join(lines) + "\n"
 
 

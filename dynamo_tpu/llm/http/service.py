@@ -202,6 +202,15 @@ class HttpService:
                 ctxs = [Context(v) for v in variants]
             else:
                 ctxs = [Context(parsed) for _ in range(parsed.n)]
+            # per-request migration budget (fault plane): "x-migration-limit:
+            # 0" opts a request out of mid-stream migration entirely
+            mig_limit = request.headers.get("x-migration-limit")
+            if mig_limit is not None:
+                try:
+                    for c in ctxs:
+                        c.annotations["migration_limit"] = max(0, int(mig_limit))
+                except ValueError:
+                    pass
             streams = [entry.engine.generate(c) for c in ctxs]
             if parsed.stream:
                 return await self._stream_response(request, ctxs, streams, rid, parsed, chat, guard)
@@ -323,6 +332,12 @@ class HttpService:
             usage = usage_dict(ctxs[0].annotations.get("prompt_tokens", 0), n_out)
             if chat:
                 await resp.write(sse_encode(chat_chunk(rid, parsed.model, usage=usage)))
+            # headers are long gone on a stream, so the migration marker
+            # rides an SSE comment (spec-legal, ignored by parsers)
+            migrated = max((c.annotations.get("migrations", 0) for c in ctxs),
+                           default=0)
+            if migrated:
+                await resp.write(f": x-migrated {migrated}\n\n".encode())
             await resp.write(SSE_DONE)
             guard.ok()
             self.metrics.tokens_out[parsed.model] += n_out
@@ -404,4 +419,7 @@ class HttpService:
                 resp["choices"].extend(piece["choices"])
         guard.ok()
         self.metrics.tokens_out[parsed.model] += n_out
-        return web.json_response(resp)
+        migrated = max((c.annotations.get("migrations", 0) for c in ctxs),
+                       default=0)
+        headers = {"x-migrated": str(migrated)} if migrated else None
+        return web.json_response(resp, headers=headers)

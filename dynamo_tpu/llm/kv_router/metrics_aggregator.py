@@ -83,7 +83,10 @@ class KvMetricsAggregator:
 
 class KvRouterSubscriber:
     """Makes a KvRouter live: events → indexer, metrics → scheduler,
-    hit-rate decisions → `{ns}.kv_hit_rate` for the metrics component."""
+    hit-rate decisions → `{ns}.kv_hit_rate` for the metrics component,
+    and (``workers_prefix``) discovery deletes → worker teardown, so a
+    dead worker stops attracting prefix-hit routing the moment its lease
+    expires instead of lingering until its metrics go stale."""
 
     def __init__(
         self,
@@ -91,14 +94,27 @@ class KvRouterSubscriber:
         coordinator,
         namespace: str = "default",
         hit_rate_flush_s: float = 1.0,
+        workers_prefix: Optional[str] = None,
     ):
         self.router = router
         self.coord = coordinator
         self.namespace = namespace
         self.hit_rate_flush_s = hit_rate_flush_s
+        self.workers_prefix = workers_prefix
         self.aggregator = KvMetricsAggregator(coordinator, router.scheduler, namespace)
         self._ev_sub: Optional[int] = None
+        self._watch_id: Optional[int] = None
         self._hit_task: Optional[asyncio.Task] = None
+
+    def _on_discovery(self, event: str, key: str, value) -> None:
+        if event != "delete":
+            return
+        try:
+            wid = int(key.rsplit("/", 1)[-1], 16)
+        except ValueError:
+            return
+        log.info("worker %x left discovery; removing from router", wid)
+        self.router.remove_worker(wid)
 
     def _on_event(self, subject: str, payload: bytes) -> None:
         try:
@@ -131,11 +147,21 @@ class KvRouterSubscriber:
         self._ev_sub = await self.coord.subscribe(
             events_subject(self.namespace), self._on_event
         )
+        if self.workers_prefix:
+            self._watch_id, _ = await self.coord.watch(
+                self.workers_prefix, self._on_discovery
+            )
         await self.aggregator.start()
         self._hit_task = asyncio.ensure_future(self._flush_hit_events())
         return self
 
     async def stop(self) -> None:
+        if self._watch_id is not None:
+            try:
+                await self.coord.unwatch(self._watch_id)
+            except (ConnectionError, RuntimeError):
+                pass
+            self._watch_id = None
         if self._hit_task:
             self._hit_task.cancel()
             try:

@@ -99,6 +99,7 @@ class KvScheduler:
         self.selector = selector or DefaultWorkerSelector()
         self.block_size = block_size
         self._workers: dict[int, WorkerMetrics] = {}
+        self._suspects: set[int] = set()
         self._hit_events: list[KVHitRateEvent] = []
 
     # ------------------------------------------------------------ worker set
@@ -107,14 +108,33 @@ class KvScheduler:
 
     def remove_worker(self, worker_id: int) -> None:
         self._workers.pop(worker_id, None)
+        self._suspects.discard(worker_id)
 
     def workers(self) -> dict[int, WorkerMetrics]:
         return dict(self._workers)
 
+    # ---------------------------------------------------------- suspect state
+    # fed by the fault plane's HealthMonitor (fault/health.py): a suspect
+    # worker stops attracting prefix-hit routing seconds before its lease
+    # would expire, but is NOT forgotten — a recovered probe restores it.
+    def mark_suspect(self, worker_id: int) -> None:
+        self._suspects.add(worker_id)
+
+    def clear_suspect(self, worker_id: int) -> None:
+        self._suspects.discard(worker_id)
+
+    def suspects(self) -> set[int]:
+        return set(self._suspects)
+
     # -------------------------------------------------------------- schedule
     def schedule(self, overlaps: dict[int, int], request_tokens: int) -> int:
         request_blocks = max(1, request_tokens // self.block_size)
-        wid = self.selector.select(self._workers, overlaps, request_blocks)
+        candidates = {w: m for w, m in self._workers.items()
+                      if w not in self._suspects}
+        # every worker suspect = probes failing cluster-wide (or the probe
+        # plane itself broke): routing somewhere beats routing nowhere
+        wid = self.selector.select(candidates or self._workers, overlaps,
+                                   request_blocks)
         if wid is None:
             raise AllWorkersBusy("no live workers")
         self._hit_events.append(

@@ -256,11 +256,12 @@ class DecodeWorker(AsyncEngine):
             if not first_task.done():
                 first_task.cancel()
                 # let the cancellation reach the inner generator before
-                # aclose() — aclose() on a still-running generator raises
-                try:
-                    await first_task
-                except (asyncio.CancelledError, StopAsyncIteration, Exception):
-                    pass
+                # aclose() — aclose() on a still-running generator raises.
+                # gather(return_exceptions=True) absorbs first_task's own
+                # CancelledError/errors but still re-raises if THIS task is
+                # cancelled from outside — swallowing that would wedge
+                # shutdown (the caller's cancel would never land).
+                await asyncio.gather(first_task, return_exceptions=True)
             if not alloc_fut.done():
                 alloc_fut.cancel()
             await agen.aclose()

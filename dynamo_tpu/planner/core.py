@@ -63,7 +63,10 @@ class LogActuator:
 class SupervisorActuator:
     """Scale sdk-supervised worker processes toward the plan.  A role
     flip needs no special casing: the plan's replica numbers already
-    moved one worker between pools, so two scale() calls realize it."""
+    moved one worker between pools, so two scale() calls realize it.
+    Downscales are graceful: the supervisor's SIGTERM triggers the
+    worker's drain lifecycle (deregister → finish in-flight → exit), so
+    a flip completes live streams instead of amputating them."""
 
     def __init__(self, supervisor, prefill_service: str, decode_service: str):
         self.supervisor = supervisor

@@ -48,6 +48,9 @@ class DistributedRuntime:
         self.coordinator: Optional[CoordinatorClient] = None
         self._tcp_server: Optional[EndpointTcpServer] = None
         self.primary_lease: Optional[int] = None
+        # every Endpoint.serve() registers here so drain_all() can run the
+        # graceful-drain lifecycle over the whole process on shutdown
+        self._served: list[tuple["Endpoint", int]] = []
 
     @classmethod
     async def connect(cls, config: Optional[RuntimeConfig] = None) -> "DistributedRuntime":
@@ -65,6 +68,18 @@ class DistributedRuntime:
             await self._tcp_server.stop()
         if self.coordinator:
             await self.coordinator.close()
+
+    async def drain_all(self, timeout: float = 30.0) -> None:
+        """Gracefully drain every endpoint this runtime serves: discovery
+        keys go first (no new routing), live streams finish, then subjects
+        deregister.  Callers follow with shutdown().  The serve_worker
+        SIGTERM path rides this so a supervisor downscale / planner role
+        flip never amputates in-flight requests."""
+        served, self._served = self._served, []
+        await asyncio.gather(
+            *(ep.drain(lease_id=iid, timeout=timeout) for ep, iid in served),
+            return_exceptions=True,
+        )
 
     @property
     def instance_id(self) -> int:
@@ -161,8 +176,50 @@ class Endpoint:
         created = await rt.coordinator.kv_create(key, info, lease_id=instance_id)
         if not created:
             raise RuntimeError(f"endpoint instance already registered at {key}")
+        rt._served.append((self, instance_id))
         log.info("serving %s as instance %x on %s:%s", self.url, instance_id, info["host"], info["port"])
         return Instance(instance_id, info["host"], info["port"], subject, metadata)
+
+    async def drain(self, lease_id: Optional[int] = None, timeout: float = 30.0) -> bool:
+        """Graceful drain of this endpoint's instance (ref: the reference
+        workers deregister-then-drain on shutdown).  Order matters:
+
+          1. delete the discovery key — routing stops sending new work;
+          2. wait for in-flight requests on the subject to finish;
+          3. deregister the engine from the TCP server.
+
+        Returns True if the subject went idle inside ``timeout``.  Safe to
+        call twice (the second delete/unregister is a no-op)."""
+        from dynamo_tpu.fault.counters import counters
+
+        rt = self.runtime
+        iid = lease_id or rt.primary_lease
+        subject = self.subject(iid)
+        counters.drains_in_progress += 1
+        try:
+            try:
+                # bounded: a stalled coordinator must not hold up process
+                # shutdown — if the delete can't land, the lease expiry
+                # deletes the key for us; keep draining local streams
+                await asyncio.wait_for(
+                    rt.coordinator.kv_delete(f"{self.discovery_prefix}{iid:x}"),
+                    min(2.0, timeout))
+            except (ConnectionError, RuntimeError, asyncio.TimeoutError):
+                log.warning("drain of %s: discovery delete failed", self.url)
+            rt._served = [(e, i) for e, i in rt._served
+                          if not (i == iid and e.subject(i) == subject)]
+            idle = True
+            if rt._tcp_server is not None:
+                idle = await rt._tcp_server.wait_idle(subject, timeout)
+                if not idle:
+                    log.warning(
+                        "drain of %s instance %x timed out with %d streams live",
+                        self.url, iid, rt._tcp_server.inflight(subject))
+                rt._tcp_server.unregister(subject)
+            log.info("drained %s instance %x (idle=%s)", self.url, iid, idle)
+            return idle
+        finally:
+            counters.drains_in_progress -= 1
 
     # ----------------------------------------------------------------- client
     async def client(self) -> "Client":
@@ -178,7 +235,13 @@ class Client(AsyncEngine):
         self.endpoint = endpoint
         self._instances: dict[int, Instance] = {}
         self._conns: dict[int, EndpointTcpClient] = {}
-        self._rr = 0
+        # round-robin cursor: the LAST instance id handed out.  Tracking
+        # the id (not a list index) keeps rotation stable when membership
+        # churn reshuffles the sorted id list under us.
+        self._rr_last: Optional[int] = None
+        # optional fault/health.HealthMonitor (anything with
+        # is_suspect(instance_id)); picks deprioritize suspect instances
+        self.health = None
         self._watch_id: Optional[int] = None
         self._changed = asyncio.Event()
         # seen-then-deleted instance ids, insertion-ordered so the churn
@@ -289,18 +352,38 @@ class Client(AsyncEngine):
             self._conns[instance_id] = conn
         return conn
 
-    def pick_random(self) -> int:
+    def _candidate_ids(self, exclude: Optional[set] = None) -> list[int]:
+        """Live instance ids minus exclusions, with suspect instances
+        deprioritized: a suspect id is only eligible when every healthy id
+        is also excluded (better a maybe-dead worker than none)."""
         ids = self.instance_ids()
+        if exclude:
+            ids = [i for i in ids if i not in exclude] or ids
+        if self.health is not None:
+            healthy = [i for i in ids if not self.health.is_suspect(i)]
+            if healthy:
+                return healthy
+        return ids
+
+    def pick_random(self, exclude: Optional[set] = None) -> int:
+        ids = self._candidate_ids(exclude)
         if not ids:
             raise RuntimeError(f"no instances of {self.endpoint.url}")
         return _random.choice(ids)
 
     def pick_round_robin(self) -> int:
-        ids = self.instance_ids()
+        ids = self._candidate_ids()
         if not ids:
             raise RuntimeError(f"no instances of {self.endpoint.url}")
-        self._rr = (self._rr + 1) % len(ids)
-        return ids[self._rr]
+        # first id strictly after the last pick, wrapping — the first call
+        # starts at ids[0] (no pre-increment skip), and a membership change
+        # just continues the rotation from the same cursor id
+        if self._rr_last is None:
+            pick = ids[0]
+        else:
+            pick = next((i for i in ids if i > self._rr_last), ids[0])
+        self._rr_last = pick
+        return pick
 
     def direct(self, request: Context, instance_id: int) -> AsyncIterator[Any]:
         return self._direct_stream(request, instance_id)

@@ -3,7 +3,9 @@
 Frame layout: [u32 header_len][u32 payload_len][header JSON][payload bytes]
 (big-endian).  The header carries control/routing metadata; the payload is
 opaque bytes (JSON bodies, or raw tensor data for KV-block transfer, which
-must not pay a JSON/base64 tax).
+must not pay a JSON/base64 tax).  Control frames (stop/kill, and the
+fault plane's ping/pong health probes — transports/tcp.py) are
+header-only: zero payload, so a probe costs 8 bytes + the header.
 
 Reference parity: lib/runtime/src/pipeline/network/codec/two_part.rs.
 """

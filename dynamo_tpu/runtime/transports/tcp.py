@@ -9,9 +9,15 @@ control frames, ref ControlMessage network.rs:58) shares the socket.
 Frames (framing.py headers):
   client → server:  {type:"request",  req_id} + payload(serde)
                     {type:"stop"|"kill", req_id}
+                    {type:"ping", req_id}
   server → client:  {type:"item", req_id} + payload(serde)
                     {type:"end",  req_id}
                     {type:"error", req_id, error}
+                    {type:"pong", req_id}
+
+``ping``/``pong`` is the health-probe plane (fault/health.py): it rides the
+same socket as requests, so a pong proves the whole request path — not just
+that the port accepts connections.
 """
 
 from __future__ import annotations
@@ -19,7 +25,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
-from typing import Any, AsyncIterator, Optional
+from typing import Any, AsyncIterator, Callable, Optional
 
 from dynamo_tpu.runtime import serde
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
@@ -27,9 +33,26 @@ from dynamo_tpu.runtime.transports.framing import read_frame, write_frame
 
 log = logging.getLogger("dynamo_tpu.tcp")
 
-__all__ = ["EndpointTcpServer", "EndpointTcpClient"]
+__all__ = [
+    "EndpointTcpServer",
+    "EndpointTcpClient",
+    "TransportError",
+    "EndpointDisconnected",
+]
 
 _END = object()
+_PONG = object()
+
+
+class TransportError(ConnectionError):
+    """Typed failure on the endpoint request plane.  Subclasses
+    ConnectionError so pre-existing handlers keep working; the fault
+    plane (fault/migration.py) keys migration decisions off this type."""
+
+
+class EndpointDisconnected(TransportError):
+    """The peer vanished mid-stream — server death, socket cut, or a
+    reset — as opposed to an application error the engine reported."""
 
 
 class EndpointTcpServer:
@@ -42,12 +65,53 @@ class EndpointTcpServer:
         self._engines: dict[str, AsyncEngine] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set[asyncio.StreamWriter] = set()
+        self._handlers: set[asyncio.Task] = set()
+        # per-subject in-flight request counts + idle events: the drain
+        # lifecycle (Endpoint.drain) waits on these so a deregistered
+        # endpoint finishes its live streams before the process stops
+        self._inflight: dict[str, int] = {}
+        self._idle_events: dict[str, asyncio.Event] = {}
+        # deterministic fault-injection seam (fault/injector.py): called
+        # with each outbound frame header; may return "drop" (swallow the
+        # frame) or "sever" (abort the peer's transport mid-stream)
+        self.fault_hook: Optional[Callable[[dict], Optional[str]]] = None
 
     def register(self, subject: str, engine: AsyncEngine) -> None:
         self._engines[subject] = engine
 
     def unregister(self, subject: str) -> None:
         self._engines.pop(subject, None)
+
+    # ------------------------------------------------------- drain support
+    def inflight(self, subject: str) -> int:
+        """Live request count for one registered subject."""
+        return self._inflight.get(subject, 0)
+
+    def _track(self, subject: str, delta: int) -> None:
+        n = self._inflight.get(subject, 0) + delta
+        self._inflight[subject] = n
+        ev = self._idle_events.get(subject)
+        if n <= 0:
+            self._inflight.pop(subject, None)
+            if ev:
+                ev.set()
+        elif ev:
+            ev.clear()
+
+    async def wait_idle(self, subject: str, timeout: float = 30.0) -> bool:
+        """Block until no request for ``subject`` is in flight (True), or
+        the timeout lapses with streams still live (False)."""
+        ev = self._idle_events.setdefault(subject, asyncio.Event())
+        ev.clear()
+        # re-check after registering (no await in between): the last
+        # stream may have finished before the event existed to be set
+        if self._inflight.get(subject, 0) <= 0:
+            return True
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return self._inflight.get(subject, 0) <= 0
 
     async def start(self) -> "EndpointTcpServer":
         if self._server is None:
@@ -63,15 +127,56 @@ class EndpointTcpServer:
             for w in list(self._conns):
                 w.close()
             await self._server.wait_closed()
+            await self._reap_handlers()
+            self._server = None
+
+    async def _reap_handlers(self) -> None:
+        """Cancel and await connection handlers still winding down —
+        py3.10's wait_closed() doesn't wait on them, and a prompt stop()
+        must not leave tasks to be destroyed with the loop."""
+        for t in list(self._handlers):
+            t.cancel()
+        if self._handlers:
+            await asyncio.gather(*self._handlers, return_exceptions=True)
+        self._handlers.clear()
+
+    async def abort(self) -> None:
+        """Hard-kill: drop the listener and RST every live connection
+        without flushing — the fault injector's 'worker died mid-stream'.
+        Unlike stop(), peers see an abrupt reset, not a clean FIN."""
+        if self._server:
+            self._server.close()
+            for w in list(self._conns):
+                try:
+                    w.transport.abort()
+                except Exception:
+                    pass
+            await self._server.wait_closed()
+            await self._reap_handlers()
             self._server = None
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         self._conns.add(writer)
+        task = asyncio.current_task()
+        if task is not None:
+            self._handlers.add(task)
+            task.add_done_callback(self._handlers.discard)
         contexts: dict[int, Context] = {}
         tasks: dict[int, asyncio.Task] = {}
         wlock = asyncio.Lock()
 
         async def send(header: dict, payload: bytes = b"") -> None:
+            hook = self.fault_hook
+            if hook is not None:
+                action = hook(header)
+                if action == "drop":
+                    return
+                if action == "sever":
+                    try:
+                        writer.transport.abort()
+                    except Exception:
+                        pass
+                    return
             async with wlock:
                 try:
                     write_frame(writer, header, payload)
@@ -87,6 +192,7 @@ class EndpointTcpServer:
                 return
             ctx = Context(data)
             contexts[req_id] = ctx
+            self._track(subject, +1)
             try:
                 async for item in engine.generate(ctx):
                     await send({"type": "item", "req_id": req_id}, serde.dumps(item))
@@ -95,6 +201,7 @@ class EndpointTcpServer:
                 log.exception("endpoint %s request failed", subject)
                 await send({"type": "error", "req_id": req_id, "error": str(e)})
             finally:
+                self._track(subject, -1)
                 contexts.pop(req_id, None)
                 tasks.pop(req_id, None)
 
@@ -119,13 +226,20 @@ class EndpointTcpServer:
                     ctx = contexts.get(req_id)
                     if ctx:
                         ctx.kill()
+                elif ftype == "ping":
+                    await send({"type": "pong", "req_id": req_id})
         finally:
             # peer gone: kill all in-flight requests from this connection
             self._conns.discard(writer)
             for ctx in contexts.values():
                 ctx.kill()
-            for t in tasks.values():
+            pending = [t for t in tasks.values() if not t.done()]
+            for t in pending:
                 t.cancel()
+            if pending:
+                # await the cancellations so stop()/abort() reaping this
+                # handler leaves no engine task to die with the loop
+                await asyncio.gather(*pending, return_exceptions=True)
             writer.close()
 
 
@@ -214,6 +328,8 @@ class EndpointTcpClient(AsyncEngine):
                     q.put_nowait(serde.loads(payload))
                 elif ftype == "end":
                     q.put_nowait(_END)
+                elif ftype == "pong":
+                    q.put_nowait(_PONG)
                 elif ftype == "error":
                     q.put_nowait(RuntimeError(header.get("error", "remote error")))
         finally:
@@ -224,7 +340,9 @@ class EndpointTcpClient(AsyncEngine):
             if reader is self._reader:
                 self._connected = False
                 for q in self._streams.values():
-                    q.put_nowait(ConnectionError("endpoint connection lost"))
+                    q.put_nowait(EndpointDisconnected(
+                        f"endpoint {self.subject!r} connection lost "
+                        f"({self.host}:{self.port})"))
 
     async def _send(self, header: dict, payload: bytes = b"") -> None:
         async with self._wlock:
@@ -237,6 +355,44 @@ class EndpointTcpClient(AsyncEngine):
                 # fresh instead of deterministically reusing the corpse
                 self._connected = False
                 raise
+
+    async def ping(self, timeout: float = 1.0) -> float:
+        """Round-trip a ping control frame over the live request socket;
+        returns the latency in seconds.  Raises TransportError (dead or
+        unresponsive peer) — the health prober's suspect signal."""
+        try:
+            await self.connect()
+        except OSError as e:
+            if isinstance(e, TransportError):
+                raise
+            raise TransportError(
+                f"dial {self.host}:{self.port} failed: {e}") from e
+        req_id = next(self._ids)
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[req_id] = q
+        self._idle.clear()
+        t0 = asyncio.get_running_loop().time()
+        try:
+            await self._send({"type": "ping", "req_id": req_id})
+            try:
+                item = await asyncio.wait_for(q.get(), timeout)
+            except asyncio.TimeoutError:
+                raise TransportError(
+                    f"ping to {self.host}:{self.port} timed out after {timeout}s"
+                ) from None
+            if item is not _PONG:
+                raise TransportError(
+                    f"ping to {self.host}:{self.port} failed: {item!r}")
+            return asyncio.get_running_loop().time() - t0
+        except OSError as e:
+            if not isinstance(e, TransportError):
+                raise TransportError(
+                    f"ping to {self.host}:{self.port} failed: {e}") from e
+            raise
+        finally:
+            self._streams.pop(req_id, None)
+            if not self._streams:
+                self._idle.set()
 
     def generate(self, request: Context) -> AsyncIterator[Any]:
         return self._generate(request)
@@ -273,9 +429,16 @@ class EndpointTcpClient(AsyncEngine):
                 )
                 if cancel_task in done and not get_task.done():
                     get_task.cancel()
-                    await self._send(
-                        {"type": "kill" if request.is_killed else "stop", "req_id": req_id}
-                    )
+                    try:
+                        await self._send(
+                            {"type": "kill" if request.is_killed else "stop",
+                             "req_id": req_id}
+                        )
+                    except (ConnectionError, RuntimeError, OSError):
+                        # peer already gone: cancelling a stream on a dead
+                        # socket is a no-op — the read loop surfaces the
+                        # disconnect through the queue on its own
+                        pass
                     cancel_task = asyncio.ensure_future(asyncio.Event().wait())  # never again
                     continue
                 item = get_task.result()

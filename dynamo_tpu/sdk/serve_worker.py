@@ -39,6 +39,16 @@ async def amain(graph: str, service_name: str) -> None:
     for sig in (signal.SIGTERM, signal.SIGINT):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    # graceful drain (fault plane): discovery keys go first so routing
+    # stops sending work, in-flight streams finish, then the transport
+    # stops — a supervisor downscale or planner role flip never
+    # amputates live requests.  Grace bounded below the supervisor's
+    # SIGKILL escalation window.
+    grace = float(os.environ.get("DYNTPU_DRAIN_GRACE_S", "10"))
+    try:
+        await asyncio.wait_for(runtime.drain_all(timeout=grace), grace + 2)
+    except asyncio.TimeoutError:
+        log.warning("%s drain timed out after %.1fs", service_name, grace)
     await runtime.shutdown()
 
 

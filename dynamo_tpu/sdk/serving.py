@@ -178,11 +178,15 @@ class ServeSupervisor:
         config: Optional[ServiceConfig] = None,
         coordinator_url: Optional[str] = None,
         restart: bool = True,
+        drain_grace_s: float = 12.0,
     ):
         self.graph = graph
         self.config = config or ServiceConfig()
         self.coordinator_url = coordinator_url
         self.restart = restart
+        # SIGTERM → serve_worker drains (discovery delete, finish streams)
+        # → exits; only after this window does the supervisor SIGKILL
+        self.drain_grace_s = drain_grace_s
         self.procs: dict[str, subprocess.Popen] = {}
         self._envs: dict[str, dict[str, str]] = {}  # per-worker env_extra for respawn
         # planner-adjusted worker counts per service (scale()); absent =
@@ -218,6 +222,9 @@ class ServeSupervisor:
         env.update(env_extra)
         env.update(self.config.to_env())
         env["DYNTPU_COORDINATOR"] = self.coordinator_url
+        # worker drains strictly inside our SIGKILL escalation window
+        env.setdefault("DYNTPU_DRAIN_GRACE_S",
+                       str(max(1.0, self.drain_grace_s - 2.0)))
         key = f"{svc.name}:{worker_idx}"
         self._envs[key] = dict(env_extra)
         self.procs[key] = subprocess.Popen(
@@ -233,15 +240,21 @@ class ServeSupervisor:
         log.info("spawned %s (pid %s)", key, self.procs[key].pid)
 
     def _stop_worker(self, key: str) -> None:
-        """Terminate one worker and return its chips; popped from procs
-        FIRST so watch() can never mistake the exit for a crash."""
+        """Gracefully stop one worker and return its chips; popped from
+        procs FIRST so watch() can never mistake the exit for a crash.
+        SIGTERM triggers the worker's drain lifecycle (serve_worker.py:
+        deregister from discovery, finish in-flight streams); SIGKILL only
+        lands after drain_grace_s — so a planner role flip or downscale
+        completes live requests instead of amputating them."""
         proc = self.procs.pop(key, None)
         if proc is None:
             return
         proc.terminate()
         try:
-            proc.wait(timeout=5)
+            proc.wait(timeout=self.drain_grace_s)
         except subprocess.TimeoutExpired:
+            log.warning("%s did not drain in %.1fs; killing",
+                        key, self.drain_grace_s)
             proc.kill()
         self.allocator.release(self._envs.pop(key, {}))
         log.info("stopped %s", key)

@@ -15,6 +15,14 @@ from dynamo_tpu.utils import force_cpu_devices
 force_cpu_devices(8)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long soak / fault-injection tests excluded from tier-1 "
+        "(-m 'not slow')",
+    )
+
+
 def make_tiny_hf_checkpoint(dst, *, vocab_size=128, hidden_size=32,
                             intermediate_size=64, num_hidden_layers=2,
                             num_attention_heads=4, num_key_value_heads=2,

@@ -1,0 +1,577 @@
+"""Fault-tolerance plane tests: mid-stream migration, health probes,
+suspect-aware routing, graceful drain, and the acceptance e2e (an HTTP
+streaming completion whose worker dies mid-generation completes, migrated
+— and a drain-based role flip loses zero in-flight requests)."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import ClientSession
+
+from dynamo_tpu.fault import FaultInjector, HealthMonitor, MigratingClient
+from dynamo_tpu.fault.counters import counters
+from dynamo_tpu.fault.migration import MigrationExhausted
+from dynamo_tpu.llm.protocols import (
+    BackendInput,
+    FinishReason,
+    LLMEngineOutput,
+    StopConditions,
+)
+from dynamo_tpu.runtime import serde
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.distributed import Client, DistributedRuntime, Endpoint
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.transports.coordinator import CoordinatorServer
+from dynamo_tpu.runtime.transports.tcp import EndpointTcpServer
+
+serde.register_llm_types()
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_counters():
+    counters.reset()
+    yield
+    counters.reset()
+
+
+async def _coordinator():
+    return await CoordinatorServer(port=0).start()
+
+
+async def _runtime(url) -> DistributedRuntime:
+    return await DistributedRuntime.connect(
+        RuntimeConfig(coordinator_url=url, lease_ttl_s=5.0))
+
+
+class CountingEngine(AsyncEngine):
+    """Decode stand-in with REAL re-seed semantics: token i continues the
+    prompt arithmetically (prompt[-1]+1, +2, ...), so a migrated request
+    only produces the right sequence if the re-seeded prompt really
+    carries the tokens the dead worker already emitted."""
+
+    def __init__(self, delay_s: float = 0.02):
+        self.delay_s = delay_s
+
+    def generate(self, request):
+        return self._run(request)
+
+    async def _run(self, request):
+        inp = request.data
+        last = inp.token_ids[-1]
+        n = inp.stops.max_tokens or 4
+        for i in range(1, n + 1):
+            if request.is_stopped:
+                yield LLMEngineOutput(finish_reason=FinishReason.CANCELLED)
+                return
+            await asyncio.sleep(self.delay_s)
+            yield LLMEngineOutput(
+                token_ids=[last + i],
+                finish_reason=FinishReason.LENGTH if i == n else None,
+            )
+
+
+def _busy_runtime(runtimes):
+    """The runtime whose TCP server is currently serving a stream."""
+    for rt in runtimes:
+        srv = rt._tcp_server
+        if srv is not None and any(n > 0 for n in srv._inflight.values()):
+            return rt
+    return None
+
+
+async def _two_worker_setup(srv, engine_factory=CountingEngine):
+    w1 = await _runtime(srv.url)
+    w2 = await _runtime(srv.url)
+    fe = await _runtime(srv.url)
+    for w in (w1, w2):
+        await w.namespace("dyn").component("backend").endpoint("generate") \
+            .serve(engine_factory())
+    client = await fe.namespace("dyn").component("backend") \
+        .endpoint("generate").client()
+    await client.wait_for_instances(2)
+    return w1, w2, fe, client
+
+
+# ------------------------------------------------------------- migration ----
+
+
+def test_migration_mid_stream_kill_completes_sequence():
+    """Kill the serving worker's TCP plane mid-generation: the stream
+    migrates to the survivor with the emitted tokens re-seeded, and the
+    user sees the complete, correct token sequence."""
+    async def go():
+        srv = await _coordinator()
+        injector = FaultInjector()
+        try:
+            w1, w2, fe, client = await _two_worker_setup(srv)
+            mig = MigratingClient(client, backoff_s=0.01)
+            ctx = Context(BackendInput(
+                token_ids=[100], stops=StopConditions(max_tokens=8)))
+            got = []
+            killed = False
+            async for out in mig.generate(ctx):
+                got.extend(out.token_ids)
+                if len(got) == 2 and not killed:
+                    killed = True
+                    await injector.kill_tcp_server(_busy_runtime([w1, w2]))
+            assert got == list(range(101, 109))
+            assert ctx.annotations["migrations"] == 1
+            assert counters.migrations_total == 1
+            await client.close()
+            for rt in (w1, w2, fe):
+                await rt.shutdown()
+        finally:
+            await srv.stop()
+
+    run(go())
+
+
+def test_migration_opt_out_and_exhaustion():
+    """migration_limit=0 (per-request opt-out) surfaces the disconnect
+    instead of migrating; with every worker dead the budget exhausts."""
+    async def go():
+        srv = await _coordinator()
+        injector = FaultInjector()
+        try:
+            w1, w2, fe, client = await _two_worker_setup(srv)
+            mig = MigratingClient(client, backoff_s=0.01)
+
+            # opt-out: the kill must surface as MigrationExhausted (typed
+            # ConnectionError), not silently migrate
+            ctx = Context(BackendInput(
+                token_ids=[10], stops=StopConditions(max_tokens=8)))
+            ctx.annotations["migration_limit"] = 0
+            with pytest.raises(ConnectionError):
+                got = []
+                async for out in mig.generate(ctx):
+                    got.extend(out.token_ids)
+                    if len(got) == 2:
+                        await injector.kill_tcp_server(_busy_runtime([w1, w2]))
+            assert counters.migrations_total == 0
+
+            # both planes dead mid-stream: bounded attempts, typed failure
+            ctx2 = Context(BackendInput(
+                token_ids=[10], stops=StopConditions(max_tokens=8)))
+            with pytest.raises(MigrationExhausted):
+                got = []
+                async for out in MigratingClient(
+                        client, migration_limit=2, connect_retries=1,
+                        backoff_s=0.01).generate(ctx2):
+                    got.extend(out.token_ids)
+                    if len(got) == 1:
+                        await injector.kill_tcp_server(w1)
+                        await injector.kill_tcp_server(w2)
+            await client.close()
+            for rt in (w1, w2, fe):
+                await rt.shutdown()
+        finally:
+            await srv.stop()
+
+    run(go())
+
+
+def test_connect_retry_with_backoff():
+    """A dial failure before any token (worker plane briefly down) burns
+    connect retries with jittered backoff, not migration budget — and
+    succeeds once the plane is back."""
+    async def go():
+        srv = await _coordinator()
+        injector = FaultInjector()
+        try:
+            w1 = await _runtime(srv.url)
+            fe = await _runtime(srv.url)
+            await w1.namespace("dyn").component("backend") \
+                .endpoint("generate").serve(CountingEngine(delay_s=0.0))
+            port = w1._tcp_server.port
+            client = await fe.namespace("dyn").component("backend") \
+                .endpoint("generate").client()
+            await client.wait_for_instances(1)
+            await injector.kill_tcp_server(w1)  # discovery key survives
+
+            async def revive():
+                await asyncio.sleep(0.15)
+                # same port, fresh plane — like a fast in-place restart
+                w1._tcp_server = None
+                srv2 = await EndpointTcpServer(port=port).start()
+                srv2.register(
+                    w1.namespace("dyn").component("backend")
+                    .endpoint("generate").subject(w1.instance_id),
+                    CountingEngine(delay_s=0.0))
+                w1._tcp_server = srv2
+
+            reviver = asyncio.ensure_future(revive())
+            ctx = Context(BackendInput(
+                token_ids=[5], stops=StopConditions(max_tokens=3)))
+            mig = MigratingClient(client, connect_retries=20, backoff_s=0.02)
+            got = [t async for o in mig.generate(ctx) for t in o.token_ids]
+            await reviver
+            assert got == [6, 7, 8]
+            assert ctx.annotations.get("migrations") is None  # no hop burned
+            await client.close()
+            await fe.shutdown()
+            await w1.shutdown()
+        finally:
+            await srv.stop()
+
+    run(go())
+
+
+# ----------------------------------------------------------- round robin ----
+
+
+def test_round_robin_starts_at_first_and_survives_churn():
+    """Satellite regression: the first pick must be instance 0 (the old
+    pre-increment skipped it), and rotation continues from the cursor id
+    when membership churns instead of re-deriving position."""
+    client = Client(Endpoint(DistributedRuntime(), "ns", "c", "e"))
+    for iid in (1, 2, 3):
+        client._add({"instance_id": iid, "host": "h", "port": 1,
+                     "subject": f"s{iid}"})
+    assert [client.pick_round_robin() for _ in range(4)] == [1, 2, 3, 1]
+    # churn: 2 dies while the cursor sits at 1 — rotation resumes at 3,
+    # not back at the start
+    client._instances.pop(2)
+    assert [client.pick_round_robin() for _ in range(3)] == [3, 1, 3]
+    # new instance joins: picked in id order on the next wrap
+    client._add({"instance_id": 2, "host": "h", "port": 1, "subject": "s2"})
+    assert [client.pick_round_robin() for _ in range(3)] == [1, 2, 3]
+
+
+# ---------------------------------------------------------- health plane ----
+
+
+def test_health_monitor_suspects_and_recovers():
+    """A worker whose request plane dies turns suspect within
+    fail_threshold probes (long before its lease would expire) and stops
+    being picked; a revived plane clears the suspicion."""
+    async def go():
+        srv = await _coordinator()
+        injector = FaultInjector()
+        try:
+            w1, w2, fe, client = await _two_worker_setup(srv)
+            suspects_seen, recovered_seen = [], []
+            mon = HealthMonitor(
+                client, interval_s=0.05, timeout_s=0.3, fail_threshold=2,
+                on_suspect=suspects_seen.append,
+                on_recover=recovered_seen.append)
+            client.health = mon
+            port = w1._tcp_server.port
+
+            await mon.probe_once()
+            assert mon.suspect_ids() == set()
+
+            await injector.kill_tcp_server(w1)
+            await mon.probe_once()
+            await mon.probe_once()
+            assert mon.suspect_ids() == {w1.instance_id}
+            assert suspects_seen == [w1.instance_id]
+            assert counters.suspect_instances() == 0  # not started → no source
+            await mon.start()
+            assert counters.suspect_instances() == 1
+
+            # picks avoid the suspect while a healthy instance exists
+            for _ in range(20):
+                assert client.pick_random() == w2.instance_id
+                assert client.pick_round_robin() == w2.instance_id
+
+            # revive on the same port: next probe clears the suspicion
+            w1._tcp_server = None
+            srv2 = await EndpointTcpServer(port=port).start()
+            srv2.register(
+                w1.namespace("dyn").component("backend")
+                .endpoint("generate").subject(w1.instance_id),
+                CountingEngine())
+            w1._tcp_server = srv2
+            await mon.probe_once()
+            assert mon.suspect_ids() == set()
+            assert recovered_seen == [w1.instance_id]
+
+            await mon.stop()
+            assert counters.suspect_instances() == 0
+            await client.close()
+            for rt in (w1, w2, fe):
+                await rt.shutdown()
+        finally:
+            await srv.stop()
+
+    run(go())
+
+
+def test_scheduler_suspect_workers_excluded():
+    from dynamo_tpu.llm.kv_router.scheduler import KvScheduler, WorkerMetrics
+
+    s = KvScheduler(block_size=16)
+    s.update_worker(WorkerMetrics(worker_id=1, kv_active_blocks=0))
+    s.update_worker(WorkerMetrics(worker_id=2, kv_active_blocks=0))
+    # worker 1 holds the whole prefix — normally an easy win
+    overlaps = {1: 4}
+    assert s.schedule(overlaps, request_tokens=64) == 1
+    s.mark_suspect(1)
+    assert s.schedule(overlaps, request_tokens=64) == 2
+    # every worker suspect → degraded mode still routes somewhere
+    s.mark_suspect(2)
+    assert s.schedule(overlaps, request_tokens=64) in (1, 2)
+    s.clear_suspect(1)
+    assert s.schedule(overlaps, request_tokens=64) == 1
+    # removal forgets suspect state too
+    s.remove_worker(1)
+    assert s.suspects() == {2}
+
+
+# ------------------------------------------------- discovery delete wiring ----
+
+
+def test_router_evicts_worker_on_discovery_delete():
+    """Satellite regression: a worker whose discovery key is deleted
+    (death/drain) vanishes from the KV router's candidate set — both the
+    scheduler's worker metrics and the indexer's prefix index."""
+    from dynamo_tpu.llm.kv.events import KvStoredEvent, event_to_wire
+    from dynamo_tpu.llm.kv_router.metrics_aggregator import KvRouterSubscriber
+    from dynamo_tpu.llm.kv_router.publisher import events_subject, metrics_subject
+    from dynamo_tpu.llm.kv_router.router import KvRouter
+    from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient
+    from dynamo_tpu.tokens import sequence_hashes
+
+    async def go():
+        srv = await _coordinator()
+        try:
+            coord = await CoordinatorClient(srv.url).connect()
+            pub = await CoordinatorClient(srv.url).connect()
+            prefix = "ns/components/backend/endpoints/generate/"
+            router = KvRouter(block_size=16)
+            sub = await KvRouterSubscriber(
+                router, coord, "ns", workers_prefix=prefix).start()
+
+            wid = 0xabc
+            await pub.kv_put(f"{prefix}{wid:x}", {"instance_id": wid})
+            prompt = list(range(32))
+            await pub.publish(metrics_subject("ns", wid), json.dumps({
+                "worker_id": wid, "request_active_slots": 0,
+                "request_total_slots": 8, "kv_total_blocks": 64}).encode())
+            await pub.publish(events_subject("ns", wid), json.dumps(
+                event_to_wire(1, wid, KvStoredEvent(
+                    block_hashes=list(sequence_hashes(prompt, 16)),
+                    parent_hash=None))).encode())
+            await asyncio.sleep(0.2)
+            assert wid in router.scheduler.workers()
+            assert router.schedule(prompt).worker_id == wid
+
+            await pub.kv_delete(f"{prefix}{wid:x}")
+            await asyncio.sleep(0.2)
+            assert wid not in router.scheduler.workers()
+            assert router.indexer.find_matches(
+                sequence_hashes(prompt, 16)).scores == {}
+
+            await sub.stop()
+            await pub.close()
+            await coord.close()
+        finally:
+            await srv.stop()
+
+    run(go())
+
+
+# ------------------------------------------------------------------ drain ----
+
+
+def test_endpoint_drain_finishes_inflight_then_deregisters():
+    """Drain lifecycle: discovery key first (no new routing), in-flight
+    stream completes untouched, then the subject deregisters."""
+    async def go():
+        srv = await _coordinator()
+        try:
+            w1, w2, fe, client = await _two_worker_setup(srv)
+            ep1 = w1.namespace("dyn").component("backend").endpoint("generate")
+            ctx = Context(BackendInput(
+                token_ids=[50], stops=StopConditions(max_tokens=10)))
+            got = []
+
+            async def consume():
+                async for o in client.direct(ctx, w1.instance_id):
+                    got.append(o.token_ids[0] if o.token_ids else None)
+
+            stream = asyncio.ensure_future(consume())
+            await asyncio.sleep(0.05)  # stream underway on w1
+            assert counters.drains_in_progress == 0
+            drained = await ep1.drain(timeout=5.0)
+            assert drained is True
+            await stream
+            assert got == list(range(51, 61))  # nothing amputated
+            assert counters.drains_in_progress == 0
+
+            # discovery converged: only w2 remains, new requests land there
+            await client._wait_until(
+                lambda: client.instance_ids() == [w2.instance_id], 5.0)
+            out = [t async for o in client.generate(Context(BackendInput(
+                token_ids=[7], stops=StopConditions(max_tokens=2))))
+                for t in o.token_ids]
+            assert out == [8, 9]
+            # draining again is a no-op, and the subject is gone
+            assert await ep1.drain(timeout=0.1) is True
+            assert w1._tcp_server.inflight(ep1.subject(w1.instance_id)) == 0
+
+            await client.close()
+            for rt in (w1, w2, fe):
+                await rt.shutdown()
+        finally:
+            await srv.stop()
+
+    run(go())
+
+
+# ------------------------------------------------------------ metrics plane ----
+
+
+def test_fault_counters_scrape():
+    """The fault series ride the HTTP /metrics scrape."""
+    from dynamo_tpu.llm.http import HttpService
+
+    async def go():
+        counters.migrations_total = 7
+        counters.drains_in_progress = 2
+        counters.register_suspect_source(lambda: {1, 2, 3})
+        svc = HttpService(port=0)
+        await svc.start()
+        try:
+            async with ClientSession() as s:
+                r = await s.get(f"http://127.0.0.1:{svc.port}/metrics")
+                text = await r.text()
+            assert "dynamo_tpu_fault_migrations_total 7" in text
+            assert "dynamo_tpu_fault_drains_in_progress 2" in text
+            assert "dynamo_tpu_fault_suspect_instances 3" in text
+            assert "# TYPE dynamo_tpu_fault_migrations_total counter" in text
+        finally:
+            await svc.stop()
+
+    run(go())
+
+
+# -------------------------------------------------------------- acceptance ----
+
+
+WORDS = [f"w{i}" for i in range(40)]
+
+
+@pytest.fixture(scope="module")
+def tokenizer_file(tmp_path_factory):
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    vocab = {"<unk>": 0, "<s>": 1, "</s>": 2}
+    for w in WORDS:
+        vocab[w] = len(vocab)
+    tok = Tokenizer(models.WordLevel(vocab=vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.WhitespaceSplit()
+    path = tmp_path_factory.mktemp("tok") / "tokenizer.json"
+    tok.save(str(path))
+    return str(path)
+
+
+def test_http_streaming_completion_survives_worker_kill(tokenizer_file):
+    """Acceptance e2e: an HTTP streaming completion whose worker is
+    killed mid-generation completes with the full expected token
+    sequence — migrated, not errored — and the stream carries the
+    x-migrated marker."""
+    from dynamo_tpu.llm.engines import build_serving_pipeline
+    from dynamo_tpu.llm.http import HttpService, ModelManager
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+    async def go():
+        srv = await _coordinator()
+        injector = FaultInjector()
+        try:
+            w1, w2, fe, client = await _two_worker_setup(srv)
+            card = ModelDeploymentCard(
+                name="tiny", tokenizer_path=tokenizer_file, context_length=64)
+            manager = ModelManager()
+            manager.add_model(
+                "tiny",
+                build_serving_pipeline(
+                    MigratingClient(client, backoff_s=0.01), card),
+                card)
+            http = HttpService(manager, port=0)
+            await http.start()
+            try:
+                async with ClientSession() as s:
+                    r = await s.post(
+                        f"http://127.0.0.1:{http.port}/v1/completions",
+                        json={"model": "tiny", "prompt": "w5", "stream": True,
+                              "max_tokens": 8, "temperature": 0})
+                    assert r.status == 200
+                    texts, comments, killed = [], [], False
+                    async for raw in r.content:
+                        line = raw.decode().strip()
+                        if line.startswith(": "):
+                            comments.append(line)
+                        if not line.startswith("data: ") or line == "data: [DONE]":
+                            continue
+                        chunk = json.loads(line[6:])
+                        texts.append(chunk["choices"][0]["text"])
+                        if len(texts) == 2 and not killed:
+                            killed = True
+                            await injector.kill_tcp_server(
+                                _busy_runtime([w1, w2]))
+                # "w5" tokenizes to id 8 (3 specials + 5); CountingEngine
+                # continues 9..16 → words w6..w13, migration-transparent
+                assert "".join(texts).split() == [f"w{i}" for i in range(6, 14)]
+                assert any("x-migrated 1" in c for c in comments)
+                assert counters.migrations_total == 1
+            finally:
+                await http.stop()
+            await client.close()
+            for rt in (w1, w2, fe):
+                await rt.shutdown()
+        finally:
+            await srv.stop()
+
+    run(go())
+
+
+def test_drain_role_flip_zero_failed_inflight():
+    """Acceptance e2e: a planner-style role flip (drain one pool's
+    worker, bring up its replacement in the other role) completes with
+    zero failed in-flight requests."""
+    async def go():
+        srv = await _coordinator()
+        try:
+            w1, w2, fe, client = await _two_worker_setup(srv)
+            mig = MigratingClient(client, backoff_s=0.01)
+
+            async def one(seed):
+                ctx = Context(BackendInput(
+                    token_ids=[seed], stops=StopConditions(max_tokens=10)))
+                toks = [t async for o in mig.generate(ctx)
+                        for t in o.token_ids]
+                assert toks == list(range(seed + 1, seed + 11)), toks
+                return len(toks)
+
+            inflight = [asyncio.ensure_future(one(100 * k))
+                        for k in range(1, 7)]
+            await asyncio.sleep(0.04)  # all streams underway
+
+            # the flip: drain w1 out of the decode pool (discovery first,
+            # live streams finish), then its process "exits"; the freed
+            # capacity comes back as a new worker — the flipped role
+            ep1 = w1.namespace("dyn").component("backend").endpoint("generate")
+            assert await ep1.drain(timeout=10.0) is True
+            await w1.shutdown()
+            w3 = await _runtime(srv.url)
+            await w3.namespace("dyn").component("backend") \
+                .endpoint("generate").serve(CountingEngine())
+
+            done = await asyncio.gather(*inflight)
+            assert done == [10] * 6  # zero failed, zero truncated
+            # and the flip needed no migrations: drain ≠ amputation
+            assert counters.migrations_total == 0
+
+            await client.close()
+            for rt in (w2, w3, fe):
+                await rt.shutdown()
+        finally:
+            await srv.stop()
+
+    run(go())

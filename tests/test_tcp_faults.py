@@ -1,0 +1,187 @@
+"""TCP control plane under failure: typed transport errors, cancellation
+as a no-op after peer loss, prompt server stop, ping/pong probes, and the
+fault injector's frame-level seams (satellite of the fault plane)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.fault import FaultInjector
+from dynamo_tpu.runtime.echo import EchoEngine
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.transports.tcp import (
+    EndpointDisconnected,
+    EndpointTcpClient,
+    EndpointTcpServer,
+    TransportError,
+)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class SlowEngine(AsyncEngine):
+    def __init__(self, delay_s=0.02, n=1000):
+        self.delay_s = delay_s
+        self.n = n
+
+    def generate(self, request):
+        return self._run(request)
+
+    async def _run(self, request):
+        for i in range(self.n):
+            if request.is_stopped:
+                return
+            await asyncio.sleep(self.delay_s)
+            yield i
+
+
+def test_server_death_mid_stream_is_typed_error():
+    """A worker dying mid-stream surfaces EndpointDisconnected — a
+    TransportError AND a ConnectionError (so pre-fault-plane handlers
+    keep working) — never a bare ConnectionResetError."""
+    async def go():
+        srv = await EndpointTcpServer().start()
+        srv.register("s", SlowEngine())
+        client = await EndpointTcpClient("127.0.0.1", srv.port, "s").connect()
+        got = []
+        with pytest.raises(EndpointDisconnected) as exc_info:
+            async for item in client.generate(Context(None)):
+                got.append(item)
+                if len(got) == 3:
+                    await srv.abort()
+        assert isinstance(exc_info.value, TransportError)
+        assert isinstance(exc_info.value, ConnectionError)
+        assert "connection lost" in str(exc_info.value)
+        assert got == [0, 1, 2]
+        await client.close()
+
+    run(go())
+
+
+def test_stop_and_kill_after_peer_disconnect_are_noops():
+    """Cancelling a stream whose peer is already gone must not raise out
+    of the consumer — the disconnect itself ends the stream; and a stop
+    frame for an unknown req_id is ignored server-side."""
+    async def go():
+        srv = await EndpointTcpServer().start()
+        srv.register("s", SlowEngine())
+        client = await EndpointTcpClient("127.0.0.1", srv.port, "s").connect()
+        ctx = Context(None)
+        got = []
+        with pytest.raises(EndpointDisconnected):
+            async for item in client.generate(ctx):
+                got.append(item)
+                if len(got) == 2:
+                    await srv.abort()
+                    await asyncio.sleep(0.05)  # read loop sees the reset
+                    ctx.stop_generating()  # must be a no-op, not a crash
+        await client.close()
+
+        # server side: stop/kill for a req_id that never existed (or whose
+        # request already finished) is silently ignored
+        srv2 = await EndpointTcpServer().start()
+        srv2.register("s", EchoEngine())
+        c2 = await EndpointTcpClient("127.0.0.1", srv2.port, "s").connect()
+        await c2._send({"type": "stop", "req_id": 999})
+        await c2._send({"type": "kill", "req_id": 999})
+        out = [x async for x in c2.generate(Context([1, 2]))]
+        assert out == [1, 2]  # server alive and well
+        await c2.close()
+        await srv2.stop()
+
+    run(go())
+
+
+def test_server_stop_cancels_handlers_promptly():
+    """stop() with a slow engine mid-request returns promptly (severed
+    connections EOF the handlers; in-flight generate tasks cancel) —
+    py3.12 wait_closed() semantics must not hang on live handlers."""
+    async def go():
+        srv = await EndpointTcpServer().start()
+        srv.register("s", SlowEngine(delay_s=0.05, n=10_000))
+        client = await EndpointTcpClient("127.0.0.1", srv.port, "s").connect()
+        agen = client.generate(Context(None))
+        assert await agen.__anext__() == 0  # request provably in flight
+        t0 = asyncio.get_running_loop().time()
+        await srv.stop()
+        assert asyncio.get_running_loop().time() - t0 < 2.0
+        with pytest.raises(EndpointDisconnected):
+            await agen.__anext__()  # the severed stream ends typed
+        await client.close()
+
+    run(go())
+
+
+def test_ping_pong_and_ping_failure():
+    async def go():
+        srv = await EndpointTcpServer().start()
+        srv.register("s", EchoEngine())
+        client = await EndpointTcpClient("127.0.0.1", srv.port, "s").connect()
+        rtt = await client.ping(timeout=1.0)
+        assert 0 <= rtt < 1.0
+        # probes don't disturb the request path
+        assert [x async for x in client.generate(Context([7]))] == [7]
+        await srv.stop()
+        await asyncio.sleep(0.02)
+        with pytest.raises(TransportError):
+            await client.ping(timeout=0.3)
+        await client.close()
+        # a never-listening port fails typed too
+        dead = EndpointTcpClient("127.0.0.1", srv.port, "s")
+        with pytest.raises(TransportError):
+            await dead.ping(timeout=0.3)
+        await dead.close()
+
+    run(go())
+
+
+def test_injector_drop_and_sever_frames():
+    async def go():
+        injector = FaultInjector()
+        srv = await EndpointTcpServer().start()
+        srv.register("s", EchoEngine())
+        client = await EndpointTcpClient("127.0.0.1", srv.port, "s").connect()
+
+        # drop the 2nd item frame: stream still ends, one item missing
+        dropped = injector.drop_frames(srv, ftype="item", nth=2)
+        out = [x async for x in client.generate(Context([1, 2, 3]))]
+        assert out == [1, 3] and dropped() == 1
+        injector.clear(srv)
+        out = [x async for x in client.generate(Context([1, 2]))]
+        assert out == [1, 2]  # hook fully removed
+
+        # sever at the 2nd item: deterministic mid-stream death
+        injector.sever_after(srv, 2)
+        with pytest.raises(EndpointDisconnected):
+            async for _ in client.generate(Context([1, 2, 3])):
+                pass
+        injector.release_all()
+        await srv.stop()
+        await client.close()
+
+    run(go())
+
+
+def test_inflight_tracking_and_wait_idle():
+    async def go():
+        srv = await EndpointTcpServer().start()
+        srv.register("s", SlowEngine(delay_s=0.02, n=5))
+        client = await EndpointTcpClient("127.0.0.1", srv.port, "s").connect()
+        assert srv.inflight("s") == 0
+        assert await srv.wait_idle("s", timeout=0.1) is True  # vacuously idle
+
+        agen = client.generate(Context(None))
+        await agen.__anext__()
+        assert srv.inflight("s") == 1
+        # wait_idle blocks until the stream drains, then reports idle
+        drained = asyncio.ensure_future(srv.wait_idle("s", timeout=5.0))
+        rest = [x async for x in agen]
+        assert rest == [1, 2, 3, 4]
+        assert await drained is True
+        assert srv.inflight("s") == 0
+        await srv.stop()
+        await client.close()
+
+    run(go())
