@@ -244,18 +244,23 @@ def _hbm_limit(dev) -> int:
 
 
 def _probe_pallas_prefill(mcfg: dict, max_len: int, bs: int,
-                          prefill_chunk: int) -> None:
+                          prefill_chunk: int,
+                          prefill_budget: int = 0) -> None:
     """Compile-probe the flash-prefill kernel on the real backend AT THE
     MODEL'S GEOMETRY (heads/head_dim/block size); on ANY failure fall back
     to the pure-JAX prefill path for this run rather than dying mid-bench.
     A tiny fixed-shape probe gave a false negative in round 4: its d=64
     head slicing failed to lower while the real 8B (d=128) kernel was
-    fine — the probe must compile what the run will run."""
+    fine — the probe must compile what the run will run.  With a prefill
+    token budget the ragged variant is probed too (a run that batches
+    prefill dispatches the ragged kernel, not the single-sequence one)."""
     import jax
     import jax.numpy as jnp
 
     try:
-        from dynamo_tpu.ops.pallas.prefill_attention import paged_prefill_attention
+        from dynamo_tpu.ops.pallas.prefill_attention import (
+            paged_prefill_attention, ragged_paged_prefill_attention,
+        )
 
         h, hk, hd, n, bt, lens = _probe_geometry(mcfg, 1, max_len, bs)
         s = min(prefill_chunk or 512, max_len)
@@ -268,6 +273,19 @@ def _probe_pallas_prefill(mcfg: dict, max_len: int, bs: int,
             jnp.asarray([min(2 * bs, max_len - s)], jnp.int32),
         )
         jax.block_until_ready(out)
+        if prefill_budget:
+            sr = min(prefill_budget, max_len)
+            pfx = min(2 * bs, max_len - sr)
+            q = jnp.ones((1, sr, h, hd), jnp.bfloat16)
+            kv = jnp.ones((1, sr, hk, hd), jnp.bfloat16)
+            bt2 = jnp.concatenate([bt[:1], bt[:1]], axis=0)
+            out = ragged_paged_prefill_attention(
+                q, kv, kv, cache, jnp.int32(0), bt2,
+                jnp.asarray([sr // 2, pfx + sr // 2], jnp.int32),
+                jnp.asarray([0, pfx], jnp.int32),
+                jnp.asarray([0, sr // 2], jnp.int32),
+            )
+            jax.block_until_ready(out)
     except Exception as e:  # pragma: no cover - hardware-specific
         print(f"# pallas prefill probe failed ({type(e).__name__}: "
               f"{str(e)[:500]}); falling back to pure-JAX prefill",
@@ -796,6 +814,10 @@ def main() -> None:
                                     "32" if on_accel else "16"))
     prefill_chunk = int(os.environ.get("DYNAMO_BENCH_PREFILL_CHUNK",
                                        "512" if on_accel else "0"))
+    # token-budget ragged prefill: >0 packs several waiting prompts'
+    # chunks into one dispatch (engine/core.py _run_prefill_batch)
+    prefill_budget = int(os.environ.get("DYNAMO_BENCH_PREFILL_BUDGET",
+                                        "1024" if on_accel else "0"))
     # int8 weight-only quantization (models/quant.py): halves weight HBM
     # footprint AND per-decode-step weight traffic — this is what fits the
     # north-star 8B model on a single 16GiB v5e chip (the reference's
@@ -877,6 +899,7 @@ def main() -> None:
         num_blocks=batch * (max_len // block_size) + 64,
         decode_steps=decode_steps,
         prefill_chunk_tokens=min(prefill_chunk, max_len) if prefill_chunk else 0,
+        prefill_token_budget=prefill_budget,
         enable_prefix_reuse=False,  # distinct prompts; measure raw decode
         cache_dtype="int8" if kv_quant == "int8" else None,
     )
@@ -884,7 +907,8 @@ def main() -> None:
     # above already covered both kernels against the quantized cache)
     if pallas_on and not env("DYNAMO_DISABLE_PALLAS_PREFILL") \
             and kv_quant == "none":
-        _probe_pallas_prefill(mcfg, max_len, block_size, prefill_chunk)
+        _probe_pallas_prefill(mcfg, max_len, block_size, prefill_chunk,
+                              prefill_budget)
     if pallas_on and not env("DYNAMO_DISABLE_PALLAS_DECODE") \
             and kv_quant == "none":
         _probe_pallas_decode(mcfg, batch, max_len, block_size)

@@ -67,7 +67,9 @@ def main() -> None:
     from dynamo_tpu.ops.pallas.decode_attention import (
         paged_decode_attention, paged_decode_attention_mq,
     )
-    from dynamo_tpu.ops.pallas.prefill_attention import paged_prefill_attention
+    from dynamo_tpu.ops.pallas.prefill_attention import (
+        paged_prefill_attention, ragged_paged_prefill_attention,
+    )
 
     h, hk, d, batch, max_len, bs, s = (
         geom["h"], geom["hk"], geom["d"], geom["batch"], geom["max_len"],
@@ -118,6 +120,18 @@ def main() -> None:
                 cache, jnp.int32(0), bt[:1],
                 jnp.asarray([min(2 * bs + s, max_len)], jnp.int32),
                 jnp.asarray([min(2 * bs, max_len - s)], jnp.int32))),
+            # token-budget ragged prefill: two rows packed on one flat
+            # axis, the second with a cached prefix (per-row DMA path)
+            (f"ragged/{mode}", lambda cache=cache: (
+                ragged_paged_prefill_attention(
+                    jnp.ones((1, s, h, d), jnp.bfloat16),
+                    jnp.ones((1, s, hk, d), jnp.bfloat16),
+                    jnp.ones((1, s, hk, d), jnp.bfloat16),
+                    cache, jnp.int32(0), bt[:2],
+                    jnp.asarray([s // 2, min(2 * bs, max_len - s) + s // 2],
+                                jnp.int32),            # seq_lens
+                    jnp.asarray([0, min(2 * bs, max_len - s)], jnp.int32),
+                    jnp.asarray([0, s // 2], jnp.int32)))),
         ]
     # dequant-in-kernel int8 matmul at decode and prefill row counts
     from dynamo_tpu.ops.pallas.int8_matmul import int8_matmul

@@ -91,6 +91,41 @@ async def sweep_level(url, model, prompt, osl, concurrency, requests_per_conc):
     }
 
 
+async def prefill_dispatch_stats(url):
+    """Scrape the serving endpoint's prefill-batching counters
+    (dynamo_tpu_engine_prefill_* on /metrics): dispatch count and mean
+    tokens-per-dispatch — the direct readout of the token-budget ragged
+    prefill win.  Returns None when the server doesn't expose them
+    (non-dynamo endpoint) or saw no prefill work."""
+    try:
+        async with ClientSession() as session:
+            async with session.get(f"{url}/metrics") as resp:
+                if resp.status != 200:
+                    return None
+                text = await resp.text()
+    except Exception:
+        return None
+    vals = {}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        for key in ("prefill_dispatches_total", "prefill_tokens_total",
+                    "prefill_batch_occupancy", "prefill_budget_utilization"):
+            if line.startswith(f"dynamo_tpu_engine_{key} "):
+                vals[key] = float(line.rsplit(" ", 1)[-1])
+    dispatches = vals.get("prefill_dispatches_total", 0)
+    if not dispatches:
+        return None
+    return {
+        "prefill_dispatches": int(dispatches),
+        "prefill_tokens_per_dispatch": round(
+            vals.get("prefill_tokens_total", 0) / dispatches, 1),
+        "prefill_batch_occupancy": vals.get("prefill_batch_occupancy", 0.0),
+        "prefill_budget_utilization": vals.get(
+            "prefill_budget_utilization", 0.0),
+    }
+
+
 async def run(args):
     # Per-mode ISL calibration (ADVICE r5): the in-process modes
     # (--spawn-echo/--native) detokenize with WordLevel + WhitespaceSplit
@@ -112,8 +147,12 @@ async def run(args):
         rows.append(row)
         print(json.dumps(row), flush=True)
     best = max(rows, key=lambda r: r["output_tok_s"])
-    print(json.dumps({"metric": "serve_output_tok_s", "value": best["output_tok_s"],
-                      "unit": "tok/s", "best_concurrency": best["concurrency"]}))
+    summary = {"metric": "serve_output_tok_s", "value": best["output_tok_s"],
+               "unit": "tok/s", "best_concurrency": best["concurrency"]}
+    prefill = await prefill_dispatch_stats(args.url)
+    if prefill is not None:
+        summary.update(prefill)
+    print(json.dumps(summary))
     return rows
 
 
@@ -199,6 +238,12 @@ async def run_with_native(args):
         num_blocks=batch * (max_len // bs) + 64,
         decode_steps=8,
         prefill_chunk_tokens=512 if on_accel else 0,
+        # token-budget ragged prefill: pack concurrent prompts' chunks
+        # into one dispatch (the sweep's higher concurrency levels are
+        # exactly the backlog shape this converts from N round-trips to
+        # ~ceil(tokens/budget))
+        prefill_token_budget=int(os.environ.get(
+            "DYNAMO_PREFILL_TOKEN_BUDGET", "1024" if on_accel else "0")),
         enable_prefix_reuse=False,
         cache_dtype="int8" if quant else None,
     )

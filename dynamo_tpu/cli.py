@@ -161,6 +161,12 @@ def _build_local_engine(args) -> tuple[object, object]:
         # mesh whose "data" axis is > 1)
         sp_prefill_threshold=int(
             getattr(args, "sp_prefill_threshold", 0) or 0),
+        prefill_chunk_tokens=int(
+            getattr(args, "prefill_chunk_tokens", 0) or 0),
+        # token-budget ragged prefill: pack several waiting prompts'
+        # chunks into one dispatch (docs/engine_scheduling.md)
+        prefill_token_budget=int(
+            getattr(args, "prefill_token_budget", 0) or 0),
     )
     draft = None
     dpath = getattr(args, "spec_draft_model", None)
@@ -847,6 +853,15 @@ def _parser() -> argparse.ArgumentParser:
                      help="prompts at least this long prefill with the "
                      "sequence sharded over the mesh data axis (ring "
                      "attention context parallelism); 0 = off, needs dp>1")
+    run.add_argument("--prefill-chunk-tokens", type=int, default=0,
+                     help="chunked prefill: max prompt tokens per prefill "
+                     "dispatch (0 = whole remainder); keeps decode ITL "
+                     "flat under long prompts")
+    run.add_argument("--prefill-token-budget", type=int, default=0,
+                     help="token-budget ragged prefill: pack up to this "
+                     "many tokens of several waiting prompts' chunks "
+                     "into ONE dispatch (0 = one request per dispatch); "
+                     "see docs/engine_scheduling.md")
     run.add_argument("--nnodes", type=int, default=1,
                      help="worker processes forming ONE mesh (multi-host)")
     run.add_argument("--node-rank", type=int, default=0)

@@ -34,6 +34,15 @@ class EngineConfig:
     # native).  Rounded down to a block multiple so resumed chunks stay
     # block-aligned for the prefill fast path.
     prefill_chunk_tokens: int = 0
+    # token-budget ragged prefill: pack the prefill chunks of SEVERAL
+    # pending requests into one flat-token-axis dispatch of at most this
+    # many tokens (each request's chunk occupies a block-aligned span; the
+    # flat axis is bucketed via bucket_for so executables stay O(log)).
+    # Converts a backlog of N short prompts from N device round-trips to
+    # ~ceil(total_tokens / budget) dispatches.  0 = legacy one-request-
+    # per-dispatch prefill.  Rounded down to a block multiple; capped at
+    # max_model_len (the largest prefill bucket).
+    prefill_token_budget: int = 0
     # decode burst length while prefill work is pending (admitted/waiting
     # requests or a mid-prefill slot).  Long bursts amortise dispatch
     # overhead but make a freshly-arrived prompt wait a whole burst
@@ -99,6 +108,17 @@ class EngineConfig:
             self.prefill_chunk_tokens = max(
                 self.block_size,
                 self.prefill_chunk_tokens // self.block_size * self.block_size,
+            )
+        if self.prefill_token_budget:
+            # block-align (spans in the packed axis are block multiples)
+            # and cap at the largest prefill bucket — bucket_for pads the
+            # flat axis, so a budget past max_model_len could never fill
+            self.prefill_token_budget = max(
+                self.block_size,
+                self.prefill_token_budget // self.block_size * self.block_size,
+            )
+            self.prefill_token_budget = min(
+                self.prefill_token_budget, self.max_model_len
             )
 
     @property

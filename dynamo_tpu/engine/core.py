@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.counters import counters as prefill_counters
 from dynamo_tpu.engine.grammar import (
     INIT_STATE, JsonGrammar, compile_choice_vocab, compile_regex_vocab,
     compose_tables, device_tables, grammar_advance, grammar_mask,
@@ -50,7 +51,8 @@ from dynamo_tpu.tokens import TokenBlockSequence
 
 log = logging.getLogger("dynamo_tpu.engine")
 
-__all__ = ["EngineCore", "unified_step", "multi_decode_step"]
+__all__ = ["EngineCore", "unified_step", "multi_decode_step",
+           "ragged_prefill_step"]
 
 
 def unified_step(
@@ -183,6 +185,41 @@ def multi_decode_step(
     return out, carry[0]
 
 
+def ragged_prefill_step(
+    model, params, cache, tokens, positions, block_tables, seq_lens,
+    slot_idx, seq_ids, seq_starts, row_offsets, last_idx, rng, temp, top_k,
+    top_p, prefix_blocks=0, k_cand=K_MAX, exact=False, grammar=None,
+    jrows=None, jstate=None, jdepth=None, jstack=None, min_p=None,
+    bias_tokens=None, bias_vals=None, seeds=None, seed_rows=None,
+):
+    """Token-budget ragged prefill step: ONE forward over a flat packed
+    token axis ([1, T]) holding several sequences' prefill chunks, then a
+    per-SEQUENCE sample — ``last_idx`` [R] gathers each row's last fresh
+    hidden state off the flat axis.  The host keeps only final-chunk rows'
+    samples (mixed batches: some rows sample with grammar/logprobs/seeded
+    RNG, mid-chunk rows discard).
+
+    ``seed_steps`` is each row's absolute end position (``seq_lens``), so
+    a seeded row's sampled token is bit-identical to the one the legacy
+    single-request dispatch would draw.
+    """
+    hidden, cache = model.forward(
+        params, tokens, positions, cache, block_tables, seq_lens, slot_idx,
+        prefix_blocks=prefix_blocks,
+        ragged=(seq_ids, seq_starts, row_offsets),
+    )
+    last_h = hidden[0, last_idx]  # [R, Dm] — flat-axis gather per sequence
+    logits = model.compute_logits(params, last_h)  # [R, V] f32
+    if grammar is not None:
+        logits = grammar_mask(logits, grammar, jrows, jstate, jdepth, jstack)
+    out = sample_full(logits, rng, temp, top_k, top_p,
+                      bias_tokens=bias_tokens, bias_vals=bias_vals,
+                      min_p=min_p, seeds=seeds, seed_rows=seed_rows,
+                      seed_steps=(seq_lens if seeds is not None else None),
+                      k_cand=k_cand, exact=exact)
+    return out, cache
+
+
 class EngineCore:
     def __init__(
         self,
@@ -313,6 +350,10 @@ class EngineCore:
             self._spec_impl, donate_argnums=(1,),
             static_argnames=("k_cand", "exact"),
         )
+        self._ragged_fn = jax.jit(
+            self._ragged_impl, donate_argnums=(1,),
+            static_argnames=("prefix_blocks", "k_cand", "exact"),
+        )
         # sequence-parallel long-prefill (ring attention over the "data"
         # axis): one dispatch computes the whole prompt with the sequence
         # sharded across the mesh — SURVEY §5 long-context path
@@ -358,6 +399,12 @@ class EngineCore:
         # perf counters
         self.steps = 0
         self.prefill_steps = 0
+        # prefill batching: dispatches (any path), sequences packed over
+        # them, and the token budget offered/used by batched dispatches
+        self.prefill_dispatches = 0
+        self.prefill_rows_dispatched = 0
+        self.prefill_budget_offered = 0
+        self.prefill_budget_used = 0
         self.decode_steps = 0
         self.tokens_generated = 0
         self.prompt_tokens_computed = 0  # actual prefill work (dedupe-aware)
@@ -380,6 +427,22 @@ class EngineCore:
                             min_p=min_p, bias_tokens=bias_tokens,
                             bias_vals=bias_vals, seeds=seeds,
                             seed_rows=seed_rows)
+
+    def _ragged_impl(self, params, cache, tokens, positions, block_tables,
+                     seq_lens, slot_idx, seq_ids, seq_starts, row_offsets,
+                     last_idx, rng, temp, top_k, top_p, *, prefix_blocks=0,
+                     k_cand=K_MAX, exact=False, grammar=None, jrows=None,
+                     jstate=None, jdepth=None, jstack=None, min_p=None,
+                     bias_tokens=None, bias_vals=None, seeds=None,
+                     seed_rows=None):
+        return ragged_prefill_step(
+            self.model, params, cache, tokens, positions, block_tables,
+            seq_lens, slot_idx, seq_ids, seq_starts, row_offsets, last_idx,
+            rng, temp, top_k, top_p, prefix_blocks=prefix_blocks,
+            k_cand=k_cand, exact=exact, grammar=grammar, jrows=jrows,
+            jstate=jstate, jdepth=jdepth, jstack=jstack, min_p=min_p,
+            bias_tokens=bias_tokens, bias_vals=bias_vals, seeds=seeds,
+            seed_rows=seed_rows)
 
     def _sp_impl(self, params, tokens, positions, last_idx, rng, temp,
                  top_k, top_p, *, nb, k_cand=K_MAX, exact=False):
@@ -622,15 +685,18 @@ class EngineCore:
             )
         return self._gdev_cache[keys]
 
-    def _sampling_extras(self, reqs, rows=None) -> dict:
+    def _sampling_extras(self, reqs, rows=None, b=None) -> dict:
         """min_p / logit_bias device kwargs for one dispatch, or {} when no
         request uses them (the common case compiles no extra executables).
 
         ``rows``: slot index per request for batch-shaped dispatches
         (decode); None = requests are the dispatch rows in order (prefill).
+        ``b`` overrides the dispatch row count (ragged prefill: the padded
+        sequence-row axis, not max_batch_size).
         """
         kw = {}
-        b = self.config.max_batch_size if rows is not None else len(reqs)
+        if b is None:
+            b = self.config.max_batch_size if rows is not None else len(reqs)
         at = (lambda i: rows[i]) if rows is not None else (lambda i: i)
         if any(r.sampling.min_p > 0 for r in reqs):
             mp = np.zeros(b, np.float32)
@@ -814,6 +880,16 @@ class EngineCore:
             "spec_steps": self.spec_steps,
             "spec_proposed": self.spec_proposed,
             "spec_accepted": self.spec_accepted,
+            # prefill batching (token-budget ragged prefill)
+            "prefill_dispatches_total": self.prefill_dispatches,
+            "prefill_batch_occupancy": (
+                self.prefill_rows_dispatched / self.prefill_dispatches
+                if self.prefill_dispatches else 0.0
+            ),
+            "prefill_budget_utilization": (
+                self.prefill_budget_used / self.prefill_budget_offered
+                if self.prefill_budget_offered else 0.0
+            ),
         }
         if self.host_pool is not None:
             out.update(self.host_pool.stats())
@@ -836,33 +912,31 @@ class EngineCore:
                 and req.abort_requested
             ):
                 self._finish_slot(req, FinishReason.CANCELLED)
-        prefill = next(
-            (
-                r
-                for r in self.slots
-                if r is not None
-                and r.state is RequestState.PREFILL
-                and self._prefill_ready(r)
-            ),
-            None,
-        )
+        ready = [
+            r
+            for r in self.slots
+            if r is not None
+            and r.state is RequestState.PREFILL
+            and self._prefill_ready(r)
+        ]
         decoding = any(
             r is not None and r.state is RequestState.RUNNING for r in self.slots
         )
         # chunked-prefill interleave: when both phases have work, alternate
-        # one prefill chunk with one decode burst so admissions never stall
-        # the decoders for a whole long prompt (VERDICT r1 weak #2)
-        if prefill is not None and decoding and self.config.prefill_chunk_tokens:
+        # one prefill turn (one chunk, or one ragged token-budget batch)
+        # with one decode burst so admissions never stall the decoders for
+        # a whole long prompt (VERDICT r1 weak #2)
+        if ready and decoding and self.config.prefill_chunk_tokens:
             if self._last_was_prefill:
                 self._last_was_prefill = False
                 self._run_decode()
             else:
                 self._last_was_prefill = True
-                self._dispatch_prefill(prefill)
+                self._dispatch_prefill(ready)
             return True
-        if prefill is not None:
+        if ready:
             self._last_was_prefill = True
-            self._dispatch_prefill(prefill)
+            self._dispatch_prefill(ready)
             return True
         if decoding:
             self._last_was_prefill = False
@@ -1014,11 +1088,25 @@ class EngineCore:
                     log.exception("on_allocated callback failed for %s", req.request_id)
                     req.abort_requested = True
 
-    def _dispatch_prefill(self, req: EngineRequest) -> None:
-        if self._sp_eligible(req):
-            self._run_sp_prefill(req)
+    def _dispatch_prefill(self, ready: list[EngineRequest]) -> None:
+        """One prefill turn over the READY requests (slot order): the
+        head request keeps its historical routing (seq-parallel long
+        prompts dispatch alone), otherwise the token-budget ragged batch
+        packs every non-SP ready request — or, with batching disabled
+        (prefill_token_budget=0) or a model without the ragged attention
+        path, the legacy one-request dispatch."""
+        head = ready[0]
+        if self._sp_eligible(head):
+            self._run_sp_prefill(head)
+            return
+        if self.config.prefill_token_budget > 0 and getattr(
+            self.model, "supports_ragged_prefill", False
+        ):
+            self._run_prefill_batch(
+                [r for r in ready if not self._sp_eligible(r)]
+            )
         else:
-            self._run_prefill(req)
+            self._run_prefill(head)
 
     # ---------------------------------------------------------------- prefill
     def _reserve_own(self, req: EngineRequest) -> None:
@@ -1108,18 +1196,164 @@ class EngineCore:
             extras=self._sampling_extras([req]) if final else None,
         )
         self.prefill_steps += 1
+        self.prefill_dispatches += 1
+        self.prefill_rows_dispatched += 1
+        prefill_counters.record(rows=1, tokens=take)
         self.prompt_tokens_computed += take
         req.computed_tokens = end
-        # prompt blocks fully computed so far become reusable (commit is
-        # idempotent; chunked prefill re-offers earlier blocks cheaply)
-        for blk in req.seq.blocks[: req.computed_tokens // cfg.block_size]:
-            bid = req.block_ids[blk.position]
-            self.block_manager.commit(
-                bid, blk.sequence_hash, blk.parent_sequence_hash, list(blk.tokens)
-            )
+        self._commit_prefill_blocks(req)
         if not final:
             return  # more chunks to go; sample discarded (no logits needed)
         self._complete_prefill(req, sampled, lps, cids, clps)
+
+    def _commit_prefill_blocks(self, req: EngineRequest) -> None:
+        """Offer newly completed prompt blocks to the block manager.  The
+        ``committed_upto`` watermark makes chunked prefill linear: each
+        chunk commits only the blocks it completed — re-offering every
+        earlier block per chunk (commit is idempotent but not free) made
+        an L-block prompt pay O(L^2) commit calls across its chunks."""
+        bs = self.config.block_size
+        done = req.computed_tokens // bs
+        for blk in req.seq.blocks[req.committed_upto // bs : done]:
+            self.block_manager.commit(
+                req.block_ids[blk.position], blk.sequence_hash,
+                blk.parent_sequence_hash, list(blk.tokens),
+            )
+        req.committed_upto = done * bs
+
+    def _run_prefill_batch(self, reqs: list[EngineRequest]) -> None:
+        """Token-budget ragged prefill: pack up to ``prefill_token_budget``
+        tokens of pending prefill work (several requests' chunks) onto one
+        flat token axis and run ONE ragged dispatch.
+
+        Each selected chunk occupies a contiguous block-aligned span of
+        the flat axis (padding slots are -1 / seq_id -1), so the
+        block-granular cache write and the ragged attention masks hold by
+        construction.  The axis is bucketed via ``config.bucket_for`` and
+        the sequence-row axis is power-of-two padded — executables stay
+        O(log^2).  Only final-chunk rows' samples are kept: those rows
+        carry their request's grammar state, sampling extras and seeds;
+        mid-chunk rows sample garbage that the host discards."""
+        cfg = self.config
+        bs = cfg.block_size
+        budget = cfg.prefill_token_budget
+        sel: list[tuple[EngineRequest, int, bool]] = []  # (req, take, final)
+        used = 0
+        for req in reqs:
+            avail = budget - used
+            if avail < bs:
+                break
+            remaining = req.prompt_len - req.computed_tokens
+            chunk = cfg.prefill_chunk_tokens or remaining
+            take = min(remaining, chunk, avail)
+            if take < remaining:
+                # non-final chunks end block-aligned so the resumed chunk
+                # starts block-aligned (fast-path + packing requirement)
+                take = take // bs * bs
+                if take == 0:
+                    break
+            sel.append((req, take, take == remaining))
+            used += -(-take // bs) * bs  # span = block-rounded take
+
+        r_real = len(sel)
+        r_pad = 1 << max(0, (r_real - 1).bit_length())
+        t_pad = cfg.bucket_for(used)
+        m = cfg.max_blocks_per_seq
+        tokens = np.zeros((1, t_pad), np.int32)
+        positions = np.zeros((1, t_pad), np.int32)
+        slot_idx = np.full((1, t_pad), -1, np.int32)
+        seq_ids = np.full((1, t_pad), -1, np.int32)
+        bt = np.zeros((r_pad, m), np.int32)
+        seq_lens = np.zeros(r_pad, np.int32)
+        starts = np.zeros(r_pad, np.int32)
+        roff = np.zeros(r_pad, np.int32)
+        last_idx = np.zeros(r_pad, np.int32)
+        temp = np.zeros(r_pad, np.float32)
+        top_k = np.zeros(r_pad, np.int32)
+        top_p = np.ones(r_pad, np.float32)
+        off = 0
+        max_pb = 0
+        for r, (req, take, final) in enumerate(sel):
+            begin = req.computed_tokens
+            end = begin + take
+            tokens[0, off:off + take] = req.prompt[begin:end]
+            pos = np.arange(begin, end, dtype=np.int32)
+            positions[0, off:off + take] = pos
+            bt[r, : len(req.block_ids)] = req.block_ids
+            slot_idx[0, off:off + take] = (
+                bt[r, pos // bs] * bs + pos % bs
+            )
+            seq_ids[0, off:off + take] = r
+            seq_lens[r] = end
+            starts[r] = begin
+            roff[r] = off
+            last_idx[r] = off + take - 1
+            temp[r] = req.sampling.temperature
+            top_k[r] = req.sampling.top_k
+            top_p[r] = req.sampling.top_p
+            max_pb = max(max_pb, begin // bs)
+            off += -(-take // bs) * bs
+        # cached-prefix gather bound: max over rows, pow2-bucketed like the
+        # single-request path (rows with shorter prefixes mask by start)
+        pb = 0 if max_pb == 0 else 1 << (max_pb - 1).bit_length()
+        pb = min(pb, m)
+
+        finals = [(r, req) for r, (req, _, fin) in enumerate(sel) if fin]
+        final_reqs = [req for _, req in finals]
+        k_cand, exact = self._sampling_mode(final_reqs)
+        gram = None
+        if final_reqs and any(
+            self._grammar_key(rq) for rq in final_reqs
+        ) and self._ensure_grammar() is not None:
+            keys = self._dispatch_keys(final_reqs)
+            offs = self._composite_for(keys)[1]
+            jrows = np.zeros(r_pad, bool)
+            jstate = np.full(r_pad, INIT_STATE, np.int32)
+            jdepth = np.zeros(r_pad, np.int32)
+            jstack = np.zeros(r_pad, np.int32)
+            for r, rq in finals:
+                key = self._grammar_key(rq)
+                if key is None:
+                    continue
+                jrows[r] = True
+                gs, gd, gk = rq.gstate
+                jstate[r] = gs + offs[key] if gs > 0 else gs
+                jdepth[r], jstack[r] = gd, gk
+            gram = (keys, jrows, jstate, jdepth, jstack)
+        extras = None
+        if final_reqs:
+            extras = self._sampling_extras(
+                final_reqs, rows=[r for r, _ in finals], b=r_pad
+            )
+
+        self._rng, rng = jax.random.split(self._rng)
+        gkw = self._gram_kwargs(gram)
+        gkw.update(extras or {})
+        up, gkw = self._upload_dispatch(
+            (tokens, positions, bt, seq_lens, slot_idx, seq_ids, starts,
+             roff, last_idx, temp, top_k, top_p), gkw)
+        out, self.cache = self._ragged_fn(
+            self.params, self.cache, *up[:9], rng, *up[9:],
+            prefix_blocks=pb, k_cand=k_cand, exact=exact, **gkw,
+        )
+        sampled, lps, cids, clps = jax.device_get(out)  # one batched pull
+        self.steps += 1
+        self.prefill_steps += 1
+        take_sum = sum(take for _, take, _ in sel)
+        self.prompt_tokens_computed += take_sum
+        self.prefill_dispatches += 1
+        self.prefill_rows_dispatched += r_real
+        self.prefill_budget_offered += budget
+        self.prefill_budget_used += take_sum
+        prefill_counters.record(rows=r_real, tokens=take_sum, budget=budget)
+        for r, (req, take, final) in enumerate(sel):
+            req.computed_tokens += take
+            self._commit_prefill_blocks(req)
+            if final:
+                self._complete_prefill(
+                    req, sampled[r:r + 1], lps[r:r + 1],
+                    cids[r:r + 1], clps[r:r + 1],
+                )
 
     def _complete_prefill(self, req, sampled, lps, cids, clps) -> None:
         """Shared tail of chunked and sequence-parallel prefill: state
@@ -1219,13 +1453,12 @@ class EngineCore:
         self.steps += 1
         self.prefill_steps += 1
         self.sp_prefills += 1
+        self.prefill_dispatches += 1
+        self.prefill_rows_dispatched += 1
+        prefill_counters.record(rows=1, tokens=req.prompt_len)
         self.prompt_tokens_computed += req.prompt_len
         req.computed_tokens = req.prompt_len
-        for blk in req.seq.blocks[: req.prompt_len // bs]:
-            self.block_manager.commit(
-                req.block_ids[blk.position], blk.sequence_hash,
-                blk.parent_sequence_hash, list(blk.tokens)
-            )
+        self._commit_prefill_blocks(req)
         self._complete_prefill(req, sampled, lps, cids, clps)
 
     # ----------------------------------------------------------------- decode

@@ -1,0 +1,57 @@
+"""Process-global prefill-batching counters.
+
+Same dependency-free idiom as ``dynamo_tpu/fault/counters.py``: the
+engine layer records, the llm layer (http/metrics.py render) and the
+benchmarks read — no import cycles.  The HTTP metrics endpoint exposes:
+
+    dynamo_tpu_engine_prefill_dispatches_total     counter
+    dynamo_tpu_engine_prefill_tokens_total         counter
+    dynamo_tpu_engine_prefill_batch_occupancy      gauge (rows/dispatch)
+    dynamo_tpu_engine_prefill_budget_utilization   gauge (used/offered)
+"""
+
+from __future__ import annotations
+
+__all__ = ["PrefillCounters", "counters"]
+
+
+class PrefillCounters:
+    def __init__(self) -> None:
+        self.reset()
+
+    def record(self, rows: int, tokens: int, budget: int = 0) -> None:
+        """One prefill dispatch: ``rows`` sequences packed, ``tokens``
+        prompt tokens computed.  ``budget`` is the token budget offered
+        (0 for legacy one-request / seq-parallel dispatches — those don't
+        count toward budget utilization)."""
+        self.dispatches_total += 1
+        self.rows_total += rows
+        self.tokens_total += tokens
+        if budget > 0:
+            self.budget_offered_total += budget
+            self.budget_used_total += tokens
+
+    @property
+    def batch_occupancy(self) -> float:
+        """Mean sequences per prefill dispatch (lifetime)."""
+        if not self.dispatches_total:
+            return 0.0
+        return self.rows_total / self.dispatches_total
+
+    @property
+    def budget_utilization(self) -> float:
+        """Tokens packed / budget offered over batched dispatches."""
+        if not self.budget_offered_total:
+            return 0.0
+        return self.budget_used_total / self.budget_offered_total
+
+    def reset(self) -> None:
+        """Test isolation hook — the counters are process-global."""
+        self.dispatches_total = 0
+        self.rows_total = 0
+        self.tokens_total = 0
+        self.budget_offered_total = 0
+        self.budget_used_total = 0
+
+
+counters = PrefillCounters()

@@ -50,6 +50,11 @@ class EngineRequest:
     block_ids: list[int] = field(default_factory=list)
     cached_tokens: int = 0     # prefix-cache hit (KV already resident)
     computed_tokens: int = 0   # prompt tokens whose KV is computed
+    # prompt tokens whose blocks were already offered to block_manager
+    # .commit — the chunked-prefill watermark (each chunk commits only the
+    # blocks it completed; re-offering every earlier block per chunk made
+    # an L-block prompt pay O(L^2) commit calls)
+    committed_upto: int = 0
     # prompt tokens [computed_tokens, wait_upto) live in blocks another
     # request is prefilling right now (joined via the reserved-block
     # registry): this request absorbs them as the owner commits instead of

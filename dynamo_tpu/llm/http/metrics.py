@@ -12,10 +12,12 @@ import time
 from collections import defaultdict
 from typing import Iterator
 
+from dynamo_tpu.engine.counters import counters as prefill_counters
 from dynamo_tpu.fault.counters import counters as fault_counters
 
 PREFIX = "dynamo_tpu_http_service"
 FAULT_PREFIX = "dynamo_tpu_fault"
+ENGINE_PREFIX = "dynamo_tpu_engine"
 
 # seconds; TTFT and whole-request durations share one ladder
 _BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
@@ -104,6 +106,20 @@ class Metrics:
         lines.append(f"# TYPE {FAULT_PREFIX}_suspect_instances gauge")
         lines.append(f"{FAULT_PREFIX}_suspect_instances "
                      f"{fault_counters.suspect_instances()}")
+        # prefill batching (process-global, like the fault plane): how
+        # well the token-budget ragged prefill packs the device
+        lines.append(f"# TYPE {ENGINE_PREFIX}_prefill_dispatches_total counter")
+        lines.append(f"{ENGINE_PREFIX}_prefill_dispatches_total "
+                     f"{prefill_counters.dispatches_total}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_prefill_tokens_total counter")
+        lines.append(f"{ENGINE_PREFIX}_prefill_tokens_total "
+                     f"{prefill_counters.tokens_total}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_prefill_batch_occupancy gauge")
+        lines.append(f"{ENGINE_PREFIX}_prefill_batch_occupancy "
+                     f"{round(prefill_counters.batch_occupancy, 6)}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_prefill_budget_utilization gauge")
+        lines.append(f"{ENGINE_PREFIX}_prefill_budget_utilization "
+                     f"{round(prefill_counters.budget_utilization, 6)}")
         return "\n".join(lines) + "\n"
 
 

@@ -48,6 +48,7 @@ from dynamo_tpu.models.quant import (
 from dynamo_tpu.ops.paged_attention import (
     paged_attention_layer,
     prefill_attention,
+    ragged_prefill_attention,
     softcap,
     write_kv_cache_layer,
 )
@@ -117,6 +118,11 @@ def apply_rope(x: jax.Array, positions: jax.Array, theta: float,
 
 class LlamaModel:
     """Functional model: params pytree + pure forward functions."""
+
+    # forward() accepts the token-budget ragged prefill layout (the engine
+    # gates the batched scheduler on this; models without the ragged
+    # attention path — expanded-MLA DeepSeek — fall back to per-request)
+    supports_ragged_prefill = True
 
     def __init__(self, config: ModelConfig):
         self.config = config
@@ -346,6 +352,7 @@ class LlamaModel:
         seq_lens: jax.Array,      # [B] int32 — context length incl. new tokens
         slot_idx: jax.Array,      # [B, S] int32 — cache slot per new token, -1 pad
         prefix_blocks: int | None = None,  # STATIC — prefill fast path (see below)
+        ragged: tuple | None = None,       # (seq_ids, starts, row_offsets)
     ) -> tuple[jax.Array, jax.Array]:
         """Returns (hidden [B,S,Dm], updated kv_cache).
 
@@ -355,11 +362,24 @@ class LlamaModel:
         the whole padded block table.  Requires the S tokens of each row to
         be contiguous from block-aligned position ``positions[:, 0]``
         (exactly how the engine lays out prefill).  None = generic path.
+
+        ``ragged`` switches the prefill fast path to token-budget ragged
+        form: B is 1 and the S axis packs several sequences' chunks, each a
+        contiguous block-aligned span.  ``seq_ids`` [1, S] names each
+        token's owning row (-1 = padding), ``starts``/``row_offsets`` [R]
+        give each row's absolute chunk start and flat offset, and
+        ``block_tables``/``seq_lens`` are per-ROW ([R, M] / [R]) rather
+        than per-batch-row.  Requires ``prefix_blocks`` to be set.
         """
         cfg = self.config
         b, s = tokens.shape
         dh, hq, hk = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
-        fast_prefill = prefix_blocks is not None and s > 1
+        ragged_prefill = (
+            ragged is not None and prefix_blocks is not None and s > 1
+        )
+        fast_prefill = (
+            prefix_blocks is not None and s > 1 and not ragged_prefill
+        )
 
         hidden = take_rows(params["embed"], tokens, cfg.jax_dtype)
         if cfg.scale_embeddings:  # Gemma multiplies by sqrt(hidden_size)
@@ -380,12 +400,21 @@ class LlamaModel:
             q, k, v = _qkv_proj(cfg, lp, x, b, s)
             q = apply_rope(q, positions, cfg.rope_theta, self.inv_freq)
             k = apply_rope(k, positions, cfg.rope_theta, self.inv_freq)
-            # fast_prefill implies the engine's block-aligned contiguous
-            # chunk layout — unlocks the block-granular cache write
+            # fast_prefill/ragged imply the engine's block-aligned
+            # contiguous span layout — unlocks the block-granular write
             cache = write_kv_cache_layer(
-                cache, li, k, v, slot_idx, block_aligned=fast_prefill
+                cache, li, k, v, slot_idx,
+                block_aligned=fast_prefill or ragged_prefill,
             )
-            if fast_prefill:
+            if ragged_prefill:
+                seq_ids, seq_starts, row_offsets = ragged
+                attn = ragged_prefill_attention(
+                    q, k, v, cache, li, block_tables, seq_lens,
+                    seq_starts, row_offsets, seq_ids, prefix_blocks,
+                    sm_scale=self.sm_scale, logit_cap=cfg.attn_logit_softcap,
+                    window=cfg.sliding_window,
+                )
+            elif fast_prefill:
                 attn = prefill_attention(
                     q, k, v, cache, li, block_tables, seq_lens,
                     positions[:, 0], prefix_blocks,

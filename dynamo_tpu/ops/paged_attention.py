@@ -36,6 +36,7 @@ __all__ = [
     "paged_attention",
     "paged_attention_layer",
     "prefill_attention",
+    "ragged_prefill_attention",
 ]
 
 
@@ -260,6 +261,124 @@ def prefill_attention(
         "bkgst,btkd->bskgd", probs[..., t:], v_new.astype(jnp.float32)
     )
     return out.reshape(b, s, h, d).astype(q.dtype)
+
+
+def ragged_prefill_attention(
+    q: jax.Array,             # [1, T, H, D] — packed fresh queries (flat token axis)
+    k_new: jax.Array,         # [1, T, Hk, D] — packed fresh keys (pre-cache-write)
+    v_new: jax.Array,         # [1, T, Hk, D]
+    cache: jax.Array,         # [L, N, 2, Bs, Hk*D]
+    layer: jax.Array,         # scalar int32
+    block_tables: jax.Array,  # [R, M] int32 — one table per packed sequence
+    seq_lens: jax.Array,      # [R] int32 — context length incl. this chunk
+    starts: jax.Array,        # [R] int32 — absolute chunk start (block-aligned)
+    row_offsets: jax.Array,   # [R] int32 — flat index of each row's first token
+    seq_ids: jax.Array,       # [1, T] int32 — owning row per flat token; -1 = pad
+    prefix_blocks: int,       # STATIC: max cached-prefix blocks over rows (bucketed)
+    sm_scale: float | None = None,
+    logit_cap: float | None = None,
+    window: int | None = None,
+) -> jax.Array:
+    """Multi-sequence ragged prefill attention over one flat token axis.
+
+    The token-budget scheduler packs several sequences' prefill chunks
+    onto a single [T] axis (each chunk a contiguous, block-aligned span);
+    ``seq_ids`` names each token's owner.  Fresh-fresh attention is causal
+    *within* a sequence — flat order equals position order inside a span,
+    so the mask is seq-equality plus flat-index causality — and tokens
+    never see another sequence.  Fresh-prefix attention gathers each ROW's
+    own cached-prefix blocks and masks slots past that row's ``start``.
+
+    This is the pure-JAX oracle (CPU tests, XLA fallback); the per-token
+    prefix gather materialises [T, P*Bs] keys, which the Pallas kernel
+    (ops/pallas/prefill_attention.py) avoids by streaming each row's
+    blocks from HBM.  Padding tokens attend only padding (finite rows,
+    discarded by the caller).  Returns [1, T, H, D].
+    """
+    _, t, h, d = q.shape
+    hk = k_new.shape[2]
+    g = h // hk
+    quant = is_quant(cache)
+    if sm_scale is None:
+        sm_scale = 1.0 / (d**0.5)
+    data = cache.data if quant else cache
+    _, n, _, bs, hkd = data.shape
+    # same window routing as prefill_attention: only when the static
+    # attended span can actually exceed the window
+    windowed = window is not None and prefix_blocks * bs + t > window
+    if not windowed:
+        window = None
+    kernel_ok = (not quant or bs % 32 == 0) and not windowed
+    if t > 1 and kernel_ok and _pallas_prefill_enabled():
+        from dynamo_tpu.ops.pallas.prefill_attention import (
+            ragged_paged_prefill_attention,
+        )
+
+        return ragged_paged_prefill_attention(
+            q, k_new, v_new, cache, layer, block_tables, seq_lens, starts,
+            row_offsets, sm_scale=sm_scale, logit_cap=logit_cap,
+        )
+
+    qg = q[0].reshape(t, hk, g, d).astype(jnp.float32)
+    sid = seq_ids[0]                              # [T]
+    idx = jnp.arange(t, dtype=jnp.int32)
+    same = sid[:, None] == sid[None, :]           # padding pairs with padding
+    allow_f = same & (idx[None, :] <= idx[:, None])
+    if window is not None:
+        # flat gap IS the position gap inside a contiguous span
+        allow_f &= (idx[:, None] - idx[None, :]) < window
+    sf = jnp.einsum(
+        "skgd,tkd->kgst", qg, k_new[0].astype(jnp.float32)
+    ) * sm_scale
+    if logit_cap is not None:
+        sf = softcap(sf, logit_cap)
+    sf = jnp.where(allow_f[None, None], sf, -jnp.inf)
+
+    if prefix_blocks == 0:
+        probs = jax.nn.softmax(sf, axis=-1)
+        out = jnp.einsum(
+            "kgst,tkd->skgd", probs, v_new[0].astype(jnp.float32)
+        )
+        return out.reshape(1, t, h, d).astype(q.dtype)
+
+    r_rows = block_tables.shape[0]
+    layer_kv = jax.lax.dynamic_index_in_dim(data, layer, axis=0, keepdims=False)
+    ctx = layer_kv[block_tables[:, :prefix_blocks]]  # [R, P, 2, Bs, HkD]
+    if quant:
+        layer_sc = jax.lax.dynamic_index_in_dim(
+            cache.scale, layer, axis=0, keepdims=False
+        )
+        ctx = dequant_layer_slice(
+            ctx, layer_sc[block_tables[:, :prefix_blocks]], hk
+        )
+    u = prefix_blocks * bs
+    kp = ctx[:, :, 0].reshape(r_rows, u, hk, d)
+    vp = ctx[:, :, 1].reshape(r_rows, u, hk, d)
+    rid = jnp.clip(sid, 0, r_rows - 1)
+    kp_t = kp[rid]                                # [T, U, Hk, D] own-row prefix
+    vp_t = vp[rid]
+    sp = jnp.einsum(
+        "skgd,sukd->kgsu", qg, kp_t.astype(jnp.float32)
+    ) * sm_scale
+    if logit_cap is not None:
+        sp = softcap(sp, logit_cap)
+    slot = jnp.arange(u, dtype=jnp.int32)
+    allow_p = (sid[:, None] >= 0) & (slot[None, :] < starts[rid][:, None])
+    if window is not None:
+        # prefix slot u IS absolute position u; the query's absolute
+        # position is its row start plus its offset within the span
+        q_pos = starts[rid] + idx - row_offsets[rid]
+        allow_p &= (q_pos[:, None] - slot[None, :]) < window
+    sp = jnp.where(allow_p[None, None], sp, -jnp.inf)
+
+    scores = jnp.concatenate([sp, sf], axis=-1)   # [Hk, G, T, U+T]
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "kgsu,sukd->skgd", probs[..., :u], vp_t.astype(jnp.float32)
+    ) + jnp.einsum(
+        "kgst,tkd->skgd", probs[..., u:], v_new[0].astype(jnp.float32)
+    )
+    return out.reshape(1, t, h, d).astype(q.dtype)
 
 
 def write_kv_cache_layer(

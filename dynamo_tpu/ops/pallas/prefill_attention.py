@@ -31,7 +31,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from dynamo_tpu.ops.paged_attention import softcap
 
-__all__ = ["paged_prefill_attention"]
+__all__ = ["paged_prefill_attention", "ragged_paged_prefill_attention"]
 
 NEG_INF = -1e30
 
@@ -320,3 +320,322 @@ def paged_prefill_attention(
     )(*operands)
     # [B, Hk, S, G*D] -> [B, S, H, D]
     return out.transpose(0, 2, 1, 3).reshape(b, s, h, d)
+
+
+# --------------------------------------------------------- ragged prefill
+# Token-budget batched prefill: several sequences' chunks packed onto ONE
+# flat token axis (each chunk a contiguous block-aligned span).  The grid
+# walks flat query tiles; a tile may straddle sequences, so row membership
+# is derived in-kernel from the span table (row_offsets/row_ends in SMEM)
+# instead of a seq_ids vector — 1-D vector gathers are hostile on TPU,
+# span comparisons against a 2-D iota are free.  Fresh-fresh attention is
+# causal by flat index within a span (flat order == position order); the
+# cached prefix streams per ROW: the row loop DMAs each overlapping row's
+# own prefix blocks, masked to that row's queries.
+
+
+def _ragged_kernel(
+    start_ref, roff_ref, rend_ref, bt_ref, layer_ref, q_ref, k_ref, v_ref,
+    cache_ref, out_ref, acc_ref, m_ref, l_ref, kvbuf, sems,
+    *, c: int, tq: int, hk: int, g: int, d: int, r_rows: int,
+    sm_scale: float, logit_cap=None,
+):
+    return _ragged_kernel_impl(
+        start_ref, roff_ref, rend_ref, bt_ref, layer_ref, q_ref, k_ref,
+        v_ref, cache_ref, None, out_ref, acc_ref, m_ref, l_ref, kvbuf,
+        sems, None, None, c=c, tq=tq, hk=hk, g=g, d=d, r_rows=r_rows,
+        sm_scale=sm_scale, logit_cap=logit_cap)
+
+
+def _ragged_kernel_quant(
+    start_ref, roff_ref, rend_ref, bt_ref, layer_ref, q_ref, k_ref, v_ref,
+    cache_ref, scale_ref, out_ref, acc_ref, m_ref, l_ref, kvbuf, sems,
+    scbuf, scsems,
+    *, c: int, tq: int, hk: int, g: int, d: int, r_rows: int,
+    sm_scale: float, logit_cap=None,
+):
+    return _ragged_kernel_impl(
+        start_ref, roff_ref, rend_ref, bt_ref, layer_ref, q_ref, k_ref,
+        v_ref, cache_ref, scale_ref, out_ref, acc_ref, m_ref, l_ref,
+        kvbuf, sems, scbuf, scsems, c=c, tq=tq, hk=hk, g=g, d=d,
+        r_rows=r_rows, sm_scale=sm_scale, logit_cap=logit_cap)
+
+
+def _ragged_kernel_impl(
+    # scalar prefetch (SMEM)
+    start_ref,   # [R] int32 — absolute chunk start per row (prefix length)
+    roff_ref,    # [R] int32 — flat index of the row's first token
+    rend_ref,    # [R] int32 — flat index one past the row's last REAL token
+    bt_ref,      # [R, M] int32
+    layer_ref,   # [1] int32
+    # inputs
+    q_ref,       # [1, Hk, TQ, G*D] VMEM — this grid step's query rows
+    k_ref,       # [1, T, Hk*D] VMEM — whole packed fresh K
+    v_ref,       # [1, T, Hk*D] VMEM
+    cache_ref,   # [L, N, 2, Bs, Hk*D] HBM (manual DMA)
+    scale_ref,   # [L, N, 2, Hp, Sp] HBM f32, or None (bf16 cache)
+    # outputs
+    out_ref,     # [1, Hk, TQ, G*D] VMEM
+    # scratch
+    acc_ref,     # [Hk, TQ*G, D] f32
+    m_ref,       # [Hk, TQ*G, 128] f32
+    l_ref,       # [Hk, TQ*G, 128] f32
+    kvbuf,       # [2, C, 2, Bs, Hk*D] cache-dtype (double buffer)
+    sems,        # [2, C] DMA semaphores
+    scbuf,       # [2, C, 2, Hp, Sp] f32, or None
+    scsems,      # [2, C] DMA semaphores, or None
+    *,
+    c: int,
+    tq: int,
+    hk: int,
+    g: int,
+    d: int,
+    r_rows: int,
+    sm_scale: float,
+    logit_cap=None,
+):
+    quant = scale_ref is not None
+    ri = pl.program_id(0)
+    bs = kvbuf.shape[3]
+    t_chunk = c * bs
+    lyr = layer_ref[0]
+    q0 = ri * tq
+
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (tq * g, 1), 0) // g
+    qflat = q0 + rows                      # [TQ*G, 1] flat query index
+
+    def sid_at(x):
+        """Row id per flat index in ``x`` (-1 = padding), from the span
+        table — spans are disjoint, so the last matching row wins."""
+        def body(r, acc):
+            hit = (x >= roff_ref[r]) & (x < rend_ref[r])
+            return jnp.where(hit, r, acc)
+        return jax.lax.fori_loop(
+            0, r_rows, body, jnp.full(x.shape, -1, jnp.int32))
+
+    sid_q = sid_at(qflat)                  # [TQ*G, 1]
+
+    def flash_update(h, s_scores, v_cols, p_scale=None):
+        m_prev = m_ref[h, :, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s_scores, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s_scores - m_new)
+        l_ref[h] = l_ref[h] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[h] = jnp.broadcast_to(m_new, m_ref.shape[1:])
+        pv = jnp.dot(p if p_scale is None else p * p_scale, v_cols,
+                     preferred_element_type=jnp.float32)
+        acc_ref[h] = acc_ref[h] * alpha + pv
+
+    def q_head(h):
+        return q_ref[0, h].reshape(tq * g, d).astype(jnp.float32) * sm_scale
+
+    # ------------------------------------------------ prefix phase (per row)
+    def block_dmas(r, ci, slot):
+        m_table = bt_ref.shape[1]
+        out = []
+        for i in range(c):  # static unroll: C block copies per chunk
+            bid = bt_ref[r, jnp.minimum(ci * c + i, m_table - 1)]
+            out.append(pltpu.make_async_copy(
+                cache_ref.at[lyr, bid], kvbuf.at[slot, i], sems.at[slot, i]
+            ))
+            if quant:
+                out.append(pltpu.make_async_copy(
+                    scale_ref.at[lyr, bid], scbuf.at[slot, i],
+                    scsems.at[slot, i]
+                ))
+        return out
+
+    def row_body(r, _):
+        prefix = start_ref[r]
+        overlap = (q0 < rend_ref[r]) & (q0 + tq > roff_ref[r])
+
+        @pl.when(overlap & (prefix > 0))
+        def _row():
+            n_pref = pl.cdiv(prefix, t_chunk)
+            for dma in block_dmas(r, 0, 0):
+                dma.start()
+
+            def pref_body(ci, _):
+                slot = jax.lax.rem(ci, 2)
+
+                @pl.when(ci + 1 < n_pref)
+                def _prefetch():
+                    for dma in block_dmas(r, ci + 1, jax.lax.rem(ci + 1, 2)):
+                        dma.start()
+
+                for dma in block_dmas(r, ci, slot):
+                    dma.wait()
+
+                kc = kvbuf[slot, :, 0].reshape(t_chunk, hk * d).astype(
+                    jnp.float32)
+                vc = kvbuf[slot, :, 1].reshape(t_chunk, hk * d).astype(
+                    jnp.float32)
+                if quant:
+                    sck = jnp.concatenate(
+                        [scbuf[slot, i, 0][:hk, :bs] for i in range(c)],
+                        axis=-1)
+                    scv = jnp.concatenate(
+                        [scbuf[slot, i, 1][:hk, :bs] for i in range(c)],
+                        axis=-1)
+                col = ci * t_chunk + jax.lax.broadcasted_iota(
+                    jnp.int32, (1, t_chunk), 1)
+                # only this row's queries see this row's prefix slots
+                allow = (col < prefix) & (sid_q == r)
+                for h in range(hk):
+                    s_ = jax.lax.dot_general(
+                        q_head(h), kc[:, h * d:(h + 1) * d],
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    if quant:
+                        s_ = s_ * sck[h:h + 1, :]
+                    if logit_cap is not None:
+                        s_ = softcap(s_, logit_cap)
+                    s_ = jnp.where(allow, s_, NEG_INF)
+                    flash_update(h, s_, vc[:, h * d:(h + 1) * d],
+                                 p_scale=scv[h:h + 1, :] if quant else None)
+                return 0
+
+            jax.lax.fori_loop(0, n_pref, pref_body, 0)
+
+        return 0
+
+    jax.lax.fori_loop(0, r_rows, row_body, 0)
+
+    # ------------------------------------------------- fresh phase (causal)
+    def fresh_body(cj, _):
+        col0 = cj * tq
+        kc = k_ref[0, pl.ds(col0, tq)].astype(jnp.float32)   # [TQ, Hk*D]
+        vc = v_ref[0, pl.ds(col0, tq)].astype(jnp.float32)
+        col = col0 + jax.lax.broadcasted_iota(jnp.int32, (1, tq), 1)
+        sid_c = sid_at(col)                                  # [1, TQ]
+        # same sequence + causal by flat index; padding queries (sid -1)
+        # match nothing — fully-masked rows degenerate to a finite
+        # uniform-weight PV mean (exp(NEG_INF - NEG_INF) = 1), which the
+        # caller discards, matching the base kernel's padding contract
+        allow = (sid_c == sid_q) & (col <= qflat) & (sid_q >= 0)
+        for h in range(hk):
+            s_ = jax.lax.dot_general(
+                q_head(h), kc[:, h * d:(h + 1) * d],
+                (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            )
+            if logit_cap is not None:
+                s_ = softcap(s_, logit_cap)
+            s_ = jnp.where(allow, s_, NEG_INF)
+            flash_update(h, s_, vc[:, h * d:(h + 1) * d])
+        return 0
+
+    jax.lax.fori_loop(0, ri + 1, fresh_body, 0)
+
+    for h in range(hk):
+        denom = jnp.maximum(l_ref[h, :, :1], 1e-9)  # keep padding finite
+        out_ref[0, h] = (
+            (acc_ref[h] / denom).reshape(tq, g * d).astype(out_ref.dtype)
+        )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("sm_scale", "logit_cap", "rows_per_chunk",
+                     "blocks_per_chunk", "interpret"),
+)
+def ragged_paged_prefill_attention(
+    q: jax.Array,             # [1, T, H, D] — packed fresh queries
+    k_new: jax.Array,         # [1, T, Hk, D]
+    v_new: jax.Array,         # [1, T, Hk, D]
+    cache: jax.Array,         # [L, N, 2, Bs, Hk*D]
+    layer: jax.Array,         # scalar int32
+    block_tables: jax.Array,  # [R, M] int32 — per packed sequence
+    seq_lens: jax.Array,      # [R] int32 — context length incl. this chunk
+    starts: jax.Array,        # [R] int32 — absolute chunk start per row
+    row_offsets: jax.Array,   # [R] int32 — flat index of row's first token
+    sm_scale: float | None = None,
+    logit_cap: float | None = None,
+    rows_per_chunk: int = 128,
+    blocks_per_chunk: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash ragged prefill: T packed fresh tokens of up to R sequences
+    against fresh K/V + each row's own cached prefix.  Returns
+    [1, T, H, D]."""
+    from dynamo_tpu.ops.kv_quant import is_quant
+
+    quant = is_quant(cache)
+    data, scale = (cache.data, cache.scale) if quant else (cache, None)
+    _, t, h, d = q.shape
+    l, n, _, bs, hkd = data.shape
+    hk = hkd // d
+    g = h // hk
+    m = block_tables.shape[1]
+    r_rows = block_tables.shape[0]
+    if sm_scale is None:
+        sm_scale = 1.0 / (d**0.5)
+    tq = min(rows_per_chunk, t)
+    while t % tq:
+        tq //= 2
+    c = min(blocks_per_chunk, m)
+
+    q_in = q.reshape(1, t, hk, g * d).transpose(0, 2, 1, 3)
+    k_in = k_new.reshape(1, t, hkd)
+    v_in = v_new.reshape(1, t, hkd)
+    row_ends = row_offsets + (seq_lens - starts)  # one past last real token
+
+    in_specs = [
+        pl.BlockSpec((1, hk, tq, g * d), lambda ri, *_: (0, 0, ri, 0)),
+        pl.BlockSpec((1, t, hkd), lambda ri, *_: (0, 0, 0)),
+        pl.BlockSpec((1, t, hkd), lambda ri, *_: (0, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),  # cache stays in HBM
+    ]
+    scratch = [
+        pltpu.VMEM((hk, tq * g, d), jnp.float32),
+        pltpu.VMEM((hk, tq * g, 128), jnp.float32),
+        pltpu.VMEM((hk, tq * g, 128), jnp.float32),
+        pltpu.VMEM((2, c, 2, bs, hkd), data.dtype),
+        pltpu.SemaphoreType.DMA((2, c)),
+    ]
+    operands = [
+        starts.astype(jnp.int32),
+        row_offsets.astype(jnp.int32),
+        row_ends.astype(jnp.int32),
+        block_tables.astype(jnp.int32),
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        q_in,
+        k_in,
+        v_in,
+        data,
+    ]
+    if quant:
+        hp, sp = scale.shape[-2:]
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        scratch += [
+            pltpu.VMEM((2, c, 2, hp, sp), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, c)),
+        ]
+        operands.append(scale)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(t // tq,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, hk, tq, g * d), lambda ri, *_: (0, 0, ri, 0)
+        ),
+        scratch_shapes=scratch,
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _ragged_kernel_quant if quant else _ragged_kernel,
+            c=c, tq=tq, hk=hk, g=g, d=d, r_rows=r_rows,
+            sm_scale=float(sm_scale), logit_cap=logit_cap,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((1, hk, t, g * d), q.dtype),
+        interpret=interpret,
+    )(*operands)
+    # [1, Hk, T, G*D] -> [1, T, H, D]
+    return out.transpose(0, 2, 1, 3).reshape(1, t, h, d)

@@ -412,3 +412,124 @@ def test_kernels_at_8b_serving_geometry():
     for i, f in enumerate([s, s - 9]):
         np.testing.assert_allclose(np.asarray(got2)[i, :f],
                                    np.asarray(ref2)[i, :f], atol=3e-5)
+
+
+# ---------------------------------------------------- ragged flash prefill
+
+
+def _mk_ragged(rng, takes, starts_l, bs, n, m, r_pad=None):
+    """Pack per-row (take, start) specs onto a flat axis: returns
+    (T, seq_ids [1,T], block_tables [R,M], seq_lens, starts, roff)."""
+    r = len(takes) if r_pad is None else r_pad
+    spans = [-(-tk // bs) * bs for tk in takes]
+    t = sum(spans)
+    seq_ids = np.full((1, t), -1, np.int32)
+    roff = np.zeros(r, np.int32)
+    starts = np.zeros(r, np.int32)
+    seq_lens = np.zeros(r, np.int32)
+    bt = np.zeros((r, m), np.int32)
+    off = 0
+    for i, (tk, st) in enumerate(zip(takes, starts_l)):
+        seq_ids[0, off:off + tk] = i
+        roff[i] = off
+        starts[i] = st
+        seq_lens[i] = st + tk
+        bt[i] = (np.arange(m, dtype=np.int32) + i * m) % n
+        off += spans[i]
+    return (t, jnp.asarray(seq_ids), jnp.asarray(bt),
+            jnp.asarray(seq_lens), jnp.asarray(starts), jnp.asarray(roff))
+
+
+def _ragged_oracle(q, k_new, v_new, cache, layer, bt, seq_lens, starts,
+                   roff, seq_ids, prefix_blocks):
+    """Pure-JAX reference — pin the pure path (see _prefill_oracle)."""
+    import os
+
+    from dynamo_tpu.ops.paged_attention import ragged_prefill_attention
+
+    os.environ["DYNAMO_DISABLE_PALLAS_PREFILL"] = "1"
+    try:
+        return ragged_prefill_attention(
+            q, k_new, v_new, cache, jnp.int32(layer), bt, seq_lens,
+            starts, roff, seq_ids, prefix_blocks,
+        )
+    finally:
+        os.environ.pop("DYNAMO_DISABLE_PALLAS_PREFILL", None)
+
+
+@pytest.mark.parametrize(
+    "takes,starts_l,prefix_blks,tq,c,layer",
+    [
+        # three rows, no prefix; tiles straddle sequence boundaries
+        ([40, 16, 50], [0, 0, 0], 0, 32, 2, 0),
+        # mixed cached prefixes (per-row gathers + start masking)
+        ([40, 16, 50], [32, 0, 16], 4, 32, 2, 1),
+        # single row (degenerate ragged == plain prefill)
+        ([64], [16], 1, 32, 4, 0),
+        # many small rows inside one tile + padded row tail (r_pad > real)
+        ([8, 8, 8, 8], [0, 16, 0, 32], 2, 16, 8, 2),
+    ],
+)
+def test_ragged_prefill_kernel_matches_oracle(takes, starts_l, prefix_blks,
+                                              tq, c, layer):
+    from dynamo_tpu.ops.pallas.prefill_attention import (
+        ragged_paged_prefill_attention,
+    )
+
+    rng = np.random.default_rng(11)
+    hk, d, h, bs, n, m = 2, 32, 4, 16, 64, 8
+    t, seq_ids, bt, seq_lens, starts, roff = _mk_ragged(
+        rng, takes, starts_l, bs, n, m, r_pad=len(takes) + 1)
+    q = jnp.asarray(rng.normal(size=(1, t, h, d)), jnp.float32)
+    k_new = jnp.asarray(rng.normal(size=(1, t, hk, d)), jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(1, t, hk, d)), jnp.float32)
+    cache = _mk_cache(rng, 3, n, bs, hk, d)
+
+    ref = _ragged_oracle(q, k_new, v_new, cache, layer, bt, seq_lens,
+                         starts, roff, seq_ids, prefix_blks)
+    out = ragged_paged_prefill_attention(
+        q, k_new, v_new, cache, jnp.int32(layer), bt, seq_lens, starts,
+        roff, rows_per_chunk=tq, blocks_per_chunk=c, interpret=True,
+    )
+    # compare only real tokens: kernel and oracle agree there; padding
+    # rows are finite garbage both discard (contracts differ in value)
+    real = np.asarray(seq_ids)[0] >= 0
+    np.testing.assert_allclose(
+        np.asarray(out)[0][real], np.asarray(ref)[0][real],
+        atol=2e-5, rtol=1e-5,
+    )
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_ragged_prefill_kernel_quant_geometry():
+    """Ragged kernel against the int8 cache at the serving tile shape
+    (bs=32, padded scales) — per-row prefix DMA must rescale like the
+    base kernel."""
+    from dynamo_tpu.ops.kv_quant import QuantKvCache, pad_scales
+    from dynamo_tpu.ops.pallas.prefill_attention import (
+        ragged_paged_prefill_attention,
+    )
+
+    rng = np.random.default_rng(13)
+    l, n, bs, hk, d, h, m = 1, 16, 32, 2, 64, 4, 4
+    data = jnp.asarray(rng.integers(-127, 127, size=(l, n, 2, bs, hk * d)),
+                       jnp.int8)
+    scale = pad_scales(jnp.asarray(
+        rng.random((l, n, 2, hk, bs)) * 0.05 + 0.01, jnp.float32))
+    cache = QuantKvCache(data, scale)
+    t, seq_ids, bt, seq_lens, starts, roff = _mk_ragged(
+        rng, [32, 64], [32, 64], bs, n, m)
+    q = jnp.asarray(rng.normal(size=(1, t, h, d)), jnp.float32)
+    kn = jnp.asarray(rng.normal(size=(1, t, hk, d)), jnp.float32)
+    vn = jnp.asarray(rng.normal(size=(1, t, hk, d)), jnp.float32)
+
+    ref = _ragged_oracle(q, kn, vn, cache, 0, bt, seq_lens, starts, roff,
+                         seq_ids, 2)
+    out = ragged_paged_prefill_attention(
+        q, kn, vn, cache, jnp.int32(0), bt, seq_lens, starts, roff,
+        rows_per_chunk=32, blocks_per_chunk=2, interpret=True,
+    )
+    real = np.asarray(seq_ids)[0] >= 0
+    np.testing.assert_allclose(
+        np.asarray(out)[0][real], np.asarray(ref)[0][real], atol=3e-5,
+    )
