@@ -1,0 +1,22 @@
+"""Static-analysis suite: async-safety + JAX/TPU rules with a baseline
+and a zero-findings tier-1 gate (docs/static_analysis.md)."""
+
+from dynamo_tpu.analysis.core import (
+    DEFAULT_BASELINE_PATH,
+    Baseline,
+    Finding,
+    Rule,
+    all_rules,
+    lint_file,
+    lint_paths,
+)
+
+__all__ = [
+    "DEFAULT_BASELINE_PATH",
+    "Baseline",
+    "Finding",
+    "Rule",
+    "all_rules",
+    "lint_file",
+    "lint_paths",
+]

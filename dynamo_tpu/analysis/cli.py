@@ -1,0 +1,114 @@
+"""``dynamo-tpu lint`` — CLI front end for the static-analysis suite.
+
+Text output for humans, ``--format json`` (stable-sorted) for CI diffing,
+exit code 1 on any non-baselined finding.  ``--update-baseline`` rewrites
+the committed baseline from the current findings, carrying existing
+justifications over where the (path, rule, content) key still matches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Optional
+
+from dynamo_tpu.analysis.core import (
+    DEFAULT_BASELINE_PATH,
+    Baseline,
+    all_rules,
+    lint_paths,
+)
+
+__all__ = ["configure_parser", "run_lint", "main"]
+
+
+def configure_parser(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to lint (default: the dynamo_tpu "
+                        "package)")
+    p.add_argument("--format", choices=["text", "json"], default="text",
+                   dest="fmt")
+    p.add_argument("--select", default=None, metavar="DT001,DT102",
+                   help="comma-separated rule codes to run (default: all)")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="baseline file (default: the committed "
+                        "analysis/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from current findings "
+                        "(carries justifications over by key)")
+    p.add_argument("--root", default=None,
+                   help="paths in output are relative to this directory "
+                        "(default: cwd)")
+    return p
+
+
+def run_lint(args: argparse.Namespace, out=None) -> int:
+    out = out if out is not None else sys.stdout
+    paths = [Path(p) for p in (args.paths or [])]
+    if args.root:
+        root = Path(args.root)
+    elif not paths:
+        # bare `dynamo-tpu lint` from any cwd: paths repo-root-relative
+        # so they match the committed baseline
+        root = Path(__file__).resolve().parents[2]
+    else:
+        root = Path.cwd()
+    if not paths:
+        paths = [Path(__file__).resolve().parents[1]]  # the package
+    select = args.select.split(",") if args.select else None
+    try:
+        rules = all_rules(select)
+    except ValueError as e:
+        print(f"dynamo-tpu lint: {e}", file=sys.stderr)
+        return 2
+
+    findings = lint_paths(paths, rules, root=root)
+
+    baseline_path = Path(args.baseline) if args.baseline else (
+        DEFAULT_BASELINE_PATH
+    )
+    baseline = (
+        Baseline() if args.no_baseline else Baseline.load(baseline_path)
+    )
+
+    if args.update_baseline:
+        Baseline.from_findings(findings, baseline).save(baseline_path)
+        print(
+            f"baseline updated: {len(findings)} entr"
+            f"{'y' if len(findings) == 1 else 'ies'} -> {baseline_path}",
+            file=out,
+        )
+        return 0
+
+    fresh = baseline.filter(findings)
+    n_baselined = len(findings) - len(fresh)
+
+    if args.fmt == "json":
+        doc = {
+            "findings": [f.to_json() for f in fresh],  # already sorted
+            "baselined": n_baselined,
+            "total": len(findings),
+        }
+        print(json.dumps(doc, indent=2, sort_keys=True), file=out)
+    else:
+        for f in fresh:
+            print(f.render(), file=out)
+        summary = (
+            f"{len(fresh)} finding{'s' if len(fresh) != 1 else ''}"
+            f" ({n_baselined} baselined)"
+        )
+        print(summary, file=out)
+    return 1 if fresh else 0
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    p = configure_parser(argparse.ArgumentParser(prog="dynamo-tpu lint"))
+    return run_lint(p.parse_args(argv))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

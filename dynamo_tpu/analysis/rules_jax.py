@@ -1,0 +1,243 @@
+"""JAX/TPU rules (DT101–DT104) for the engine hot path.
+
+These encode the discipline engine/core.py's step functions follow: jit
+once at init, donate the cache and never touch the stale buffer, pull
+results host-side in ONE batched device_get per step, and never leak
+tracers onto ``self`` from inside a jitted function.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from dynamo_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    dotted_name,
+    is_jit_call,
+    register,
+)
+
+
+def _assigned_names(stmt: ast.AST) -> set[str]:
+    """Dotted names a statement (re)binds."""
+    names: set[str] = set()
+    targets: list[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    for t in targets:
+        for el in ast.walk(t):
+            name = dotted_name(el)
+            if name:
+                names.add(name)
+    return names
+
+
+@register
+class JitPerCall(Rule):
+    """DT101 — ``jax.jit`` constructed per call.  An immediately-invoked
+    ``jax.jit(f)(x)`` (or a jit built inside a loop / rebuilt in a plain
+    local each call) makes a fresh jitted callable every time: its
+    Python-scalar arguments re-trigger tracing, and on TPU that's a
+    recompilation storm — seconds of XLA compile on the per-token path.
+    Build the jit once at init scope and declare per-call Python scalars
+    in ``static_argnums`` (or pass them as arrays)."""
+
+    code = "DT101"
+    name = "jit-per-call"
+    summary = (
+        "jax.jit constructed per call (recompilation storm); hoist it "
+        "and use static_argnums for varying Python scalars"
+    )
+    interests = (ast.Call,)
+
+    def visit(self, node: ast.Call, ctx: ModuleContext) -> Iterable[Finding]:
+        if not is_jit_call(node, ctx):
+            return
+        parent = getattr(node, "_dt_parent", None)
+        immediately_invoked = (
+            isinstance(parent, ast.Call) and parent.func is node
+        )
+        if immediately_invoked:
+            yield ctx.finding(
+                self, node,
+                "jax.jit(...) immediately invoked: a fresh jitted "
+                "callable (and a fresh trace) per call — hoist the jit "
+                "to init scope and mark varying Python scalars "
+                "static_argnums",
+            )
+            return
+        if ctx.loop_depth > 0:
+            yield ctx.finding(
+                self, node,
+                "jax.jit(...) constructed inside a loop: re-jits every "
+                "iteration — hoist it out of the loop",
+            )
+            return
+        func = ctx.current_func
+        if func is None or func.name == "__init__":
+            return  # module/class/init scope: built once, fine
+        # inside a regular function: fine only if cached somewhere that
+        # outlives the call (an attribute target, e.g. ``self._fn = ...``
+        # or ``fn = self._fn = jax.jit(...)``)
+        stmt = parent
+        while stmt is not None and not isinstance(stmt, ast.stmt):
+            stmt = getattr(stmt, "_dt_parent", None)
+        if isinstance(stmt, ast.Assign) and any(
+            "." in n for n in _assigned_names(stmt)
+        ):
+            return
+        yield ctx.finding(
+            self, node,
+            "jax.jit(...) built inside a function without caching the "
+            "result on an attribute: re-jits on every call — hoist to "
+            "__init__/module scope or cache it",
+        )
+
+
+@register
+class DeviceGetInLoop(Rule):
+    """DT102 — ``jax.device_get``/``block_until_ready`` inside a Python
+    loop.  Each call is a device→host round trip that serialises the
+    pipelined dispatch queue; on a remote-attached TPU the per-call
+    latency dominates.  Batch the pulls: stack outputs device-side and
+    issue ONE device_get per step, the way engine/core.py's decode path
+    does (its blessed batched-pull sites are loop-free)."""
+
+    code = "DT102"
+    name = "device-get-in-loop"
+    summary = (
+        "per-iteration device_get/block_until_ready: serialise into one "
+        "batched pull per step"
+    )
+    interests = (ast.Call,)
+
+    def visit(self, node: ast.Call, ctx: ModuleContext) -> Iterable[Finding]:
+        if ctx.loop_depth <= 0:
+            return
+        fn = ctx.call_name(node)
+        is_pull = fn in ("jax.device_get", "jax.block_until_ready") or (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "block_until_ready"
+        )
+        if not is_pull:
+            return
+        yield ctx.finding(
+            self, node,
+            "device_get/block_until_ready inside a loop: one "
+            "device->host sync per iteration — batch outputs and pull "
+            "once per step (engine/core.py pattern)",
+        )
+
+
+@register
+class UseAfterDonate(Rule):
+    """DT103 — reading a donated buffer after the jitted call.  With
+    ``donate_argnums`` XLA reuses the input's HBM for the output; the
+    Python reference now points at freed/aliased memory and JAX raises
+    (or worse, silently reads garbage under some transfer paths).  The
+    engine's convention: the donated cache is rebound by the same
+    statement (``out, self.cache = self._step_fn(self.params,
+    self.cache, ...)``)."""
+
+    code = "DT103"
+    name = "use-after-donate"
+    summary = "donated buffer read after the jitted call"
+    interests = (ast.Call,)
+
+    def visit(self, node: ast.Call, ctx: ModuleContext) -> Iterable[Finding]:
+        callee = ctx.canonical(dotted_name(node.func))
+        # donated registry keys are un-canonicalised dotted names
+        # ("self._step_fn", "_scatter_donated")
+        raw = dotted_name(node.func)
+        positions = ctx.jit.donated.get(raw) or ctx.jit.donated.get(callee)
+        if not positions:
+            return
+        func = ctx.current_func
+        if func is None:
+            return
+        stmt = getattr(node, "_dt_parent", None)
+        while stmt is not None and not isinstance(stmt, ast.stmt):
+            stmt = getattr(stmt, "_dt_parent", None)
+        if stmt is None:
+            return
+        rebound = _assigned_names(stmt)
+        call_line = stmt.lineno
+        for pos in positions:
+            if pos >= len(node.args):
+                continue
+            donated = dotted_name(node.args[pos])
+            if not donated or donated in rebound:
+                continue  # dynamic arg, or rebound by the same statement
+            # collect later stores (kills) and loads of the donated name
+            kills: list[int] = []
+            uses: list[tuple[int, ast.AST]] = []
+            for sub in ast.walk(func):
+                name = dotted_name(sub)
+                if name != donated:
+                    continue
+                lineno = getattr(sub, "lineno", 0)
+                if lineno <= call_line:
+                    continue
+                ctx_attr = getattr(sub, "ctx", None)
+                if isinstance(ctx_attr, ast.Store):
+                    kills.append(lineno)
+                elif isinstance(ctx_attr, ast.Load):
+                    uses.append((lineno, sub))
+            for lineno, use in sorted(uses):
+                if any(k <= lineno for k in kills):
+                    break  # rebound before (or at) this use
+                yield ctx.finding(
+                    self, use,
+                    f"'{donated}' was donated to {raw or callee}() at "
+                    f"line {call_line} (donate_argnums) and read "
+                    "afterwards: the buffer is freed/aliased — rebind it "
+                    "from the call's outputs",
+                )
+                break  # one finding per donated arg is enough
+
+
+@register
+class TracerOnSelf(Rule):
+    """DT104 — storing values on ``self`` from inside a jitted function.
+    Under trace the value is a Tracer; stashing it on the instance leaks
+    it past the trace, and the next (non-traced or re-traced) read
+    raises ``UnexpectedTracerError`` — or silently freezes a stale
+    constant into the compiled graph.  Return the value instead and let
+    the non-jitted caller store it."""
+
+    code = "DT104"
+    name = "tracer-on-self"
+    summary = "attribute store on self inside a jitted function"
+    interests = (ast.Assign, ast.AugAssign, ast.AnnAssign)
+
+    def visit(self, node: ast.AST, ctx: ModuleContext) -> Iterable[Finding]:
+        func = ctx.current_func
+        if func is None or func.name not in ctx.jit.jitted_fns:
+            return
+        targets = (
+            list(node.targets)
+            if isinstance(node, ast.Assign)
+            else [node.target]
+        )
+        for t in targets:
+            for el in ast.walk(t):
+                if (
+                    isinstance(el, ast.Attribute)
+                    and isinstance(el.value, ast.Name)
+                    and el.value.id in ("self", "cls")
+                ):
+                    yield ctx.finding(
+                        self, node,
+                        f"store to {el.value.id}.{el.attr} inside jitted "
+                        f"function {func.name}(): leaks a tracer out of "
+                        "the trace — return the value and store it in "
+                        "the caller",
+                    )
+                    return

@@ -478,6 +478,14 @@ async def _cmd_http(args) -> None:
     svc = HttpService(host=args.host, port=args.http_port)
     ns = args.namespace or "dynamo"
     clients: dict[str, object] = {}
+    # discovery-event tasks, retained so a failed add_model (bad entry,
+    # unreachable endpoint) is logged instead of vanishing with the task
+    add_tasks: set[asyncio.Task] = set()
+
+    def _add_done(task: asyncio.Task) -> None:
+        add_tasks.discard(task)
+        if not task.cancelled() and task.exception() is not None:
+            log.error("add_model failed", exc_info=task.exception())
 
     async def add_model(name: str, entry: dict) -> None:
         e_ns, comp, ep = parse_endpoint_url(entry["endpoint"])
@@ -494,14 +502,20 @@ async def _cmd_http(args) -> None:
     def on_event(event: str, key: str, value) -> None:
         name = key.rsplit("/", 1)[-1]
         if event == "put":
-            asyncio.ensure_future(add_model(name, value))
+            task = asyncio.ensure_future(add_model(name, value))
+            add_tasks.add(task)
+            task.add_done_callback(_add_done)
         elif event == "delete":
             svc.manager.remove_model(name)
             clients.pop(name, None)
 
     _, snapshot = await runtime.coordinator.watch(f"{ns}/{MODELS_PREFIX}", on_event)
     for key, value in snapshot.items():
-        await add_model(key.rsplit("/", 1)[-1], value)
+        try:
+            await add_model(key.rsplit("/", 1)[-1], value)
+        except Exception:
+            # one bad registration must not take down the whole frontend
+            log.exception("add_model %s failed at startup", key)
 
     await svc.start()
     log.info("OpenAI frontend on %s:%s (namespace %s)", svc.host, svc.port, ns)
@@ -1000,6 +1014,14 @@ def _parser() -> argparse.ArgumentParser:
                         help="pull: cache directory override")
     common(models)
 
+    from dynamo_tpu.analysis.cli import configure_parser as _lint_parser
+
+    _lint_parser(sub.add_parser(
+        "lint",
+        help="async-safety + JAX/TPU static analysis "
+        "(docs/static_analysis.md); exit 1 on non-baselined findings",
+    ))
+
     quant = sub.add_parser(
         "quantize",
         help="convert an HF/GGUF checkpoint to a native serving checkpoint "
@@ -1050,6 +1072,10 @@ def main(argv: Optional[list[str]] = None) -> None:
         asyncio.run(_cmd_mock_worker(args))
     elif args.cmd == "models":
         asyncio.run(_cmd_models(args))
+    elif args.cmd == "lint":
+        from dynamo_tpu.analysis.cli import run_lint
+
+        raise SystemExit(run_lint(args))
     elif args.cmd == "quantize":
         _cmd_quantize(args)
 

@@ -116,6 +116,22 @@ class CoordinatorServer:
         self._blobs: dict[str, dict] = {}
         self._blob_data: dict[str, bytes] = {}
         self._blob_uploads: dict[int, dict] = {}
+        # background tasks (watcher notifies, long queue pulls): retained
+        # so their exceptions are logged instead of vanishing at loop
+        # teardown, and drained on stop() so no task outlives the server
+        self._bg_tasks: set[asyncio.Task] = set()
+
+    def _spawn(self, coro) -> asyncio.Task:
+        task = asyncio.ensure_future(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_done)
+        return task
+
+    def _bg_done(self, task: asyncio.Task) -> None:
+        self._bg_tasks.discard(task)
+        if not task.cancelled() and task.exception() is not None:
+            log.error("coordinator background task failed",
+                      exc_info=task.exception())
 
     @staticmethod
     def _id_epoch() -> int:
@@ -239,6 +255,12 @@ class CoordinatorServer:
             for w in list(self._conn_writers.values()):
                 w.close()
             await self._server.wait_closed()
+        # drain retained background tasks (watcher notifies, queue pulls):
+        # cancel-then-gather is bounded — nothing here waits on a peer
+        for t in list(self._bg_tasks):
+            t.cancel()
+        if self._bg_tasks:
+            await asyncio.gather(*self._bg_tasks, return_exceptions=True)
         if self._wal is not None:
             self._wal.close()
             self._wal = None
@@ -438,7 +460,7 @@ class CoordinatorServer:
                     await self._send(conn_id, writer,
                                      {"id": rid, "ok": True, "msg_id": item.msg_id}, item.payload)
 
-            asyncio.ensure_future(_pull())
+            self._spawn(_pull())
 
         elif op == "queue_ack":
             key = (h["queue"], h["msg_id"])
@@ -617,7 +639,7 @@ class CoordinatorServer:
         if existed:
             if not lease_id:
                 self._log({"t": "kvdel", "key": key})
-            asyncio.ensure_future(self._notify_watchers("delete", key, None))
+            self._spawn(self._notify_watchers("delete", key, None))
         return existed
 
     def _revoke_lease(self, lease_id: int) -> None:
@@ -630,7 +652,7 @@ class CoordinatorServer:
             self._kv_lease.pop(key, None)
             # a pre-lease durable value must not resurrect on restart
             self._log({"t": "kvdel", "key": key})
-            asyncio.ensure_future(self._notify_watchers("delete", key, None))
+            self._spawn(self._notify_watchers("delete", key, None))
 
     async def _notify_watchers(self, event: str, key: str, value: Any) -> None:
         for watch_id, (prefix, writer, conn_id) in list(self._watches.items()):
@@ -814,7 +836,8 @@ class CoordinatorClient:
                     try:
                         self._writer.close()
                     except Exception:
-                        pass
+                        log.debug("closing stale writer failed",
+                                  exc_info=True)
                     await asyncio.sleep(delay)
         finally:
             self._reconnecting = False

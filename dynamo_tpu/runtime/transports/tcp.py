@@ -150,7 +150,8 @@ class EndpointTcpServer:
                 try:
                     w.transport.abort()
                 except Exception:
-                    pass
+                    log.debug("aborting connection transport failed",
+                              exc_info=True)
             await self._server.wait_closed()
             await self._reap_handlers()
             self._server = None
@@ -175,7 +176,8 @@ class EndpointTcpServer:
                     try:
                         writer.transport.abort()
                     except Exception:
-                        pass
+                        log.debug("fault-hook sever abort failed",
+                                  exc_info=True)
                     return
             async with wlock:
                 try:
@@ -279,7 +281,8 @@ class EndpointTcpClient(AsyncEngine):
                     try:
                         self._writer.close()
                     except Exception:
-                        pass
+                        log.debug("closing stale endpoint socket failed",
+                                  exc_info=True)
                 self._reader, self._writer = await asyncio.open_connection(
                     self.host, self.port
                 )

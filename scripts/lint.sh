@@ -1,0 +1,8 @@
+#!/bin/sh
+# Convenience wrapper for the static-analysis suite (docs/static_analysis.md).
+#   scripts/lint.sh                      # lint dynamo_tpu/, human output
+#   scripts/lint.sh --format json        # stable-sorted JSON for CI diffing
+#   scripts/lint.sh --update-baseline    # rebuild analysis/baseline.json
+# Exit code 1 on any non-baselined finding.
+cd "$(dirname "$0")/.." || exit 2
+exec python -m dynamo_tpu lint "$@"

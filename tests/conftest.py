@@ -37,6 +37,12 @@ _TIME_BUDGET_S = float(os.environ.get("DYNAMO_TEST_TIME_BUDGET", "20"))
 # Known offenders predating the guard (module-level: any test in these
 # files is exempt — several share module-scoped fixtures whose cost lands
 # on whichever test runs first).  Burn this list down; do NOT grow it.
+# Pruned (verified: worst standalone call time via --durations=0 AND a
+# full in-suite tier-1 run with the guard active): test_http_service.py
+# (0.04s), test_multistep_decode.py (5.5s), test_deepseek.py (7.1s),
+# test_disagg.py (8.3s).  test_sampling_extras.py stays: 5.0s
+# standalone but its engine-compiling e2e test blew the budget under
+# full-suite load (in-suite durations run ~2x+ standalone).
 _TIME_BUDGET_GRANDFATHERED_FILES = {
     "test_e2e_serving.py",
     "test_engine.py",
@@ -45,13 +51,9 @@ _TIME_BUDGET_GRANDFATHERED_FILES = {
     "test_model_correctness.py",
     "test_multihost.py",
     "test_multihost_disagg.py",
+    "test_sampling_extras.py",
     "test_serve_bench.py",
     "test_spec_decode.py",
-    "test_multistep_decode.py",
-    "test_sampling_extras.py",
-    "test_disagg.py",
-    "test_deepseek.py",
-    "test_http_service.py",
 }
 
 

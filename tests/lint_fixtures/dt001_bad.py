@@ -1,0 +1,15 @@
+"""DT001 bad: the spawned task's handle is dropped on the floor."""
+
+import asyncio
+
+
+async def work() -> None:
+    await asyncio.sleep(0)
+
+
+async def fire_and_forget() -> None:
+    asyncio.ensure_future(work())
+
+
+async def fire_and_forget_create() -> None:
+    asyncio.create_task(work())

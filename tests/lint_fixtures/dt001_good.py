@@ -1,0 +1,35 @@
+"""DT001 good: handles are stored, drained, and exception-logged."""
+
+import asyncio
+import logging
+
+log = logging.getLogger(__name__)
+
+_tasks: set = set()
+
+
+def _done(task: asyncio.Task) -> None:
+    _tasks.discard(task)
+    if not task.cancelled() and task.exception() is not None:
+        log.error("task failed", exc_info=task.exception())
+
+
+async def work() -> None:
+    await asyncio.sleep(0)
+
+
+async def retained() -> None:
+    task = asyncio.ensure_future(work())
+    _tasks.add(task)
+    task.add_done_callback(_done)
+
+
+async def awaited() -> None:
+    await asyncio.create_task(work())
+
+
+async def drain() -> None:
+    for t in list(_tasks):
+        t.cancel()
+    if _tasks:
+        await asyncio.gather(*_tasks, return_exceptions=True)

@@ -1,0 +1,19 @@
+"""DT002 bad: broad excepts in async code that eat errors silently."""
+
+import asyncio
+
+
+async def poll_loop(conn) -> None:
+    while True:
+        try:
+            await conn.recv()
+        except Exception:
+            pass
+        await asyncio.sleep(0.1)
+
+
+async def bare_except(conn) -> None:
+    try:
+        await conn.send(b"x")
+    except:  # noqa: E722
+        pass

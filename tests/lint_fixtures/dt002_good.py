@@ -1,0 +1,37 @@
+"""DT002 good: broad excepts log (or re-raise), narrow ones may pass."""
+
+import asyncio
+import logging
+
+log = logging.getLogger(__name__)
+
+
+async def poll_loop(conn) -> None:
+    while True:
+        try:
+            await conn.recv()
+        except Exception:
+            log.debug("transport fault in poll loop", exc_info=True)
+        await asyncio.sleep(0.1)
+
+
+async def reraise(conn) -> None:
+    try:
+        await conn.send(b"x")
+    except Exception:
+        log.exception("send failed")
+        raise
+
+
+async def narrow_is_fine(writer) -> None:
+    try:
+        writer.close()
+    except (ConnectionResetError, RuntimeError):
+        pass
+
+
+def sync_scope_is_out_of_scope(conn) -> None:
+    try:
+        conn.close()
+    except Exception:
+        pass

@@ -1,0 +1,16 @@
+"""DT003 bad: blocking calls directly on the event loop."""
+
+import subprocess
+import time
+
+
+async def stalls_everyone() -> None:
+    time.sleep(1.0)
+
+
+async def shells_out(cmd) -> None:
+    subprocess.run(cmd, check=True)
+
+
+async def sync_file_io(path) -> bytes:
+    return open(path, "rb").read()

@@ -1,0 +1,19 @@
+"""DT003 good: asyncio equivalents, executor offload, sync scope."""
+
+import asyncio
+import time
+
+
+async def sleeps_politely() -> None:
+    await asyncio.sleep(1.0)
+
+
+async def offloads_file_io(path) -> bytes:
+    def _read() -> bytes:
+        return open(path, "rb").read()
+
+    return await asyncio.get_running_loop().run_in_executor(None, _read)
+
+
+def sync_scope_may_block() -> None:
+    time.sleep(0.01)

@@ -1,0 +1,14 @@
+"""DT004 bad: FIRST_COMPLETED race whose loser keeps running."""
+
+import asyncio
+
+
+async def leaky_race(queue, stop_event) -> object:
+    get_task = asyncio.ensure_future(queue.get())
+    stop_task = asyncio.ensure_future(stop_event.wait())
+    done, pending = await asyncio.wait(
+        [get_task, stop_task], return_when=asyncio.FIRST_COMPLETED
+    )
+    if get_task in done:
+        return get_task.result()
+    return None

@@ -1,0 +1,28 @@
+"""DT004 good: the losing waiter is cancelled (or awaited) on every
+exit path — the tcp.py / async_engine.py generate-loop shape."""
+
+import asyncio
+
+
+async def clean_race(queue, stop_event) -> object:
+    get_task = asyncio.ensure_future(queue.get())
+    stop_task = asyncio.ensure_future(stop_event.wait())
+    try:
+        done, pending = await asyncio.wait(
+            [get_task, stop_task], return_when=asyncio.FIRST_COMPLETED
+        )
+        if get_task in done:
+            return get_task.result()
+        return None
+    finally:
+        get_task.cancel()
+        stop_task.cancel()
+
+
+async def cancel_via_pending(tasks) -> None:
+    done, pending = await asyncio.wait(
+        tasks, return_when=asyncio.FIRST_COMPLETED
+    )
+    for t in pending:
+        t.cancel()
+    await asyncio.gather(*pending, return_exceptions=True)

@@ -1,0 +1,20 @@
+"""DT101 bad: a fresh jax.jit per call — recompilation storm."""
+
+import jax
+
+
+def impl(x, n):
+    return x * n
+
+
+class Engine:
+    def step(self, x, n):
+        # immediately-invoked: traces (and on TPU compiles) every call
+        return jax.jit(impl)(x, n)
+
+    def steps(self, xs):
+        out = []
+        for x in xs:
+            fn = jax.jit(impl)
+            out.append(fn(x, 2))
+        return out

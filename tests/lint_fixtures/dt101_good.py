@@ -1,0 +1,24 @@
+"""DT101 good: jit built once (module / __init__ / cached attribute),
+varying Python scalars declared static."""
+
+import jax
+
+
+def impl(x, n):
+    return x * n
+
+
+_fn = jax.jit(impl, static_argnums=(1,))
+
+
+class Engine:
+    def __init__(self):
+        self._step_fn = jax.jit(impl, static_argnums=(1,))
+
+    def step(self, x, n):
+        return self._step_fn(x, n)
+
+    def lazy_step(self, x, n):
+        # lazily built but cached on the instance: jits once
+        fn = self._lazy_fn = jax.jit(impl, static_argnums=(1,))
+        return fn(x, n)

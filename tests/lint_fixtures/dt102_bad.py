@@ -1,0 +1,16 @@
+"""DT102 bad: one device->host sync per loop iteration."""
+
+import jax
+
+
+def decode_tokens(step_outputs):
+    tokens = []
+    for out in step_outputs:
+        tokens.append(jax.device_get(out))
+    return tokens
+
+
+def wait_each(step_outputs):
+    for out in step_outputs:
+        out.block_until_ready()
+    return step_outputs

@@ -1,0 +1,18 @@
+"""DT102 good: outputs stay on device through the loop; ONE batched
+pull per step (the engine/core.py decode-path pattern)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def decode_tokens(step_outputs):
+    stacked = jnp.stack(step_outputs)
+    return jax.device_get(stacked)
+
+
+def loop_stays_on_device(step_fn, state, n):
+    outs = []
+    for _ in range(n):
+        state, out = step_fn(state)
+        outs.append(out)
+    return tuple(jax.device_get(jnp.stack(outs)))

@@ -1,0 +1,17 @@
+"""DT103 bad: reading the donated cache after the jitted call — the
+buffer's HBM was reused for the output."""
+
+import jax
+
+
+def impl(params, cache, tokens):
+    return tokens, cache
+
+
+_step = jax.jit(impl, donate_argnums=(1,))
+
+
+def run(params, cache, tokens):
+    out, new_cache = _step(params, cache, tokens)
+    stale = cache.sum()
+    return out, stale

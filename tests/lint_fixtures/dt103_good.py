@@ -1,0 +1,16 @@
+"""DT103 good: the donated buffer is rebound by the call statement
+(``out, cache = step(params, cache, ...)`` — the engine convention)."""
+
+import jax
+
+
+def impl(params, cache, tokens):
+    return tokens, cache
+
+
+_step = jax.jit(impl, donate_argnums=(1,))
+
+
+def run(params, cache, tokens):
+    out, cache = _step(params, cache, tokens)
+    return out, cache.sum()

@@ -1,0 +1,14 @@
+"""DT104 bad: stashing a traced value on self from inside a jitted
+function — the tracer leaks out of the trace."""
+
+from functools import partial
+
+import jax
+
+
+class Model:
+    @partial(jax.jit, static_argnums=(0,))
+    def forward(self, x):
+        hidden = x * 2
+        self.last_hidden = hidden
+        return hidden

@@ -1,0 +1,18 @@
+"""DT104 good: the jitted function returns values; the non-jitted
+caller owns instance state."""
+
+from functools import partial
+
+import jax
+
+
+class Model:
+    @partial(jax.jit, static_argnums=(0,))
+    def forward(self, x):
+        hidden = x * 2
+        return hidden
+
+    def step(self, x):
+        hidden = self.forward(x)
+        self.last_hidden = hidden  # outside the trace: fine
+        return hidden

@@ -1,0 +1,268 @@
+"""Static-analysis suite tests: per-rule fixtures, noqa suppression,
+baseline round-trip, stable JSON output, and THE GATE — zero
+non-baselined findings over the whole package.
+
+The gate is the point of the suite (docs/static_analysis.md): every
+future PR fails tier-1 if it introduces a fire-and-forget task, a silent
+broad except, a blocking call on the event loop, a FIRST_COMPLETED
+waiter leak, or a jit/donation/tracer misuse — unless it is explicitly
+suppressed (``# dt: noqa[DTxxx]``) or baselined with a justification.
+"""
+
+import argparse
+import io
+import json
+from pathlib import Path
+
+import pytest
+
+from dynamo_tpu.analysis import (
+    DEFAULT_BASELINE_PATH,
+    Baseline,
+    all_rules,
+    lint_file,
+    lint_paths,
+)
+from dynamo_tpu.analysis.cli import run_lint
+
+ROOT = Path(__file__).resolve().parents[1]
+PACKAGE = ROOT / "dynamo_tpu"
+FIXTURES = Path(__file__).parent / "lint_fixtures"
+
+RULES = ["DT001", "DT002", "DT003", "DT004",
+         "DT101", "DT102", "DT103", "DT104"]
+
+
+def _codes(findings):
+    return {f.rule for f in findings}
+
+
+# ------------------------------------------------------------- fixtures ----
+
+
+@pytest.mark.parametrize("code", RULES)
+def test_bad_fixture_trips_exactly_its_rule(code):
+    path = FIXTURES / f"{code.lower()}_bad.py"
+    findings = lint_file(path, all_rules(), root=ROOT)
+    assert findings, f"{path.name} should trip {code}"
+    assert _codes(findings) == {code}, (
+        f"{path.name} tripped {_codes(findings)}, expected exactly "
+        f"{{{code}}}: {[f.render() for f in findings]}"
+    )
+
+
+@pytest.mark.parametrize("code", RULES)
+def test_good_fixture_is_clean(code):
+    path = FIXTURES / f"{code.lower()}_good.py"
+    findings = lint_file(path, all_rules(), root=ROOT)
+    assert not findings, (
+        f"{path.name} should be clean under ALL rules: "
+        f"{[f.render() for f in findings]}"
+    )
+
+
+def test_every_rule_has_both_fixtures():
+    for code in RULES:
+        assert (FIXTURES / f"{code.lower()}_bad.py").is_file()
+        assert (FIXTURES / f"{code.lower()}_good.py").is_file()
+
+
+# ------------------------------------------------------------- the gate ----
+
+
+def test_package_has_zero_nonbaselined_findings():
+    """THE tier-1 gate: `dynamo-tpu lint` over dynamo_tpu/ is clean
+    modulo the committed baseline.  If this fails you either fix the
+    finding (preferred), suppress it in place with `# dt: noqa[DTxxx]`
+    and a comment saying why, or — for pre-existing debt only — add a
+    baseline entry with a justification (docs/static_analysis.md)."""
+    findings = lint_paths([PACKAGE], all_rules(), root=ROOT)
+    baseline = Baseline.load(DEFAULT_BASELINE_PATH)
+    fresh = baseline.filter(findings)
+    assert not fresh, (
+        "non-baselined static-analysis findings:\n  "
+        + "\n  ".join(f.render() for f in fresh)
+        + "\nFix them, `# dt: noqa[DTxxx]` them with a reason, or (for "
+        "grandfathered debt) add a justified baseline entry via "
+        "`dynamo-tpu lint --update-baseline`."
+    )
+
+
+def test_baseline_entries_are_justified_and_live():
+    """Every committed baseline entry still matches a real finding (no
+    stale grandfathering) and carries a real justification."""
+    baseline = Baseline.load(DEFAULT_BASELINE_PATH)
+    for e in baseline.entries:
+        assert e.get("justification", "").strip() not in ("", "TODO: justify"), (
+            f"baseline entry {e['path']}:{e['rule']} needs a one-line "
+            "justification"
+        )
+    findings = lint_paths([PACKAGE], all_rules(), root=ROOT)
+    keys = {f.baseline_key for f in findings}
+    stale = [
+        e for e in baseline.entries
+        if (e["path"], e["rule"], e.get("content", "")) not in keys
+    ]
+    assert not stale, (
+        "baseline entries no longer match any finding (fixed code — "
+        "prune them with `dynamo-tpu lint --update-baseline`): "
+        + str([(e["path"], e["rule"]) for e in stale])
+    )
+
+
+# ----------------------------------------------------------------- noqa ----
+
+
+def test_noqa_specific_code_suppresses(tmp_path):
+    f = tmp_path / "m.py"
+    f.write_text(
+        "import asyncio\n"
+        "async def go():\n"
+        "    asyncio.ensure_future(asyncio.sleep(0))  # dt: noqa[DT001]\n"
+    )
+    assert lint_file(f, all_rules()) == []
+
+
+def test_noqa_blanket_suppresses(tmp_path):
+    f = tmp_path / "m.py"
+    f.write_text(
+        "import time\n"
+        "async def go():\n"
+        "    time.sleep(1)  # dt: noqa\n"
+    )
+    assert lint_file(f, all_rules()) == []
+
+
+def test_noqa_wrong_code_does_not_suppress(tmp_path):
+    f = tmp_path / "m.py"
+    f.write_text(
+        "import time\n"
+        "async def go():\n"
+        "    time.sleep(1)  # dt: noqa[DT001]\n"
+    )
+    findings = lint_file(f, all_rules())
+    assert _codes(findings) == {"DT003"}
+
+
+# -------------------------------------------------------------- baseline ----
+
+
+def _args(**kw) -> argparse.Namespace:
+    base = dict(paths=None, fmt="text", select=None, baseline=None,
+                no_baseline=False, update_baseline=False, root=None)
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+BAD_SRC = (
+    "import asyncio\n"
+    "async def go():\n"
+    "    asyncio.ensure_future(asyncio.sleep(0))\n"
+)
+FIXED_SRC = (
+    "import asyncio\n"
+    "async def go():\n"
+    "    await asyncio.ensure_future(asyncio.sleep(0))\n"
+)
+
+
+def test_baseline_roundtrip(tmp_path):
+    """add finding -> baselined (gate green) -> fix -> --update-baseline
+    removes the entry, and justifications survive an update."""
+    mod = tmp_path / "m.py"
+    mod.write_text(BAD_SRC)
+    bl = tmp_path / "baseline.json"
+
+    # 1. fresh finding: exit 1
+    args = _args(paths=[str(mod)], baseline=str(bl), root=str(tmp_path))
+    assert run_lint(args, out=io.StringIO()) == 1
+
+    # 2. baseline it: gate goes green
+    assert run_lint(
+        _args(paths=[str(mod)], baseline=str(bl), root=str(tmp_path),
+              update_baseline=True),
+        out=io.StringIO(),
+    ) == 0
+    assert run_lint(args, out=io.StringIO()) == 0
+
+    # 3. justifications are carried across an update by key
+    data = json.loads(bl.read_text())
+    assert len(data["entries"]) == 1
+    data["entries"][0]["justification"] = "kept: demo entry"
+    bl.write_text(json.dumps(data))
+    assert run_lint(
+        _args(paths=[str(mod)], baseline=str(bl), root=str(tmp_path),
+              update_baseline=True),
+        out=io.StringIO(),
+    ) == 0
+    data = json.loads(bl.read_text())
+    assert data["entries"][0]["justification"] == "kept: demo entry"
+
+    # 4. line drift does not break the match (content key, not line)
+    mod.write_text("import os\n" + BAD_SRC)
+    assert run_lint(args, out=io.StringIO()) == 0
+
+    # 5. fix the code; --update-baseline prunes the entry
+    mod.write_text(FIXED_SRC)
+    assert run_lint(args, out=io.StringIO()) == 0
+    assert run_lint(
+        _args(paths=[str(mod)], baseline=str(bl), root=str(tmp_path),
+              update_baseline=True),
+        out=io.StringIO(),
+    ) == 0
+    assert json.loads(bl.read_text())["entries"] == []
+
+
+def test_no_baseline_flag_reports_everything(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text(BAD_SRC)
+    bl = tmp_path / "baseline.json"
+    run_lint(_args(paths=[str(mod)], baseline=str(bl), root=str(tmp_path),
+                   update_baseline=True), out=io.StringIO())
+    assert run_lint(
+        _args(paths=[str(mod)], baseline=str(bl), root=str(tmp_path),
+              no_baseline=True),
+        out=io.StringIO(),
+    ) == 1
+
+
+# ------------------------------------------------------------ CLI output ----
+
+
+def test_json_output_stable_sorted():
+    out1, out2 = io.StringIO(), io.StringIO()
+    args = lambda o: _args(paths=[str(FIXTURES)], fmt="json",  # noqa: E731
+                           no_baseline=True, root=str(ROOT))
+    rc1 = run_lint(args(out1), out=out1)
+    rc2 = run_lint(args(out2), out=out2)
+    assert rc1 == rc2 == 1
+    assert out1.getvalue() == out2.getvalue(), "JSON output must be stable"
+    doc = json.loads(out1.getvalue())
+    keys = [(f["path"], f["line"], f["col"], f["rule"])
+            for f in doc["findings"]]
+    assert keys == sorted(keys), "findings must be stable-sorted"
+    assert doc["total"] == len(doc["findings"]) + doc["baselined"]
+
+
+def test_select_limits_rules(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "import asyncio, time\n"
+        "async def go():\n"
+        "    time.sleep(1)\n"
+        "    asyncio.ensure_future(asyncio.sleep(0))\n"
+    )
+    findings = lint_file(mod, all_rules(["DT003"]))
+    assert _codes(findings) == {"DT003"}
+
+
+def test_unknown_rule_code_is_an_error():
+    with pytest.raises(ValueError):
+        all_rules(["DT999"])
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text("def broken(:\n")
+    findings = lint_file(mod, all_rules())
+    assert _codes(findings) == {"DT000"}
