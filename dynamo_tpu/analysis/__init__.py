@@ -1,5 +1,11 @@
-"""Static-analysis suite: async-safety + JAX/TPU rules with a baseline
-and a zero-findings tier-1 gate (docs/static_analysis.md)."""
+"""Two-plane concurrency correctness tool (docs/static_analysis.md).
+
+Plane A (static): per-file async-safety + JAX/TPU rules (core.py,
+rules_async.py, rules_jax.py) and the interprocedural project pass
+(project.py, DT005-DT008) with a shared baseline and a zero-findings
+tier-1 gate.  Plane B (dynamic): the dtsan runtime sanitizer
+(sanitizer.py + pytest_sanitizer.py) — task-leak checking on by default
+in tier-1, full instrumentation under ``DYNAMO_SANITIZE=1``."""
 
 from dynamo_tpu.analysis.core import (
     DEFAULT_BASELINE_PATH,
@@ -10,6 +16,12 @@ from dynamo_tpu.analysis.core import (
     lint_file,
     lint_paths,
 )
+from dynamo_tpu.analysis.project import (
+    ProjectIndex,
+    ProjectRule,
+    lint_project,
+    project_rules,
+)
 
 __all__ = [
     "DEFAULT_BASELINE_PATH",
@@ -19,4 +31,8 @@ __all__ = [
     "all_rules",
     "lint_file",
     "lint_paths",
+    "ProjectIndex",
+    "ProjectRule",
+    "lint_project",
+    "project_rules",
 ]

@@ -30,6 +30,10 @@ def configure_parser(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "package)")
     p.add_argument("--format", choices=["text", "json"], default="text",
                    dest="fmt")
+    p.add_argument("--project", action="store_true",
+                   help="also run the interprocedural pass (DT005-DT008: "
+                        "cross-module call-graph rules) on top of the "
+                        "per-file rules")
     p.add_argument("--select", default=None, metavar="DT001,DT102",
                    help="comma-separated rule codes to run (default: all)")
     p.add_argument("--baseline", default=None, metavar="PATH",
@@ -60,13 +64,32 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
     if not paths:
         paths = [Path(__file__).resolve().parents[1]]  # the package
     select = args.select.split(",") if args.select else None
+    use_project = getattr(args, "project", False)
+    file_select = select
+    project_only = False
+    if select and use_project:
+        # project codes live in their own registry; route the split
+        from dynamo_tpu.analysis.project import _PROJECT_REGISTRY
+
+        file_select = [
+            c for c in select if c.strip().upper() not in _PROJECT_REGISTRY
+        ]
+        project_only = not file_select
     try:
-        rules = all_rules(select)
+        rules = [] if project_only else all_rules(file_select or None)
     except ValueError as e:
         print(f"dynamo-tpu lint: {e}", file=sys.stderr)
         return 2
 
     findings = lint_paths(paths, rules, root=root)
+    if use_project:
+        from dynamo_tpu.analysis.project import lint_project, project_rules
+
+        prules = project_rules(select)
+        if prules:
+            findings = sorted(
+                findings + lint_project(paths, prules, root=root)
+            )
 
     baseline_path = Path(args.baseline) if args.baseline else (
         DEFAULT_BASELINE_PATH

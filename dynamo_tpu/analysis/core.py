@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import ast
 import json
+import os
 import re
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -38,6 +39,7 @@ __all__ = [
     "lint_file",
     "lint_paths",
     "iter_python_files",
+    "parse_module",
 ]
 
 DEFAULT_BASELINE_PATH = Path(__file__).parent / "baseline.json"
@@ -426,6 +428,33 @@ class Baseline:
 
 # ----------------------------------------------------------------- drivers ----
 
+# Parse-once cache shared by the per-file pass (lint_file) and the
+# interprocedural pass (project.ProjectIndex): running both over the same
+# tree — as `dynamo-tpu lint --project` and the tier-1 gate do — pays the
+# ast.parse cost once per file.  Keyed on (mtime_ns, size) so edited
+# files (fixtures, tmp paths in tests) re-parse.
+_PARSE_CACHE: dict[str, tuple[tuple[int, int], str, ast.Module]] = {}
+
+
+def parse_module(path: Path) -> tuple[str, ast.Module]:
+    """Return (source, tree) for ``path``, cached on content identity.
+    Raises SyntaxError for unparsable files (callers decide whether that
+    is a DT000 finding or a skip)."""
+    p = str(Path(path).resolve())
+    try:
+        st = os.stat(p)
+        key = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        key = None
+    hit = _PARSE_CACHE.get(p)
+    if hit is not None and key is not None and hit[0] == key:
+        return hit[1], hit[2]
+    source = Path(p).read_text(encoding="utf-8", errors="replace")
+    tree = ast.parse(source)
+    if key is not None:
+        _PARSE_CACHE[p] = (key, source, tree)
+    return source, tree
+
 
 def iter_python_files(paths: Sequence[Path]) -> Iterator[Path]:
     for p in paths:
@@ -449,9 +478,8 @@ def lint_file(
             rel = path.resolve().relative_to(Path(root).resolve())
         except ValueError:
             rel = path
-    source = path.read_text(encoding="utf-8", errors="replace")
     try:
-        tree = ast.parse(source)
+        source, tree = parse_module(path)
     except SyntaxError as e:
         return [Finding(
             path=rel.as_posix(), line=e.lineno or 1, col=e.offset or 0,

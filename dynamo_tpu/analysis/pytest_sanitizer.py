@@ -1,0 +1,146 @@
+"""pytest integration for the dtsan runtime sanitizer (sanitizer.py).
+
+tests/conftest.py delegates into this module (so its existing
+``pytest_runtest_makereport`` time-budget hook and the sanitizer check
+compose in one place); the module also exposes the same behavior as
+standalone pytest hooks, so ``pytest -p dynamo_tpu.analysis.pytest_sanitizer``
+works outside this repo's conftest.
+
+Policy (satellite of ISSUE 5): task-LEAK checking is on by DEFAULT in
+tier-1 — a passing test that leaves a live task behind fails with the
+task's creation traceback.  ``DYNAMO_SANITIZE=1`` upgrades to the full
+instrument set (blocking callbacks, unclosed transports, frame-protocol
+violations); ``DYNAMO_SANITIZE=0`` disables everything.
+
+Grandfathered files mirror the lint-baseline idiom (and conftest's
+time-budget list): module-level entries whose tests intentionally keep
+background services alive across tests.  Burn the list down; do NOT
+grow it without a justification comment.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import pytest
+
+from dynamo_tpu.analysis.sanitizer import (
+    MODE_OFF,
+    Sanitizer,
+    mode_from_env,
+)
+
+__all__ = [
+    "configure",
+    "begin_test",
+    "check_report",
+    "get_sanitizer",
+    "LEAK_GRANDFATHERED_FILES",
+]
+
+# Files exempt from per-test sanitizer failures.  Each entry carries the
+# reason it is grandfathered; remove the entry when the file is fixed.
+LEAK_GRANDFATHERED_FILES = {
+    # multihost suites run worker event loops on background threads that
+    # legitimately outlive individual tests (module-scoped mesh fixtures)
+    "test_multihost.py",
+    "test_multihost_disagg.py",
+}
+
+# threshold for the blocking-callback monitor (full mode); generous by
+# default — tier-1 shares one CPU with jit compilation
+_BLOCKING_THRESHOLD_S = float(
+    os.environ.get("DYNAMO_SANITIZE_BLOCK_S", "0.25")
+)
+
+_sanitizer: Optional[Sanitizer] = None
+
+
+def get_sanitizer() -> Optional[Sanitizer]:
+    return _sanitizer
+
+
+def configure(config=None) -> Optional[Sanitizer]:
+    """Install the sanitizer per DYNAMO_SANITIZE (idempotent)."""
+    global _sanitizer
+    if _sanitizer is not None:
+        return _sanitizer
+    mode = mode_from_env()
+    if mode == MODE_OFF:
+        return None
+    _sanitizer = Sanitizer(
+        mode, blocking_threshold_s=_BLOCKING_THRESHOLD_S
+    ).install()
+    return _sanitizer
+
+
+def unconfigure() -> None:
+    global _sanitizer
+    if _sanitizer is not None:
+        _sanitizer.uninstall()
+        _sanitizer = None
+
+
+def begin_test(item=None) -> None:
+    """Open a fresh epoch: findings are attributed to the test between
+    this call and its check_report."""
+    if _sanitizer is not None:
+        _sanitizer.begin_epoch()
+
+
+def check_report(item, call, rep) -> None:
+    """Flip a PASSING call-phase report to failed on sanitizer findings.
+
+    Mirrors the conftest time-budget guard: failing tests are left alone
+    (the real failure is the signal there), and grandfathered files are
+    exempt.  Mutates ``rep`` in place; call from a hookwrapper
+    ``pytest_runtest_makereport``.
+    """
+    if _sanitizer is None or rep.when != "call" or not rep.passed:
+        return
+    fname = os.path.basename(str(item.fspath))
+    if fname in LEAK_GRANDFATHERED_FILES:
+        return
+    if item.get_closest_marker("no_sanitize") is not None:
+        return
+    findings = _sanitizer.epoch_report()
+    if not findings:
+        return
+    rep.outcome = "failed"
+    rep.longrepr = (
+        f"{item.nodeid}: dtsan found {len(findings)} issue"
+        f"{'s' if len(findings) != 1 else ''} at teardown "
+        "(docs/static_analysis.md#runtime-sanitizer):\n\n"
+        + "\n\n".join(findings)
+        + "\n\nFix the leak (cancel AND reap the task / close_writer the "
+        "stream), mark the test @pytest.mark.no_sanitize with a reason, "
+        "or — for pre-existing debt only — grandfather the file in "
+        "pytest_sanitizer.LEAK_GRANDFATHERED_FILES."
+    )
+
+
+# ------------------------------------------------- standalone plugin hooks ----
+# Only used when loaded with `-p dynamo_tpu.analysis.pytest_sanitizer`;
+# this repo's tests/conftest.py calls the helpers above directly instead
+# (its own makereport hook composes the time budget + sanitizer checks).
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "no_sanitize: exempt this test from dtsan runtime-sanitizer "
+        "failures (leaked tasks / blocking callbacks / unclosed "
+        "transports)",
+    )
+    configure(config)
+
+
+def pytest_runtest_setup(item):
+    begin_test(item)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    check_report(item, call, outcome.get_result())

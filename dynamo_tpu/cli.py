@@ -345,7 +345,13 @@ def _attach_worker_publishers(runtime, engine, namespace: str) -> None:
     wid = runtime.instance_id
     events = KvEventPublisher(runtime.coordinator, wid, namespace).start()
     core.block_manager.event_sink = events.sink
-    KvMetricsPublisher(runtime.coordinator, wid, core.metrics, namespace).start()
+    metrics = KvMetricsPublisher(
+        runtime.coordinator, wid, core.metrics, namespace
+    ).start()
+    # both publishers' flush loops must die with the runtime — nothing
+    # else ever holds a reference that can reach their stop() (dtsan leak)
+    runtime.on_shutdown(events.stop)
+    runtime.on_shutdown(metrics.stop)
 
 
 # ------------------------------------------------------------------ serve -----
@@ -556,8 +562,11 @@ async def start_router_service(runtime, namespace: str = "default",
         comp, _, ep = workers_endpoint.partition("/")
         workers_prefix = f"{namespace}/components/{comp}/endpoints/{ep or 'generate'}/"
     router = KvRouter(block_size=block_size)
-    await KvRouterSubscriber(router, runtime.coordinator, namespace,
-                             workers_prefix=workers_prefix).start()
+    sub = await KvRouterSubscriber(router, runtime.coordinator, namespace,
+                                   workers_prefix=workers_prefix).start()
+    # the subscriber's flush/watch tasks must die with the runtime, or
+    # they outlive every caller that can reach sub.stop() (dtsan leak)
+    runtime.on_shutdown(sub.stop)
     # KvRouter IS the endpoint engine: its generate() yields one
     # wire-serializable decision dict per request
     ep = runtime.namespace(namespace).component("router").endpoint("generate")

@@ -123,6 +123,7 @@ class AsyncLLMEngine(AsyncEngine):
         self._wake.set()
 
         cancel_task = asyncio.ensure_future(request.stopped())
+        get_task: asyncio.Future | None = None
         try:
             while True:
                 get_task = asyncio.ensure_future(out_q.get())
@@ -145,6 +146,11 @@ class AsyncLLMEngine(AsyncEngine):
                         if out.finished:
                             return
         finally:
+            # a consumer abandoning the stream lands here from the
+            # `await asyncio.wait` — without the cancel, get_task stays
+            # pending on out_q.get() forever (dtsan task leak)
+            if get_task is not None and not get_task.done():
+                get_task.cancel()
             cancel_task.cancel()
             if not request.is_stopped and req.finish_reason is None:
                 # consumer dropped the stream mid-generation

@@ -272,7 +272,10 @@ class HttpService:
         n = len(streams)
         n_out = 0
         text_off = [0] * n
-        merged: asyncio.Queue = asyncio.Queue()
+        # bounded (DT006): the pumps' `await put()` applies backpressure
+        # to the engine streams when the SSE writer (the client's socket)
+        # is slow, instead of buffering the whole generation in memory
+        merged: asyncio.Queue = asyncio.Queue(maxsize=max(16, 4 * n))
 
         async def pump(i: int, s: AsyncIterator[LLMEngineOutput]) -> None:
             try:

@@ -31,13 +31,24 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import os
 from typing import Awaitable, Callable, Optional
 
 import numpy as np
 
-from dynamo_tpu.runtime.transports.framing import read_frame, write_frame
+from dynamo_tpu.runtime.transports.framing import (
+    close_writer,
+    read_frame,
+    write_frame,
+)
 
 log = logging.getLogger("dynamo_tpu.kv_transfer")
+
+# Bound on one write/read round-trip under the per-connection lock
+# (DT005): a wedged-but-connected peer must surface as ConnectionError —
+# otherwise every transfer caller queues forever behind its lock.
+# Generous: a multi-hundred-MB block push over DCN is normal.
+_TRANSFER_TIMEOUT_S = float(os.environ.get("DYN_KV_TRANSFER_TIMEOUT_S", "60"))
 
 __all__ = [
     "pack_blocks",
@@ -187,7 +198,7 @@ class KvTransferServer:
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
         finally:
-            writer.close()
+            await close_writer(writer)
 
 
 class LocalKvTransferClient:
@@ -256,15 +267,31 @@ class KvTransferClient:
         return self
 
     async def close(self) -> None:
-        if self._writer:
-            self._writer.close()
+        # close AND await the transport teardown (bounded): stopping at
+        # close() leaks a live TCP transport at loop shutdown (DT007);
+        # null the reference so a repeated close() cannot double-close
+        await close_writer(self._writer)
+        self._writer = None
+
+    async def _roundtrip(self, header: dict, payload: bytes):
+        write_frame(self._writer, header, payload)
+        await self._writer.drain()
+        return await read_frame(self._reader)
 
     async def _call(self, header: dict, payload: bytes = b"") -> tuple[dict, bytes]:
         async with self._lock:  # strict request/reply per connection
             header["id"] = next(self._ids)
-            write_frame(self._writer, header, payload)
-            await self._writer.drain()
-            frame = await read_frame(self._reader)
+            # bounded (DT005): the reply wait under the lock must not
+            # wedge other transfers behind a dead-but-connected peer
+            try:
+                frame = await asyncio.wait_for(
+                    self._roundtrip(header, payload), _TRANSFER_TIMEOUT_S
+                )
+            except asyncio.TimeoutError:
+                raise ConnectionError(
+                    f"kv transfer to {self.host}:{self.port} timed out "
+                    f"after {_TRANSFER_TIMEOUT_S}s"
+                ) from None
         if frame is None:
             raise ConnectionError("kv transfer peer closed")
         resp, data = frame

@@ -51,6 +51,11 @@ class DistributedRuntime:
         # every Endpoint.serve() registers here so drain_all() can run the
         # graceful-drain lifecycle over the whole process on shutdown
         self._served: list[tuple["Endpoint", int]] = []
+        # services wired onto this runtime (router subscribers, metric
+        # aggregators) register their stop() here; shutdown() runs them
+        # first so their background tasks drain before the transports
+        # they ride on close (otherwise the tasks leak — dtsan/DT008)
+        self._on_shutdown: list[Callable[[], Any]] = []
 
     @classmethod
     async def connect(cls, config: Optional[RuntimeConfig] = None) -> "DistributedRuntime":
@@ -63,7 +68,17 @@ class DistributedRuntime:
         rt.primary_lease = await rt.coordinator.lease_create(rt.config.lease_ttl_s)
         return rt
 
+    def on_shutdown(self, stop: Callable[[], Any]) -> None:
+        """Register an async callable to run first at shutdown()."""
+        self._on_shutdown.append(stop)
+
     async def shutdown(self) -> None:
+        stops, self._on_shutdown = self._on_shutdown, []
+        for stop in reversed(stops):  # LIFO: later services stop first
+            try:
+                await stop()
+            except Exception:
+                log.debug("on_shutdown hook failed", exc_info=True)
         if self._tcp_server:
             await self._tcp_server.stop()
         if self.coordinator:
@@ -225,6 +240,10 @@ class Endpoint:
     async def client(self) -> "Client":
         c = Client(self)
         await c.start()
+        # vended clients die with the runtime: callers that never reach
+        # their close() (or forget it) must not leak watch subscriptions
+        # and endpoint transports past shutdown (close() is idempotent)
+        self.runtime.on_shutdown(c.close)
         return c
 
 
@@ -261,8 +280,9 @@ class Client(AsyncEngine):
 
     async def close(self) -> None:
         if self._watch_id is not None:
+            wid, self._watch_id = self._watch_id, None  # idempotent close
             try:
-                await self.endpoint.runtime.coordinator.unwatch(self._watch_id)
+                await self.endpoint.runtime.coordinator.unwatch(wid)
             except (ConnectionError, RuntimeError):
                 pass
         for conn in self._conns.values():

@@ -37,9 +37,19 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Callable, Optional
 
-from dynamo_tpu.runtime.transports.framing import read_frame, write_frame
+from dynamo_tpu.runtime.transports.framing import (
+    close_writer,
+    read_frame,
+    write_frame,
+)
 
 log = logging.getLogger("dynamo_tpu.coordinator")
+
+# Bound on each coordinator round-trip made while holding the heal lock
+# (DT005): a stalled coordinator must surface as a ConnectionError, not
+# wedge every lease writer queued behind the heal — the serve_worker
+# drain path rides these locks at shutdown.
+_HEAL_TIMEOUT_S = float(os.environ.get("DYNTPU_HEAL_TIMEOUT_S", "5"))
 
 __all__ = ["CoordinatorServer", "CoordinatorClient"]
 
@@ -106,6 +116,9 @@ class CoordinatorServer:
         self._expiry_task: Optional[asyncio.Task] = None
         self._write_locks: dict[int, asyncio.Lock] = {}
         self._conn_writers: dict[int, asyncio.StreamWriter] = {}
+        # per-connection handler tasks (spawned inside asyncio's Server,
+        # where DT008 cannot see them) — reaped in stop()
+        self._conn_tasks: dict[int, Optional[asyncio.Task]] = {}
         # blob store (plane 4 — NATS object-store parity, ref
         # lib/llm/src/model_card/model.rs:150-199 publishing model
         # artifacts for remote workers): name -> {size, sha256, meta,
@@ -255,6 +268,14 @@ class CoordinatorServer:
             for w in list(self._conn_writers.values()):
                 w.close()
             await self._server.wait_closed()
+        # on py<3.12 wait_closed() does NOT wait for connection handlers:
+        # cancel-and-reap them, or each _handle task outlives the server
+        # (their finally blocks still run the connection-drop cleanup)
+        handlers = [t for t in self._conn_tasks.values() if t is not None]
+        for t in handlers:
+            t.cancel()
+        if handlers:
+            await asyncio.gather(*handlers, return_exceptions=True)
         # drain retained background tasks (watcher notifies, queue pulls):
         # cancel-then-gather is bounded — nothing here waits on a peer
         for t in list(self._bg_tasks):
@@ -282,6 +303,7 @@ class CoordinatorServer:
         conn_id = next(self._conn_ids)
         self._write_locks[conn_id] = asyncio.Lock()
         self._conn_writers[conn_id] = writer
+        self._conn_tasks[conn_id] = asyncio.current_task()
         try:
             while True:
                 frame = await read_frame(reader)
@@ -317,6 +339,7 @@ class CoordinatorServer:
                         pass
             self._write_locks.pop(conn_id, None)
             self._conn_writers.pop(conn_id, None)
+            self._conn_tasks.pop(conn_id, None)
             writer.close()
 
     async def _send(self, conn_id: int, writer: asyncio.StreamWriter,
@@ -754,8 +777,11 @@ class CoordinatorClient:
             self._reconnect_task.cancel()
         if self._read_task:
             self._read_task.cancel()
-        if self._writer:
-            self._writer.close()
+        # close AND await the transport teardown (bounded) — stopping at
+        # close() leaves a live TCP transport behind at loop shutdown;
+        # null the reference so a repeated close() cannot double-close
+        await close_writer(self._writer)
+        self._writer = None
         self._connected.clear()
         self.closed.set()
 
@@ -812,6 +838,16 @@ class CoordinatorClient:
         delay = 0.1
         try:
             while not self._closing:
+                # tear down the dead socket first: a server-side sever
+                # only half-closes it (EOF), and replacing the reference
+                # without closing leaks the old transport at every redial
+                if self._writer is not None:
+                    try:
+                        await close_writer(self._writer)
+                    except Exception:
+                        log.debug("closing severed writer failed",
+                                  exc_info=True)
+                    self._writer = None
                 try:
                     self._reader, self._writer = await asyncio.open_connection(
                         self.host, self.port
@@ -834,10 +870,11 @@ class CoordinatorClient:
                     log.exception("re-registration failed; redialing")
                     self._connected.clear()
                     try:
-                        self._writer.close()
+                        await close_writer(self._writer)
                     except Exception:
                         log.debug("closing stale writer failed",
                                   exc_info=True)
+                    self._writer = None  # the next dial replaces it
                     await asyncio.sleep(delay)
         finally:
             self._reconnecting = False
@@ -1071,43 +1108,54 @@ class CoordinatorClient:
     async def _heal_expired_lease(self, handle: int, ttl: float) -> None:
         # serialize heals: the keepalive tick and any number of inline
         # _lease_call heals can race — interleaved lease_create/re-put
-        # would strand keys on an orphaned (un-keepalive'd) lease
+        # would strand keys on an orphaned (un-keepalive'd) lease.
+        # Every round-trip under the lock is bounded (DT005): a stalled
+        # coordinator surfaces as ConnectionError instead of wedging the
+        # writers — and the serve_worker drain — queued behind the heal.
         async with self._heal_lock:
-            probe, _ = await self._call({
-                "op": "lease_keepalive",
-                "lease_id": self._lease_srv.get(handle, handle),
-            })
-            if probe.get("ok"):
-                return  # another heal won while we waited on the lock
-            resp, _ = await self._call({"op": "lease_create", "ttl": ttl})
-            live = resp["lease_id"]
-            log.warning(
-                "lease %x expired while connected; healed as %x and re-putting keys",
-                handle, live,
-            )
-            for key, (value, lh, created) in list(self._leased_kv.items()):
-                if lh != handle:
-                    continue
-                if created:
-                    # the server-side expiry DELETED the key, so another
-                    # process may have legitimately claimed it since —
-                    # re-acquire with create-exclusivity and cede on
-                    # conflict instead of silently overwriting the new
-                    # owner's value and rebinding it to the healed lease
-                    resp, _ = await self._call({
-                        "op": "kv_create", "key": key, "value": value,
-                        "lease_id": live,
-                    })
-                    if not resp.get("ok"):
-                        log.warning(
-                            "heal: key %s was claimed by another owner "
-                            "during lease expiry; ceding it", key)
-                        del self._leased_kv[key]
-                else:
-                    await self._call({
-                        "op": "kv_put", "key": key, "value": value,
-                        "lease_id": live,
-                    })
+            try:
+                probe, _ = await asyncio.wait_for(self._call({
+                    "op": "lease_keepalive",
+                    "lease_id": self._lease_srv.get(handle, handle),
+                }), _HEAL_TIMEOUT_S)
+                if probe.get("ok"):
+                    return  # another heal won while we waited on the lock
+                resp, _ = await asyncio.wait_for(
+                    self._call({"op": "lease_create", "ttl": ttl}),
+                    _HEAL_TIMEOUT_S)
+                live = resp["lease_id"]
+                log.warning(
+                    "lease %x expired while connected; healed as %x and "
+                    "re-putting keys", handle, live,
+                )
+                for key, (value, lh, created) in list(self._leased_kv.items()):
+                    if lh != handle:
+                        continue
+                    if created:
+                        # the server-side expiry DELETED the key, so
+                        # another process may have legitimately claimed it
+                        # since — re-acquire with create-exclusivity and
+                        # cede on conflict instead of silently overwriting
+                        # the new owner's value and rebinding it to the
+                        # healed lease
+                        resp, _ = await asyncio.wait_for(self._call({
+                            "op": "kv_create", "key": key, "value": value,
+                            "lease_id": live,
+                        }), _HEAL_TIMEOUT_S)
+                        if not resp.get("ok"):
+                            log.warning(
+                                "heal: key %s was claimed by another owner "
+                                "during lease expiry; ceding it", key)
+                            del self._leased_kv[key]
+                    else:
+                        await asyncio.wait_for(self._call({
+                            "op": "kv_put", "key": key, "value": value,
+                            "lease_id": live,
+                        }), _HEAL_TIMEOUT_S)
+            except asyncio.TimeoutError:
+                raise ConnectionError(
+                    "coordinator stalled during lease heal"
+                ) from None
             # publish the mapping only AFTER the re-puts: a concurrent
             # writer meanwhile resolves the dead id, fails, and queues
             # behind the heal lock — its retry then lands strictly after

@@ -21,11 +21,30 @@ _HDR = struct.Struct(">II")
 
 MAX_FRAME = 1 << 30  # 1 GiB guard
 
-__all__ = ["write_frame", "read_frame", "FrameError"]
+__all__ = ["write_frame", "read_frame", "close_writer", "FrameError"]
 
 
 class FrameError(Exception):
     pass
+
+
+async def close_writer(writer: Optional[asyncio.StreamWriter],
+                       timeout: float = 2.0) -> None:
+    """Close a StreamWriter AND await its transport teardown, bounded.
+
+    ``writer.close()`` alone only schedules the close — nothing awaits
+    ``connection_lost``, so shutdown paths that stop at close() leak
+    live TCP transports (the sanitizer and DT007 both catch this).  The
+    wait is bounded: a transport whose peer never acknowledges the FIN
+    must not wedge a drain, and errors are swallowed — the socket may
+    already be dead, which is fine on a close path."""
+    if writer is None:
+        return
+    try:
+        writer.close()
+        await asyncio.wait_for(writer.wait_closed(), timeout)
+    except (asyncio.TimeoutError, OSError, RuntimeError):
+        pass  # already-dead socket or closing loop: nothing left to tear down
 
 
 def encode_frame(header: dict[str, Any], payload: bytes = b"") -> bytes:

@@ -25,11 +25,16 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import os
 from typing import Any, AsyncIterator, Callable, Optional
 
 from dynamo_tpu.runtime import serde
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
-from dynamo_tpu.runtime.transports.framing import read_frame, write_frame
+from dynamo_tpu.runtime.transports.framing import (
+    close_writer,
+    read_frame,
+    write_frame,
+)
 
 log = logging.getLogger("dynamo_tpu.tcp")
 
@@ -42,6 +47,15 @@ __all__ = [
 
 _END = object()
 _PONG = object()
+
+# Per-stream item-queue bound: under normal operation the consumer (an
+# SSE writer, a router hop) drains faster than decode produces, so the
+# queue never fills; if a consumer truly wedges, the read loop stops
+# buffering at this watermark instead of growing without bound (DT006).
+_STREAM_QUEUE_MAX = int(os.environ.get("DYNTPU_STREAM_QUEUE_MAX", "1024"))
+# Dial bound: an unroutable peer must not wedge the connect lock (and
+# everything queued behind it) for the kernel's full SYN backoff.
+_DIAL_TIMEOUT_S = float(os.environ.get("DYNTPU_DIAL_TIMEOUT_S", "30"))
 
 
 class TransportError(ConnectionError):
@@ -180,6 +194,11 @@ class EndpointTcpServer:
                                   exc_info=True)
                     return
             async with wlock:
+                if writer.is_closing():
+                    # severed/closed transport: asyncio silently drops
+                    # the bytes anyway — don't write into the void
+                    # (data-after-sever, the framing guard checks this)
+                    return
                 try:
                     write_frame(writer, header, payload)
                     await writer.drain()
@@ -233,16 +252,22 @@ class EndpointTcpServer:
         finally:
             # peer gone: kill all in-flight requests from this connection
             self._conns.discard(writer)
-            for ctx in contexts.values():
-                ctx.kill()
-            pending = [t for t in tasks.values() if not t.done()]
-            for t in pending:
-                t.cancel()
-            if pending:
-                # await the cancellations so stop()/abort() reaping this
-                # handler leaves no engine task to die with the loop
-                await asyncio.gather(*pending, return_exceptions=True)
-            writer.close()
+            try:
+                for ctx in contexts.values():
+                    ctx.kill()
+                pending = [t for t in tasks.values() if not t.done()]
+                for t in pending:
+                    t.cancel()
+                if pending:
+                    # await the cancellations so stop()/abort() reaping
+                    # this handler leaves no engine task to die with the
+                    # loop
+                    await asyncio.gather(*pending, return_exceptions=True)
+            finally:
+                # nested finally: _reap_handlers() cancelling us while we
+                # await the gather above must still close the transport
+                # (a cancel delivered mid-finally skips trailing lines)
+                writer.close()
 
 
 class EndpointTcpClient(AsyncEngine):
@@ -279,13 +304,23 @@ class EndpointTcpClient(AsyncEngine):
                     self._read_task.cancel()
                 if self._writer is not None:
                     try:
-                        self._writer.close()
+                        await close_writer(self._writer)
                     except Exception:
                         log.debug("closing stale endpoint socket failed",
                                   exc_info=True)
-                self._reader, self._writer = await asyncio.open_connection(
-                    self.host, self.port
-                )
+                    # drop the reference NOW: if the dial below fails,
+                    # a later close() must not re-close the stale writer
+                    self._reader = self._writer = None
+                try:
+                    self._reader, self._writer = await asyncio.wait_for(
+                        asyncio.open_connection(self.host, self.port),
+                        _DIAL_TIMEOUT_S,
+                    )
+                except asyncio.TimeoutError:
+                    raise TransportError(
+                        f"dial {self.host}:{self.port} timed out after "
+                        f"{_DIAL_TIMEOUT_S}s"
+                    ) from None
                 self._read_task = asyncio.ensure_future(
                     self._read_loop(self._reader)
                 )
@@ -312,9 +347,29 @@ class EndpointTcpClient(AsyncEngine):
         async with self._connect_lock:
             if self._read_task:
                 self._read_task.cancel()
-            if self._writer:
-                self._writer.close()
+            # close AND await the transport teardown (bounded): stopping
+            # at close() leaves a live transport for the sanitizer/GC;
+            # null the reference so a second close() is a no-op, not a
+            # double-close (the framing guard checks this)
+            await close_writer(self._writer)
+            self._reader = self._writer = None
             self._connected = False
+
+    @staticmethod
+    def _force_put(q: asyncio.Queue, item: Any) -> None:
+        """Control markers (end/error/pong/disconnect) must land even on
+        a full queue: evict the oldest buffered item to make room — the
+        stream is terminating anyway, and a wedged consumer must still
+        find its terminal marker when it wakes."""
+        while True:
+            try:
+                q.put_nowait(item)
+                return
+            except asyncio.QueueFull:
+                try:
+                    q.get_nowait()
+                except asyncio.QueueEmpty:
+                    pass  # racing consumer freed space; retry the put
 
     async def _read_loop(self, reader) -> None:
         try:
@@ -323,18 +378,36 @@ class EndpointTcpClient(AsyncEngine):
                 if frame is None:
                     break
                 header, payload = frame
-                q = self._streams.get(header.get("req_id"))
+                rid = header.get("req_id")
+                q = self._streams.get(rid)
                 if q is None:
                     continue
                 ftype = header.get("type")
                 if ftype == "item":
-                    q.put_nowait(serde.loads(payload))
+                    item = serde.loads(payload)
+                    # bounded-queue backpressure (DT006): a wedged
+                    # consumer stops the read loop buffering at the
+                    # watermark instead of growing without bound.  Poll
+                    # rather than block in put(): a consumer cancelled
+                    # mid-wait deregisters its stream, and a blocking
+                    # put on its dead queue would wedge every stream
+                    # multiplexed on this connection.
+                    while True:
+                        if self._streams.get(rid) is not q:
+                            break  # consumer gone: drop the item
+                        try:
+                            q.put_nowait(item)
+                            break
+                        except asyncio.QueueFull:
+                            await asyncio.sleep(0.01)
                 elif ftype == "end":
-                    q.put_nowait(_END)
+                    self._force_put(q, _END)
                 elif ftype == "pong":
-                    q.put_nowait(_PONG)
+                    self._force_put(q, _PONG)
                 elif ftype == "error":
-                    q.put_nowait(RuntimeError(header.get("error", "remote error")))
+                    self._force_put(
+                        q, RuntimeError(header.get("error", "remote error"))
+                    )
         finally:
             # only the CURRENT read loop may do disconnect bookkeeping: a
             # cancelled stale loop (its connection already replaced by a
@@ -343,7 +416,7 @@ class EndpointTcpClient(AsyncEngine):
             if reader is self._reader:
                 self._connected = False
                 for q in self._streams.values():
-                    q.put_nowait(EndpointDisconnected(
+                    self._force_put(q, EndpointDisconnected(
                         f"endpoint {self.subject!r} connection lost "
                         f"({self.host}:{self.port})"))
 
@@ -371,7 +444,8 @@ class EndpointTcpClient(AsyncEngine):
             raise TransportError(
                 f"dial {self.host}:{self.port} failed: {e}") from e
         req_id = next(self._ids)
-        q: asyncio.Queue = asyncio.Queue()
+        # a probe sees at most pong + disconnect marker; bounded (DT006)
+        q: asyncio.Queue = asyncio.Queue(4)
         self._streams[req_id] = q
         self._idle.clear()
         t0 = asyncio.get_running_loop().time()
@@ -403,7 +477,7 @@ class EndpointTcpClient(AsyncEngine):
     async def _generate(self, request: Context) -> AsyncIterator[Any]:
         await self.connect()
         req_id = next(self._ids)
-        q: asyncio.Queue = asyncio.Queue()
+        q: asyncio.Queue = asyncio.Queue(_STREAM_QUEUE_MAX)
         # registered BEFORE the send (a reply must not race the
         # registration) — but cleaned up if the send itself fails, or the
         # entry and its queue leak forever

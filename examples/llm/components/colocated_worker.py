@@ -57,13 +57,14 @@ class ColocatedWorker:
         from .worker import resolve_cfg_model
 
         cfg = await resolve_cfg_model(self._cfg, rt)
-        decode_engine, self.card = build_engine(cfg)
+        # off-loop: each model build blocks for seconds (see worker.boot)
+        decode_engine, self.card = await asyncio.to_thread(build_engine, cfg)
         # prefill engine: same model, its own cache/batch sizing
         pcfg = dict(cfg)
         for k, v in list(cfg.items()):
             if k.startswith("prefill."):
                 pcfg[k[len("prefill."):]] = v
-        prefill_engine, _ = build_engine(pcfg)
+        prefill_engine, _ = await asyncio.to_thread(build_engine, pcfg)
 
         conf = DisaggRouterConf(
             max_local_prefill_length=int(cfg.get("max-local-prefill-length", 0)),

@@ -32,7 +32,9 @@ class PrefillWorker:
         from .worker import resolve_cfg_model
 
         rt = self.dynamo_runtime
-        engine, _card = build_engine(await resolve_cfg_model(self._cfg, rt))
+        # off-loop: the model build blocks for seconds (see worker.boot)
+        engine, _card = await asyncio.to_thread(
+            build_engine, await resolve_cfg_model(self._cfg, rt))
         self.worker = EnginePrefillWorker(engine, rt.coordinator, NAMESPACE)
         self._task = asyncio.ensure_future(self.worker.run())
 

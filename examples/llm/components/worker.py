@@ -19,6 +19,7 @@ prefill_worker.py; here the engine is the native JAX EngineCore.  Config
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from types import SimpleNamespace
 
@@ -149,7 +150,11 @@ class TpuWorker:
     async def boot(self):
         rt = getattr(self, "dynamo_runtime", None)
         cfg = await resolve_cfg_model(self._cfg, rt)
-        self.engine, self.card = build_engine(cfg)
+        # off-loop: a model build (jit compile + param init) blocks for
+        # seconds — on the loop it would stall coordinator keepalives
+        # and health probes (the dtsan blocking-callback monitor flags
+        # exactly this)
+        self.engine, self.card = await asyncio.to_thread(build_engine, cfg)
         if cfg.get("remote-prefill") and rt is not None:
             from dynamo_tpu.llm.disagg_router import (
                 DisaggregatedRouter,

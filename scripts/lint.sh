@@ -1,8 +1,12 @@
 #!/bin/sh
 # Convenience wrapper for the static-analysis suite (docs/static_analysis.md).
+# Runs BOTH passes: per-file rules (DT001-DT104) and the interprocedural
+# project pass (DT005-DT008) — they share one ast.parse per file.
 #   scripts/lint.sh                      # lint dynamo_tpu/, human output
 #   scripts/lint.sh --format json        # stable-sorted JSON for CI diffing
 #   scripts/lint.sh --update-baseline    # rebuild analysis/baseline.json
+#   scripts/lint.sh --select DT005       # one rule (project codes route
+#                                        # to the project registry)
 # Exit code 1 on any non-baselined finding.
 cd "$(dirname "$0")/.." || exit 2
-exec python -m dynamo_tpu lint "$@"
+exec python -m dynamo_tpu lint --project "$@"

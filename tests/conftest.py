@@ -16,6 +16,11 @@ from dynamo_tpu.utils import force_cpu_devices
 
 force_cpu_devices(8)
 
+# dtsan runtime sanitizer (docs/static_analysis.md#runtime-sanitizer):
+# task-LEAK checking is on by default in tier-1; DYNAMO_SANITIZE=1
+# upgrades to the full instrument set, DYNAMO_SANITIZE=0 disables.
+from dynamo_tpu.analysis import pytest_sanitizer as _dtsan  # noqa: E402
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -23,6 +28,17 @@ def pytest_configure(config):
         "slow: long soak / fault-injection tests excluded from tier-1 "
         "(-m 'not slow')",
     )
+    config.addinivalue_line(
+        "markers",
+        "no_sanitize: exempt this test from dtsan runtime-sanitizer "
+        "failures (leaked tasks / blocking callbacks / unclosed "
+        "transports)",
+    )
+    _dtsan.configure(config)
+
+
+def pytest_runtest_setup(item):
+    _dtsan.begin_test(item)
 
 
 # ---------------------------------------------------- tier-1 time budget
@@ -76,6 +92,10 @@ def pytest_runtest_makereport(item, call):
             "@pytest.mark.slow (excluded from tier-1) or make it faster. "
             "Override with DYNAMO_TEST_TIME_BUDGET."
         )
+    # dtsan: fail passing tests that leak tasks (and, under
+    # DYNAMO_SANITIZE=1, blocking callbacks / unclosed transports /
+    # frame-protocol violations)
+    _dtsan.check_report(item, call, rep)
 
 
 def make_tiny_hf_checkpoint(dst, *, vocab_size=128, hidden_size=32,

@@ -1,0 +1,24 @@
+"""DT005 bad: lock held across an unbounded await that reaches the
+network — a wedged peer queues every other acquirer behind the dead
+round-trip."""
+import asyncio
+
+
+class Rpc:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self._reader = None
+        self._writer = None
+
+    async def connect(self, host, port):
+        self._reader, self._writer = await asyncio.open_connection(host, port)
+
+    async def call(self, payload):
+        async with self._lock:
+            self._writer.write(payload)
+            await self._writer.drain()
+            return await self._reader.readexactly(8)
+
+    async def close(self):
+        self._writer.close()
+        await self._writer.wait_closed()

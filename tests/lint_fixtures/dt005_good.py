@@ -1,0 +1,23 @@
+"""DT005 good: the round-trip under the lock is bounded with wait_for —
+a wedged peer surfaces as TimeoutError instead of wedging the lock."""
+import asyncio
+
+
+class Rpc:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self._reader = None
+        self._writer = None
+
+    async def connect(self, host, port):
+        self._reader, self._writer = await asyncio.open_connection(host, port)
+
+    async def call(self, payload):
+        async with self._lock:
+            self._writer.write(payload)
+            await self._writer.drain()
+            return await asyncio.wait_for(self._reader.readexactly(8), 5.0)
+
+    async def close(self):
+        self._writer.close()
+        await self._writer.wait_closed()

@@ -1,0 +1,26 @@
+"""DT006 bad: unbounded asyncio.Queue fed from a network callback path —
+a slow consumer turns it into an unbounded buffer of peer-controlled
+bytes."""
+import asyncio
+
+
+class Tail:
+    def __init__(self):
+        self._q = asyncio.Queue()
+        self._reader = None
+        self._writer = None
+
+    async def connect(self, host, port):
+        self._reader, self._writer = await asyncio.open_connection(host, port)
+
+    async def _pump(self):
+        while True:
+            data = await self._reader.readexactly(4)
+            self._q.put_nowait(data)
+
+    async def next_item(self):
+        return await self._q.get()
+
+    async def close(self):
+        self._writer.close()
+        await self._writer.wait_closed()

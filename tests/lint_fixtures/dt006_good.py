@@ -1,0 +1,25 @@
+"""DT006 good: the network-fed queue is bounded — the pump's await put()
+applies real backpressure to the peer when the consumer is slow."""
+import asyncio
+
+
+class Tail:
+    def __init__(self):
+        self._q = asyncio.Queue(maxsize=256)
+        self._reader = None
+        self._writer = None
+
+    async def connect(self, host, port):
+        self._reader, self._writer = await asyncio.open_connection(host, port)
+
+    async def _pump(self):
+        while True:
+            data = await self._reader.readexactly(4)
+            await self._q.put(data)
+
+    async def next_item(self):
+        return await self._q.get()
+
+    async def close(self):
+        self._writer.close()
+        await self._writer.wait_closed()

@@ -1,0 +1,13 @@
+"""DT007 bad: the writer from open_connection is closed only on the
+happy path — a raising request leaks the transport."""
+import asyncio
+
+
+async def fetch(host, port, payload):
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(payload)
+    await writer.drain()
+    data = await reader.readexactly(8)
+    writer.close()
+    await writer.wait_closed()
+    return data

@@ -1,0 +1,14 @@
+"""DT007 good: close()/wait_closed() live in a finally, so every exit
+path — including a raising read — tears the transport down."""
+import asyncio
+
+
+async def fetch(host, port, payload):
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        writer.write(payload)
+        await writer.drain()
+        return await reader.readexactly(8)
+    finally:
+        writer.close()
+        await writer.wait_closed()

@@ -1,0 +1,16 @@
+"""DT008 bad: a task spawned into self._task with no cancel/drain on any
+shutdown-path method — it outlives its owner and is destroyed pending at
+loop teardown."""
+import asyncio
+
+
+class Poller:
+    def __init__(self):
+        self._task = None
+
+    def start(self):
+        self._task = asyncio.ensure_future(self._poll())
+
+    async def _poll(self):
+        while True:
+            await asyncio.sleep(1.0)

@@ -1,0 +1,24 @@
+"""DT008 good: the spawned task is cancelled AND awaited from stop(), a
+shutdown-path method, so it cannot outlive its owner."""
+import asyncio
+
+
+class Poller:
+    def __init__(self):
+        self._task = None
+
+    def start(self):
+        self._task = asyncio.ensure_future(self._poll())
+
+    async def _poll(self):
+        while True:
+            await asyncio.sleep(1.0)
+
+    async def stop(self):
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None

@@ -42,7 +42,10 @@ def test_full_serving_stack(tokenizer_file):
     async def go():
         cfg = ModelConfig.tiny(vocab_size=vocab_size)
         model = LlamaModel(cfg)
-        params = model.init_params(jax.random.PRNGKey(0))
+        # off-loop: param init jit-compiles for >1s and would stall the
+        # loop this test's whole serving stack runs on (dtsan flags it)
+        params = await asyncio.to_thread(
+            model.init_params, jax.random.PRNGKey(0))
         core = EngineCore(
             model,
             params,

@@ -1,0 +1,354 @@
+"""dtsan runtime-sanitizer tests (Plane B of the concurrency tool): each
+instrument catches its injected bug — a leaked task, a blocking
+callback, an unclosed transport, an illegal frame sequence — and the
+pytest plugin turns a deliberately-leaky test into a failure.
+
+Tests instrument loops/instances directly where possible (no global
+patches to stack on top of the conftest's default leak-check); the
+monitor/guard tests install globally and uninstall in a finally.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from dynamo_tpu.analysis import pytest_sanitizer as plugin
+from dynamo_tpu.analysis.sanitizer import (
+    MODE_FULL,
+    MODE_LEAKS,
+    MODE_OFF,
+    BlockingCallbackMonitor,
+    FrameProtocolError,
+    FrameStateMachine,
+    FramingGuard,
+    Sanitizer,
+    TaskTracker,
+    TransportTracker,
+    mode_from_env,
+)
+
+
+def _reap(loop, tasks):
+    for t in tasks:
+        t.cancel()
+    if tasks:
+        loop.run_until_complete(asyncio.gather(*tasks, return_exceptions=True))
+
+
+# ------------------------------------------------------------ task leaks ----
+
+
+def test_injected_task_leak_is_caught():
+    tracker = TaskTracker()
+    loop = asyncio.new_event_loop()
+    try:
+        tracker.instrument_loop(loop)
+        tracker.begin_epoch()
+
+        async def leaky():
+            asyncio.ensure_future(asyncio.sleep(60))
+            await asyncio.sleep(0)
+
+        loop.run_until_complete(leaky())
+        pending = tracker.pending_in_epoch()
+        assert len(pending) == 1
+        task, rec = pending[0]
+        # the report carries the creation traceback pointing at the test
+        assert "test_sanitizer.py" in rec.render()
+        assert "leaky" in rec.render()
+
+        # fixing the leak (cancel AND reap) makes the epoch clean
+        _reap(loop, [task])
+        assert tracker.pending_in_epoch() == []
+    finally:
+        _reap(loop, list(asyncio.all_tasks(loop)))
+        loop.close()
+
+
+def test_cancel_requested_task_is_not_a_leak():
+    """A pending task whose owner already called cancel() is drained
+    best-effort, not leaked — only never-cancelled tasks fail the
+    default check."""
+    tracker = TaskTracker()
+    loop = asyncio.new_event_loop()
+    try:
+        tracker.instrument_loop(loop)
+        tracker.begin_epoch()
+
+        async def stubborn():
+            try:
+                await asyncio.sleep(60)
+            except asyncio.CancelledError:
+                # swallow the first cancel so the task STAYS pending
+                await asyncio.sleep(60)
+
+        async def go():
+            t = asyncio.ensure_future(stubborn())
+            await asyncio.sleep(0)
+            t.cancel()   # requested, but never reaped
+
+        loop.run_until_complete(go())
+        assert tracker.pending_in_epoch() == []
+        assert len(tracker.pending_in_epoch(
+            include_cancel_requested=True)) == 1
+    finally:
+        _reap(loop, list(asyncio.all_tasks(loop)))
+        loop.close()
+
+
+def test_epoch_scoping_attributes_leaks_to_their_test():
+    tracker = TaskTracker()
+    loop = asyncio.new_event_loop()
+    try:
+        tracker.instrument_loop(loop)
+        tracker.begin_epoch()
+
+        async def leaky():
+            asyncio.ensure_future(asyncio.sleep(60))
+            await asyncio.sleep(0)
+
+        loop.run_until_complete(leaky())
+        assert len(tracker.pending_in_epoch()) == 1
+        # next epoch: the old leak is not re-attributed
+        tracker.begin_epoch()
+        assert tracker.pending_in_epoch() == []
+    finally:
+        _reap(loop, list(asyncio.all_tasks(loop)))
+        loop.close()
+
+
+# ----------------------------------------------------- blocking callbacks ----
+
+
+def test_injected_blocking_callback_is_caught():
+    mon = BlockingCallbackMonitor(threshold_s=0.05)
+    mon.install()
+    try:
+        mon.begin_epoch()
+        loop = asyncio.new_event_loop()
+
+        async def blocker():
+            time.sleep(0.2)   # deliberate block ON the loop thread
+
+        loop.run_until_complete(blocker())
+        loop.close()
+        reports = mon.reports_in_epoch()
+        assert reports, "blocking callback not detected"
+        worst = max(reports, key=lambda r: r.duration_s)
+        assert worst.duration_s >= 0.05
+        # the watchdog sampled the stack WHILE it was blocking
+        assert "time.sleep" in worst.blocked_stack or (
+            "blocker" in worst.blocked_stack
+        ), worst.render()
+    finally:
+        mon.uninstall()
+
+
+# ---------------------------------------------------------- transports ----
+
+
+def test_unclosed_transport_is_caught():
+    tracker = TransportTracker()
+    tracker.install()
+    try:
+        tracker.begin_epoch()
+        loop = asyncio.new_event_loop()
+
+        async def handler(reader, writer):
+            await reader.read()
+            writer.close()
+
+        async def dial_and_abandon():
+            server = await asyncio.start_server(handler, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            return server, writer
+
+        server, writer = loop.run_until_complete(dial_and_abandon())
+        leaks = tracker.unclosed_in_epoch()
+        assert leaks, "dialed transport not tracked"
+        assert any("test_sanitizer.py" in rec.render(t) for t, rec in leaks)
+
+        async def cleanup():
+            writer.close()
+            await writer.wait_closed()
+            server.close()
+            await server.wait_closed()
+            # let the server side observe EOF and finish closing
+            for _ in range(100):
+                if not tracker.unclosed_in_epoch():
+                    break
+                await asyncio.sleep(0.01)
+
+        loop.run_until_complete(cleanup())
+        assert tracker.unclosed_in_epoch() == []
+        _reap(loop, list(asyncio.all_tasks(loop)))
+        loop.close()
+    finally:
+        tracker.uninstall()
+
+
+# ------------------------------------------------------- frame protocol ----
+
+
+def test_frame_state_machine_illegal_sequences():
+    m = FrameStateMachine("conn1")
+    m.on_write()
+    m.on_write()            # any number of writes while open is legal
+    m.on_sever()
+    with pytest.raises(FrameProtocolError, match="data-after-sever"):
+        m.on_write()
+    m.on_close()            # severed -> closed is the normal teardown
+    with pytest.raises(FrameProtocolError, match="double-close"):
+        m.on_close()
+
+    # non-strict: violations accumulate instead of raising
+    m2 = FrameStateMachine("conn2", strict=False)
+    m2.on_close()
+    m2.on_close()
+    m2.on_write()
+    assert len(m2.violations) == 2
+    assert any("double-close" in v for v in m2.violations)
+    assert any("data-after-close" in v for v in m2.violations)
+
+
+@pytest.mark.no_sanitize  # deliberately violates the frame protocol to
+#                           prove the guard catches it — under
+#                           DYNAMO_SANITIZE=1 the GLOBAL guard would
+#                           (correctly) flag this test otherwise
+def test_framing_guard_catches_illegal_wire_sequence():
+    """End to end on a real socket: the guard wraps the framing module
+    (and every module that imported its functions by name) and records
+    data-after-close and double-close."""
+    from dynamo_tpu.runtime.transports import framing
+
+    guard = FramingGuard()
+    guard.install()
+    loop = asyncio.new_event_loop()
+    try:
+        guard.begin_epoch()
+
+        async def handler(reader, writer):
+            await reader.read()
+            writer.close()
+
+        async def go():
+            server = await asyncio.start_server(handler, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            framing.write_frame(writer, {"op": "legal"})
+            await writer.drain()
+            await framing.close_writer(writer)
+            framing.write_frame(writer, {"op": "too-late"})   # after close
+            await framing.close_writer(writer)                # double-close
+            server.close()
+            await server.wait_closed()
+
+        loop.run_until_complete(go())
+        v = guard.violations_in_epoch()
+        assert any("data-after" in msg for msg in v), v
+        assert any("double-close" in msg for msg in v), v
+    finally:
+        guard.uninstall()
+        _reap(loop, list(asyncio.all_tasks(loop)))
+        loop.close()
+
+
+# ------------------------------------------------------------- the plugin ----
+
+
+def test_plugin_fails_a_deliberately_leaky_test(monkeypatch):
+    """The acceptance demonstration: a test body that leaks a live task
+    is flipped from passed to failed by the plugin, with the creation
+    traceback in the failure text."""
+    san = Sanitizer(MODE_LEAKS)          # not installed: driven directly
+    loop = asyncio.new_event_loop()
+    san.tasks.instrument_loop(loop)
+    san.begin_epoch()
+
+    async def deliberately_leaky_test_body():
+        asyncio.ensure_future(asyncio.sleep(60))
+        await asyncio.sleep(0)
+
+    loop.run_until_complete(deliberately_leaky_test_body())
+
+    monkeypatch.setattr(plugin, "_sanitizer", san)
+
+    class FakeReport:
+        when = "call"
+        passed = True
+        outcome = "passed"
+        longrepr = None
+
+    class FakeItem:
+        fspath = "/tmp/test_leaky_fixture.py"
+        nodeid = "test_leaky_fixture.py::test_leaks_a_task"
+
+        def get_closest_marker(self, name):
+            return None
+
+    rep = FakeReport()
+    plugin.check_report(FakeItem(), None, rep)
+    assert rep.outcome == "failed"
+    assert "leaked task" in str(rep.longrepr)
+    assert "deliberately_leaky_test_body" in str(rep.longrepr)
+
+    # grandfathered files are exempt (the lint-baseline idiom)
+    rep2 = FakeReport()
+    exempt = sorted(plugin.LEAK_GRANDFATHERED_FILES)[0]
+
+    class ExemptItem(FakeItem):
+        fspath = f"/tmp/{exempt}"
+
+    plugin.check_report(ExemptItem(), None, rep2)
+    assert rep2.outcome == "passed"
+
+    # failing tests are left alone: the real failure is the signal
+    rep3 = FakeReport()
+    rep3.passed = False
+    rep3.outcome = "failed"
+    rep3.longrepr = "original failure"
+    plugin.check_report(FakeItem(), None, rep3)
+    assert rep3.longrepr == "original failure"
+
+    # reap the injected leak so this test is clean under the REAL plugin
+    _reap(loop, [t for t, _ in san.tasks.pending_in_epoch()])
+    loop.close()
+
+
+def test_mode_from_env(monkeypatch):
+    monkeypatch.delenv("DYNAMO_SANITIZE", raising=False)
+    assert mode_from_env() == MODE_LEAKS
+    monkeypatch.setenv("DYNAMO_SANITIZE", "0")
+    assert mode_from_env() == MODE_OFF
+    monkeypatch.setenv("DYNAMO_SANITIZE", "1")
+    assert mode_from_env() == MODE_FULL
+    monkeypatch.setenv("DYNAMO_SANITIZE", "full")
+    assert mode_from_env() == MODE_FULL
+
+
+def test_full_sanitizer_install_uninstall_roundtrip():
+    """MODE_FULL installs all four instruments and uninstall restores
+    every patched seam (policy, Handle._run, _make_socket_transport,
+    framing functions)."""
+    import asyncio.events as ev
+    import asyncio.selector_events as sel
+
+    from dynamo_tpu.runtime.transports import framing
+
+    orig_run = ev.Handle._run
+    orig_make = sel.BaseSelectorEventLoop._make_socket_transport
+    orig_write = framing.write_frame
+
+    san = Sanitizer(MODE_FULL).install()
+    try:
+        assert ev.Handle._run is not orig_run
+        assert sel.BaseSelectorEventLoop._make_socket_transport is not orig_make
+        assert framing.write_frame is not orig_write
+        assert san.epoch_report() == []   # nothing recorded yet
+    finally:
+        san.uninstall()
+    assert ev.Handle._run is orig_run
+    assert sel.BaseSelectorEventLoop._make_socket_transport is orig_make
+    assert framing.write_frame is orig_write

@@ -236,7 +236,10 @@ def test_serve_worker_subprocess(tmp_path):
             await rt.shutdown()
         finally:
             proc.terminate()
-            proc.wait(timeout=10)
+            # off-loop: a sync wait() here blocks the event loop for the
+            # worker's whole shutdown (the dtsan blocking-callback
+            # monitor flags exactly this)
+            await asyncio.to_thread(proc.wait, timeout=10)
             await srv.stop()
 
     run(go())
