@@ -1,11 +1,18 @@
-"""Two-plane concurrency correctness tool (docs/static_analysis.md).
+"""Three-plane correctness tool (docs/static_analysis.md).
 
-Plane A (static): per-file async-safety + JAX/TPU rules (core.py,
+Plane A (static source): per-file async-safety + JAX/TPU rules (core.py,
 rules_async.py, rules_jax.py) and the interprocedural project pass
 (project.py, DT005-DT008) with a shared baseline and a zero-findings
 tier-1 gate.  Plane B (dynamic): the dtsan runtime sanitizer
 (sanitizer.py + pytest_sanitizer.py) — task-leak checking on by default
-in tier-1, full instrumentation under ``DYNAMO_SANITIZE=1``."""
+in tier-1, full instrumentation under ``DYNAMO_SANITIZE=1``.  Plane C
+(compile): the dttrace jaxpr/HLO audit (tracecheck.py, TR001-TR007) —
+trace-signature census, donation aliasing, dtype propagation, and static
+HBM footprint per jitted entrypoint against the committed
+``trace_manifest.json`` (``dynamo-tpu lint --trace``).
+
+tracecheck is imported lazily (it pulls in jax + the engine); reach it
+via ``dynamo_tpu.analysis.tracecheck``."""
 
 from dynamo_tpu.analysis.core import (
     DEFAULT_BASELINE_PATH,

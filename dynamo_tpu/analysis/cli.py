@@ -34,6 +34,14 @@ def configure_parser(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="also run the interprocedural pass (DT005-DT008: "
                         "cross-module call-graph rules) on top of the "
                         "per-file rules")
+    p.add_argument("--trace", action="store_true",
+                   help="run the compile-plane pass instead (TR001-TR007: "
+                        "jaxpr/HLO trace census, donation audit, dtype "
+                        "propagation, static HBM footprint) against the "
+                        "committed trace manifest")
+    p.add_argument("--manifest", default=None, metavar="PATH",
+                   help="trace manifest file (default: the committed "
+                        "analysis/trace_manifest.json; --trace only)")
     p.add_argument("--select", default=None, metavar="DT001,DT102",
                    help="comma-separated rule codes to run (default: all)")
     p.add_argument("--baseline", default=None, metavar="PATH",
@@ -52,6 +60,12 @@ def configure_parser(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
 
 def run_lint(args: argparse.Namespace, out=None) -> int:
     out = out if out is not None else sys.stdout
+    if getattr(args, "trace", False):
+        # compile-plane pass: its unit is jitted entrypoints, not source
+        # files — it runs on its own manifest contract
+        from dynamo_tpu.analysis.tracecheck import run_trace
+
+        return run_trace(args, out)
     paths = [Path(p) for p in (args.paths or [])]
     if args.root:
         root = Path(args.root)

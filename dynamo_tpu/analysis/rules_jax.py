@@ -12,6 +12,7 @@ import ast
 from typing import Iterable
 
 from dynamo_tpu.analysis.core import (
+    PARTIAL_NAMES,
     Finding,
     ModuleContext,
     Rule,
@@ -92,6 +93,28 @@ class JitPerCall(Rule):
         if isinstance(stmt, ast.Assign) and any(
             "." in n for n in _assigned_names(stmt)
         ):
+            return
+        if (
+            node.args
+            and isinstance(node.args[0], ast.Call)
+            and ctx.canonical(dotted_name(node.args[0].func))
+            in PARTIAL_NAMES
+        ):
+            # the partial-inside-method shape: even if the wrapped fn is
+            # stable, each call builds a DISTINCT partial object, so the
+            # jit cache keys never hit — the compile-plane census
+            # (`dynamo-tpu lint --trace`, TR003 unstable-trace-key) sees
+            # the same defect as an unstable signature
+            yield ctx.finding(
+                self, node,
+                "jax.jit(functools.partial(...)) built per call: every "
+                "call makes a fresh partial (and a fresh jitted "
+                "callable), so the trace cache never hits — one compile "
+                "PER STEP.  The compile-plane census flags this as "
+                "TR003 unstable-trace-key (`dynamo-tpu lint --trace`); "
+                "hoist the jit+partial to __init__/module scope or bind "
+                "the varying value via static_argnums",
+            )
             return
         yield ctx.finding(
             self, node,

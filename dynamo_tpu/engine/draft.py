@@ -156,12 +156,14 @@ class DraftProposer:
             last_idx[i] = n - 1
             active[i] = draft_active
             self._synced[i] = start + n
-        props, self.cache = self._fn(
-            self.params, self.cache,
-            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(bt),
-            jnp.asarray(seq_lens), jnp.asarray(slot_idx),
-            jnp.asarray(last_idx), jnp.asarray(active), k=k,
+        # ONE batched host->device upload (engine/core.py:_upload_dispatch
+        # convention): per-array jnp.asarray would issue seven transfer
+        # round trips, and per-transfer latency is the cost that matters
+        # on a remote-attached chip
+        up = jax.device_put(
+            (tokens, positions, bt, seq_lens, slot_idx, last_idx, active)
         )
+        props, self.cache = self._fn(self.params, self.cache, *up, k=k)
         self.dispatches += 1
         return np.asarray(props)
 

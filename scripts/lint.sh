@@ -1,12 +1,23 @@
 #!/bin/sh
 # Convenience wrapper for the static-analysis suite (docs/static_analysis.md).
-# Runs BOTH passes: per-file rules (DT001-DT104) and the interprocedural
-# project pass (DT005-DT008) — they share one ast.parse per file.
+# Runs ALL THREE passes:
+#   1+2. per-file rules (DT001-DT104) + interprocedural project pass
+#        (DT005-DT008) — one invocation, sharing one ast.parse per file
+#   3.   compile-plane trace audit (TR001-TR007, docs section "compile
+#        plane") against the committed analysis/trace_manifest.json
 #   scripts/lint.sh                      # lint dynamo_tpu/, human output
-#   scripts/lint.sh --format json        # stable-sorted JSON for CI diffing
+#   scripts/lint.sh --format json        # stable JSON (one doc per pass)
 #   scripts/lint.sh --update-baseline    # rebuild analysis/baseline.json
+#                                        # AND the trace manifest
+#                                        # (justifications carried by key)
 #   scripts/lint.sh --select DT005       # one rule (project codes route
-#                                        # to the project registry)
-# Exit code 1 on any non-baselined finding.
+#                                        # to the project registry; the
+#                                        # trace pass ignores --select)
+# Exit code 1 on any non-baselined finding from any pass.
 cd "$(dirname "$0")/.." || exit 2
-exec python -m dynamo_tpu lint --project "$@"
+python -m dynamo_tpu lint --project "$@"
+rc_ast=$?
+python -m dynamo_tpu lint --trace "$@"
+rc_trace=$?
+[ "$rc_ast" -ne 0 ] && exit "$rc_ast"
+exit "$rc_trace"

@@ -56,17 +56,15 @@ _TIME_BUDGET_S = float(os.environ.get("DYNAMO_TEST_TIME_BUDGET", "20"))
 # Pruned (verified: worst standalone call time via --durations=0 AND a
 # full in-suite tier-1 run with the guard active): test_http_service.py
 # (0.04s), test_multistep_decode.py (5.5s), test_deepseek.py (7.1s),
-# test_disagg.py (8.3s).  test_sampling_extras.py stays: 5.0s
-# standalone but its engine-compiling e2e test blew the budget under
-# full-suite load (in-suite durations run ~2x+ standalone).
+# test_disagg.py (8.3s); PR 6 full-run (--durations=0, guard active):
+# test_e2e_serving.py (<4.4s), test_engine.py (5.1s),
+# test_multihost_disagg.py (6.1s), test_multihost.py (7.7s),
+# test_grammar_engine.py (8.8s), test_model_correctness.py (12.4s).
+# The keepers' worst in-suite calls that same run: test_engine_soak.py
+# 29.5s, test_sampling_extras.py 29.2s, test_spec_decode.py 23.8s,
+# test_serve_bench.py 19.3s (within 4% of the budget — not "under").
 _TIME_BUDGET_GRANDFATHERED_FILES = {
-    "test_e2e_serving.py",
-    "test_engine.py",
     "test_engine_soak.py",
-    "test_grammar_engine.py",
-    "test_model_correctness.py",
-    "test_multihost.py",
-    "test_multihost_disagg.py",
     "test_sampling_extras.py",
     "test_serve_bench.py",
     "test_spec_decode.py",

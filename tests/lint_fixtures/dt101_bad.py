@@ -1,5 +1,7 @@
 """DT101 bad: a fresh jax.jit per call — recompilation storm."""
 
+import functools
+
 import jax
 
 
@@ -18,3 +20,14 @@ class Engine:
             fn = jax.jit(impl)
             out.append(fn(x, 2))
         return out
+
+
+class PartialEngine:
+    """The functools.partial-inside-method shape: the compile-plane
+    census (dynamo-tpu lint --trace) sees the same defect as TR003."""
+
+    def step(self, x, cfg):
+        # a fresh partial (and a fresh jitted callable) per call: the
+        # trace cache keys never hit — one compile per step
+        fn = jax.jit(functools.partial(impl, n=cfg.n))
+        return fn(x)

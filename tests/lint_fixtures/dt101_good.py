@@ -1,6 +1,8 @@
 """DT101 good: jit built once (module / __init__ / cached attribute),
 varying Python scalars declared static."""
 
+import functools
+
 import jax
 
 
@@ -22,3 +24,12 @@ class Engine:
         # lazily built but cached on the instance: jits once
         fn = self._lazy_fn = jax.jit(impl, static_argnums=(1,))
         return fn(x, n)
+
+
+class PartialEngine:
+    def __init__(self, cfg):
+        # partial bound ONCE at init scope: one stable jitted callable
+        self._step_fn = jax.jit(functools.partial(impl, n=cfg.n))
+
+    def step(self, x):
+        return self._step_fn(x)
