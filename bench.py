@@ -22,7 +22,9 @@ whichever model the chip fits and labels it).
 Env knobs: DYNAMO_BENCH_MODEL (tiny|1b|8b|auto), DYNAMO_BENCH_BATCH,
 DYNAMO_BENCH_STEPS, DYNAMO_BENCH_ISL, DYNAMO_BENCH_MAX_LEN,
 DYNAMO_BENCH_BLOCK_SIZE, DYNAMO_BENCH_DECODE_STEPS,
-DYNAMO_BENCH_PREFILL_CHUNK, DYNAMO_BENCH_TTFT_ISL,
+DYNAMO_BENCH_PREFILL_CHUNK, DYNAMO_BENCH_PREFILL_BUDGET,
+DYNAMO_BENCH_UNIFIED (1 = unified mixed prefill+decode dispatch),
+DYNAMO_BENCH_TTFT_ISL,
 DYNAMO_BENCH_TTFT_BATCH (north-star TTFT phase batch, default 8),
 DYNAMO_BENCH_QUANT (int8|none, weights),
 DYNAMO_BENCH_KV_QUANT (auto|int8|none, KV cache),
@@ -289,6 +291,57 @@ def _probe_pallas_prefill(mcfg: dict, max_len: int, bs: int,
     except Exception as e:  # pragma: no cover - hardware-specific
         print(f"# pallas prefill probe failed ({type(e).__name__}: "
               f"{str(e)[:500]}); falling back to pure-JAX prefill",
+              file=sys.stderr)
+        os.environ["DYNAMO_DISABLE_PALLAS_PREFILL"] = "1"
+
+
+def _probe_pallas_unified(mcfg: dict, batch: int, max_len: int, bs: int,
+                          prefill_budget: int) -> None:
+    """Compile-probe the ragged kernel at the UNIFIED mixed geometry the
+    engine dispatches under DYNAMO_BENCH_UNIFIED: decode rows (1 fresh
+    token each, starts NOT block-aligned) leading the flat axis, one
+    block-aligned prefill span behind them.  The single-phase ragged
+    probe cannot stand in for this — the non-aligned per-row prefix DMA
+    bound (cdiv(start, C*Bs) chunks) is the shape that differs.  On
+    failure, fall back to the pure-JAX path for the run."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        from dynamo_tpu.ops.pallas.prefill_attention import (
+            ragged_paged_prefill_attention,
+        )
+
+        h, hk, hd, n, bt, lens = _probe_geometry(mcfg, batch, max_len, bs)
+        lens = np.asarray(lens)
+        d_region = -(-batch // bs) * bs
+        span = min(max(bs, prefill_budget - d_region), max_len - d_region)
+        span = max(bs, span // bs * bs)
+        t = d_region + span
+        n_dec = min(batch, d_region)
+        q = jnp.ones((1, t, h, hd), jnp.bfloat16)
+        kv = jnp.ones((1, t, hk, hd), jnp.bfloat16)
+        cache = jnp.zeros((1, n, 2, bs, hk * hd), jnp.bfloat16)
+        rows = n_dec + 1
+        # decode rows: full cached prefix ending mid-block; prefill row:
+        # a fresh block-aligned span with a 2-block cached prefix
+        starts = np.concatenate([
+            np.minimum(lens[:n_dec] - 1, max_len - 2),
+            [min(2 * bs, max_len - span)],
+        ]).astype(np.int32)
+        seq_lens = np.concatenate([
+            starts[:n_dec] + 1, [starts[n_dec] + span]]).astype(np.int32)
+        roff = np.concatenate([
+            np.arange(n_dec), [d_region]]).astype(np.int32)
+        out = ragged_paged_prefill_attention(
+            q, kv, kv, cache, jnp.int32(0),
+            jnp.asarray(np.resize(np.asarray(bt), (rows, bt.shape[1]))),
+            jnp.asarray(seq_lens), jnp.asarray(starts), jnp.asarray(roff),
+        )
+        jax.block_until_ready(out)
+    except Exception as e:  # pragma: no cover - hardware-specific
+        print(f"# pallas unified probe failed ({type(e).__name__}: "
+              f"{str(e)[:500]}); falling back to pure-JAX attention",
               file=sys.stderr)
         os.environ["DYNAMO_DISABLE_PALLAS_PREFILL"] = "1"
 
@@ -818,6 +871,10 @@ def main() -> None:
     # chunks into one dispatch (engine/core.py _run_prefill_batch)
     prefill_budget = int(os.environ.get("DYNAMO_BENCH_PREFILL_BUDGET",
                                         "1024" if on_accel else "0"))
+    # unified mixed prefill+decode dispatch: 1 = one token-budget ragged
+    # step per mixed turn (engine/core.py _run_unified); default off
+    # until the on-chip numbers are re-landed (ROADMAP standing note)
+    unified = bool(int(os.environ.get("DYNAMO_BENCH_UNIFIED", "0")))
     # int8 weight-only quantization (models/quant.py): halves weight HBM
     # footprint AND per-decode-step weight traffic — this is what fits the
     # north-star 8B model on a single 16GiB v5e chip (the reference's
@@ -900,6 +957,7 @@ def main() -> None:
         decode_steps=decode_steps,
         prefill_chunk_tokens=min(prefill_chunk, max_len) if prefill_chunk else 0,
         prefill_token_budget=prefill_budget,
+        unified_token_dispatch=unified,
         enable_prefix_reuse=False,  # distinct prompts; measure raw decode
         cache_dtype="int8" if kv_quant == "int8" else None,
     )
@@ -909,6 +967,11 @@ def main() -> None:
             and kv_quant == "none":
         _probe_pallas_prefill(mcfg, max_len, block_size, prefill_chunk,
                               prefill_budget)
+    if unified and pallas_on and not env("DYNAMO_DISABLE_PALLAS_PREFILL"):
+        # the mixed dispatch exercises the ragged kernel at a geometry
+        # the single-phase probes never touch (non-aligned decode starts)
+        _probe_pallas_unified(mcfg, batch, max_len, block_size,
+                              ecfg.prefill_token_budget)
     if pallas_on and not env("DYNAMO_DISABLE_PALLAS_DECODE") \
             and kv_quant == "none":
         _probe_pallas_decode(mcfg, batch, max_len, block_size)

@@ -132,6 +132,18 @@ def main() -> None:
                                 jnp.int32),            # seq_lens
                     jnp.asarray([0, min(2 * bs, max_len - s)], jnp.int32),
                     jnp.asarray([0, s // 2], jnp.int32)))),
+            # unified mixed dispatch: a DECODE row (1 fresh token, start
+            # NOT block-aligned — the full-cached-prefix DMA path) ahead
+            # of a block-aligned prefill span on the same flat axis
+            (f"unified/{mode}", lambda cache=cache: (
+                ragged_paged_prefill_attention(
+                    jnp.ones((1, bs + s, h, d), jnp.bfloat16),
+                    jnp.ones((1, bs + s, hk, d), jnp.bfloat16),
+                    jnp.ones((1, bs + s, hk, d), jnp.bfloat16),
+                    cache, jnp.int32(0), bt[:2],
+                    jnp.asarray([2 * bs + 3 + 1, s], jnp.int32),  # seq_lens
+                    jnp.asarray([2 * bs + 3, 0], jnp.int32),      # starts
+                    jnp.asarray([0, bs], jnp.int32)))),           # roff
         ]
     # dequant-in-kernel int8 matmul at decode and prefill row counts
     from dynamo_tpu.ops.pallas.int8_matmul import int8_matmul

@@ -110,13 +110,15 @@ async def prefill_dispatch_stats(url):
         if line.startswith("#"):
             continue
         for key in ("prefill_dispatches_total", "prefill_tokens_total",
-                    "prefill_batch_occupancy", "prefill_budget_utilization"):
+                    "prefill_batch_occupancy", "prefill_budget_utilization",
+                    "unified_dispatches_total", "unified_decode_rows",
+                    "unified_prefill_tokens", "unified_budget_utilization"):
             if line.startswith(f"dynamo_tpu_engine_{key} "):
                 vals[key] = float(line.rsplit(" ", 1)[-1])
     dispatches = vals.get("prefill_dispatches_total", 0)
     if not dispatches:
         return None
-    return {
+    out = {
         "prefill_dispatches": int(dispatches),
         "prefill_tokens_per_dispatch": round(
             vals.get("prefill_tokens_total", 0) / dispatches, 1),
@@ -124,6 +126,20 @@ async def prefill_dispatch_stats(url):
         "prefill_budget_utilization": vals.get(
             "prefill_budget_utilization", 0.0),
     }
+    unified = vals.get("unified_dispatches_total", 0)
+    if unified:
+        # unified mixed dispatch engaged: the interleave win per run —
+        # each of these turns replaced a decode burst + prefill pair
+        out.update({
+            "unified_dispatches": int(unified),
+            "unified_decode_rows_per_dispatch": round(
+                vals.get("unified_decode_rows", 0) / unified, 1),
+            "unified_prefill_tokens_per_dispatch": round(
+                vals.get("unified_prefill_tokens", 0) / unified, 1),
+            "unified_budget_utilization": vals.get(
+                "unified_budget_utilization", 0.0),
+        })
+    return out
 
 
 async def run(args):
@@ -244,6 +260,10 @@ async def run_with_native(args):
         # ~ceil(tokens/budget))
         prefill_token_budget=int(os.environ.get(
             "DYNAMO_PREFILL_TOKEN_BUDGET", "1024" if on_accel else "0")),
+        # unified mixed prefill+decode dispatch (one ragged step per
+        # mixed turn); DYNAMO_UNIFIED_DISPATCH=1 to enable for a sweep
+        unified_token_dispatch=bool(int(os.environ.get(
+            "DYNAMO_UNIFIED_DISPATCH", "0"))),
         enable_prefix_reuse=False,
         cache_dtype="int8" if quant else None,
     )

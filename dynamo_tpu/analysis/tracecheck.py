@@ -11,8 +11,9 @@ traffic), or a config change that statically cannot fit a chip's HBM.
 With hardware down (ROADMAP standing note), these CPU-side compile-level
 checks are the only guard on TPU behavior.
 
-This pass registers every jitted serving entrypoint — the four donated
-``EngineCore`` impls, the model forwards, the Pallas-backed ops (audited
+This pass registers every jitted serving entrypoint — the five donated
+``EngineCore`` impls (incl. the unified mixed prefill+decode dispatch),
+the model forwards, the Pallas-backed ops (audited
 through their XLA fallback lowerings on CPU) — and, per entrypoint and
 per config of a small representative matrix, extracts four fact
 families **without running any model math** (``jax.eval_shape`` /
@@ -340,7 +341,8 @@ def _tiny_engine_config(**kw):
 
 
 def _engine_entrypoints(tag: str, model_cfg, engine_cfg) -> list[Entrypoint]:
-    """The four donated EngineCore impls under one (model, engine)
+    """The donated EngineCore impls (step / multi-decode / spec-verify /
+    ragged-prefill / unified-mixed) under one (model, engine)
     config.  The core is built with shape-only params (eval_shape), so
     registration never materializes weights."""
     import jax
@@ -474,6 +476,54 @@ def _engine_entrypoints(tag: str, model_cfg, engine_cfg) -> list[Entrypoint]:
             donate_argnums=(1,),
             representatives=[
                 dict(t_bucket=t_axis[-1], r_pad=r_axis[-1],
+                     prefix_blocks=0),
+            ],
+            upcast_min_elems=min_elems,
+        ))
+
+    if cfg.unified_token_dispatch and cfg.prefill_token_budget > 0 and \
+            getattr(model, "supports_unified_dispatch", False):
+        bs = cfg.block_size
+        # mirror engine _run_unified's flat-axis math exactly: a STATIC
+        # decode region leads the axis, prefill spans pack the remainder
+        d_region = -(-b // bs) * bs
+        pf_budget = max(bs, cfg.prefill_token_budget - d_region)
+        pf_budget = min(pf_budget, cfg.max_model_len - d_region)
+        t_lo = cfg.bucket_for(d_region + bs)
+        t_hi = cfg.bucket_for(d_region + pf_budget)
+        tu_axis = [t for t in cfg.prefill_buckets if t_lo <= t <= t_hi]
+        ru_axis = [r for r in _pow2s_upto(1 << max(0, (b - 1).bit_length()))
+                   if r >= 2]  # a mixed dispatch has >= 2 rows
+
+        def build_unified(t_bucket, r_pad, prefix_blocks):
+            # pow2ceil(r_real) == r_pad needs more rows than the slots
+            # can supply, or no block-wide span fits past the region
+            min_rows = r_pad // 2 + 1 if r_pad > 1 else 1
+            if min_rows > b or (t_bucket - d_region) // bs < 1:
+                return None
+            args = (params, cache,
+                    _sds((1, t_bucket), i32), _sds((1, t_bucket), i32),
+                    _sds((r_pad, m), i32), _sds((r_pad,), i32),
+                    _sds((1, t_bucket), i32), _sds((1, t_bucket), i32),
+                    _sds((r_pad,), i32), _sds((r_pad,), i32),
+                    _sds((r_pad,), i32), rng,
+                    _sds((r_pad,), f32), _sds((r_pad,), i32),
+                    _sds((r_pad,), f32))
+            return Signature(
+                f"t={t_bucket},r={r_pad},pb={prefix_blocks}", args,
+                dict(row_tokens=d_region, prefix_blocks=prefix_blocks,
+                     k_cand=K_MAX, exact=False),
+            )
+
+        eps.append(Entrypoint(
+            name=f"engine.unified[{tag}]",
+            axes={"t_bucket": tu_axis, "r_pad": ru_axis,
+                  "prefix_blocks": pb_axis},
+            build=build_unified,
+            jit_fn=core._unified_fn, raw_fn=core._unified_impl,
+            donate_argnums=(1,),
+            representatives=[
+                dict(t_bucket=tu_axis[-1], r_pad=ru_axis[-1],
                      prefix_blocks=0),
             ],
             upcast_min_elems=min_elems,
@@ -706,11 +756,16 @@ def build_registry() -> list[Entrypoint]:
     eps += _engine_entrypoints(
         "tiny-llama", tiny,
         _tiny_engine_config(decode_steps=16, spec_tokens=2,
-                            prefill_token_budget=64),
+                            prefill_token_budget=64,
+                            unified_token_dispatch=True),
     )
     eps += _engine_entrypoints(
         "tiny-llama-int8", tiny,
-        _tiny_engine_config(cache_dtype="int8"),
+        # budget + unified on: the QuantKvCache pytree doubles the
+        # donated leaf count of the ragged AND unified impls, so their
+        # donation audit covers both cache layouts
+        _tiny_engine_config(cache_dtype="int8", prefill_token_budget=64,
+                            unified_token_dispatch=True),
     )
     eps.append(_llama_forward_entrypoint(
         "tiny-llama", tiny, num_blocks=64, block_size=8, batch=4,

@@ -167,6 +167,10 @@ def _build_local_engine(args) -> tuple[object, object]:
         # chunks into one dispatch (docs/engine_scheduling.md)
         prefill_token_budget=int(
             getattr(args, "prefill_token_budget", 0) or 0),
+        # unified mixed prefill+decode dispatch: one token-budget ragged
+        # step per turn when both phases have work
+        unified_token_dispatch=bool(
+            getattr(args, "unified_token_dispatch", False)),
     )
     draft = None
     dpath = getattr(args, "spec_draft_model", None)
@@ -885,6 +889,13 @@ def _parser() -> argparse.ArgumentParser:
                      "many tokens of several waiting prompts' chunks "
                      "into ONE dispatch (0 = one request per dispatch); "
                      "see docs/engine_scheduling.md")
+    run.add_argument("--unified-token-dispatch", action="store_true",
+                     help="unified mixed prefill+decode dispatch: when "
+                     "both phases have work, run ONE token-budget "
+                     "ragged step per turn (decode rows lead the flat "
+                     "axis, prefill chunks pack the remaining "
+                     "--prefill-token-budget, which defaults to 1024 "
+                     "when unset); see docs/engine_scheduling.md")
     run.add_argument("--nnodes", type=int, default=1,
                      help="worker processes forming ONE mesh (multi-host)")
     run.add_argument("--node-rank", type=int, default=0)

@@ -43,6 +43,17 @@ class EngineConfig:
     # per-dispatch prefill.  Rounded down to a block multiple; capped at
     # max_model_len (the largest prefill bucket).
     prefill_token_budget: int = 0
+    # unified mixed prefill+decode dispatch: when BOTH phases have work,
+    # run ONE token-budget ragged step per turn — decode rows (1 token
+    # each) lead the flat axis, waiting prefill chunks pack into the
+    # remaining prefill_token_budget.  Replaces the chunked-prefill
+    # alternation (one device round-trip per phase switch) with a single
+    # dispatch per turn; decode-only turns keep the multi-step burst and
+    # prefill-only turns the ragged batch.  Requires a model with the
+    # ragged forward path; prefill_token_budget defaults on when unset.
+    # Default off until parity-gated (tests/test_unified_dispatch.py
+    # pins seeded-stream parity vs the legacy paths).
+    unified_token_dispatch: bool = False
     # decode burst length while prefill work is pending (admitted/waiting
     # requests or a mid-prefill slot).  Long bursts amortise dispatch
     # overhead but make a freshly-arrived prompt wait a whole burst
@@ -109,6 +120,11 @@ class EngineConfig:
                 self.block_size,
                 self.prefill_chunk_tokens // self.block_size * self.block_size,
             )
+        if self.unified_token_dispatch and not self.prefill_token_budget:
+            # the unified scheduler packs under prefill_token_budget; a
+            # bare --unified-token-dispatch gets a sensible default
+            # rather than silently staying on the legacy paths
+            self.prefill_token_budget = min(1024, self.max_model_len)
         if self.prefill_token_budget:
             # block-align (spans in the packed axis are block multiples)
             # and cap at the largest prefill bucket — bucket_for pads the

@@ -18,6 +18,12 @@ iteration (keeps decode ITL bounded), otherwise run one decode step for all
 running slots.  Prefix-cache hits shorten prefill via the block manager
 (lib/llm/src/kv/manager.rs:31 prepare_prefill_sequence analogue).
 
+With ``unified_token_dispatch`` the prefill/decode alternation collapses:
+a turn with work in both phases runs ONE token-budget ragged dispatch
+(``_run_unified`` / ``_unified_fn``) — decode rows lead the flat axis as
+1-token chunks, prefill spans pack the remainder — so the per-switch
+device round-trip disappears (docs/engine_scheduling.md).
+
 Thread-safety: everything here runs on the engine thread; submit()/abort()
 are the only cross-thread entry points and only touch thread-safe queues.
 """
@@ -52,7 +58,7 @@ from dynamo_tpu.tokens import TokenBlockSequence
 log = logging.getLogger("dynamo_tpu.engine")
 
 __all__ = ["EngineCore", "unified_step", "multi_decode_step",
-           "ragged_prefill_step"]
+           "ragged_prefill_step", "unified_token_step"]
 
 
 def unified_step(
@@ -220,6 +226,52 @@ def ragged_prefill_step(
     return out, cache
 
 
+def unified_token_step(
+    model, params, cache, tokens, positions, block_tables, seq_lens,
+    slot_idx, seq_ids, seq_starts, row_offsets, last_idx, rng, temp, top_k,
+    top_p, pen_tokens=None, pen_first=None, freq_pen=None, pres_pen=None,
+    *, row_tokens=0, prefix_blocks=0, k_cand=K_MAX, exact=False,
+    grammar=None, jrows=None, jstate=None, jdepth=None, jstack=None,
+    min_p=None, bias_tokens=None, bias_vals=None, seeds=None,
+    seed_rows=None,
+):
+    """Unified mixed prefill+decode step: ONE forward over a flat packed
+    token axis whose first ``row_tokens`` slots hold DECODE rows (one
+    fresh token each, written to the cache per row — their in-block
+    offsets are arbitrary) and whose remainder holds block-aligned
+    prefill chunk spans.  Decode rows are just 1-token chunks to the
+    ragged attention: their ``start`` is the full cached context, the
+    per-row prefix gather/DMA covers it, and the positionally-exact
+    prefix mask handles the partially-filled tail block.
+
+    Per-row sampling preserves the legacy paths' semantics: decode rows
+    and final-chunk prefill rows sample (grammar masks, per-request
+    seeds folded on the absolute position ``seq_lens``, penalties over
+    the host-built generated-token buffers, logit bias, min_p,
+    top_logprobs candidates); mid-chunk rows sample garbage the host
+    discards.  Seeded/greedy rows are therefore bit-identical to the
+    decode-burst and ragged-prefill dispatches they replace
+    (tests/test_unified_dispatch.py pins this).
+    """
+    hidden, cache = model.forward(
+        params, tokens, positions, cache, block_tables, seq_lens, slot_idx,
+        prefix_blocks=prefix_blocks,
+        ragged=(seq_ids, seq_starts, row_offsets),
+        ragged_row_tokens=row_tokens,
+    )
+    last_h = hidden[0, last_idx]  # [R, Dm] — flat-axis gather per row
+    logits = model.compute_logits(params, last_h)  # [R, V] f32
+    if grammar is not None:
+        logits = grammar_mask(logits, grammar, jrows, jstate, jdepth, jstack)
+    out = sample_full(logits, rng, temp, top_k, top_p,
+                      pen_tokens, pen_first, freq_pen, pres_pen,
+                      bias_tokens=bias_tokens, bias_vals=bias_vals,
+                      min_p=min_p, seeds=seeds, seed_rows=seed_rows,
+                      seed_steps=(seq_lens if seeds is not None else None),
+                      k_cand=k_cand, exact=exact)
+    return out, cache
+
+
 class EngineCore:
     def __init__(
         self,
@@ -354,6 +406,13 @@ class EngineCore:
             self._ragged_impl, donate_argnums=(1,),
             static_argnames=("prefix_blocks", "k_cand", "exact"),
         )
+        # the fifth donated serving impl: unified mixed prefill+decode
+        # dispatch (decode rows + prefill spans on one flat token axis)
+        self._unified_fn = jax.jit(
+            self._unified_impl, donate_argnums=(1,),
+            static_argnames=("row_tokens", "prefix_blocks", "k_cand",
+                             "exact"),
+        )
         # sequence-parallel long-prefill (ring attention over the "data"
         # axis): one dispatch computes the whole prompt with the sequence
         # sharded across the mesh — SURVEY §5 long-context path
@@ -412,6 +471,12 @@ class EngineCore:
         self.spec_steps = 0              # speculative verify dispatches
         self.spec_proposed = 0           # tokens proposed by n-gram lookup
         self.spec_accepted = 0           # proposals the model agreed with
+        # unified mixed prefill+decode dispatch (unified_token_dispatch)
+        self.unified_dispatches = 0      # mixed dispatches issued
+        self.unified_decode_rows = 0     # decode rows packed over them
+        self.unified_prefill_tokens = 0  # prefill tokens packed over them
+        self.unified_budget_offered = 0  # flat-axis budget offered
+        self.unified_budget_used = 0     # decode rows + prefill tokens
         self._last_was_prefill = False
 
     # ----------------------------------------------------------- step kernel
@@ -439,6 +504,25 @@ class EngineCore:
             self.model, params, cache, tokens, positions, block_tables,
             seq_lens, slot_idx, seq_ids, seq_starts, row_offsets, last_idx,
             rng, temp, top_k, top_p, prefix_blocks=prefix_blocks,
+            k_cand=k_cand, exact=exact, grammar=grammar, jrows=jrows,
+            jstate=jstate, jdepth=jdepth, jstack=jstack, min_p=min_p,
+            bias_tokens=bias_tokens, bias_vals=bias_vals, seeds=seeds,
+            seed_rows=seed_rows)
+
+    def _unified_impl(self, params, cache, tokens, positions, block_tables,
+                      seq_lens, slot_idx, seq_ids, seq_starts, row_offsets,
+                      last_idx, rng, temp, top_k, top_p, *, row_tokens=0,
+                      prefix_blocks=0, k_cand=K_MAX, exact=False,
+                      grammar=None, jrows=None, jstate=None, jdepth=None,
+                      jstack=None, min_p=None, bias_tokens=None,
+                      bias_vals=None, seeds=None, seed_rows=None,
+                      pen_tokens=None, pen_first=None, freq_pen=None,
+                      pres_pen=None):
+        return unified_token_step(
+            self.model, params, cache, tokens, positions, block_tables,
+            seq_lens, slot_idx, seq_ids, seq_starts, row_offsets, last_idx,
+            rng, temp, top_k, top_p, pen_tokens, pen_first, freq_pen,
+            pres_pen, row_tokens=row_tokens, prefix_blocks=prefix_blocks,
             k_cand=k_cand, exact=exact, grammar=grammar, jrows=jrows,
             jstate=jstate, jdepth=jdepth, jstack=jstack, min_p=min_p,
             bias_tokens=bias_tokens, bias_vals=bias_vals, seeds=seeds,
@@ -890,6 +974,14 @@ class EngineCore:
                 self.prefill_budget_used / self.prefill_budget_offered
                 if self.prefill_budget_offered else 0.0
             ),
+            # unified mixed prefill+decode dispatch
+            "unified_dispatches_total": self.unified_dispatches,
+            "unified_decode_rows": self.unified_decode_rows,
+            "unified_prefill_tokens": self.unified_prefill_tokens,
+            "unified_budget_utilization": (
+                self.unified_budget_used / self.unified_budget_offered
+                if self.unified_budget_offered else 0.0
+            ),
         }
         if self.host_pool is not None:
             out.update(self.host_pool.stats())
@@ -922,6 +1014,11 @@ class EngineCore:
         decoding = any(
             r is not None and r.state is RequestState.RUNNING for r in self.slots
         )
+        if self._unified_enabled():
+            # unified token-budget scheduler: a mixed turn is ONE ragged
+            # dispatch (decode rows + prefill spans on one flat axis) —
+            # no alternation state machine, no per-switch round-trip
+            return self._step_unified(ready, decoding)
         # chunked-prefill interleave: when both phases have work, alternate
         # one prefill turn (one chunk, or one ragged token-budget batch)
         # with one decode burst so admissions never stall the decoders for
@@ -940,6 +1037,35 @@ class EngineCore:
             return True
         if decoding:
             self._last_was_prefill = False
+            self._run_decode()
+            return True
+        return False
+
+    def _unified_enabled(self) -> bool:
+        return (
+            self.config.unified_token_dispatch
+            and self.config.prefill_token_budget > 0
+            and getattr(self.model, "supports_unified_dispatch", False)
+        )
+
+    def _step_unified(self, ready: list[EngineRequest], decoding: bool
+                      ) -> bool:
+        """One turn of the unified token-budget scheduler: mixed work
+        runs as ONE dispatch via :meth:`_run_unified`; pure-prefill turns
+        keep the ragged token-budget batch and pure-decode turns keep the
+        multi-step burst (its scan amortisation and the speculative path
+        only make sense with no prefill sharing the axis)."""
+        if ready and self._sp_eligible(ready[0]):
+            # seq-parallel long prompts keep their dedicated dispatch
+            self._run_sp_prefill(ready[0])
+            return True
+        ready = [r for r in ready if not self._sp_eligible(r)]
+        if ready and decoding and self._run_unified(ready):
+            return True
+        if ready:
+            self._dispatch_prefill(ready)
+            return True
+        if decoding:
             self._run_decode()
             return True
         return False
@@ -1389,6 +1515,234 @@ class EngineCore:
             return
         self._append_token(req, int(sampled[0]), first=True,
                            logprob=float(lps[0]), cand=(cids[0], clps[0]))
+
+    # ------------------------------------------- unified mixed dispatch
+    def _run_unified(self, ready: list[EngineRequest]) -> bool:
+        """ONE mixed dispatch for this turn: every RUNNING slot
+        contributes a decode row (1 fresh token) on the leading
+        row-scatter region of the flat axis, then the READY prefill
+        chunks pack block-aligned spans into the remaining token budget.
+        The legacy interleave's two dispatches per mixed turn (decode
+        burst + prefill turn, with a device round-trip between) collapse
+        to one — chunked-prefill-under-decode co-scheduling falls out of
+        the layout.  Returns False when no decode row is dispatchable
+        or no prefill chunk fits (the caller falls back to a pure
+        prefill/decode turn)."""
+        cfg = self.config
+        bs = cfg.block_size
+        m = cfg.max_blocks_per_seq
+        # decode region: a STATIC block-multiple of the flat axis (one
+        # slot per batch slot), so the prefill spans after it stay
+        # block-aligned for the block-granular write and the executable
+        # count gains no new axis
+        d_region = -(-cfg.max_batch_size // bs) * bs
+        budget = max(bs, cfg.prefill_token_budget - d_region)
+        budget = min(budget, cfg.max_model_len - d_region)
+        if budget < bs:
+            return False  # flat axis cannot fit a span past the region
+
+        dec: list[EngineRequest] = []
+        for req in self.slots:
+            if req is None or req.state is not RequestState.RUNNING:
+                continue
+            if self._grow_blocks(req, 1) is None:
+                continue  # no slot for even the current token: LENGTH
+            dec.append(req)
+        if not dec:
+            return False
+
+        # prefill packing under the remaining budget (same selection as
+        # _run_prefill_batch)
+        sel: list[tuple[EngineRequest, int, bool]] = []
+        used = 0
+        for req in ready:
+            avail = budget - used
+            if avail < bs:
+                break
+            remaining = req.prompt_len - req.computed_tokens
+            chunk = cfg.prefill_chunk_tokens or remaining
+            take = min(remaining, chunk, avail)
+            if take < remaining:
+                take = take // bs * bs  # resumed chunks stay block-aligned
+                if take == 0:
+                    break
+            sel.append((req, take, take == remaining))
+            used += -(-take // bs) * bs  # span = block-rounded take
+        if not sel:
+            return False
+
+        n_dec = len(dec)
+        r_real = n_dec + len(sel)
+        r_pad = 1 << max(0, (r_real - 1).bit_length())
+        t_pad = cfg.bucket_for(d_region + used)
+        tokens = np.zeros((1, t_pad), np.int32)
+        positions = np.zeros((1, t_pad), np.int32)
+        slot_idx = np.full((1, t_pad), -1, np.int32)
+        seq_ids = np.full((1, t_pad), -1, np.int32)
+        bt = np.zeros((r_pad, m), np.int32)
+        seq_lens = np.zeros(r_pad, np.int32)
+        starts = np.zeros(r_pad, np.int32)
+        roff = np.zeros(r_pad, np.int32)
+        last_idx = np.zeros(r_pad, np.int32)
+        temp = np.zeros(r_pad, np.float32)
+        top_k = np.zeros(r_pad, np.int32)
+        top_p = np.ones(r_pad, np.float32)
+        max_pb = 0
+        for r, req in enumerate(dec):
+            p = req.seq.total_tokens - 1  # uncomputed tail position
+            tokens[0, r] = req.seq.tokens[-1]
+            positions[0, r] = p
+            slot_idx[0, r] = req.block_ids[p // bs] * bs + p % bs
+            seq_ids[0, r] = r
+            bt[r, : len(req.block_ids)] = req.block_ids
+            seq_lens[r] = p + 1
+            starts[r] = p  # full cached prefix; need NOT be block-aligned
+            roff[r] = r
+            last_idx[r] = r
+            temp[r] = req.sampling.temperature
+            top_k[r] = req.sampling.top_k
+            top_p[r] = req.sampling.top_p
+            max_pb = max(max_pb, -(-p // bs))
+        off = d_region
+        for j, (req, take, _final) in enumerate(sel):
+            r = n_dec + j
+            begin = req.computed_tokens
+            end = begin + take
+            tokens[0, off:off + take] = req.prompt[begin:end]
+            pos = np.arange(begin, end, dtype=np.int32)
+            positions[0, off:off + take] = pos
+            bt[r, : len(req.block_ids)] = req.block_ids
+            slot_idx[0, off:off + take] = bt[r, pos // bs] * bs + pos % bs
+            seq_ids[0, off:off + take] = r
+            seq_lens[r] = end
+            starts[r] = begin
+            roff[r] = off
+            last_idx[r] = off + take - 1
+            temp[r] = req.sampling.temperature
+            top_k[r] = req.sampling.top_k
+            top_p[r] = req.sampling.top_p
+            max_pb = max(max_pb, begin // bs)
+            off += -(-take // bs) * bs
+        pb = 0 if max_pb == 0 else 1 << (max_pb - 1).bit_length()
+        pb = min(pb, m)
+
+        # sampling rows: every decode row plus final-chunk prefill rows
+        # (mid-chunk rows' samples are discarded below)
+        samp = list(enumerate(dec)) + [
+            (n_dec + j, rq) for j, (rq, _, fin) in enumerate(sel) if fin
+        ]
+        samp_reqs = [rq for _, rq in samp]
+        k_cand, exact = self._sampling_mode(samp_reqs)
+        gram = None
+        if any(self._grammar_key(rq) for rq in samp_reqs) \
+                and self._ensure_grammar() is not None:
+            keys = self._dispatch_keys(samp_reqs)
+            offs = self._composite_for(keys)[1]
+            jrows = np.zeros(r_pad, bool)
+            jstate = np.full(r_pad, INIT_STATE, np.int32)
+            jdepth = np.zeros(r_pad, np.int32)
+            jstack = np.zeros(r_pad, np.int32)
+            for r, rq in samp:
+                key = self._grammar_key(rq)
+                if key is None:
+                    continue
+                jrows[r] = True
+                gs, gd, gk = rq.gstate
+                jstate[r] = gs + offs[key] if gs > 0 else gs
+                jdepth[r], jstack[r] = gd, gk
+            gram = (keys, jrows, jstate, jdepth, jstack)
+        extras = self._sampling_extras(
+            samp_reqs, rows=[r for r, _ in samp], b=r_pad)
+        extras.update(self._unified_penalties(samp, r_pad))
+
+        # growth allocations above may have evicted registered blocks
+        # that this very dispatch writes into — offload them first
+        self._drain_offload()
+        self._rng, rng = jax.random.split(self._rng)
+        gkw = self._gram_kwargs(gram)
+        gkw.update(extras)
+        up, gkw = self._upload_dispatch(
+            (tokens, positions, bt, seq_lens, slot_idx, seq_ids, starts,
+             roff, last_idx, temp, top_k, top_p), gkw)
+        out, self.cache = self._unified_fn(
+            self.params, self.cache, *up[:9], rng, *up[9:],
+            row_tokens=d_region, prefix_blocks=pb, k_cand=k_cand,
+            exact=exact, **gkw,
+        )
+        sampled, lps, cids, clps = jax.device_get(out)  # one batched pull
+        self.steps += 1
+        self.prefill_steps += 1
+        self.decode_steps += 1
+        take_sum = sum(take for _, take, _ in sel)
+        self.prompt_tokens_computed += take_sum
+        self.prefill_dispatches += 1
+        self.prefill_rows_dispatched += len(sel)
+        self.prefill_budget_offered += budget
+        self.prefill_budget_used += take_sum
+        self.unified_dispatches += 1
+        self.unified_decode_rows += n_dec
+        self.unified_prefill_tokens += take_sum
+        self.unified_budget_offered += cfg.prefill_token_budget
+        self.unified_budget_used += n_dec + take_sum
+        prefill_counters.record(rows=len(sel), tokens=take_sum,
+                                budget=budget)
+        prefill_counters.record_unified(
+            decode_rows=n_dec, prefill_tokens=take_sum,
+            budget=cfg.prefill_token_budget)
+
+        for r, req in enumerate(dec):
+            want_lp = req.sampling.logprobs or req.sampling.top_logprobs > 0
+            self._append_token(
+                req, int(sampled[r]),
+                logprob=float(lps[r]) if want_lp else None,
+                cand=(cids[r], clps[r]) if want_lp else None,
+            )
+        for j, (req, take, final) in enumerate(sel):
+            r = n_dec + j
+            req.computed_tokens += take
+            self._commit_prefill_blocks(req)
+            if final:
+                self._complete_prefill(
+                    req, sampled[r:r + 1], lps[r:r + 1],
+                    cids[r:r + 1], clps[r:r + 1],
+                )
+        return True
+
+    def _unified_penalties(self, samp, r_pad: int) -> dict:
+        """Penalty buffers for one unified dispatch, keyed by DISPATCH
+        row (cf. :meth:`_penalty_buffers`, which keys by slot): a
+        [R_pad, T] generated-token buffer + first-occurrence mask +
+        per-row strengths, rebuilt host-side each turn (single-step
+        dispatch — no on-device cursor to carry).  {} when no sampling
+        row uses penalties, so the common case compiles no extra
+        executables."""
+        users = [(r, rq) for r, rq in samp
+                 if rq.sampling.frequency_penalty
+                 or rq.sampling.presence_penalty]
+        if not users:
+            return {}
+        longest = max(rq.seq.total_tokens - rq.prompt_len
+                      for _, rq in users)
+        t_cap = max(16, 1 << max(0, longest - 1).bit_length())
+        t_cap = min(t_cap, max(
+            16, 1 << (self.config.max_model_len - 1).bit_length()))
+        ptoks = np.full((r_pad, t_cap), -1, np.int32)
+        pfirst = np.zeros((r_pad, t_cap), bool)
+        freq = np.zeros(r_pad, np.float32)
+        pres = np.zeros(r_pad, np.float32)
+        for r, rq in users:
+            gen = rq.seq.tokens[rq.prompt_len:]
+            n = min(len(gen), t_cap)
+            seen: set[int] = set()
+            for j, t in enumerate(gen[:n]):
+                ptoks[r, j] = t
+                if t not in seen:
+                    pfirst[r, j] = True
+                    seen.add(t)
+            freq[r] = rq.sampling.frequency_penalty
+            pres[r] = rq.sampling.presence_penalty
+        return dict(pen_tokens=ptoks, pen_first=pfirst,
+                    freq_pen=freq, pres_pen=pres)
 
     # ------------------------------------------------ seq-parallel prefill
     def _sp_eligible(self, req: EngineRequest) -> bool:

@@ -8,6 +8,16 @@ benchmarks read — no import cycles.  The HTTP metrics endpoint exposes:
     dynamo_tpu_engine_prefill_tokens_total         counter
     dynamo_tpu_engine_prefill_batch_occupancy      gauge (rows/dispatch)
     dynamo_tpu_engine_prefill_budget_utilization   gauge (used/offered)
+    dynamo_tpu_engine_unified_dispatches_total     counter
+    dynamo_tpu_engine_unified_decode_rows          counter
+    dynamo_tpu_engine_unified_prefill_tokens       counter
+    dynamo_tpu_engine_unified_budget_utilization   gauge (used/offered)
+
+The ``unified_*`` family counts the mixed prefill+decode dispatches of
+the unified token-budget scheduler (engine/core.py ``_run_unified``):
+how many turns collapsed the legacy two-dispatch interleave into one,
+how many decode rows and prefill tokens shared each flat axis, and how
+full the offered axis budget ran.
 """
 
 from __future__ import annotations
@@ -31,6 +41,26 @@ class PrefillCounters:
             self.budget_offered_total += budget
             self.budget_used_total += tokens
 
+    def record_unified(self, decode_rows: int, prefill_tokens: int,
+                       budget: int) -> None:
+        """One unified mixed dispatch: ``decode_rows`` 1-token decode
+        rows plus ``prefill_tokens`` prompt tokens packed on one flat
+        axis, under an offered budget of ``budget`` tokens."""
+        self.unified_dispatches_total += 1
+        self.unified_decode_rows_total += decode_rows
+        self.unified_prefill_tokens_total += prefill_tokens
+        self.unified_budget_offered_total += budget
+        self.unified_budget_used_total += decode_rows + prefill_tokens
+
+    @property
+    def unified_budget_utilization(self) -> float:
+        """(decode rows + prefill tokens) / budget offered over unified
+        dispatches."""
+        if not self.unified_budget_offered_total:
+            return 0.0
+        return (self.unified_budget_used_total
+                / self.unified_budget_offered_total)
+
     @property
     def batch_occupancy(self) -> float:
         """Mean sequences per prefill dispatch (lifetime)."""
@@ -52,6 +82,11 @@ class PrefillCounters:
         self.tokens_total = 0
         self.budget_offered_total = 0
         self.budget_used_total = 0
+        self.unified_dispatches_total = 0
+        self.unified_decode_rows_total = 0
+        self.unified_prefill_tokens_total = 0
+        self.unified_budget_offered_total = 0
+        self.unified_budget_used_total = 0
 
 
 counters = PrefillCounters()

@@ -120,6 +120,20 @@ class Metrics:
         lines.append(f"# TYPE {ENGINE_PREFIX}_prefill_budget_utilization gauge")
         lines.append(f"{ENGINE_PREFIX}_prefill_budget_utilization "
                      f"{round(prefill_counters.budget_utilization, 6)}")
+        # unified mixed prefill+decode dispatch: how many turns collapsed
+        # the two-dispatch interleave into one, and what shared the axis
+        lines.append(f"# TYPE {ENGINE_PREFIX}_unified_dispatches_total counter")
+        lines.append(f"{ENGINE_PREFIX}_unified_dispatches_total "
+                     f"{prefill_counters.unified_dispatches_total}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_unified_decode_rows counter")
+        lines.append(f"{ENGINE_PREFIX}_unified_decode_rows "
+                     f"{prefill_counters.unified_decode_rows_total}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_unified_prefill_tokens counter")
+        lines.append(f"{ENGINE_PREFIX}_unified_prefill_tokens "
+                     f"{prefill_counters.unified_prefill_tokens_total}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_unified_budget_utilization gauge")
+        lines.append(f"{ENGINE_PREFIX}_unified_budget_utilization "
+                     f"{round(prefill_counters.unified_budget_utilization, 6)}")
         return "\n".join(lines) + "\n"
 
 

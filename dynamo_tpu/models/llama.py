@@ -123,6 +123,10 @@ class LlamaModel:
     # gates the batched scheduler on this; models without the ragged
     # attention path — expanded-MLA DeepSeek — fall back to per-request)
     supports_ragged_prefill = True
+    # forward() additionally accepts the unified mixed layout (decode
+    # rows leading the flat axis via ``ragged_row_tokens``) — the engine
+    # gates the unified token-budget scheduler on this
+    supports_unified_dispatch = True
 
     def __init__(self, config: ModelConfig):
         self.config = config
@@ -353,6 +357,7 @@ class LlamaModel:
         slot_idx: jax.Array,      # [B, S] int32 — cache slot per new token, -1 pad
         prefix_blocks: int | None = None,  # STATIC — prefill fast path (see below)
         ragged: tuple | None = None,       # (seq_ids, starts, row_offsets)
+        ragged_row_tokens: int = 0,        # STATIC — unified mixed layout
     ) -> tuple[jax.Array, jax.Array]:
         """Returns (hidden [B,S,Dm], updated kv_cache).
 
@@ -370,6 +375,14 @@ class LlamaModel:
         give each row's absolute chunk start and flat offset, and
         ``block_tables``/``seq_lens`` are per-ROW ([R, M] / [R]) rather
         than per-batch-row.  Requires ``prefix_blocks`` to be set.
+
+        ``ragged_row_tokens`` (static) marks the unified mixed layout:
+        the first that-many flat tokens are DECODE rows — one fresh token
+        each, at an arbitrary (non-block-aligned) in-block cache slot —
+        so the KV write scatters them per row and only the block-aligned
+        prefill spans after them take the block-granular write.  The
+        ragged attention itself needs no change: its prefix mask is
+        positionally exact for any ``starts``.
         """
         cfg = self.config
         b, s = tokens.shape
@@ -405,6 +418,7 @@ class LlamaModel:
             cache = write_kv_cache_layer(
                 cache, li, k, v, slot_idx,
                 block_aligned=fast_prefill or ragged_prefill,
+                row_tokens=ragged_row_tokens if ragged_prefill else 0,
             )
             if ragged_prefill:
                 seq_ids, seq_starts, row_offsets = ragged

@@ -279,15 +279,22 @@ def ragged_prefill_attention(
     logit_cap: float | None = None,
     window: int | None = None,
 ) -> jax.Array:
-    """Multi-sequence ragged prefill attention over one flat token axis.
+    """Mixed-chunk ragged attention over one flat token axis — the
+    unified prefill+decode kernel oracle.
 
-    The token-budget scheduler packs several sequences' prefill chunks
-    onto a single [T] axis (each chunk a contiguous, block-aligned span);
-    ``seq_ids`` names each token's owner.  Fresh-fresh attention is causal
-    *within* a sequence — flat order equals position order inside a span,
-    so the mask is seq-equality plus flat-index causality — and tokens
-    never see another sequence.  Fresh-prefix attention gathers each ROW's
-    own cached-prefix blocks and masks slots past that row's ``start``.
+    The token-budget scheduler packs several sequences' chunks onto a
+    single [T] axis; ``seq_ids`` names each token's owner.  A row may be
+    a *prefill chunk* (L contiguous tokens, ``start`` block-aligned) or a
+    *decode row* (1 fresh token at ``start = context − 1``, which need
+    NOT be block-aligned: the prefix mask is positionally exact, so the
+    partially-filled tail block simply contributes ``start % Bs`` visible
+    slots).  Fresh-fresh attention is causal *within* a sequence — flat
+    order equals position order inside a span, so the mask is
+    seq-equality plus flat-index causality — and tokens never see
+    another sequence.  Fresh-prefix attention gathers each ROW's own
+    cached-prefix blocks and masks slots at/past that row's ``start``
+    (for a decode row that is its full cached context, so
+    ``prefix_blocks`` must cover ``ceil(start / Bs)`` blocks).
 
     This is the pure-JAX oracle (CPU tests, XLA fallback); the per-token
     prefix gather materialises [T, P*Bs] keys, which the Pallas kernel
@@ -389,8 +396,18 @@ def write_kv_cache_layer(
     slot_idx: jax.Array, # [B, S] int32  flat slot = block_id * Bs + offset; -1 = drop
     block_aligned: bool = False,  # STATIC: rows are Bs-groups, each group
                                   # contiguous from a block-leading slot
+    row_tokens: int = 0,  # STATIC: leading tokens written per-row (see below)
 ) -> jax.Array:
     """Scatter new K/V rows straight into the full multi-layer cache.
+
+    ``row_tokens`` (static) splits the S axis of a ``block_aligned``
+    write: the first ``row_tokens`` tokens take the per-row scatter path
+    (their slots may sit anywhere in a block) and only the remainder
+    takes the block-granular path.  This is the unified mixed-dispatch
+    layout: decode rows — one fresh token each at an arbitrary in-block
+    offset — lead the flat axis, block-aligned prefill spans follow, and
+    the big spans keep the fast write.  ``row_tokens`` must be a block
+    multiple so the aligned remainder starts on a span boundary.
 
     The cache is a scan carry: scattering into it (rather than slicing a
     per-layer view) lets XLA update the buffer in place — the whole-cache
@@ -412,6 +429,17 @@ def write_kv_cache_layer(
     indices — write-time quantization is what keeps every read path
     (decode kernel, prefill prefix, transfer) a plain rescale.
     """
+    if block_aligned and 0 < row_tokens < k_new.shape[1]:
+        cache = write_kv_cache_layer(
+            cache, layer, k_new[:, :row_tokens], v_new[:, :row_tokens],
+            slot_idx[:, :row_tokens], block_aligned=False,
+        )
+        return write_kv_cache_layer(
+            cache, layer, k_new[:, row_tokens:], v_new[:, row_tokens:],
+            slot_idx[:, row_tokens:], block_aligned=True,
+        )
+    if block_aligned and row_tokens >= k_new.shape[1]:
+        block_aligned = False  # everything is row-path tokens
     if is_quant(cache):
         b, s, hk, d = k_new.shape
         kq, ks = quantize_kv_rows(k_new)

@@ -323,8 +323,14 @@ def paged_prefill_attention(
 
 
 # --------------------------------------------------------- ragged prefill
-# Token-budget batched prefill: several sequences' chunks packed onto ONE
-# flat token axis (each chunk a contiguous block-aligned span).  The grid
+# Token-budget batched attention over ONE flat token axis holding several
+# sequences' chunks.  A row may be a prefill chunk (a contiguous
+# block-aligned span) or — in the engine's unified mixed dispatch — a
+# DECODE row: one fresh token whose `start` (= context − 1) is NOT
+# block-aligned; the per-row prefix DMA streams ceil(start / (C·Bs))
+# chunks and the `col < prefix` mask is positionally exact, so the
+# partially-filled tail block contributes exactly its resident slots.
+# The grid
 # walks flat query tiles; a tile may straddle sequences, so row membership
 # is derived in-kernel from the span table (row_offsets/row_ends in SMEM)
 # instead of a seq_ids vector — 1-D vector gathers are hostile on TPU,
@@ -559,8 +565,10 @@ def ragged_paged_prefill_attention(
     blocks_per_chunk: int = 8,
     interpret: bool = False,
 ) -> jax.Array:
-    """Flash ragged prefill: T packed fresh tokens of up to R sequences
-    against fresh K/V + each row's own cached prefix.  Returns
+    """Flash ragged (mixed-chunk) attention: T packed fresh tokens of up
+    to R sequences against fresh K/V + each row's own cached prefix.
+    Rows may be prefill chunks or 1-token decode rows (``starts`` need
+    not be block-aligned — see the module comment).  Returns
     [1, T, H, D]."""
     from dynamo_tpu.ops.kv_quant import is_quant
 

@@ -63,6 +63,11 @@ _TIME_BUDGET_S = float(os.environ.get("DYNAMO_TEST_TIME_BUDGET", "20"))
 # The keepers' worst in-suite calls that same run: test_engine_soak.py
 # 29.5s, test_sampling_extras.py 29.2s, test_spec_decode.py 23.8s,
 # test_serve_bench.py 19.3s (within 4% of the budget — not "under").
+# PR 7 full-run re-check (--durations=0, 503 passed, 972s): none of the
+# four prunable — test_spec_decode.py 35.7s, test_engine_soak.py 30.3s,
+# test_sampling_extras.py 20.2s (still over), test_serve_bench.py 19.1s
+# (within 5% of the budget — run-to-run jitter would make a prune
+# flaky-fail tier-1).
 _TIME_BUDGET_GRANDFATHERED_FILES = {
     "test_engine_soak.py",
     "test_sampling_extras.py",

@@ -130,9 +130,13 @@ def test_registry_covers_the_donated_engine_impls(real_facts):
     families = {n.split("[")[0] for n in donated}
     assert families >= {
         "engine.step", "engine.decode_multi", "engine.spec_verify",
-        "engine.prefill_ragged", "engine.draft_propose",
+        "engine.prefill_ragged", "engine.unified", "engine.draft_propose",
         "ops.scatter_blocks_inplace",
     }
+    # the unified mixed dispatch is audited on BOTH cache layouts (the
+    # QuantKvCache pytree doubles its donated leaf count)
+    assert {"engine.unified[tiny-llama]",
+            "engine.unified[tiny-llama-int8]"} <= set(donated)
     for name, f in donated.items():
         don = f["donation"]
         assert don["aliased_leaves"] == don["donated_leaves"], name
@@ -472,6 +476,67 @@ def test_seeded_run_ragged_and_spec_once():
     assert core._step_fn._cache_size() == 0  # batching replaced it
 
 
+def test_seeded_run_unified_once():
+    """Census proof for the fifth donated impl: a seeded mixed
+    prefill+decode workload compiles the unified dispatch exactly once
+    for its single touched (t, r, pb) bucket, and an identical second
+    run triggers ZERO further compile events — no latent retrace in the
+    mixed hot loop."""
+    import jax._src.monitoring as monitoring
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.engine.request import EngineRequest
+    from dynamo_tpu.llm.protocols import SamplingOptions, StopConditions
+
+    model, params = _runtime_model()
+
+    def drive(core):
+        outs = []
+        # A reaches decode first; B arrives while A decodes, so the
+        # turn that prefills B is a mixed one — the unified dispatch
+        core.submit(EngineRequest(
+            "a", list(range(1, 9)), SamplingOptions(temperature=0.0),
+            StopConditions(max_tokens=8), outs.append))
+        for _ in range(3):
+            core.step()
+        core.submit(EngineRequest(
+            "b", list(range(2, 14)), SamplingOptions(temperature=0.0),
+            StopConditions(max_tokens=4), outs.append))
+        for _ in range(64):
+            if not core.step():
+                break
+        return outs
+
+    core = EngineCore(model, params, EngineConfig(
+        max_batch_size=2, max_model_len=64, block_size=8, num_blocks=32,
+        prefill_buckets=[16, 32, 64], prefill_token_budget=32,
+        unified_token_dispatch=True, seed=0,
+        # prefix reuse off: the rerun must replay a bit-identical
+        # dispatch stream (cached prefixes would change the pb buckets)
+        enable_prefix_reuse=False,
+    ))
+    drive(core)
+    assert core.unified_dispatches >= 1
+    assert core._unified_fn._cache_size() == 1
+
+    compile_events = []
+
+    def listener(name, **kw):
+        if "compile" in name:
+            compile_events.append(name)
+
+    jax.monitoring.register_event_listener(listener)
+    try:
+        drive(core)  # identical seeded workload, fresh requests
+    finally:
+        monitoring._unregister_event_listener_by_callback(listener)
+    assert compile_events == [], (
+        f"second identical run recompiled: {compile_events}"
+    )
+    assert core._unified_fn._cache_size() == 1
+
+
 def test_runtime_buckets_are_declared_in_manifest():
     """Cross-plane check: the buckets the seeded runs exercise are
     inside the committed census axes for the matching entrypoints."""
@@ -483,3 +548,6 @@ def test_runtime_buckets_are_declared_in_manifest():
     assert multi["n_signatures"] == len(multi["axes"]["num_steps"])
     ragged_axes = eps["engine.prefill_ragged[tiny-llama]"]["axes"]
     assert 32 in ragged_axes["t_bucket"]
+    uni_axes = eps["engine.unified[tiny-llama]"]["axes"]
+    assert 32 in uni_axes["t_bucket"]
+    assert 2 in uni_axes["r_pad"]
