@@ -112,7 +112,10 @@ async def prefill_dispatch_stats(url):
         for key in ("prefill_dispatches_total", "prefill_tokens_total",
                     "prefill_batch_occupancy", "prefill_budget_utilization",
                     "unified_dispatches_total", "unified_decode_rows",
-                    "unified_prefill_tokens", "unified_budget_utilization"):
+                    "unified_prefill_tokens", "unified_budget_utilization",
+                    "persist_hits_total", "persist_misses_total",
+                    "persist_restored_tokens_total",
+                    "persist_spill_bytes_total", "persist_resident_bytes"):
             if line.startswith(f"dynamo_tpu_engine_{key} "):
                 vals[key] = float(line.rsplit(" ", 1)[-1])
     dispatches = vals.get("prefill_dispatches_total", 0)
@@ -138,6 +141,23 @@ async def prefill_dispatch_stats(url):
                 vals.get("unified_prefill_tokens", 0) / unified, 1),
             "unified_budget_utilization": vals.get(
                 "unified_budget_utilization", 0.0),
+        })
+    phits = vals.get("persist_hits_total", 0)
+    pmiss = vals.get("persist_misses_total", 0)
+    if phits or pmiss or vals.get("persist_resident_bytes", 0):
+        # persistent prefix-cache tier engaged (--kv-persist-dir): how
+        # many probed block groups restored from disk instead of being
+        # re-prefilled, and the store's current footprint
+        out.update({
+            "persist_hits": int(phits),
+            "persist_hit_rate": round(phits / (phits + pmiss), 4)
+            if (phits + pmiss) else 0.0,
+            "persist_restored_tokens": int(
+                vals.get("persist_restored_tokens_total", 0)),
+            "persist_spill_bytes": int(
+                vals.get("persist_spill_bytes_total", 0)),
+            "persist_resident_bytes": int(
+                vals.get("persist_resident_bytes", 0)),
         })
     return out
 

@@ -18,6 +18,9 @@ noqa machinery:
          wait_closed outside finally; transport teardown never awaited)
   DT008  task spawn site with no reachable cancel/drain on any
          shutdown-path method (close/stop/shutdown/drain/...)
+  DT009  blocking file I/O reachable from an async function through a
+         sync call chain (no asyncio.to_thread / run_in_executor) —
+         the interprocedural complement of per-file DT003
 
 Exposed as ``dynamo-tpu lint --project`` with the same JSON / baseline /
 exit-code contract as the per-file pass.  Parsing is shared with the
@@ -80,6 +83,18 @@ GENERIC_ATTRS = frozenset({
     "release", "flush", "sleep", "gather", "result", "done", "values",
     "items", "keys", "open", "wait", "setdefault", "extend", "copy",
     "encode", "decode", "format", "split", "strip", "sort",
+    # step: engine loop / decode-stream / policy all expose one;
+    # __init__: obj.__init__() would alias every constructor in the tree
+    "step", "__init__",
+})
+
+# blocking file-I/O primitives (DT009 sinks): dotted calls that open or
+# flush a file, plus the pathlib whole-file convenience methods (attr
+# calls).  `.open()` the attr is deliberately absent — too many non-file
+# objects expose an open() method (stores, pools, devices).
+FILE_IO_CALLS = frozenset({"open", "io.open", "os.fsync"})
+FILE_IO_ATTRS = frozenset({
+    "read_bytes", "write_bytes", "read_text", "write_text",
 })
 
 SHUTDOWN_METHOD_NAMES = frozenset({
@@ -997,6 +1012,90 @@ class SpawnWithoutShutdownDrain(ProjectRule):
                     ):
                         return True
         return False
+
+
+@register_project
+class BlockingFileIoFromAsync(ProjectRule):
+    """DT009 — blocking file I/O reachable from an async function
+    through a sync call chain.  The per-file pass (DT003) catches
+    ``open()`` written directly inside an ``async def``; it cannot see
+    an ``open()`` hiding one sync call away — the event loop stalls just
+    the same (a slow disk or an fsync under a busy page cache holds
+    every connection sharing the loop).  The fix is the coordinator's
+    blob-I/O idiom: push the sync helper through ``asyncio.to_thread``
+    or ``run_in_executor``.  Handing the helper to an executor passes it
+    as an *argument*, not a call, so the blessed pattern creates no
+    call edge and discharges naturally.  Async callees are not carriers:
+    awaiting one suspends rather than blocks, and direct I/O in an
+    async body is DT003's finding, not ours."""
+
+    code = "DT009"
+    name = "blocking-file-io-from-async"
+    summary = (
+        "async function calls a sync helper that performs blocking file "
+        "I/O (open/read/write/fsync) without to_thread/run_in_executor"
+    )
+
+    @staticmethod
+    def _direct_io(fn: FunctionInfo) -> Optional[str]:
+        for site in fn.calls:
+            if site.kind == "dotted" and site.name in FILE_IO_CALLS:
+                return f"{site.name}()"
+            if site.kind in ("attr", "self") and site.name in FILE_IO_ATTRS:
+                return f".{site.name}()"
+        return None
+
+    def _io_reachers(self, index: ProjectIndex) -> dict[str, str]:
+        """qualname -> leaf-sink description, for sync functions that do
+        (or transitively reach, through sync calls only) blocking file
+        I/O — the same reverse fixpoint as ProjectIndex.net."""
+        io: dict[str, str] = {}
+        for q, f in index.functions.items():
+            if f.is_async:
+                continue
+            desc = self._direct_io(f)
+            if desc:
+                io[q] = desc
+        changed = True
+        while changed:
+            changed = False
+            for q, f in index.functions.items():
+                if f.is_async or q in io:
+                    continue
+                for site in f.calls:
+                    hit = next(
+                        (t for t in index.resolve(site, f)
+                         if not t.is_async and t.qualname in io),
+                        None,
+                    )
+                    if hit is not None:
+                        io[q] = io[hit.qualname]
+                        changed = True
+                        break
+        return io
+
+    def check(self, index: ProjectIndex) -> Iterable[Finding]:
+        io = self._io_reachers(index)
+        for fn in index.functions.values():
+            if not fn.is_async:
+                continue
+            ctx = index.modules[fn.module]
+            reported: set[str] = set()
+            for site in fn.calls:
+                for target in index.resolve(site, fn):
+                    if target.is_async or target.qualname not in io:
+                        continue
+                    if target.qualname in reported:
+                        continue
+                    reported.add(target.qualname)
+                    yield self.finding(
+                        ctx, site.node,
+                        f"async {fn.name}() calls "
+                        f"{_short(target.qualname)}() which does blocking "
+                        f"file I/O ({io[target.qualname]}) on the event "
+                        "loop — wrap the call in asyncio.to_thread or "
+                        "run_in_executor",
+                    )
 
 
 def _dotted_names(node: ast.AST) -> set[str]:

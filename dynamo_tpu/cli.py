@@ -152,6 +152,11 @@ def _build_local_engine(args) -> tuple[object, object]:
         block_size=args.block_size,
         num_blocks=args.num_blocks,
         num_host_blocks=int(getattr(args, "num_host_blocks", 0) or 0),
+        # persistent prefix-cache tier (llm/kv/persist.py): default off
+        kv_persist_dir=(getattr(args, "kv_persist_dir", None) or None),
+        kv_persist_max_bytes=int(
+            getattr(args, "kv_persist_max_bytes", 0) or 0),
+        kv_persist_ttl_s=float(getattr(args, "kv_persist_ttl", 0) or 0),
         cache_dtype=(
             "int8" if getattr(args, "kv_cache_dtype", "model") == "int8" else None
         ),
@@ -356,6 +361,16 @@ def _attach_worker_publishers(runtime, engine, namespace: str) -> None:
     # else ever holds a reference that can reach their stop() (dtsan leak)
     runtime.on_shutdown(events.stop)
     runtime.on_shutdown(metrics.stop)
+    # persistent tier replication: sync the content-addressed block store
+    # with the coordinator index (boot-time pull = planner scale-up
+    # pre-warm; periodic publish shares this worker's prefixes)
+    store = getattr(core, "persist_store", None)
+    if store is not None:
+        from dynamo_tpu.llm.kv.persist import PersistReplicator
+
+        replicator = PersistReplicator(runtime.coordinator, store, namespace)
+        replicator.start_soon()
+        runtime.on_shutdown(replicator.stop)
 
 
 # ------------------------------------------------------------------ serve -----
@@ -693,15 +708,17 @@ async def _cmd_planner(args) -> None:
     queue depth.  Dry-run by default (LogActuator); in-cluster scaling
     actuates through the operator, local scaling through the sdk
     supervisor (docs/planner.md)."""
+    from dynamo_tpu.llm.kv.persist import PrewarmActuator
     from dynamo_tpu.planner import LogActuator, PlannerConfig, PlannerLoop
     from dynamo_tpu.runtime.transports.coordinator import CoordinatorClient
 
     coord = await CoordinatorClient(
         args.coordinator or "tcp://127.0.0.1:6180"
     ).connect()
+    ns = args.namespace or "dynamo"
     loop = await PlannerLoop(
         coord,
-        namespace=args.namespace or "dynamo",
+        namespace=ns,
         config=PlannerConfig(
             queue_target_per_replica=args.target_per_replica,
             decode_target_usage=args.target_usage,
@@ -709,7 +726,10 @@ async def _cmd_planner(args) -> None:
         prefill_component=args.prefill_component,
         decode_component=args.decode_component,
         interval_s=args.interval,
-        actuators=(LogActuator(),),
+        # scale-ups also publish a persist pre-warm hint: fresh workers'
+        # PersistReplicators pull the shared KV store at boot instead of
+        # cold-starting (docs/kv_persistence.md)
+        actuators=(LogActuator(), PrewarmActuator(coord, ns)),
     ).start()
     log.info("planner loop on namespace %r — ctrl-c to stop", loop.namespace)
     await asyncio.Event().wait()
@@ -906,6 +926,19 @@ def _parser() -> argparse.ArgumentParser:
                      help="host-RAM KV offload tier (0 = disabled): "
                      "evicted device blocks park in host memory and "
                      "restore on prefix re-arrival")
+    run.add_argument("--kv-persist-dir", default=None,
+                     help="persistent prefix-cache tier (default off): "
+                     "directory for the content-addressed KV block store "
+                     "(llm/kv/persist.py).  Host-published blocks spill "
+                     "here; restarts and coordinator-replicated peers "
+                     "restore warm prefixes as cached_tokens.  Requires "
+                     "--num-host-blocks > 0")
+    run.add_argument("--kv-persist-max-bytes", type=int, default=0,
+                     help="size cap for --kv-persist-dir (LRU by "
+                     "last-touch; 0 = unbounded)")
+    run.add_argument("--kv-persist-ttl", type=float, default=0,
+                     help="TTL in seconds for persisted block groups "
+                     "since last touch (0 = no expiry)")
     run.add_argument("--max-tokens", type=int, default=128)
     run.add_argument("--host", default="127.0.0.1")
     run.add_argument("--http-port", type=int, default=8080)

@@ -29,6 +29,7 @@ Run via `dynamo-tpu api-store --db graphs.db --port 7180`.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import sqlite3
 import time
@@ -73,6 +74,12 @@ class ApiStore:
     def put_graph(self, name: str, spec: dict, labels: Optional[dict] = None) -> int:
         # the spec must render — reject broken uploads at the door
         render_manifests(self._to_spec(spec))
+        return self._insert_graph(name, spec, labels)
+
+    def _insert_graph(self, name: str, spec: dict,
+                      labels: Optional[dict] = None) -> int:
+        # sqlite connections are thread-bound: this must run on the
+        # thread that created self.db (the event loop thread)
         cur = self.db.execute(
             "SELECT COALESCE(MAX(version), 0) FROM graphs WHERE name = ?", (name,)
         )
@@ -134,6 +141,11 @@ class ApiStore:
     @staticmethod
     def _to_spec(spec: dict) -> DeploymentSpec:
         return DeploymentSpec.from_yaml(yaml.safe_dump(spec))
+
+    def _validate_spec(self, spec: dict) -> None:
+        """Blocking (template read_text): run via asyncio.to_thread from
+        handlers."""
+        render_manifests(self._to_spec(spec))
 
     # ------------------------------------------------------------- packages
     def put_package(self, archive: bytes) -> tuple[str, int]:
@@ -216,9 +228,13 @@ class ApiStore:
         if not isinstance(spec, dict) or "name" not in body:
             raise web.HTTPBadRequest(text="need {name, spec}")
         try:
-            version = self.put_graph(body["name"], spec, body.get("labels"))
+            # the render validation reads spec templates off disk
+            # (DeploymentSpec.from_yaml) — keep it off the event loop;
+            # the sqlite insert stays here (connections are thread-bound)
+            await asyncio.to_thread(self._validate_spec, spec)
         except (KeyError, ValueError, TypeError) as e:
             raise web.HTTPUnprocessableEntity(text=f"spec does not render: {e}")
+        version = self._insert_graph(body["name"], spec, body.get("labels"))
         return web.json_response({"name": body["name"], "version": version}, status=201)
 
     async def _list(self, request: web.Request) -> web.Response:
@@ -253,7 +269,8 @@ class ApiStore:
         )
         if g is None:
             raise web.HTTPNotFound
-        return web.json_response(render_manifests(self._to_spec(g["spec"])))
+        spec = await asyncio.to_thread(self._to_spec, g["spec"])
+        return web.json_response(render_manifests(spec))
 
     # ------------------------------------------------------- packages HTTP
     @staticmethod

@@ -88,6 +88,19 @@ class EngineConfig:
     # synchronously instead (each queued snapshot pins its blocks' HBM —
     # a burst of large evictions must not pin hundreds of MB)
     offload_inflight_blocks: int = 256
+    # persistent prefix-cache tier (llm/kv/persist.py): directory for the
+    # content-addressed block store.  None/"" = disabled (the default).
+    # Requires num_host_blocks > 0 — spill and restore both stage through
+    # the host pool.  Blocks published to the host pool spill here
+    # asynchronously; host-pool misses on admission fall through to this
+    # tier, so a restart (same dir) or a replicated index re-enters warm
+    # prefixes as cached_tokens.
+    kv_persist_dir: Optional[str] = None
+    # size cap for the persistent store (LRU by last-touch at block-group
+    # file granularity); 0 = unbounded
+    kv_persist_max_bytes: int = 0
+    # TTL for persisted block groups since last touch; 0 = no expiry
+    kv_persist_ttl_s: float = 0.0
     # KV cache dtype: None = model dtype; "int8" = quantized cache with
     # per-token-per-head scales (ops/kv_quant.py) — half the KV HBM
     # footprint and decode-step KV traffic

@@ -30,6 +30,7 @@ are the only cross-thread entry points and only touch thread-safe queues.
 
 from __future__ import annotations
 
+import collections
 import concurrent.futures
 import dataclasses
 import logging
@@ -363,6 +364,39 @@ class EngineCore:
                     target=self._offload_worker, name="kv-offload", daemon=True
                 )
                 self._offload_thread.start()
+
+        # persistent prefix-cache tier (llm/kv/persist.py): host-published
+        # blocks spill to a content-addressed disk store; host-pool misses
+        # on admission fall through to it, so warm prefixes survive worker
+        # restarts and replicate across workers via the coordinator index
+        self.persist_store = None
+        self._persist_events: "collections.deque" = collections.deque()
+        if config.kv_persist_dir:
+            if self.host_pool is None:
+                log.warning(
+                    "kv_persist_dir=%s ignored: the persistent tier stages "
+                    "through the host pool (set num_host_blocks > 0 and "
+                    "keep enable_prefix_reuse on)", config.kv_persist_dir,
+                )
+            else:
+                from dynamo_tpu.llm.kv.persist import PersistentKvStore
+
+                self.persist_store = PersistentKvStore(
+                    config.kv_persist_dir,
+                    generation=self._persist_generation(model, cache_dtype),
+                    max_bytes=config.kv_persist_max_bytes,
+                    ttl_s=config.kv_persist_ttl_s,
+                )
+                resident = self.persist_store.resident_hashes()
+                if resident:
+                    # announce what a restart found on disk, so the router
+                    # index learns this worker's persist tier once a
+                    # publisher attaches (events drain on the engine
+                    # thread each step)
+                    from dynamo_tpu.llm.kv.events import KvStoredEvent
+
+                    self._persist_events.append(
+                        KvStoredEvent(block_hashes=resident, tier="persist"))
 
         cache = model.init_kv_cache(config.num_blocks, config.block_size, cache_dtype)
         self._cache_specs = None
@@ -985,6 +1019,8 @@ class EngineCore:
         }
         if self.host_pool is not None:
             out.update(self.host_pool.stats())
+        if self.persist_store is not None:
+            out.update(self.persist_store.stats())
         return out
 
     # -------------------------------------------------------------- main loop
@@ -2243,6 +2279,72 @@ class EngineCore:
             self.block_manager.release(ids)
 
     # ------------------------------------------------------ host offload tier
+    @staticmethod
+    def _persist_generation(model, cache_dtype) -> str:
+        """Generation tag for the persistent KV tier: a stable hash of
+        everything that determines block-file layout and validity —
+        model architecture/dtype, cache dtype, block size.  Any change
+        opens a fresh store generation and invalidates the old one."""
+        import hashlib
+        import json as _json
+
+        mc = getattr(model, "config", None)
+        if mc is not None and hasattr(mc, "__dict__"):
+            ident = {k: repr(v) for k, v in sorted(vars(mc).items())}
+        else:
+            ident = {"model": repr(mc)}
+        ident["__cache_dtype"] = str(cache_dtype)
+        ident["__model_cls"] = type(model).__name__
+        blob = _json.dumps(ident, sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+    def _flush_persist_events(self) -> None:
+        """Forward queued persist-tier router events (engine thread only;
+        the kv-offload thread enqueues, this drains into the publisher's
+        sink which is not thread-safe)."""
+        if self.persist_store is None:
+            return
+        sink = self.block_manager.event_sink
+        while self._persist_events:
+            ev = self._persist_events.popleft()
+            if sink is not None:
+                sink(ev)
+
+    def _spill_to_persist(self, hashes: list[int], blocks) -> None:
+        """Mirror a host-pool store batch into the persistent tier (runs
+        on the kv-offload thread — fsync never blocks the engine loop)."""
+        from dynamo_tpu.llm.kv.events import KvRemovedEvent, KvStoredEvent
+
+        try:
+            wrote = self.persist_store.spill(hashes, blocks)
+        except Exception:  # pragma: no cover - disk full etc; tier degrades
+            log.exception("persist spill failed; tier continues without it")
+            return
+        if wrote:
+            self._persist_events.append(
+                KvStoredEvent(block_hashes=list(hashes), tier="persist"))
+        removed = self.persist_store.drain_removed()
+        if removed:
+            self._persist_events.append(
+                KvRemovedEvent(block_hashes=removed, tier="persist"))
+
+    def _promote_from_persist(self, hashes: list[int]) -> int:
+        """Load a persist-tier prefix host-side so the ordinary host-pool
+        restore picks it up; returns how many blocks were promoted."""
+        try:
+            phit = self.persist_store.match_prefix(hashes)
+            if not phit:
+                return 0
+            blocks = self.persist_store.load(phit)
+        except KeyError:
+            return 0  # raced an eviction / corrupt file — plain miss
+        except Exception:  # pragma: no cover - keep admission alive
+            log.exception("persist restore failed; treating as miss")
+            return 0
+        with self._offload_lock:
+            self.host_pool.store(phit, blocks)
+        return len(phit)
+
     def _drain_offload(self) -> None:
         """Offload just-evicted device blocks to the host pool.
 
@@ -2253,7 +2355,10 @@ class EngineCore:
         the kv-offload thread (the CopyStream analogue, kv/layer.rs:619),
         so a request's TTFT never includes another conversation's store.
         """
-        if self.host_pool is None or not self._pending_offload:
+        if self.host_pool is None:
+            return
+        self._flush_persist_events()
+        if not self._pending_offload:
             return
         pending, self._pending_offload = self._pending_offload, []
         with self._offload_lock:
@@ -2313,6 +2418,11 @@ class EngineCore:
             raise
         with self._offload_lock:
             self.host_pool.publish(hids, [hashes[r] for r in rows])
+        if self.persist_store is not None:
+            # write-through: published content spills to disk here on the
+            # offload thread, so a restart (or a replica pulling the
+            # coordinator index) can restore it
+            self._spill_to_persist(hashes, blocks)
 
     def _offload_worker(self) -> None:
         while True:
@@ -2357,25 +2467,46 @@ class EngineCore:
             self._offload_q.put(None)
             t.join(timeout=30.0)
         self._offload_thread = None
+        if getattr(self, "persist_store", None) is not None:
+            self.persist_store.close()
 
     def _restore_from_host(self, req: EngineRequest) -> None:
         """Upload host-resident prefix blocks into the request's fresh
         device blocks, register them, and extend the cached prefix —
         turning a device cache miss into a host hit (TTFT win, ref
-        docs/architecture.md:87-93)."""
+        docs/architecture.md:87-93).  Host-pool misses fall through to
+        the persistent tier (llm/kv/persist.py): matched blocks are
+        promoted host-side first, then ride the same gather/scatter/
+        commit path, so a restored prefix is indistinguishable from a
+        warm host hit downstream."""
+        from dynamo_tpu.engine.counters import persist_counters
+
         bs = self.config.block_size
         dev = req.cached_tokens // bs
         max_blocks = (req.prompt_len - 1) // bs  # >=1 token must remain
+        want = [b.sequence_hash for b in req.seq.blocks[dev:max_blocks]]
+        if not want:
+            return
+        with self._offload_lock:
+            host_hit = len(self.host_pool.match_prefix(want))
+        promoted = 0
+        if self.persist_store is not None and host_hit < len(want):
+            promoted = self._promote_from_persist(want[host_hit:])
+            if not promoted:
+                persist_counters.record_miss()
         with self._offload_lock:
             # the kv-offload thread stores/evicts concurrently; a block
             # still in flight to the pool just misses here (re-prefilled
-            # — correct, merely slower)
-            hit = self.host_pool.match_prefix(
-                [b.sequence_hash for b in req.seq.blocks[dev:max_blocks]]
-            )
+            # — correct, merely slower).  match+gather under ONE lock
+            # hold: a matched block must not be evicted before gather.
+            hit = self.host_pool.match_prefix(want)
             if not hit:
                 return
             blocks = self.host_pool.gather(hit)  # [n, L, 2, Bs, HkD] (pytree)
+        if promoted:
+            restored = max(0, len(hit) - host_hit)
+            if restored:
+                persist_counters.record_restore(restored, restored * bs)
         target = req.block_ids[dev : dev + len(hit)]
         self.scatter_external(
             target, jax.tree.map(lambda a: np.moveaxis(a, 0, 1), blocks)

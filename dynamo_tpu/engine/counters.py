@@ -22,7 +22,7 @@ full the offered axis budget ran.
 
 from __future__ import annotations
 
-__all__ = ["PrefillCounters", "counters"]
+__all__ = ["PrefillCounters", "counters", "PersistCounters", "persist_counters"]
 
 
 class PrefillCounters:
@@ -90,3 +90,47 @@ class PrefillCounters:
 
 
 counters = PrefillCounters()
+
+
+class PersistCounters:
+    """Persistent prefix-cache tier (llm/kv/persist.py) counters.
+
+        dynamo_tpu_engine_persist_hits_total            counter (blocks)
+        dynamo_tpu_engine_persist_misses_total          counter (lookups
+                                                        that restored
+                                                        nothing)
+        dynamo_tpu_engine_persist_restored_tokens_total counter
+        dynamo_tpu_engine_persist_spill_bytes_total     counter
+        dynamo_tpu_engine_persist_resident_bytes        gauge
+
+    The store records spill volume and residency; the engine's restore
+    path records hits/misses/restored tokens at commit time, so a match
+    that failed to land on device never counts as a hit.
+    """
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def record_restore(self, blocks: int, tokens: int) -> None:
+        self.hits_total += blocks
+        self.restored_tokens_total += tokens
+
+    def record_miss(self) -> None:
+        self.misses_total += 1
+
+    def record_spill(self, nbytes: int) -> None:
+        self.spill_bytes_total += nbytes
+
+    def set_resident(self, nbytes: int) -> None:
+        self.resident_bytes = nbytes
+
+    def reset(self) -> None:
+        """Test isolation hook — the counters are process-global."""
+        self.hits_total = 0
+        self.misses_total = 0
+        self.restored_tokens_total = 0
+        self.spill_bytes_total = 0
+        self.resident_bytes = 0
+
+
+persist_counters = PersistCounters()

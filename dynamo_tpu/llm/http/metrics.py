@@ -13,6 +13,7 @@ from collections import defaultdict
 from typing import Iterator
 
 from dynamo_tpu.engine.counters import counters as prefill_counters
+from dynamo_tpu.engine.counters import persist_counters
 from dynamo_tpu.fault.counters import counters as fault_counters
 
 PREFIX = "dynamo_tpu_http_service"
@@ -134,6 +135,24 @@ class Metrics:
         lines.append(f"# TYPE {ENGINE_PREFIX}_unified_budget_utilization gauge")
         lines.append(f"{ENGINE_PREFIX}_unified_budget_utilization "
                      f"{round(prefill_counters.unified_budget_utilization, 6)}")
+        # persistent prefix-cache tier (llm/kv/persist.py): blocks/tokens
+        # restored from disk instead of re-prefilled, spill volume, and
+        # the store's current footprint
+        lines.append(f"# TYPE {ENGINE_PREFIX}_persist_hits_total counter")
+        lines.append(f"{ENGINE_PREFIX}_persist_hits_total "
+                     f"{persist_counters.hits_total}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_persist_misses_total counter")
+        lines.append(f"{ENGINE_PREFIX}_persist_misses_total "
+                     f"{persist_counters.misses_total}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_persist_restored_tokens_total counter")
+        lines.append(f"{ENGINE_PREFIX}_persist_restored_tokens_total "
+                     f"{persist_counters.restored_tokens_total}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_persist_spill_bytes_total counter")
+        lines.append(f"{ENGINE_PREFIX}_persist_spill_bytes_total "
+                     f"{persist_counters.spill_bytes_total}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_persist_resident_bytes gauge")
+        lines.append(f"{ENGINE_PREFIX}_persist_resident_bytes "
+                     f"{persist_counters.resident_bytes}")
         return "\n".join(lines) + "\n"
 
 

@@ -23,6 +23,10 @@ class KvStoredEvent:
     block_hashes: list[int]
     parent_hash: Optional[int] = None
     token_blocks: list[list[int]] = field(default_factory=list)  # optional token payload
+    # which cache tier holds the blocks: "device" (HBM radix hit, free to
+    # reuse) or "persist" (disk tier — reusable after a host-side restore,
+    # so the router scores it at a discount)
+    tier: str = "device"
 
     kind = "stored"
 
@@ -32,6 +36,7 @@ class KvRemovedEvent:
     """Blocks were evicted from a worker's cache."""
 
     block_hashes: list[int]
+    tier: str = "device"
 
     kind = "removed"
 
@@ -49,16 +54,20 @@ def event_to_wire(event_id: int, worker_id: int, ev: KvCacheEvent) -> dict:
             out["token_blocks"] = ev.token_blocks
     else:
         out["block_hashes"] = ev.block_hashes
+    if ev.tier != "device":  # wire-compat: old consumers never see the key
+        out["tier"] = ev.tier
     return out
 
 
 def event_from_wire(d: dict) -> tuple[int, int, KvCacheEvent]:
+    tier = d.get("tier", "device")
     if d["kind"] == "stored":
         ev: KvCacheEvent = KvStoredEvent(
             block_hashes=list(d["block_hashes"]),
             parent_hash=d.get("parent_hash"),
             token_blocks=[list(t) for t in d.get("token_blocks", [])],
+            tier=tier,
         )
     else:
-        ev = KvRemovedEvent(block_hashes=list(d["block_hashes"]))
+        ev = KvRemovedEvent(block_hashes=list(d["block_hashes"]), tier=tier)
     return d["event_id"], d["worker_id"], ev

@@ -58,6 +58,10 @@ class HostKvPool:
         self.restored_blocks = 0
         self.evicted_blocks = 0
         self.dropped_blocks = 0  # capacity-cap truncations (see reserve)
+        # lookup counters (block granularity): how much of each probed
+        # prefix was resident vs not — the tier's effective hit rate
+        self.hit_blocks = 0
+        self.miss_blocks = 0
 
     # ------------------------------------------------------------------ state
     @property
@@ -218,6 +222,8 @@ class HostKvPool:
             if h not in self._table:
                 break
             out.append(h)
+        self.hit_blocks += len(out)
+        self.miss_blocks += len(seq_hashes) - len(out)
         return out
 
     def gather(self, seq_hashes: Sequence[int]):
@@ -250,4 +256,6 @@ class HostKvPool:
             "host_blocks_restored": self.restored_blocks,
             "host_blocks_evicted": self.evicted_blocks,
             "host_blocks_dropped": self.dropped_blocks,
+            "host_blocks_hits": self.hit_blocks,
+            "host_blocks_misses": self.miss_blocks,
         }

@@ -27,9 +27,14 @@ __all__ = ["KvIndexer", "OverlapScores"]
 @dataclass
 class OverlapScores:
     """worker_id → number of consecutive prefix blocks resident there
-    (ref indexer.rs OverlapScores)."""
+    (ref indexer.rs OverlapScores).  ``persist_scores`` is the same
+    longest-prefix walk over each worker's PERSISTENT tier (llm/kv/
+    persist.py): blocks a worker can restore host-side before prefill
+    rather than already holding in HBM — the scheduler scores them at a
+    discount."""
 
     scores: dict[int, int] = field(default_factory=dict)
+    persist_scores: dict[int, int] = field(default_factory=dict)
 
     def best(self) -> tuple[int, int] | None:
         if not self.scores:
@@ -58,6 +63,10 @@ class KvIndexer:
         self._holders: dict[int, set[int]] = {}
         # worker id → hashes it holds (for teardown)
         self._worker_blocks: dict[int, set[int]] = {}
+        # persistent tier (tier="persist" events) — always Python-side:
+        # the native index only models the device tier
+        self._persist_holders: dict[int, set[int]] = {}
+        self._persist_worker_blocks: dict[int, set[int]] = {}
         # per-worker last event id (gap/ordering diagnostics)
         self._last_event_id: dict[int, int] = {}
 
@@ -68,8 +77,10 @@ class KvIndexer:
     # ---------------------------------------------------------------- queries
     def find_matches(self, seq_hashes: Sequence[int]) -> OverlapScores:
         """Longest-prefix match per worker over the request's block hashes."""
+        persist = self._persist_matches(seq_hashes)
         if self._native is not None:
-            return OverlapScores(self._native.find_matches(seq_hashes))
+            return OverlapScores(self._native.find_matches(seq_hashes),
+                                 persist)
         scores: dict[int, int] = {}
         live: set[int] | None = None  # workers matching every block so far
         for i, h in enumerate(seq_hashes):
@@ -81,7 +92,30 @@ class KvIndexer:
                 break
             for w in live:  # workers that dropped out keep their shorter score
                 scores[w] = i + 1
-        return OverlapScores(scores)
+        return OverlapScores(scores, persist)
+
+    def _persist_matches(self, seq_hashes: Sequence[int]) -> dict[int, int]:
+        """Longest prefix per worker over the persistent tier alone —
+        what each worker could restore host-side starting from a cold
+        device cache.  Conservative: the walk starts at the sequence
+        root, so persist blocks that merely CONTINUE a device-resident
+        prefix (device holds 0..k, persist holds k+1..) score 0 here;
+        the scheduler only adds the persist term where it EXCEEDS the
+        device score, so undercounting can never double-pay."""
+        if not self._persist_holders:
+            return {}
+        scores: dict[int, int] = {}
+        live: set[int] | None = None
+        for i, h in enumerate(seq_hashes):
+            holders = self._persist_holders.get(h)
+            if not holders:
+                break
+            live = set(holders) if live is None else (live & holders)
+            if not live:
+                break
+            for w in live:
+                scores[w] = i + 1
+        return scores
 
     @property
     def num_blocks(self) -> int:
@@ -101,6 +135,24 @@ class KvIndexer:
                     "worker %s event id gap: %s -> %s", worker_id, last, event_id
                 )
             self._last_event_id[worker_id] = event_id
+
+        if getattr(event, "tier", "device") == "persist":
+            # persist-tier events bypass the native index (device-only)
+            if isinstance(event, KvStoredEvent):
+                blocks = self._persist_worker_blocks.setdefault(worker_id, set())
+                for h in event.block_hashes:
+                    self._persist_holders.setdefault(h, set()).add(worker_id)
+                    blocks.add(h)
+            elif isinstance(event, KvRemovedEvent):
+                blocks = self._persist_worker_blocks.get(worker_id, set())
+                for h in event.block_hashes:
+                    holders = self._persist_holders.get(h)
+                    if holders:
+                        holders.discard(worker_id)
+                        if not holders:
+                            del self._persist_holders[h]
+                    blocks.discard(h)
+            return
 
         if self._native is not None:
             if isinstance(event, KvStoredEvent):
@@ -129,6 +181,12 @@ class KvIndexer:
     def remove_worker(self, worker_id: int) -> None:
         """Worker died/left: drop all its blocks (ref: client watcher delete
         path, component/client.rs:145-154 → router stops picking it)."""
+        for h in self._persist_worker_blocks.pop(worker_id, set()):
+            holders = self._persist_holders.get(h)
+            if holders:
+                holders.discard(worker_id)
+                if not holders:
+                    del self._persist_holders[h]
         if self._native is not None:
             self._native.remove_worker(worker_id)
             self._worker_blocks.pop(worker_id, None)
@@ -147,4 +205,6 @@ class KvIndexer:
             self._native.clear()
         self._holders.clear()
         self._worker_blocks.clear()
+        self._persist_holders.clear()
+        self._persist_worker_blocks.clear()
         self._last_event_id.clear()

@@ -23,8 +23,9 @@ __all__ = ["KvRouter", "RoutingDecision"]
 @dataclass
 class RoutingDecision:
     worker_id: int
-    overlap_blocks: int     # prefix blocks already on that worker
+    overlap_blocks: int     # prefix blocks already on that worker (device)
     overlap_tokens: int
+    persist_blocks: int = 0  # prefix blocks restorable from its persist tier
 
 
 class KvRouter(AsyncEngine):
@@ -41,11 +42,15 @@ class KvRouter(AsyncEngine):
 
     def schedule(self, token_ids: Sequence[int]) -> RoutingDecision:
         hashes = sequence_hashes(token_ids, self.block_size)
-        overlaps = self.indexer.find_matches(hashes).scores
-        wid = self.scheduler.schedule(overlaps, len(token_ids))
+        match = self.indexer.find_matches(hashes)
+        overlaps = match.scores
+        wid = self.scheduler.schedule(overlaps, len(token_ids),
+                                      persist_overlaps=match.persist_scores)
         blocks = overlaps.get(wid, 0)
         return RoutingDecision(
-            worker_id=wid, overlap_blocks=blocks, overlap_tokens=blocks * self.block_size
+            worker_id=wid, overlap_blocks=blocks,
+            overlap_tokens=blocks * self.block_size,
+            persist_blocks=match.persist_scores.get(wid, 0),
         )
 
     def remove_worker(self, worker_id: int) -> None:

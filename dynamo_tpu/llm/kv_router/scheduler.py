@@ -95,9 +95,16 @@ class AllWorkersBusy(Exception):
 class KvScheduler:
     """Combines worker metrics + overlap scores into routing decisions."""
 
-    def __init__(self, selector: Optional[WorkerSelector] = None, block_size: int = 16):
+    def __init__(self, selector: Optional[WorkerSelector] = None, block_size: int = 16,
+                 persist_weight: float = 1.0):
         self.selector = selector or DefaultWorkerSelector()
         self.block_size = block_size
+        # relative worth of a persistent-tier prefix block vs a device-
+        # resident one (device term weighs 2.0 in the selector logit):
+        # restoring from disk beats re-prefilling but costs a host-side
+        # load + scatter, so it scores at persist_weight/2.0 of a warm
+        # hit.  0 disables persist-aware routing.
+        self.persist_weight = persist_weight
         self._workers: dict[int, WorkerMetrics] = {}
         self._suspects: set[int] = set()
         self._hit_events: list[KVHitRateEvent] = []
@@ -127,10 +134,25 @@ class KvScheduler:
         return set(self._suspects)
 
     # -------------------------------------------------------------- schedule
-    def schedule(self, overlaps: dict[int, int], request_tokens: int) -> int:
+    def schedule(self, overlaps: dict[int, int], request_tokens: int,
+                 persist_overlaps: Optional[dict[int, int]] = None) -> int:
         request_blocks = max(1, request_tokens // self.block_size)
         candidates = {w: m for w, m in self._workers.items()
                       if w not in self._suspects}
+        # persistent-tier matches enter as a DISCOUNTED overlap term:
+        # only the blocks persist offers beyond the device prefix count,
+        # scaled so the selector's 2.0*overlap weight nets out to
+        # persist_weight per persist block.  Folding here keeps the
+        # WorkerSelector protocol (and custom selectors) unchanged.
+        device_overlaps = overlaps
+        if persist_overlaps and self.persist_weight > 0:
+            eff = dict(overlaps)
+            for w, p in persist_overlaps.items():
+                extra = p - overlaps.get(w, 0)
+                if extra > 0:
+                    eff[w] = (overlaps.get(w, 0)
+                              + (self.persist_weight / 2.0) * extra)
+            overlaps = eff
         # every worker suspect = probes failing cluster-wide (or the probe
         # plane itself broke): routing somewhere beats routing nowhere
         wid = self.selector.select(candidates or self._workers, overlaps,
@@ -138,7 +160,7 @@ class KvScheduler:
         if wid is None:
             raise AllWorkersBusy("no live workers")
         self._hit_events.append(
-            KVHitRateEvent(wid, request_blocks, overlaps.get(wid, 0))
+            KVHitRateEvent(wid, request_blocks, device_overlaps.get(wid, 0))
         )
         # optimistic local update so burst arrivals spread before the next
         # metrics scrape lands

@@ -35,7 +35,7 @@ from typing import Optional
 log = logging.getLogger("dynamo_tpu.model_store")
 
 __all__ = ["push_model", "pull_model", "resolve_model", "manifest_key",
-           "is_model_ref", "DEFAULT_CACHE"]
+           "is_model_ref", "DEFAULT_CACHE", "file_sha256", "verify_files"]
 
 DEFAULT_CACHE = Path(os.environ.get(
     "DYNAMO_MODEL_CACHE", os.path.expanduser("~/.cache/dynamo_tpu/models")
@@ -43,6 +43,53 @@ DEFAULT_CACHE = Path(os.environ.get(
 _REF_PREFIX = "dyn://models/"
 # never shipped: transient HF artifacts and lock/cache noise
 _SKIP_PARTS = {".locks", "__pycache__", ".git"}
+
+
+def file_sha256(path: str | Path, chunk_size: int = 1 << 20) -> str:
+    """Streaming sha256 of a file on disk.  Shared integrity primitive:
+    model pulls verify manifest hashes with it, and the persistent KV
+    tier (llm/kv/persist.py) verifies block-group files against their
+    header digest with the same helper."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(chunk_size)
+            if not chunk:
+                break
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def verify_files(root: str | Path, files: dict) -> list[str]:
+    """Check every manifest entry under ``root`` against its recorded
+    sha256.  Returns the rel paths that are missing or corrupt (size
+    mismatch short-circuits the hash)."""
+    root = Path(root)
+    bad: list[str] = []
+    for rel, info in files.items():
+        p = root / rel
+        if not p.is_file():
+            bad.append(rel)
+            continue
+        size = info.get("size")
+        if size is not None and p.stat().st_size != size:
+            bad.append(rel)
+            continue
+        if file_sha256(p) != info["sha256"]:
+            bad.append(rel)
+    return bad
+
+
+def _check_rel(name: str, rel: str) -> None:
+    """The manifest is UNTRUSTED (any coordinator client can write it): a
+    '..' segment or absolute path must never escape the cache directory."""
+    relp = Path(rel)
+    if (not rel or relp.is_absolute()
+            or any(part in ("..", "") for part in relp.parts)):
+        raise IOError(
+            f"model {name!r}: manifest entry {rel!r} is not a "
+            "safe relative path"
+        )
 
 
 def manifest_key(name: str) -> str:
@@ -118,20 +165,42 @@ async def pull_model(coordinator, name: str,
     cache.mkdir(parents=True, exist_ok=True)
     target = cache / f"{name.replace('/', '--')}-{manifest['digest'][:12]}"
     if target.exists():
+        # the cache directory is content-addressed by manifest digest, but
+        # the FILES inside are not self-verifying: a torn write or disk
+        # fault leaves a directory that exists yet serves corrupt weights.
+        # Verify per-file hashes against the manifest and re-pull only the
+        # corrupt/missing ones.  Hashing runs in a worker thread — this
+        # coroutine may share its loop with live serving.
+        import asyncio
+
+        for rel in manifest["files"]:
+            _check_rel(name, rel)
+        bad = await asyncio.to_thread(verify_files, target, manifest["files"])
+        for rel in bad:
+            log.warning("model %s: cached file %s corrupt/missing; re-pulling",
+                        name, rel)
+            dest = target / rel
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            try:
+                await coordinator.blob_get(_blob_key(name, rel), dest)
+            except KeyError:
+                legacy = f"models/{name}/{rel}"
+                if legacy == _blob_key(name, rel):
+                    raise
+                await coordinator.blob_get(legacy, dest)
+        if bad:
+            still = await asyncio.to_thread(
+                verify_files, target,
+                {r: manifest["files"][r] for r in bad})
+            if still:
+                raise IOError(
+                    f"model {name!r}: files {still} still corrupt after "
+                    "re-pull (store itself damaged?)")
         return target
     tmp = Path(tempfile.mkdtemp(dir=cache, prefix=".pull-"))
     try:
         for rel, info in manifest["files"].items():
-            # the manifest is UNTRUSTED (any coordinator client can write
-            # it): a '..' segment or absolute path must never escape the
-            # cache directory
-            relp = Path(rel)
-            if (not rel or relp.is_absolute()
-                    or any(part in ("..", "") for part in relp.parts)):
-                raise IOError(
-                    f"model {name!r}: manifest entry {rel!r} is not a "
-                    "safe relative path"
-                )
+            _check_rel(name, rel)
             dest = tmp / rel
             dest.parent.mkdir(parents=True, exist_ok=True)
             try:

@@ -17,6 +17,7 @@ from dynamo_tpu.planner.admission import (
 from dynamo_tpu.planner.core import (
     LogActuator,
     PlannerLoop,
+    PrewarmActuator,
     SupervisorActuator,
 )
 from dynamo_tpu.planner.policy import (
@@ -41,6 +42,7 @@ __all__ = [
     "TokenBucket",
     "LogActuator",
     "PlannerLoop",
+    "PrewarmActuator",
     "SupervisorActuator",
     "MetricsSnapshot",
     "Plan",

@@ -26,6 +26,7 @@ import logging
 import time
 from typing import Callable, Optional, Protocol
 
+from dynamo_tpu.llm.kv.persist import PrewarmActuator  # planner-facing re-export
 from dynamo_tpu.llm.kv_router.publisher import metrics_subject
 from dynamo_tpu.planner.policy import (
     MetricsSnapshot,
@@ -38,7 +39,8 @@ from dynamo_tpu.planner.policy import (
 
 log = logging.getLogger("dynamo_tpu.planner")
 
-__all__ = ["PlannerLoop", "Actuator", "LogActuator", "SupervisorActuator"]
+__all__ = ["PlannerLoop", "Actuator", "LogActuator", "SupervisorActuator",
+           "PrewarmActuator"]
 
 
 class Actuator(Protocol):

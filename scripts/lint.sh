@@ -2,7 +2,7 @@
 # Convenience wrapper for the static-analysis suite (docs/static_analysis.md).
 # Runs ALL THREE passes:
 #   1+2. per-file rules (DT001-DT104) + interprocedural project pass
-#        (DT005-DT008) — one invocation, sharing one ast.parse per file
+#        (DT005-DT009) — one invocation, sharing one ast.parse per file
 #   3.   compile-plane trace audit (TR001-TR007, docs section "compile
 #        plane") against the committed analysis/trace_manifest.json
 #   scripts/lint.sh                      # lint dynamo_tpu/, human output

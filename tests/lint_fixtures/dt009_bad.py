@@ -1,0 +1,12 @@
+"""DT009 bad: the async handler calls a sync helper that does blocking
+file I/O — the open() hides one call away, so the per-file pass (DT003)
+cannot see it, but the event loop stalls just the same."""
+
+
+def save_snapshot(path, payload):
+    with open(path, "wb") as f:
+        f.write(payload)
+
+
+async def handle(path, payload):
+    save_snapshot(path, payload)

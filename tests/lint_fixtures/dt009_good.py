@@ -1,0 +1,14 @@
+"""DT009 good: the same sync helper, but the async caller pushes it
+through asyncio.to_thread — handing the helper to the executor passes it
+as an argument (not a call), so the loop never blocks and no blocking
+call edge exists."""
+import asyncio
+
+
+def save_snapshot(path, payload):
+    with open(path, "wb") as f:
+        f.write(payload)
+
+
+async def handle(path, payload):
+    await asyncio.to_thread(save_snapshot, path, payload)

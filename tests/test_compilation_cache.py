@@ -11,6 +11,7 @@ def test_enable_persistent_cache_configures_jax(tmp_path, monkeypatch):
     from dynamo_tpu.utils.compilation_cache import enable_persistent_cache
 
     target = str(tmp_path / "xla-cache")
+    prev = jax.config.jax_compilation_cache_dir
     try:
         got = enable_persistent_cache(target)
         assert got == target
@@ -27,8 +28,9 @@ def test_enable_persistent_cache_configures_jax(tmp_path, monkeypatch):
         assert jax.config.jax_compilation_cache_dir == alt
     finally:
         # the config is process-global: a tmp dir must not outlive the
-        # test as the suite's cache location
-        jax.config.update("jax_compilation_cache_dir", None)
+        # test as the suite's cache location — restore whatever the
+        # harness (conftest) had configured, not None
+        jax.config.update("jax_compilation_cache_dir", prev)
 
 
 def test_unwritable_cache_dir_degrades_to_cold(tmp_path):

@@ -20,8 +20,10 @@ from dynamo_tpu.models.loader import load_params_from_state_dict
 from dynamo_tpu.runtime.engine import Context
 
 
-@pytest.fixture(scope="module")
+@pytest.fixture(scope="session")
 def setup():
+    # session-scoped: four test modules share this build (~8s each if
+    # rebuilt); everything returned is treated read-only by every user
     torch = pytest.importorskip("torch")
     from transformers import LlamaConfig, LlamaForCausalLM
 

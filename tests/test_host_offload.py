@@ -50,6 +50,36 @@ def test_pool_rejects_shape_change():
     pool.store([1], _blocks(1))
     with pytest.raises(ValueError):
         pool.store([2], _blocks(1, shape=(3, 3)))
+    # the rejected store must not poison the pool: original content
+    # intact, and correctly-shaped stores still land
+    assert pool.match_prefix([1]) == [1]
+    assert pool.store([3], _blocks(1, seed=2)) == 1
+    assert pool.resident == 2
+
+
+def test_pool_hit_miss_counters():
+    pool = HostKvPool(8)
+    pool.store([1, 2], _blocks(2))
+    assert pool.match_prefix([1, 2, 3]) == [1, 2]  # 2 hits, 1 miss
+    pool.match_prefix([9])                         # 1 miss
+    s = pool.stats()
+    assert s["host_blocks_hits"] == 2
+    assert s["host_blocks_misses"] == 2
+
+
+def test_pool_reserve_abort_leaks_nothing():
+    """A failed write between reserve and publish must return every row:
+    free-list restored, nothing resident, full capacity still usable."""
+    pool = HostKvPool(2)
+    hids, rows = pool.reserve([1, 2], _blocks(2))
+    assert len(hids) == 2 and len(pool._free) == 0
+    pool.abort(hids)
+    assert len(pool._free) == 2
+    assert pool.resident == 0
+    assert pool.match_prefix([1, 2]) == []  # aborted rows never match
+    # whole capacity is still claimable in one batch
+    assert pool.store([3, 4], _blocks(2)) == 2
+    assert pool.match_prefix([3, 4]) == [3, 4]
 
 
 # --------------------------------------------------------- engine offload ----

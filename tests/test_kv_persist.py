@@ -1,0 +1,415 @@
+"""Persistent prefix-cache tier: content-addressed KV store, restart-warm
+restore, replication, and persist-aware routing.
+
+The money path mirrors test_host_offload's engine test but crosses a
+process-restart boundary: fill + churn an engine with ``kv_persist_dir``
+set, close it, build a FRESH engine (empty host pool) over the same
+directory, replay the original prompt — its prefix must come back through
+persist → host → device with bit-identical decoding.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, EngineCore
+from dynamo_tpu.engine.counters import persist_counters
+from dynamo_tpu.llm.kv.events import (
+    KvRemovedEvent,
+    KvStoredEvent,
+    event_from_wire,
+    event_to_wire,
+)
+from dynamo_tpu.llm.kv.persist import (
+    PersistentKvStore,
+    PersistReplicator,
+    prewarm_key,
+)
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer
+from dynamo_tpu.llm.kv_router.scheduler import KvScheduler, WorkerMetrics
+from tests.test_engine import collect_greedy, setup  # noqa: F401  (fixture)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def _blocks(n, shape=(2, 3, 8, 4), seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n,) + shape).astype(np.float32)
+
+
+# ------------------------------------------------------------- store unit ---
+
+
+def test_store_spill_match_load_roundtrip(tmp_path):
+    store = PersistentKvStore(tmp_path, generation="g1")
+    hashes = [11, 22, 33]
+    data = _blocks(3)
+    wrote = store.spill(hashes, data)
+    assert wrote > 0
+    assert store.match_prefix([11, 22, 33, 44]) == [11, 22, 33]
+    np.testing.assert_array_equal(store.load([11, 22, 33]), data)
+    # re-spill of resident content writes nothing new
+    assert store.spill(hashes, _blocks(3, seed=9)) == 0
+    np.testing.assert_array_equal(store.load(hashes), data)
+    store.close()
+
+
+def test_store_tuple_structure_roundtrip(tmp_path):
+    """Pytree (per-layer tuple) block batches survive the disk format."""
+    store = PersistentKvStore(tmp_path, generation="g1")
+    data = (_blocks(2, seed=1), _blocks(2, shape=(4, 2), seed=2))
+    store.spill([7, 8], data)
+    out = store.load([7, 8])
+    assert isinstance(out, tuple) and len(out) == 2
+    np.testing.assert_array_equal(out[0], data[0])
+    np.testing.assert_array_equal(out[1], data[1])
+    store.close()
+
+
+def test_store_restart_reindexes_same_generation(tmp_path):
+    hashes = [101, 102]
+    data = _blocks(2, seed=3)
+    store = PersistentKvStore(tmp_path, generation="gen-a")
+    store.spill(hashes, data)
+    store.close()
+
+    # fresh store object over the same root: the on-disk index is the truth
+    store2 = PersistentKvStore(tmp_path, generation="gen-a")
+    assert sorted(store2.resident_hashes()) == sorted(hashes)
+    assert store2.match_prefix(hashes) == hashes
+    np.testing.assert_array_equal(store2.load(hashes), data)
+    store2.close()
+
+
+def test_store_generation_invalidation(tmp_path):
+    """A generation change (different model/dtype) deletes stale content —
+    cross-generation restore would scatter garbage KV."""
+    store = PersistentKvStore(tmp_path, generation="gen-a")
+    store.spill([1, 2], _blocks(2))
+    store.close()
+
+    store2 = PersistentKvStore(tmp_path, generation="gen-b")
+    assert store2.resident_hashes() == []
+    assert store2.match_prefix([1, 2]) == []
+    assert not (tmp_path / "gen-a").exists()
+    store2.close()
+
+
+def test_store_corrupt_file_is_a_miss_not_a_crash(tmp_path):
+    store = PersistentKvStore(tmp_path, generation="g1")
+    store.spill([5, 6], _blocks(2))
+    files = store.export_files()
+    assert len(files) == 1
+    _, path, _, _ = files[0]
+    raw = bytearray(path.read_bytes())
+    raw[-1] ^= 0xFF  # flip a payload byte → sha mismatch
+    path.write_bytes(bytes(raw))
+
+    with pytest.raises(KeyError):
+        store.load([5, 6])
+    assert store.stats()["persist_invalid_files"] == 1
+    # the bad file is dropped from the index AND queued as a Removed event
+    assert store.match_prefix([5, 6]) == []
+    assert sorted(store.drain_removed()) == [5, 6]
+    store.close()
+
+
+def test_store_ttl_eviction(tmp_path):
+    now = [1000.0]
+    store = PersistentKvStore(tmp_path, generation="g1", ttl_s=60.0,
+                              clock=lambda: now[0])
+    store.spill([1, 2], _blocks(2))
+    assert store.match_prefix([1, 2]) == [1, 2]
+    now[0] += 120.0
+    assert store.match_prefix([1, 2]) == []  # expired → reclaimed in place
+    assert store.stats()["persist_evicted_blocks"] == 2
+    assert sorted(store.drain_removed()) == [1, 2]
+    assert store.stats()["persist_resident_bytes"] == 0
+    store.close()
+
+
+def test_store_size_cap_evicts_lru_first(tmp_path):
+    now = [0.0]
+    probe = PersistentKvStore(tmp_path / "probe", generation="g")
+    one_file = probe.spill([999], _blocks(1))
+    probe.close()
+
+    store = PersistentKvStore(tmp_path / "main", generation="g",
+                              max_bytes=3 * one_file, clock=lambda: now[0])
+    for i, h in enumerate([1, 2, 3]):
+        now[0] = float(i)
+        store.spill([h], _blocks(1, seed=i))
+    now[0] = 10.0
+    store.load([1])  # LRU refresh happens on load (match is a probe)
+    now[0] = 11.0
+    store.spill([4], _blocks(1, seed=4))  # over cap → evict oldest
+    resident = set(store.resident_hashes())
+    assert 2 not in resident
+    assert resident == {1, 3, 4}
+    assert store.stats()["persist_evicted_files"] == 1
+    assert 2 in store.drain_removed()
+    store.close()
+
+
+def test_store_hit_miss_counters(tmp_path):
+    store = PersistentKvStore(tmp_path, generation="g1")
+    store.spill([1], _blocks(1))
+    store.match_prefix([1])
+    store.match_prefix([42])  # nothing matched → one miss
+    s = store.stats()
+    assert s["persist_hits"] == 1
+    assert s["persist_misses"] == 1
+    store.close()
+
+
+def test_store_import_export_file(tmp_path):
+    """export_files on replica A + import_file on replica B is the whole
+    replication data path (PersistReplicator just moves the bytes)."""
+    a = PersistentKvStore(tmp_path / "a", generation="g")
+    data = _blocks(2, seed=5)
+    a.spill([61, 62], data)
+    (stem, path, hashes, size) = a.export_files()[0]
+    assert hashes == [61, 62] and size == path.stat().st_size
+
+    b = PersistentKvStore(tmp_path / "b", generation="g")
+    assert b.import_file(path.read_bytes()) == 2
+    np.testing.assert_array_equal(b.load([61, 62]), data)
+    assert b.has_file(stem)
+    assert b.import_file(path.read_bytes()) == 0  # already resident
+    a.close()
+    b.close()
+
+
+# ------------------------------------------------------ engine restart-warm
+
+
+def _persist_cfg(persist_dir, **kw):
+    return EngineConfig(
+        max_batch_size=2,
+        max_model_len=64,
+        block_size=8,
+        num_blocks=8,            # tiny device pool → eviction pressure
+        num_host_blocks=32,
+        prefill_buckets=[16, 32, 64],
+        kv_persist_dir=str(persist_dir),
+        **kw,
+    )
+
+
+def _fill_and_close(model, params, persist_dir, prompt, n=6):
+    """Cold engine: decode the prompt, churn it out to host (which
+    write-through spills to persist), then tear the engine down."""
+    rng = np.random.RandomState(99)
+    core = EngineCore(model, params, _persist_cfg(persist_dir))
+    got, _, _ = collect_greedy(core, prompt, n, request_id="cold")
+    for i in range(4):
+        other = list(rng.randint(1, 128, size=24))
+        collect_greedy(core, other, 2, request_id=f"churn{i}")
+    core.flush_host_offload()
+    assert core.persist_store is not None
+    spilled = core.metrics()["persist_blocks"]
+    assert spilled > 0, "host publishes should write-through to persist"
+    core.close()
+    return got
+
+
+def test_restart_warm_restores_prefix(setup, tmp_path):  # noqa: F811
+    """THE acceptance path: a fresh engine (empty host pool) over the
+    same persist dir restores the prefix and decodes identically."""
+    hf, model, params = setup
+    persist_counters.reset()
+    prompt = list(np.random.RandomState(7).randint(1, 128, size=24))
+    got1 = _fill_and_close(model, params, tmp_path, prompt)
+
+    core2 = EngineCore(model, params, _persist_cfg(tmp_path))
+    assert core2.host_pool.stored_blocks == 0  # genuinely cold host tier
+    got2, _, req2 = collect_greedy(core2, prompt, 6, request_id="warm")
+    assert req2.cached_tokens > 0, "persist restore should shorten prefill"
+    assert got2 == got1
+
+    stats = core2.metrics()
+    assert stats["persist_hits"] > 0
+    from dynamo_tpu.llm.http.metrics import Metrics
+    text = Metrics().render()
+    assert "dynamo_tpu_engine_persist_hits_total" in text
+    for line in text.splitlines():
+        if line.startswith("dynamo_tpu_engine_persist_hits_total "):
+            assert float(line.split()[-1]) > 0
+    core2.close()
+
+
+def test_restart_with_different_generation_is_cold(setup, tmp_path):  # noqa: F811
+    """kv_persist dir survives, but a dtype change must invalidate it."""
+    hf, model, params = setup
+    prompt = list(np.random.RandomState(11).randint(1, 128, size=24))
+    _fill_and_close(model, params, tmp_path, prompt)
+
+    core2 = EngineCore(model, params,
+                       _persist_cfg(tmp_path, cache_dtype="bfloat16"))
+    assert core2.persist_store.resident_hashes() == []
+    core2.close()
+
+
+def test_persist_disabled_by_default(setup):  # noqa: F811
+    hf, model, params = setup
+    cfg = EngineConfig(max_batch_size=2, max_model_len=64, block_size=8,
+                       num_blocks=8, num_host_blocks=32,
+                       prefill_buckets=[16, 32, 64])
+    core = EngineCore(model, params, cfg)
+    assert core.persist_store is None
+    assert "persist_blocks" not in core.metrics()
+    core.close()
+
+
+# -------------------------------------------------------- replication (e2e)
+
+
+def test_cross_replica_restore(setup, tmp_path):  # noqa: F811
+    """Replica A prefills + publishes; replica B (separate persist dir,
+    fresh engine) pulls via the coordinator and serves the prefix warm."""
+    from dynamo_tpu.runtime.transports.coordinator import (
+        CoordinatorClient,
+        CoordinatorServer,
+    )
+
+    hf, model, params = setup
+    prompt = list(np.random.RandomState(21).randint(1, 128, size=24))
+    dir_a, dir_b = tmp_path / "a", tmp_path / "b"
+    got1 = _fill_and_close(model, params, dir_a, prompt)
+
+    core_b = EngineCore(model, params, _persist_cfg(dir_b))
+    assert core_b.persist_store.resident_hashes() == []
+
+    async def replicate():
+        srv = await CoordinatorServer(port=0).start()
+        c = await CoordinatorClient(srv.url).connect()
+        try:
+            gen = core_b.persist_store.generation
+            store_a = PersistentKvStore(dir_a, generation=gen)
+            try:
+                pub = PersistReplicator(c, store_a, namespace="t")
+                assert await pub.publish_once() > 0
+            finally:
+                store_a.close()
+            sub = PersistReplicator(c, core_b.persist_store, namespace="t")
+            assert await sub.pull_once() > 0
+        finally:
+            await c.close()
+            await srv.stop()
+
+    run(replicate())
+    assert core_b.persist_store.resident_hashes() != []
+
+    got2, _, req2 = collect_greedy(core_b, prompt, 6, request_id="replB")
+    assert req2.cached_tokens > 0
+    assert got2 == got1
+    core_b.close()
+
+
+def test_replicator_start_stop(tmp_path):
+    """start() performs an immediate sync; stop() cancels cleanly."""
+    from dynamo_tpu.runtime.transports.coordinator import (
+        CoordinatorClient,
+        CoordinatorServer,
+    )
+
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        c = await CoordinatorClient(srv.url).connect()
+        store = PersistentKvStore(tmp_path, generation="g")
+        store.spill([1, 2], _blocks(2))
+        rep = PersistReplicator(c, store, namespace="n", interval_s=60.0)
+        try:
+            rep.start_soon()
+            for _ in range(100):
+                if rep.published_files:
+                    break
+                await asyncio.sleep(0.02)
+            assert rep.published_files == 1
+        finally:
+            await rep.stop()
+            store.close()
+            await c.close()
+            await srv.stop()
+
+    run(go())
+
+
+def test_prewarm_actuator_scale_up_only(tmp_path):
+    from dynamo_tpu.planner import Plan, PrewarmActuator
+    from dynamo_tpu.runtime.transports.coordinator import (
+        CoordinatorClient,
+        CoordinatorServer,
+    )
+
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        c = await CoordinatorClient(srv.url).connect()
+        try:
+            act = PrewarmActuator(c, namespace="ns")
+            key = prewarm_key("ns")
+            await act.apply(Plan(tick=1, prefill_replicas=1, decode_replicas=1))
+            assert await c.kv_get(key) is None  # baseline, not a scale-up
+            await act.apply(Plan(tick=2, prefill_replicas=2, decode_replicas=1,
+                                 reason="queue"))
+            hint = await c.kv_get(key)
+            assert hint["tick"] == 2 and hint["epoch"] == 1
+            await act.apply(Plan(tick=3, prefill_replicas=1, decode_replicas=1))
+            assert (await c.kv_get(key))["epoch"] == 1  # scale-down: no-op
+        finally:
+            await c.close()
+            await srv.stop()
+
+    run(go())
+
+
+# ------------------------------------------------------- router awareness --
+
+
+def test_events_wire_tier_roundtrip():
+    ev = KvStoredEvent(block_hashes=[1, 2], parent_hash=None, tier="persist")
+    wire = event_to_wire(7, 3, ev)
+    assert wire["tier"] == "persist"
+    _, _, back = event_from_wire(wire)
+    assert back.tier == "persist" and back.block_hashes == [1, 2]
+    # device tier stays off the wire (old consumers never see the key)
+    assert "tier" not in event_to_wire(8, 3, KvStoredEvent(block_hashes=[9]))
+    _, _, dev = event_from_wire(event_to_wire(8, 3, KvRemovedEvent([9])))
+    assert dev.tier == "device"
+
+
+def test_indexer_persist_tier_scoring():
+    idx = KvIndexer(use_native=False)
+    idx.apply_event(1, KvStoredEvent(block_hashes=[10, 20], tier="persist"))
+    idx.apply_event(2, KvStoredEvent(block_hashes=[10], tier="device"))
+
+    scores = idx.find_matches([10, 20, 30])
+    assert scores.scores == {2: 1}          # device tier: worker 2 only
+    assert scores.persist_scores == {1: 2}  # persist tier: worker 1 depth 2
+
+    idx.apply_event(1, KvRemovedEvent(block_hashes=[20], tier="persist"))
+    assert idx.find_matches([10, 20]).persist_scores == {1: 1}
+    idx.remove_worker(1)
+    assert idx.find_matches([10, 20]).persist_scores == {}
+
+
+def test_scheduler_folds_persist_overlap():
+    sched = KvScheduler(block_size=8, persist_weight=1.0)
+    for w in (1, 2):
+        sched.update_worker(WorkerMetrics(
+            worker_id=w, request_total_slots=8, kv_total_blocks=64))
+    # worker 2's persist prefix should beat worker 1's shallower device hit
+    wid = sched.schedule({1: 1}, request_tokens=64,
+                         persist_overlaps={2: 6})
+    assert wid == 2
+    # persist_weight=0 disables the fold → device hit wins again
+    sched0 = KvScheduler(block_size=8, persist_weight=0.0)
+    for w in (1, 2):
+        sched0.update_worker(WorkerMetrics(
+            worker_id=w, request_total_slots=8, kv_total_blocks=64))
+    assert sched0.schedule({1: 1}, request_tokens=64,
+                           persist_overlaps={2: 6}) == 1

@@ -1,7 +1,7 @@
 """Static-analysis suite tests: per-rule fixtures, noqa suppression,
 baseline round-trip, stable JSON output, and THE GATES — zero
 non-baselined findings over the whole package from both the per-file
-pass (DT001-DT104) and the interprocedural project pass (DT005-DT008).
+pass (DT001-DT104) and the interprocedural project pass (DT005-DT009).
 
 The gates are the point of the suite (docs/static_analysis.md): every
 future PR fails tier-1 if it introduces a fire-and-forget task, a silent
@@ -39,7 +39,7 @@ FIXTURES = Path(__file__).parent / "lint_fixtures"
 
 RULES = ["DT001", "DT002", "DT003", "DT004",
          "DT101", "DT102", "DT103", "DT104"]
-PROJECT_RULES = ["DT005", "DT006", "DT007", "DT008"]
+PROJECT_RULES = ["DT005", "DT006", "DT007", "DT008", "DT009"]
 
 
 def _codes(findings):

@@ -321,3 +321,42 @@ def test_blob_key_no_collision_across_slash_names(tmp_path):
             await srv.stop()
 
     run(go())
+
+
+def test_pull_repairs_corrupt_cached_file(tmp_path):
+    """A cache hit is NOT trusted blindly: per-file sha256 verification
+    (file_sha256/verify_files) catches a torn write in the cached dir
+    and re-pulls only the damaged file from the blob store."""
+    from dynamo_tpu.llm.model_store import file_sha256, verify_files
+
+    src = _make_model_dir(tmp_path)
+
+    async def go():
+        srv = await CoordinatorServer(port=0).start()
+        c = await CoordinatorClient(srv.url).connect()
+        try:
+            manifest = await push_model(c, "m", src)
+            cache = tmp_path / "cache"
+            got = await pull_model(c, "m", cache_dir=cache)
+
+            # corrupt one cached file in place (same length: size checks
+            # alone would miss it — only the hash catches this)
+            victim = got / "model.safetensors"
+            raw = bytearray(victim.read_bytes())
+            raw[1000] ^= 0xFF
+            victim.write_bytes(bytes(raw))
+            bad = verify_files(got, manifest["files"])
+            assert bad == ["model.safetensors"]
+
+            again = await pull_model(c, "m", cache_dir=cache)
+            assert again == got
+            assert verify_files(got, manifest["files"]) == []
+            assert (file_sha256(victim)
+                    == manifest["files"]["model.safetensors"]["sha256"])
+            assert (victim.read_bytes()
+                    == (src / "model.safetensors").read_bytes())
+        finally:
+            await c.close()
+            await srv.stop()
+
+    run(go())

@@ -177,8 +177,16 @@ def test_unstable_trace_key_detected():
     signature matrix unstable across enumerations — the compile-plane
     shape of a per-call retrace (cross-referenced by AST rule DT101)."""
 
-    class Cfg:  # default repr includes the object address
-        pass
+    class Cfg:
+        # repr differs per instance, like an id-keyed static — but via a
+        # counter, not the heap address: the first enumeration's Cfg is
+        # freed before the second is built, and allocator address reuse
+        # would make object.__repr__ collide (order-dependent flake)
+        _seq = 0
+
+        def __repr__(self):
+            Cfg._seq += 1
+            return f"<Cfg #{Cfg._seq}>"
 
     def build(n):
         return Signature(f"n={n}", (_sds((n,)), _sds((n,))),
