@@ -21,7 +21,7 @@ from dynamo_tpu.analysis.core import (
     lint_paths,
 )
 
-__all__ = ["configure_parser", "run_lint", "main"]
+__all__ = ["configure_parser", "run_lint", "run_all", "main"]
 
 
 def configure_parser(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
@@ -39,9 +39,23 @@ def configure_parser(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "jaxpr/HLO trace census, donation audit, dtype "
                         "propagation, static HBM footprint) against the "
                         "committed trace manifest")
+    p.add_argument("--wire", action="store_true",
+                   help="run the wire-plane pass instead (WR001-WR007: "
+                        "extracted cross-process message contracts, "
+                        "producer/consumer drift) against the committed "
+                        "wire manifest")
+    p.add_argument("--all", action="store_true",
+                   help="run all four passes (per-file + project, trace, "
+                        "wire) in one process sharing the parse cache; "
+                        "exit 1 if any pass fails")
+    p.add_argument("--changed", action="store_true",
+                   help="restrict the per-file pass to git-dirty files "
+                        "(project/trace/wire passes stay whole-program); "
+                        "fast pre-commit mode")
     p.add_argument("--manifest", default=None, metavar="PATH",
-                   help="trace manifest file (default: the committed "
-                        "analysis/trace_manifest.json; --trace only)")
+                   help="manifest file (default: the committed "
+                        "analysis/trace_manifest.json or "
+                        "wire_manifest.json; --trace/--wire only)")
     p.add_argument("--select", default=None, metavar="DT001,DT102",
                    help="comma-separated rule codes to run (default: all)")
     p.add_argument("--baseline", default=None, metavar="PATH",
@@ -58,14 +72,45 @@ def configure_parser(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     return p
 
 
+def _git_changed_paths(root: Path) -> list[Path]:
+    """Python files git reports dirty (staged, unstaged, untracked)."""
+    import subprocess
+
+    try:
+        res = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=root, capture_output=True, text=True, timeout=15,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return []
+    if res.returncode != 0:
+        return []
+    paths = []
+    for line in res.stdout.splitlines():
+        frag = line[3:].split(" -> ")[-1].strip().strip('"')
+        if frag.endswith(".py"):
+            p = root / frag
+            if p.is_file():
+                paths.append(p)
+    return paths
+
+
 def run_lint(args: argparse.Namespace, out=None) -> int:
     out = out if out is not None else sys.stdout
+    if getattr(args, "all", False):
+        return run_all(args, out)
     if getattr(args, "trace", False):
         # compile-plane pass: its unit is jitted entrypoints, not source
         # files — it runs on its own manifest contract
         from dynamo_tpu.analysis.tracecheck import run_trace
 
         return run_trace(args, out)
+    if getattr(args, "wire", False):
+        # wire-plane pass: its unit is cross-process message channels —
+        # it runs on its own manifest contract too
+        from dynamo_tpu.analysis.wirecheck import run_wire
+
+        return run_wire(args, out)
     paths = [Path(p) for p in (args.paths or [])]
     if args.root:
         root = Path(args.root)
@@ -95,7 +140,13 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
         print(f"dynamo-tpu lint: {e}", file=sys.stderr)
         return 2
 
-    findings = lint_paths(paths, rules, root=root)
+    file_paths = paths
+    if getattr(args, "changed", False):
+        # pre-commit mode: per-file rules only touch git-dirty files;
+        # the project pass below stays whole-program (its rules are
+        # cross-module, a partial view would miss real drift)
+        file_paths = _git_changed_paths(root)
+    findings = lint_paths(file_paths, rules, root=root)
     if use_project:
         from dynamo_tpu.analysis.project import lint_project, project_rules
 
@@ -140,6 +191,26 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
         )
         print(summary, file=out)
     return 1 if fresh else 0
+
+
+def run_all(args: argparse.Namespace, out=None) -> int:
+    """All four passes in one process: per-file + project rules (one
+    ``ast.parse`` per file via ``core.parse_module``'s cache, which the
+    wire pass shares), then the compile-plane trace audit, then the
+    wire-plane contract check.  Exit 1 if any pass has fresh findings;
+    ``--update-baseline`` rewrites all three committed baselines."""
+    out = out if out is not None else sys.stdout
+    from dynamo_tpu.analysis.tracecheck import run_trace
+    from dynamo_tpu.analysis.wirecheck import run_wire
+
+    sub = argparse.Namespace(**vars(args))
+    sub.all = False
+    sub.project = True
+    sub.manifest = None        # per-plane defaults; --manifest is ambiguous here
+    rc_file = run_lint(sub, out)
+    rc_trace = run_trace(sub, out)
+    rc_wire = run_wire(sub, out)
+    return max(rc_file, rc_trace, rc_wire)
 
 
 def main(argv: Optional[list[str]] = None) -> int:

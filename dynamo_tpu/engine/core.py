@@ -393,10 +393,10 @@ class EngineCore:
                     # index learns this worker's persist tier once a
                     # publisher attaches (events drain on the engine
                     # thread each step)
-                    from dynamo_tpu.llm.kv.events import KvStoredEvent
+                    from dynamo_tpu.llm.kv.events import TIER_PERSIST, KvStoredEvent
 
                     self._persist_events.append(
-                        KvStoredEvent(block_hashes=resident, tier="persist"))
+                        KvStoredEvent(block_hashes=resident, tier=TIER_PERSIST))
 
         cache = model.init_kv_cache(config.num_blocks, config.block_size, cache_dtype)
         self._cache_specs = None
@@ -2313,7 +2313,11 @@ class EngineCore:
     def _spill_to_persist(self, hashes: list[int], blocks) -> None:
         """Mirror a host-pool store batch into the persistent tier (runs
         on the kv-offload thread — fsync never blocks the engine loop)."""
-        from dynamo_tpu.llm.kv.events import KvRemovedEvent, KvStoredEvent
+        from dynamo_tpu.llm.kv.events import (
+            TIER_PERSIST,
+            KvRemovedEvent,
+            KvStoredEvent,
+        )
 
         try:
             wrote = self.persist_store.spill(hashes, blocks)
@@ -2322,11 +2326,11 @@ class EngineCore:
             return
         if wrote:
             self._persist_events.append(
-                KvStoredEvent(block_hashes=list(hashes), tier="persist"))
+                KvStoredEvent(block_hashes=list(hashes), tier=TIER_PERSIST))
         removed = self.persist_store.drain_removed()
         if removed:
             self._persist_events.append(
-                KvRemovedEvent(block_hashes=removed, tier="persist"))
+                KvRemovedEvent(block_hashes=removed, tier=TIER_PERSIST))
 
     def _promote_from_persist(self, hashes: list[int]) -> int:
         """Load a persist-tier prefix host-side so the ordinary host-pool

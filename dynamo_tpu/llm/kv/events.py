@@ -3,12 +3,36 @@
 Reference parity: KvCacheEvent{Stored{parent_hash, blocks}, Removed{hashes}}
 (lib/llm/src/kv_router/protocols.rs:60-120 region), published per worker on
 the event plane and consumed by the router's radix-tree indexer.
+
+The ``tier``/``kind`` string constants below are the single source of
+truth for the event plane's discriminators (wirecheck rule WR003):
+producers (engine/core.py, persist.py spill paths) and consumers
+(kv_router/indexer.py) both import them instead of re-spelling the
+literals.
 """
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 from typing import Optional, Union
+
+log = logging.getLogger("dynamo_tpu.kv_router")
+
+# cache tiers a block can be resident in (wire field "tier")
+TIER_DEVICE = "device"
+TIER_PERSIST = "persist"
+# event kinds (wire field "kind")
+KIND_STORED = "stored"
+KIND_REMOVED = "removed"
+
+# every key current producers may put on the wire; event_from_wire drops
+# anything else (forward compat: a newer worker may tag events with
+# fields this router build does not know yet)
+_WIRE_KEYS = frozenset({
+    "event_id", "worker_id", "kind", "parent_hash", "block_hashes",
+    "token_blocks", "tier",
+})
 
 
 @dataclass
@@ -26,9 +50,9 @@ class KvStoredEvent:
     # which cache tier holds the blocks: "device" (HBM radix hit, free to
     # reuse) or "persist" (disk tier — reusable after a host-side restore,
     # so the router scores it at a discount)
-    tier: str = "device"
+    tier: str = TIER_DEVICE
 
-    kind = "stored"
+    kind = KIND_STORED
 
 
 @dataclass
@@ -36,9 +60,9 @@ class KvRemovedEvent:
     """Blocks were evicted from a worker's cache."""
 
     block_hashes: list[int]
-    tier: str = "device"
+    tier: str = TIER_DEVICE
 
-    kind = "removed"
+    kind = KIND_REMOVED
 
 
 KvCacheEvent = Union[KvStoredEvent, KvRemovedEvent]
@@ -54,14 +78,21 @@ def event_to_wire(event_id: int, worker_id: int, ev: KvCacheEvent) -> dict:
             out["token_blocks"] = ev.token_blocks
     else:
         out["block_hashes"] = ev.block_hashes
-    if ev.tier != "device":  # wire-compat: old consumers never see the key
+    if ev.tier != TIER_DEVICE:  # wire-compat: old consumers never see the key
         out["tier"] = ev.tier
     return out
 
 
 def event_from_wire(d: dict) -> tuple[int, int, KvCacheEvent]:
-    tier = d.get("tier", "device")
-    if d["kind"] == "stored":
+    unknown = set(d) - _WIRE_KEYS
+    if unknown:
+        # tolerate-and-drop, never raise: a newer producer must be able
+        # to add fields (e.g. the streamed-handoff layer tags) without
+        # breaking older routers mid-rollout
+        log.debug("kv event: dropping unknown wire fields %s",
+                  sorted(unknown))
+    tier = d.get("tier", TIER_DEVICE)
+    if d["kind"] == KIND_STORED:
         ev: KvCacheEvent = KvStoredEvent(
             block_hashes=list(d["block_hashes"]),
             parent_hash=d.get("parent_hash"),

@@ -36,6 +36,7 @@ from typing import Awaitable, Callable, Optional
 
 import numpy as np
 
+from dynamo_tpu.runtime.transports.protocol import TransferOp
 from dynamo_tpu.runtime.transports.framing import (
     close_writer,
     read_frame,
@@ -172,19 +173,19 @@ class KvTransferServer:
                 h, payload = frame
                 op, rid = h.get("op"), h.get("id")
                 try:
-                    if op == "write_blocks":
+                    if op == TransferOp.WRITE_BLOCKS:
                         await self.write_sink(
                             h["block_ids"],
                             unpack_blocks(h, payload),
                             h.get("request_id"),
                         )
                         write_frame(writer, {"id": rid, "ok": True})
-                    elif op == "read_blocks":
+                    elif op == TransferOp.READ_BLOCKS:
                         if self.read_source is None:
                             raise RuntimeError("read_blocks unsupported on this worker")
                         meta, data = pack_blocks(await self.read_source(h["block_ids"]))
                         write_frame(writer, {"id": rid, "ok": True, **meta}, data)
-                    elif op == "notify":
+                    elif op == TransferOp.NOTIFY:
                         await self.notify_cb(
                             h["request_id"], h.get("first_token", -1), h.get("error")
                         )
@@ -313,7 +314,7 @@ class KvTransferClient:
         meta, data = pack_blocks(arr)
         await self._call(
             {
-                "op": "write_blocks",
+                "op": TransferOp.WRITE_BLOCKS,
                 "block_ids": list(map(int, block_ids)),
                 "request_id": request_id,
                 **meta,
@@ -324,7 +325,7 @@ class KvTransferClient:
     async def read_blocks(self, block_ids: list[int]) -> np.ndarray:
         """Pull blocks out of the peer's cache (NIXL READ)."""
         resp, data = await self._call(
-            {"op": "read_blocks", "block_ids": list(map(int, block_ids))}
+            {"op": TransferOp.READ_BLOCKS, "block_ids": list(map(int, block_ids))}
         )
         return unpack_blocks(resp, data)
 
@@ -333,7 +334,7 @@ class KvTransferClient:
     ) -> None:
         await self._call(
             {
-                "op": "notify",
+                "op": TransferOp.NOTIFY,
                 "request_id": request_id,
                 "first_token": int(first_token),
                 "error": error,

@@ -17,7 +17,13 @@ import logging
 from dataclasses import dataclass, field
 from typing import Sequence
 
-from dynamo_tpu.llm.kv.events import KvCacheEvent, KvRemovedEvent, KvStoredEvent
+from dynamo_tpu.llm.kv.events import (
+    TIER_DEVICE,
+    TIER_PERSIST,
+    KvCacheEvent,
+    KvRemovedEvent,
+    KvStoredEvent,
+)
 
 log = logging.getLogger("dynamo_tpu.kv_router")
 
@@ -136,7 +142,7 @@ class KvIndexer:
                 )
             self._last_event_id[worker_id] = event_id
 
-        if getattr(event, "tier", "device") == "persist":
+        if getattr(event, "tier", TIER_DEVICE) == TIER_PERSIST:
             # persist-tier events bypass the native index (device-only)
             if isinstance(event, KvStoredEvent):
                 blocks = self._persist_worker_blocks.setdefault(worker_id, set())

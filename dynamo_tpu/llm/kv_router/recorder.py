@@ -19,6 +19,9 @@ from dynamo_tpu.llm.kv_router.indexer import KvIndexer
 __all__ = ["KvRecorder", "replay_into"]
 
 
+RECORDING_VERSION = 1
+
+
 class KvRecorder:
     def __init__(self, path: str | Path):
         self._path = Path(path)
@@ -39,6 +42,10 @@ class KvRecorder:
             self._fh = self._path.open("a")
         line = event_to_wire(event_id, worker_id, event)
         line["ts"] = time.time()
+        # recordings outlive the process: tag the format so a future
+        # replayer can detect old captures (event_from_wire drops both
+        # "ts" and "v" as unknown keys on replay) — wirecheck WR004
+        line["v"] = RECORDING_VERSION
         self._fh.write(json.dumps(line) + "\n")
         self._fh.flush()
         self._count += 1

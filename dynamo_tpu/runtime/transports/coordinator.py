@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Callable, Optional
 
+from dynamo_tpu.runtime.transports.protocol import CoordOp
 from dynamo_tpu.runtime.transports.framing import (
     close_writer,
     read_frame,
@@ -50,6 +51,10 @@ log = logging.getLogger("dynamo_tpu.coordinator")
 # wedge every lease writer queued behind the heal — the serve_worker
 # drain path rides these locks at shutdown.
 _HEAL_TIMEOUT_S = float(os.environ.get("DYNTPU_HEAL_TIMEOUT_S", "5"))
+
+# WAL on-disk format version, written as the {"t": "ver"} head record of
+# every compacted wal.jsonl (wirecheck WR004)
+WAL_VERSION = 1
 
 __all__ = ["CoordinatorServer", "CoordinatorClient"]
 
@@ -187,7 +192,13 @@ class CoordinatorServer:
                         log.warning("truncated WAL record skipped")
                         continue  # torn tail write — ignore
                     t = rec.get("t")
-                    if t == "kv":
+                    if t == "ver":
+                        # format marker written at the head of every
+                        # compacted WAL; current readers only need to
+                        # know it exists (unknown versions still replay
+                        # best-effort — the else arm skips unknown "t")
+                        pass
+                    elif t == "kv":
                         self._kv[rec["key"]] = rec.get("value")
                     elif t == "kvdel":
                         self._kv.pop(rec["key"], None)
@@ -212,6 +223,11 @@ class CoordinatorServer:
         # compact: snapshot current state, drop the acked/deleted history
         tmp = path.with_suffix(".tmp")
         with tmp.open("w") as f:
+            # version tag first (wirecheck WR004): an old server replaying
+            # this file skips the unknown "t" harmlessly; a future format
+            # bump flips "v" so readers can detect it
+            f.write(json.dumps({"t": "ver", "v": WAL_VERSION},
+                               separators=(",", ":")) + "\n")
             for key, value in self._kv.items():
                 f.write(json.dumps({"t": "kv", "key": key, "value": value},
                                    separators=(",", ":")) + "\n")
@@ -360,13 +376,13 @@ class CoordinatorServer:
         op = h.get("op")
         rid = h.get("id")
 
-        if op == "kv_put" or op == "kv_create" or op == "kv_create_or_validate":
+        if op == CoordOp.KV_PUT or op == CoordOp.KV_CREATE or op == CoordOp.KV_CREATE_OR_VALIDATE:
             key, value = h["key"], h.get("value")
             exists = key in self._kv
-            if op == "kv_create" and exists:
+            if op == CoordOp.KV_CREATE and exists:
                 await self._send(conn_id, writer, {"id": rid, "ok": False, "exists": True})
                 return
-            if op == "kv_create_or_validate" and exists:
+            if op == CoordOp.KV_CREATE_OR_VALIDATE and exists:
                 ok = self._kv[key] == value
                 await self._send(conn_id, writer, {"id": rid, "ok": ok, "exists": True})
                 return
@@ -397,22 +413,22 @@ class CoordinatorServer:
             await self._notify_watchers("put", key, value)
             await self._send(conn_id, writer, {"id": rid, "ok": True})
 
-        elif op == "kv_get":
+        elif op == CoordOp.KV_GET:
             key = h["key"]
             await self._send(conn_id, writer,
                              {"id": rid, "ok": key in self._kv, "value": self._kv.get(key)})
 
-        elif op == "kv_get_prefix":
+        elif op == CoordOp.KV_GET_PREFIX:
             prefix = h["prefix"]
             items = {k: v for k, v in self._kv.items() if k.startswith(prefix)}
             await self._send(conn_id, writer, {"id": rid, "ok": True, "items": items})
 
-        elif op == "kv_delete":
+        elif op == CoordOp.KV_DELETE:
             key = h["key"]
             existed = self._delete_key(key)
             await self._send(conn_id, writer, {"id": rid, "ok": existed})
 
-        elif op == "watch":
+        elif op == CoordOp.WATCH:
             prefix = h["prefix"]
             watch_id = next(self._ids)
             self._watches[watch_id] = (prefix, writer, conn_id)
@@ -421,11 +437,11 @@ class CoordinatorServer:
             await self._send(conn_id, writer,
                              {"id": rid, "ok": True, "watch_id": watch_id, "snapshot": snapshot})
 
-        elif op == "unwatch":
+        elif op == CoordOp.UNWATCH:
             self._watches.pop(h["watch_id"], None)
             await self._send(conn_id, writer, {"id": rid, "ok": True})
 
-        elif op == "lease_create":
+        elif op == CoordOp.LEASE_CREATE:
             ttl = float(h.get("ttl", 10.0))
             lease_id = next(self._ids)
             self._leases[lease_id] = _Lease(
@@ -434,43 +450,43 @@ class CoordinatorServer:
             self._conn_leases[conn_id].add(lease_id)
             await self._send(conn_id, writer, {"id": rid, "ok": True, "lease_id": lease_id})
 
-        elif op == "lease_keepalive":
+        elif op == CoordOp.LEASE_KEEPALIVE:
             lease = self._leases.get(h["lease_id"])
             if lease:
                 lease.expires_at = time.monotonic() + lease.ttl
             await self._send(conn_id, writer, {"id": rid, "ok": lease is not None})
 
-        elif op == "lease_revoke":
+        elif op == CoordOp.LEASE_REVOKE:
             self._revoke_lease(h["lease_id"])
             await self._send(conn_id, writer, {"id": rid, "ok": True})
 
-        elif op == "subscribe":
+        elif op == CoordOp.SUBSCRIBE:
             sub_id = next(self._ids)
             self._subs[sub_id] = (h["subject"], writer, conn_id)
             await self._send(conn_id, writer, {"id": rid, "ok": True, "sub_id": sub_id})
 
-        elif op == "unsubscribe":
+        elif op == CoordOp.UNSUBSCRIBE:
             self._subs.pop(h["sub_id"], None)
             await self._send(conn_id, writer, {"id": rid, "ok": True})
 
-        elif op == "publish":
+        elif op == CoordOp.PUBLISH:
             subject = h["subject"]
             n = 0
             for sub_id, (pattern, w, cid) in list(self._subs.items()):
                 if _match(pattern, subject):
-                    await self._send(cid, w, {"op": "message", "sub_id": sub_id,
+                    await self._send(cid, w, {"op": CoordOp.MESSAGE, "sub_id": sub_id,
                                               "subject": subject}, payload)
                     n += 1
             await self._send(conn_id, writer, {"id": rid, "ok": True, "delivered": n})
 
-        elif op == "queue_push":
+        elif op == CoordOp.QUEUE_PUSH:
             item = _QueueItem(next(self._ids), payload, {"queue": h["queue"]})
             await self._log_durable({"t": "qpush", "q": h["queue"], "mid": item.msg_id,
                                      "p": base64.b64encode(payload).decode()})
             self._queue_deliver(h["queue"], item)
             await self._send(conn_id, writer, {"id": rid, "ok": True, "msg_id": item.msg_id})
 
-        elif op == "queue_pull":
+        elif op == CoordOp.QUEUE_PULL:
             # run as a task: a long pull must not stall this connection's
             # dispatch loop (keepalives and other ops share the socket)
             async def _pull(queue=h["queue"], timeout=h.get("timeout_ms", 0) / 1e3, rid=rid):
@@ -485,7 +501,7 @@ class CoordinatorServer:
 
             self._spawn(_pull())
 
-        elif op == "queue_ack":
+        elif op == CoordOp.QUEUE_ACK:
             key = (h["queue"], h["msg_id"])
             ok = self._pending_acks.pop(key, None) is not None
             if ok:
@@ -494,20 +510,20 @@ class CoordinatorServer:
                 )
             await self._send(conn_id, writer, {"id": rid, "ok": ok})
 
-        elif op == "queue_nack":
+        elif op == CoordOp.QUEUE_NACK:
             key = (h["queue"], h["msg_id"])
             item = self._pending_acks.pop(key, None)
             if item is not None:
                 self._queue_deliver(h["queue"], item)
             await self._send(conn_id, writer, {"id": rid, "ok": item is not None})
 
-        elif op == "queue_len":
+        elif op == CoordOp.QUEUE_LEN:
             n = len(self._queues[h["queue"]]) + sum(
                 1 for (q, _) in self._pending_acks if q == h["queue"]
             )
             await self._send(conn_id, writer, {"id": rid, "ok": True, "len": n})
 
-        elif op == "blob_begin":
+        elif op == CoordOp.BLOB_BEGIN:
             up_id = next(self._ids)
             st: dict = {"conn_id": conn_id, "size": 0,
                         "sha": hashlib.sha256()}
@@ -522,7 +538,7 @@ class CoordinatorServer:
             await self._send(conn_id, writer,
                              {"id": rid, "ok": True, "upload_id": up_id})
 
-        elif op == "blob_chunk":
+        elif op == CoordOp.BLOB_CHUNK:
             st = self._blob_uploads.get(h["upload_id"])
             if st is None:
                 await self._send(conn_id, writer,
@@ -540,7 +556,7 @@ class CoordinatorServer:
                 st["buf"] += payload
             await self._send(conn_id, writer, {"id": rid, "ok": True})
 
-        elif op == "blob_commit":
+        elif op == CoordOp.BLOB_COMMIT:
             st = self._blob_uploads.pop(h["upload_id"], None)
             if st is None:
                 await self._send(conn_id, writer,
@@ -587,7 +603,7 @@ class CoordinatorServer:
                              {"id": rid, "ok": True, "size": rec["size"],
                               "sha256": sha})
 
-        elif op == "blob_read":
+        elif op == CoordOp.BLOB_READ:
             rec = self._blobs.get(h["name"])
             if rec is None:
                 await self._send(conn_id, writer,
@@ -616,14 +632,14 @@ class CoordinatorServer:
                 data,
             )
 
-        elif op == "blob_stat":
+        elif op == CoordOp.BLOB_STAT:
             rec = self._blobs.get(h["name"])
             await self._send(conn_id, writer,
                              {"id": rid, "ok": rec is not None,
                               **(rec and {k: rec[k] for k in
                                           ("size", "sha256", "meta")} or {})})
 
-        elif op == "blob_list":
+        elif op == CoordOp.BLOB_LIST:
             prefix = h.get("prefix", "")
             items = {
                 n: {k: r[k] for k in ("size", "sha256", "meta")}
@@ -632,7 +648,7 @@ class CoordinatorServer:
             await self._send(conn_id, writer,
                              {"id": rid, "ok": True, "items": items})
 
-        elif op == "blob_delete":
+        elif op == CoordOp.BLOB_DELETE:
             rec = self._blobs.pop(h["name"], None)
             self._blob_data.pop(h["name"], None)
             if rec is not None and "file" in rec:
@@ -647,7 +663,7 @@ class CoordinatorServer:
             await self._send(conn_id, writer,
                              {"id": rid, "ok": rec is not None})
 
-        elif op == "ping":
+        elif op == CoordOp.PING:
             await self._send(conn_id, writer, {"id": rid, "ok": True})
 
         else:
@@ -681,7 +697,7 @@ class CoordinatorServer:
         for watch_id, (prefix, writer, conn_id) in list(self._watches.items()):
             if key.startswith(prefix):
                 await self._send(conn_id, writer, {
-                    "op": "watch_event", "watch_id": watch_id,
+                    "op": CoordOp.WATCH_EVENT, "watch_id": watch_id,
                     "event": event, "key": key, "value": value,
                 })
 
@@ -793,7 +809,7 @@ class CoordinatorClient:
                     break
                 header, payload = frame
                 op = header.get("op")
-                if op == "watch_event":
+                if op == CoordOp.WATCH_EVENT:
                     handle = self._watch_by_srv.get(header["watch_id"])
                     cb = self._watch_cbs.get(handle)
                     if cb:
@@ -804,7 +820,7 @@ class CoordinatorClient:
                         else:
                             known.discard(key)
                         cb(header["event"], key, header.get("value"))
-                elif op == "message":
+                elif op == CoordOp.MESSAGE:
                     handle = self._sub_by_srv.get(header["sub_id"])
                     cb = self._sub_cbs.get(handle)
                     if cb:
@@ -886,7 +902,7 @@ class CoordinatorClient:
         self._watch_by_srv.clear()
         self._sub_by_srv.clear()
         for handle, prefix in list(self._watch_reg.items()):
-            resp, _ = await self._call({"op": "watch", "prefix": prefix}, _internal=True)
+            resp, _ = await self._call({"op": CoordOp.WATCH, "prefix": prefix}, _internal=True)
             self._watch_by_srv[resp["watch_id"]] = handle
             cb = self._watch_cbs.get(handle)
             snapshot = resp.get("snapshot", {})
@@ -901,10 +917,10 @@ class CoordinatorClient:
                     cb("put", k, v)
             self._watch_keys[handle] = set(snapshot)
         for handle, subject in list(self._sub_reg.items()):
-            resp, _ = await self._call({"op": "subscribe", "subject": subject}, _internal=True)
+            resp, _ = await self._call({"op": CoordOp.SUBSCRIBE, "subject": subject}, _internal=True)
             self._sub_by_srv[resp["sub_id"]] = handle
         for handle, ttl in list(self._lease_reg.items()):
-            resp, _ = await self._call({"op": "lease_create", "ttl": ttl}, _internal=True)
+            resp, _ = await self._call({"op": CoordOp.LEASE_CREATE, "ttl": ttl}, _internal=True)
             self._lease_srv[handle] = resp["lease_id"]
         for key, (value, lease_handle, created) in list(self._leased_kv.items()):
             live = self._lease_srv.get(lease_handle)
@@ -920,15 +936,15 @@ class CoordinatorClient:
                 # it over by rebinding to the fresh lease.  A different
                 # value is a new owner: cede.
                 resp, _ = await self._call({
-                    "op": "kv_create", "key": key, "value": value,
+                    "op": CoordOp.KV_CREATE, "key": key, "value": value,
                     "lease_id": live,
                 }, _internal=True)
                 if not resp.get("ok"):
                     cur, _ = await self._call(
-                        {"op": "kv_get", "key": key}, _internal=True)
+                        {"op": CoordOp.KV_GET, "key": key}, _internal=True)
                     if cur.get("ok") and cur.get("value") == value:
                         await self._call({
-                            "op": "kv_put", "key": key, "value": value,
+                            "op": CoordOp.KV_PUT, "key": key, "value": value,
                             "lease_id": live,
                         }, _internal=True)
                     else:
@@ -938,7 +954,7 @@ class CoordinatorClient:
                         del self._leased_kv[key]
             else:
                 await self._call({
-                    "op": "kv_put", "key": key, "value": value,
+                    "op": CoordOp.KV_PUT, "key": key, "value": value,
                     "lease_id": live,
                 }, _internal=True)
 
@@ -1011,7 +1027,7 @@ class CoordinatorClient:
 
     async def kv_put(self, key: str, value: Any, lease_id: Optional[int] = None) -> None:
         await self._lease_call(
-            {"op": "kv_put", "key": key, "value": value}, lease_id)
+            {"op": CoordOp.KV_PUT, "key": key, "value": value}, lease_id)
         if lease_id and self.reconnect:
             # a value update must not erase the key's create-exclusive
             # ownership record — heals would revert to blind overwrite
@@ -1020,27 +1036,27 @@ class CoordinatorClient:
 
     async def kv_create(self, key: str, value: Any, lease_id: Optional[int] = None) -> bool:
         resp, _ = await self._lease_call(
-            {"op": "kv_create", "key": key, "value": value}, lease_id)
+            {"op": CoordOp.KV_CREATE, "key": key, "value": value}, lease_id)
         ok = bool(resp.get("ok"))
         if ok and lease_id and self.reconnect:
             self._leased_kv[key] = (value, lease_id, True)
         return ok
 
     async def kv_create_or_validate(self, key: str, value: Any) -> bool:
-        resp, _ = await self._call({"op": "kv_create_or_validate", "key": key, "value": value})
+        resp, _ = await self._call({"op": CoordOp.KV_CREATE_OR_VALIDATE, "key": key, "value": value})
         return bool(resp.get("ok"))
 
     async def kv_get(self, key: str) -> Optional[Any]:
-        resp, _ = await self._call({"op": "kv_get", "key": key})
+        resp, _ = await self._call({"op": CoordOp.KV_GET, "key": key})
         return resp.get("value") if resp.get("ok") else None
 
     async def kv_get_prefix(self, prefix: str) -> dict[str, Any]:
-        resp, _ = await self._call({"op": "kv_get_prefix", "prefix": prefix})
+        resp, _ = await self._call({"op": CoordOp.KV_GET_PREFIX, "prefix": prefix})
         return resp.get("items", {})
 
     async def kv_delete(self, key: str) -> bool:
         self._leased_kv.pop(key, None)
-        resp, _ = await self._call({"op": "kv_delete", "key": key})
+        resp, _ = await self._call({"op": CoordOp.KV_DELETE, "key": key})
         return bool(resp.get("ok"))
 
     async def watch(
@@ -1048,7 +1064,7 @@ class CoordinatorClient:
     ) -> tuple[int, dict[str, Any]]:
         """Watch a prefix; callback(event, key, value).  Returns
         (watch_id, snapshot-at-watch-start)."""
-        resp, _ = await self._call({"op": "watch", "prefix": prefix})
+        resp, _ = await self._call({"op": CoordOp.WATCH, "prefix": prefix})
         handle = resp["watch_id"]  # stable client handle = first server id
         self._watch_cbs[handle] = callback
         self._watch_by_srv[handle] = handle
@@ -1065,11 +1081,11 @@ class CoordinatorClient:
             (s for s, h in self._watch_by_srv.items() if h == watch_id), watch_id
         )
         self._watch_by_srv.pop(live, None)
-        await self._call({"op": "unwatch", "watch_id": live})
+        await self._call({"op": CoordOp.UNWATCH, "watch_id": live})
 
     # -------------------------------------------------------------- lease API
     async def lease_create(self, ttl: float = 10.0, auto_keepalive: bool = True) -> int:
-        resp, _ = await self._call({"op": "lease_create", "ttl": ttl})
+        resp, _ = await self._call({"op": CoordOp.LEASE_CREATE, "ttl": ttl})
         lease_id = resp["lease_id"]
         if self.reconnect:
             self._lease_srv[lease_id] = lease_id
@@ -1087,7 +1103,7 @@ class CoordinatorClient:
             try:
                 await asyncio.sleep(ttl / 2)
                 resp, _ = await self._call({
-                    "op": "lease_keepalive",
+                    "op": CoordOp.LEASE_KEEPALIVE,
                     "lease_id": self._lease_srv.get(handle, handle),
                 })
                 if not resp.get("ok") and handle in self._lease_reg \
@@ -1115,13 +1131,13 @@ class CoordinatorClient:
         async with self._heal_lock:
             try:
                 probe, _ = await asyncio.wait_for(self._call({
-                    "op": "lease_keepalive",
+                    "op": CoordOp.LEASE_KEEPALIVE,
                     "lease_id": self._lease_srv.get(handle, handle),
                 }), _HEAL_TIMEOUT_S)
                 if probe.get("ok"):
                     return  # another heal won while we waited on the lock
                 resp, _ = await asyncio.wait_for(
-                    self._call({"op": "lease_create", "ttl": ttl}),
+                    self._call({"op": CoordOp.LEASE_CREATE, "ttl": ttl}),
                     _HEAL_TIMEOUT_S)
                 live = resp["lease_id"]
                 log.warning(
@@ -1139,7 +1155,7 @@ class CoordinatorClient:
                         # the new owner's value and rebinding it to the
                         # healed lease
                         resp, _ = await asyncio.wait_for(self._call({
-                            "op": "kv_create", "key": key, "value": value,
+                            "op": CoordOp.KV_CREATE, "key": key, "value": value,
                             "lease_id": live,
                         }), _HEAL_TIMEOUT_S)
                         if not resp.get("ok"):
@@ -1149,7 +1165,7 @@ class CoordinatorClient:
                             del self._leased_kv[key]
                     else:
                         await asyncio.wait_for(self._call({
-                            "op": "kv_put", "key": key, "value": value,
+                            "op": CoordOp.KV_PUT, "key": key, "value": value,
                             "lease_id": live,
                         }), _HEAL_TIMEOUT_S)
             except asyncio.TimeoutError:
@@ -1172,11 +1188,11 @@ class CoordinatorClient:
         for key in [k for k, v in self._leased_kv.items() if v[1] == lease_id]:
             del self._leased_kv[key]
         live = self._lease_srv.pop(lease_id, lease_id)
-        await self._call({"op": "lease_revoke", "lease_id": live})
+        await self._call({"op": CoordOp.LEASE_REVOKE, "lease_id": live})
 
     # ------------------------------------------------------------- pub/sub API
     async def subscribe(self, subject: str, callback: Callable[[str, bytes], None]) -> int:
-        resp, _ = await self._call({"op": "subscribe", "subject": subject})
+        resp, _ = await self._call({"op": CoordOp.SUBSCRIBE, "subject": subject})
         handle = resp["sub_id"]
         self._sub_cbs[handle] = callback
         self._sub_by_srv[handle] = handle
@@ -1190,24 +1206,24 @@ class CoordinatorClient:
             (s for s, h in self._sub_by_srv.items() if h == sub_id), sub_id
         )
         self._sub_by_srv.pop(live, None)
-        await self._call({"op": "unsubscribe", "sub_id": live})
+        await self._call({"op": CoordOp.UNSUBSCRIBE, "sub_id": live})
 
     async def publish(self, subject: str, payload: bytes | dict) -> int:
         if isinstance(payload, dict):
             payload = json.dumps(payload).encode()
-        resp, _ = await self._call({"op": "publish", "subject": subject}, payload)
+        resp, _ = await self._call({"op": CoordOp.PUBLISH, "subject": subject}, payload)
         return resp.get("delivered", 0)
 
     # --------------------------------------------------------------- queue API
     async def queue_push(self, queue: str, payload: bytes | dict) -> int:
         if isinstance(payload, dict):
             payload = json.dumps(payload).encode()
-        resp, _ = await self._call({"op": "queue_push", "queue": queue}, payload)
+        resp, _ = await self._call({"op": CoordOp.QUEUE_PUSH, "queue": queue}, payload)
         return resp["msg_id"]
 
     async def queue_pull(self, queue: str, timeout_s: float = 0.0) -> Optional[tuple[int, bytes]]:
         resp, payload = await self._call(
-            {"op": "queue_pull", "queue": queue, "timeout_ms": int(timeout_s * 1e3)}
+            {"op": CoordOp.QUEUE_PULL, "queue": queue, "timeout_ms": int(timeout_s * 1e3)}
         )
         if not resp.get("ok"):
             return None
@@ -1215,14 +1231,14 @@ class CoordinatorClient:
 
     async def queue_len(self, queue: str) -> int:
         """Depth incl. unacked deliveries (disagg router backpressure input)."""
-        resp, _ = await self._call({"op": "queue_len", "queue": queue})
+        resp, _ = await self._call({"op": CoordOp.QUEUE_LEN, "queue": queue})
         return int(resp.get("len", 0))
 
     async def queue_ack(self, queue: str, msg_id: int) -> None:
-        await self._call({"op": "queue_ack", "queue": queue, "msg_id": msg_id})
+        await self._call({"op": CoordOp.QUEUE_ACK, "queue": queue, "msg_id": msg_id})
 
     async def queue_nack(self, queue: str, msg_id: int) -> None:
-        await self._call({"op": "queue_nack", "queue": queue, "msg_id": msg_id})
+        await self._call({"op": CoordOp.QUEUE_NACK, "queue": queue, "msg_id": msg_id})
 
     # ---------------------------------------------------------------- blob API
     async def blob_put(self, name: str, data, meta: Optional[dict] = None,
@@ -1230,7 +1246,7 @@ class CoordinatorClient:
         """Upload a blob: ``data`` is bytes or a filesystem path (streamed
         in chunks — a multi-GB checkpoint never materialises in memory).
         Returns {size, sha256}."""
-        resp, _ = await self._call({"op": "blob_begin"})
+        resp, _ = await self._call({"op": CoordOp.BLOB_BEGIN})
         up = resp["upload_id"]
 
         def chunks():
@@ -1247,9 +1263,9 @@ class CoordinatorClient:
                         yield b
 
         for c in chunks():
-            await self._call({"op": "blob_chunk", "upload_id": up}, c)
+            await self._call({"op": CoordOp.BLOB_CHUNK, "upload_id": up}, c)
         resp, _ = await self._call(
-            {"op": "blob_commit", "upload_id": up, "name": name,
+            {"op": CoordOp.BLOB_COMMIT, "upload_id": up, "name": name,
              "meta": meta or {}}
         )
         return {"size": resp["size"], "sha256": resp["sha256"]}
@@ -1270,7 +1286,7 @@ class CoordinatorClient:
         try:
             while True:
                 resp, payload = await self._call(
-                    {"op": "blob_read", "name": name, "offset": off,
+                    {"op": CoordOp.BLOB_READ, "name": name, "offset": off,
                      "length": chunk_size}
                 )
                 if not resp.get("ok"):
@@ -1303,19 +1319,19 @@ class CoordinatorClient:
         return meta if dest is not None else bytes(buf)
 
     async def blob_stat(self, name: str) -> Optional[dict]:
-        resp, _ = await self._call({"op": "blob_stat", "name": name})
+        resp, _ = await self._call({"op": CoordOp.BLOB_STAT, "name": name})
         if not resp.get("ok"):
             return None
         return {k: resp[k] for k in ("size", "sha256", "meta")}
 
     async def blob_list(self, prefix: str = "") -> dict[str, dict]:
-        resp, _ = await self._call({"op": "blob_list", "prefix": prefix})
+        resp, _ = await self._call({"op": CoordOp.BLOB_LIST, "prefix": prefix})
         return resp.get("items", {})
 
     async def blob_delete(self, name: str) -> bool:
-        resp, _ = await self._call({"op": "blob_delete", "name": name})
+        resp, _ = await self._call({"op": CoordOp.BLOB_DELETE, "name": name})
         return bool(resp.get("ok"))
 
     async def ping(self) -> bool:
-        resp, _ = await self._call({"op": "ping"})
+        resp, _ = await self._call({"op": CoordOp.PING})
         return bool(resp.get("ok"))

@@ -30,6 +30,7 @@ from typing import Any, AsyncIterator, Callable, Optional
 
 from dynamo_tpu.runtime import serde
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.transports.protocol import FrameType
 from dynamo_tpu.runtime.transports.framing import (
     close_writer,
     read_frame,
@@ -208,7 +209,7 @@ class EndpointTcpServer:
         async def run_request(req_id: int, subject: str, data: Any) -> None:
             engine = self._engines.get(subject)
             if engine is None:
-                await send({"type": "error", "req_id": req_id,
+                await send({"type": FrameType.ERROR, "req_id": req_id,
                             "error": f"no endpoint {subject!r}"})
                 return
             ctx = Context(data)
@@ -216,11 +217,11 @@ class EndpointTcpServer:
             self._track(subject, +1)
             try:
                 async for item in engine.generate(ctx):
-                    await send({"type": "item", "req_id": req_id}, serde.dumps(item))
-                await send({"type": "end", "req_id": req_id})
+                    await send({"type": FrameType.ITEM, "req_id": req_id}, serde.dumps(item))
+                await send({"type": FrameType.END, "req_id": req_id})
             except Exception as e:
                 log.exception("endpoint %s request failed", subject)
-                await send({"type": "error", "req_id": req_id, "error": str(e)})
+                await send({"type": FrameType.ERROR, "req_id": req_id, "error": str(e)})
             finally:
                 self._track(subject, -1)
                 contexts.pop(req_id, None)
@@ -234,21 +235,21 @@ class EndpointTcpServer:
                 header, payload = frame
                 ftype = header.get("type")
                 req_id = header.get("req_id")
-                if ftype == "request":
+                if ftype == FrameType.REQUEST:
                     data = serde.loads(payload)
                     tasks[req_id] = asyncio.ensure_future(
                         run_request(req_id, header.get("subject", ""), data)
                     )
-                elif ftype == "stop":
+                elif ftype == FrameType.STOP:
                     ctx = contexts.get(req_id)
                     if ctx:
                         ctx.stop_generating()
-                elif ftype == "kill":
+                elif ftype == FrameType.KILL:
                     ctx = contexts.get(req_id)
                     if ctx:
                         ctx.kill()
-                elif ftype == "ping":
-                    await send({"type": "pong", "req_id": req_id})
+                elif ftype == FrameType.PING:
+                    await send({"type": FrameType.PONG, "req_id": req_id})
         finally:
             # peer gone: kill all in-flight requests from this connection
             self._conns.discard(writer)
@@ -383,7 +384,7 @@ class EndpointTcpClient(AsyncEngine):
                 if q is None:
                     continue
                 ftype = header.get("type")
-                if ftype == "item":
+                if ftype == FrameType.ITEM:
                     item = serde.loads(payload)
                     # bounded-queue backpressure (DT006): a wedged
                     # consumer stops the read loop buffering at the
@@ -400,11 +401,11 @@ class EndpointTcpClient(AsyncEngine):
                             break
                         except asyncio.QueueFull:
                             await asyncio.sleep(0.01)
-                elif ftype == "end":
+                elif ftype == FrameType.END:
                     self._force_put(q, _END)
-                elif ftype == "pong":
+                elif ftype == FrameType.PONG:
                     self._force_put(q, _PONG)
-                elif ftype == "error":
+                elif ftype == FrameType.ERROR:
                     self._force_put(
                         q, RuntimeError(header.get("error", "remote error"))
                     )
@@ -450,7 +451,7 @@ class EndpointTcpClient(AsyncEngine):
         self._idle.clear()
         t0 = asyncio.get_running_loop().time()
         try:
-            await self._send({"type": "ping", "req_id": req_id})
+            await self._send({"type": FrameType.PING, "req_id": req_id})
             try:
                 item = await asyncio.wait_for(q.get(), timeout)
             except asyncio.TimeoutError:
@@ -485,7 +486,7 @@ class EndpointTcpClient(AsyncEngine):
         self._idle.clear()
         try:
             await self._send(
-                {"type": "request", "req_id": req_id, "subject": self.subject},
+                {"type": FrameType.REQUEST, "req_id": req_id, "subject": self.subject},
                 serde.dumps(request.data),
             )
         except BaseException:
@@ -508,7 +509,7 @@ class EndpointTcpClient(AsyncEngine):
                     get_task.cancel()
                     try:
                         await self._send(
-                            {"type": "kill" if request.is_killed else "stop",
+                            {"type": FrameType.KILL if request.is_killed else "stop",
                              "req_id": req_id}
                         )
                     except (ConnectionError, RuntimeError, OSError):

@@ -1,23 +1,25 @@
 #!/bin/sh
 # Convenience wrapper for the static-analysis suite (docs/static_analysis.md).
-# Runs ALL THREE passes:
+# One process, ALL FOUR passes (dynamo-tpu lint --all), sharing one
+# ast.parse per file across the per-file, project and wire passes:
 #   1+2. per-file rules (DT001-DT104) + interprocedural project pass
-#        (DT005-DT009) — one invocation, sharing one ast.parse per file
-#   3.   compile-plane trace audit (TR001-TR007, docs section "compile
-#        plane") against the committed analysis/trace_manifest.json
+#        (DT005-DT009)
+#   3.   compile-plane trace audit (TR001-TR007) against the committed
+#        analysis/trace_manifest.json
+#   4.   wire-plane contract check (WR001-WR007) against the committed
+#        analysis/wire_manifest.json
 #   scripts/lint.sh                      # lint dynamo_tpu/, human output
 #   scripts/lint.sh --format json        # stable JSON (one doc per pass)
+#   scripts/lint.sh --changed            # pre-commit mode: per-file rules
+#                                        # on git-dirty files only; the
+#                                        # project/trace/wire passes stay
+#                                        # whole-program
 #   scripts/lint.sh --update-baseline    # rebuild analysis/baseline.json
-#                                        # AND the trace manifest
+#                                        # AND both manifests
 #                                        # (justifications carried by key)
 #   scripts/lint.sh --select DT005       # one rule (project codes route
 #                                        # to the project registry; the
-#                                        # trace pass ignores --select)
+#                                        # trace/wire passes ignore it)
 # Exit code 1 on any non-baselined finding from any pass.
 cd "$(dirname "$0")/.." || exit 2
-python -m dynamo_tpu lint --project "$@"
-rc_ast=$?
-python -m dynamo_tpu lint --trace "$@"
-rc_trace=$?
-[ "$rc_ast" -ne 0 ] && exit "$rc_ast"
-exit "$rc_trace"
+exec python -m dynamo_tpu lint --all "$@"

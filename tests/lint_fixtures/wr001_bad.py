@@ -1,0 +1,14 @@
+"""WR001 bad: producer writes 'debug', no consumer ever reads it."""
+import json
+
+
+def send(sock):
+    sock.send(json.dumps(
+        {"kind": "ping", "seq": 1, "debug": "trace-me"}).encode())
+
+
+def recv(data):
+    msg = json.loads(data)
+    if msg["kind"] == "ping":
+        return msg["seq"]
+    return None

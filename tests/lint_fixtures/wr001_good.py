@@ -1,0 +1,13 @@
+"""WR001 good: every produced field has a reader."""
+import json
+
+
+def send(sock):
+    sock.send(json.dumps({"kind": "ping", "seq": 1}).encode())
+
+
+def recv(data):
+    msg = json.loads(data)
+    if msg["kind"] == "ping":
+        return msg["seq"]
+    return None

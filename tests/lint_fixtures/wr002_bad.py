@@ -1,0 +1,19 @@
+"""WR002 bad: the consumer requires 'value', but one producer of the
+'put' message never writes it — a latent KeyError on the wire."""
+import json
+
+
+def send_full(sock):
+    sock.send(json.dumps(
+        {"kind": "put", "key": "k", "value": 1}).encode())
+
+
+def send_sparse(sock):
+    sock.send(json.dumps({"kind": "put", "key": "k"}).encode())
+
+
+def recv(data):
+    msg = json.loads(data)
+    if msg["kind"] == "put":
+        return msg["key"], msg["value"]
+    return None

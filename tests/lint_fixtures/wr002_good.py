@@ -1,0 +1,18 @@
+"""WR002 good: the sometimes-absent field is read with a default."""
+import json
+
+
+def send_full(sock):
+    sock.send(json.dumps(
+        {"kind": "put", "key": "k", "value": 1}).encode())
+
+
+def send_sparse(sock):
+    sock.send(json.dumps({"kind": "put", "key": "k"}).encode())
+
+
+def recv(data):
+    msg = json.loads(data)
+    if msg["kind"] == "put":
+        return msg["key"], msg.get("value", 0)
+    return None

@@ -1,0 +1,21 @@
+"""WR003 bad: producers emit op 'fetch' that no dispatch arm handles,
+and the consumer handles op 'drop' that no producer ever emits."""
+import json
+
+
+def send_store(sock):
+    sock.send(json.dumps({"op": "store", "key": "k"}).encode())
+
+
+def send_fetch(sock):
+    sock.send(json.dumps({"op": "fetch", "key": "k"}).encode())
+
+
+def recv(data):
+    msg = json.loads(data)
+    op = msg["op"]
+    if op == "store":
+        return ("store", msg["key"])
+    elif op == "drop":
+        return ("drop", msg["key"])
+    return None

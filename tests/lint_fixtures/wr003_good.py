@@ -1,0 +1,20 @@
+"""WR003 good: the emitted op domain and the dispatch domain match."""
+import json
+
+
+def send_store(sock):
+    sock.send(json.dumps({"op": "store", "key": "k"}).encode())
+
+
+def send_fetch(sock):
+    sock.send(json.dumps({"op": "fetch", "key": "k"}).encode())
+
+
+def recv(data):
+    msg = json.loads(data)
+    op = msg["op"]
+    if op == "store":
+        return ("store", msg["key"])
+    elif op == "fetch":
+        return ("fetch", msg["key"])
+    return None

@@ -1,0 +1,7 @@
+"""WR004 bad: a durable payload (outlives the process) with no
+version/generation tag — old readers cannot detect a format change."""
+import json
+
+
+def save(path):
+    path.write_text(json.dumps({"kind": "snap", "items": [1, 2, 3]}))

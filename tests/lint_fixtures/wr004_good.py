@@ -1,0 +1,7 @@
+"""WR004 good: the durable payload carries a version tag."""
+import json
+
+
+def save(path):
+    path.write_text(json.dumps(
+        {"kind": "snap", "version": 1, "items": [1, 2, 3]}))

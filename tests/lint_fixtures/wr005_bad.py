@@ -1,0 +1,9 @@
+"""WR005 bad: struct.pack bytes flow into json.dumps — it raises
+TypeError at runtime (bytes are not JSON-serialisable)."""
+import json
+import struct
+
+
+def send(sock):
+    sock.send(json.dumps(
+        {"kind": "blob", "data": struct.pack("<I", 7)}).encode())

@@ -1,0 +1,10 @@
+"""WR005 good: binary payloads cross the wire as base64 text."""
+import base64
+import json
+import struct
+
+
+def send(sock):
+    raw = struct.pack("<I", 7)
+    sock.send(json.dumps(
+        {"kind": "blob", "data": base64.b64encode(raw).decode()}).encode())

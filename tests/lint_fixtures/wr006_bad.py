@@ -1,0 +1,8 @@
+"""WR006 bad: a framing write is reachable after the writer is closed
+on the same path — the static twin of dtsan's FramingGuard."""
+
+
+async def shutdown(writer, write_frame, close_writer):
+    await write_frame(writer, {"type": "end"}, b"")
+    close_writer(writer)
+    await write_frame(writer, {"type": "late"}, b"")

@@ -1,0 +1,6 @@
+"""WR006 good: every framing write happens before the close."""
+
+
+async def shutdown(writer, write_frame, close_writer):
+    await write_frame(writer, {"type": "end"}, b"")
+    close_writer(writer)

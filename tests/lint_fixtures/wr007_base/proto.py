@@ -1,0 +1,18 @@
+"""WR007 fixture (baseline side): the committed schema for `proto`.
+
+Paired with ../wr007_drift/proto.py — same module name under a
+different fixture root, with one extra produced field, so a manifest
+snapshotted from THIS file flags schema drift on the other.
+"""
+import json
+
+
+def send(sock):
+    sock.send(json.dumps({"kind": "ping", "seq": 1}).encode())
+
+
+def recv(data):
+    msg = json.loads(data)
+    if msg["kind"] == "ping":
+        return msg["seq"]
+    return None

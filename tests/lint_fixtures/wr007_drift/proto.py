@@ -1,0 +1,16 @@
+"""WR007 fixture (drift side): `proto` grew a produced+read field
+('host'), so its schema hash no longer matches a manifest snapshotted
+from ../wr007_base/proto.py."""
+import json
+
+
+def send(sock):
+    sock.send(json.dumps(
+        {"kind": "ping", "seq": 1, "host": "a"}).encode())
+
+
+def recv(data):
+    msg = json.loads(data)
+    if msg["kind"] == "ping":
+        return msg["seq"], msg.get("host", "")
+    return None

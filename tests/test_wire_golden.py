@@ -1,0 +1,163 @@
+"""Golden wire-format regression tests: committed byte-level recordings
+(tests/wire_golden/, regenerate with `python tests/wire_golden/
+generate.py`) decoded by CURRENT code, and re-encoded byte-identically.
+
+These are the backward-compat safety net the wire manifest's WR007
+schema hashes point at: a failure here means the bytes on the wire (or
+on disk, for DTKVP1) changed — every older peer and every persisted
+snapshot speaks the committed bytes, so either restore compatibility or
+consciously version-bump the format and regenerate.
+"""
+
+import asyncio
+import hashlib
+import json
+import struct
+from pathlib import Path
+
+import pytest
+
+from dynamo_tpu.llm.kv import persist
+from dynamo_tpu.llm.kv.events import (
+    KvStoredEvent,
+    event_from_wire,
+    event_to_wire,
+)
+from dynamo_tpu.runtime.transports.framing import encode_frame, read_frame
+from dynamo_tpu.runtime.transports.protocol import CoordOp, FrameType
+
+GOLDEN = Path(__file__).parent / "wire_golden"
+
+
+def _decode_frames(blob: bytes):
+    """Run the real async read_frame over a fed StreamReader until EOF."""
+
+    async def drain():
+        reader = asyncio.StreamReader()
+        reader.feed_data(blob)
+        reader.feed_eof()
+        frames = []
+        while True:
+            frame = await read_frame(reader)
+            if frame is None:
+                return frames
+            frames.append(frame)
+
+    return asyncio.run(drain())
+
+
+# ---------------------------------------------------------- TCP frames ----
+
+
+def test_tcp_sequence_decodes():
+    frames = _decode_frames((GOLDEN / "tcp_sequence.bin").read_bytes())
+    types = [h["type"] for h, _ in frames]
+    assert types == [FrameType.REQUEST, FrameType.ITEM, FrameType.ITEM,
+                     FrameType.END, FrameType.PING, FrameType.PONG]
+    req, preq = frames[0]
+    assert req["req_id"] == 7 and req["subject"] == "gen"
+    assert preq == b'{"prompt":"hi"}'
+    assert [p for _, p in frames[1:3]] == [b'{"token":"a"}',
+                                           b'{"token":"b"}']
+    # control frames are header-only: zero payload bytes
+    assert all(p == b"" for _, p in frames[3:])
+
+
+def test_tcp_sequence_reencodes_byte_identical():
+    committed = (GOLDEN / "tcp_sequence.bin").read_bytes()
+    frames = _decode_frames(committed)
+    assert b"".join(encode_frame(h, p) for h, p in frames) == committed
+
+
+# -------------------------------------------------- coordinator command ----
+
+
+def test_coordinator_command_decodes():
+    blob = (GOLDEN / "coordinator_command.bin").read_bytes()
+    ((header, payload),) = _decode_frames(blob)
+    assert header["op"] == CoordOp.KV_PUT
+    assert header["id"] == 42
+    assert header["key"] == "instances/worker-0"
+    assert header["value"] == {"host": "10.0.0.1", "port": 9000}
+    assert payload == b""
+    assert encode_frame(header, payload) == blob
+
+
+def test_frame_layout_is_the_documented_struct():
+    """[u32 hlen][u32 plen][header][payload], big-endian — decoded by
+    hand so a framing.py refactor can't silently move the goalposts."""
+    blob = (GOLDEN / "coordinator_command.bin").read_bytes()
+    hlen, plen = struct.unpack(">II", blob[:8])
+    assert len(blob) == 8 + hlen + plen
+    assert json.loads(blob[8:8 + hlen])["op"] == "kv_put"
+
+
+# ----------------------------------------------------- router KV event ----
+
+
+def test_router_kv_event_decodes():
+    line = (GOLDEN / "router_kv_event.jsonl").read_text().strip()
+    event_id, worker_id, ev = event_from_wire(json.loads(line))
+    assert (event_id, worker_id) == (5, 3)
+    assert isinstance(ev, KvStoredEvent)
+    assert ev.block_hashes == [111, 222]
+    assert ev.parent_hash is None
+    assert ev.token_blocks == [[1, 2], [3, 4]]
+    assert ev.tier == "persist"
+
+
+def test_router_kv_event_reencodes_byte_identical():
+    committed = (GOLDEN / "router_kv_event.jsonl").read_bytes()
+    event_id, worker_id, ev = event_from_wire(
+        json.loads(committed.decode()))
+    line = json.dumps(event_to_wire(event_id, worker_id, ev),
+                      separators=(",", ":")) + "\n"
+    assert line.encode() == committed
+
+
+def test_router_kv_event_tolerates_unknown_fields():
+    """Forward compat (and what makes recorder.py's ts/v bookkeeping
+    replayable): unknown wire keys are dropped with a debug log, never
+    a raise."""
+    d = json.loads((GOLDEN / "router_kv_event.jsonl").read_text())
+    d["ts"] = 1700000000.5
+    d["v"] = 1
+    d["layer_tags"] = [0, 1]  # a future streamed-handoff field
+    event_id, worker_id, ev = event_from_wire(d)
+    assert (event_id, worker_id) == (5, 3)
+    assert ev.block_hashes == [111, 222] and ev.tier == "persist"
+
+
+# -------------------------------------------------------- DTKVP1 header ----
+
+
+def test_dtkvp1_blob_parses():
+    blob = (GOLDEN / "dtkvp1_blob.bin").read_bytes()
+    header, payload = persist._parse(blob, "golden-gen")
+    assert header["version"] == persist.FORMAT_VERSION
+    assert header["hashes"] == [12345, 67890]
+    assert header["leaves"] == [{"dtype": "uint8", "shape": [2, 16]}]
+    assert payload == bytes(range(32))
+    assert hashlib.sha256(payload).hexdigest() == header["payload_sha256"]
+    # wrong generation must refuse (the cross-restart safety check)
+    with pytest.raises(Exception):
+        persist._parse(blob, "other-gen")
+
+
+def test_dtkvp1_blob_reencodes_byte_identical():
+    committed = (GOLDEN / "dtkvp1_blob.bin").read_bytes()
+    header, payload = persist._parse(committed, "golden-gen")
+    assert persist.PersistentKvStore._encode(header, payload) == committed
+
+
+def test_golden_fixtures_match_generator():
+    """The committed bytes ARE what generate.py produces today — so a
+    format change can't hide behind a stale regeneration."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "wire_golden_generate", GOLDEN / "generate.py")
+    gen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gen)
+    for name, fn in gen.FIXTURES.items():
+        assert fn() == (GOLDEN / name).read_bytes(), name

@@ -1,0 +1,250 @@
+"""Wire-plane static analysis (dtwire) tests: THE fourth tier-1 gate
+(zero non-accepted findings over the extracted cross-process message
+contracts against the committed wire manifest), the manifest contract
+(schema drift, ``--update-baseline`` justification carry-over, stable
+JSON), and each WR001–WR007 rule on bad/good fixtures under
+tests/lint_fixtures/.
+"""
+
+import argparse
+import io
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from dynamo_tpu.analysis.wirecheck import (
+    DEFAULT_WIRE_MANIFEST_PATH,
+    WIRE_RULES,
+    WireFinding,
+    WireManifest,
+    check_wire,
+    collect_wire_facts,
+    run_wire,
+)
+
+ROOT = Path(__file__).resolve().parents[1]
+FIXTURES = Path(__file__).parent / "lint_fixtures"
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+def _fixture_findings(path, root=FIXTURES):
+    """Intrinsic findings for one fixture file, WR007 suppressed via a
+    self-snapshot manifest (fixtures test the site rules, not drift)."""
+    facts, intrinsic = collect_wire_facts([path], root=root)
+    manifest = WireManifest(messages=facts)
+    return facts, check_wire(facts, manifest, intrinsic)
+
+
+# ------------------------------------------------------------- the gate ----
+
+
+@pytest.fixture(scope="module")
+def real():
+    t0 = time.perf_counter()
+    facts, intrinsic = collect_wire_facts()
+    elapsed = time.perf_counter() - t0
+    return facts, intrinsic, elapsed
+
+
+def test_wire_gate_zero_nonaccepted_findings(real):
+    """THE tier-1 wire-plane gate: every extracted message contract is
+    clean against the committed wire manifest.  If this fails you
+    either fix the drift (a producer/consumer field mismatch, an
+    unversioned durable payload — preferred) or, for a justified
+    by-design fact, re-snapshot with `dynamo-tpu lint --wire
+    --update-baseline` and justify the new accepted entry."""
+    facts, intrinsic, _ = real
+    manifest = WireManifest.load(DEFAULT_WIRE_MANIFEST_PATH)
+    assert manifest.messages, "wire manifest missing or empty"
+    findings = check_wire(facts, manifest, intrinsic)
+    fresh = manifest.filter(findings)
+    assert not fresh, (
+        "non-accepted wire-plane findings:\n  "
+        + "\n  ".join(f.render() for f in fresh)
+        + "\nFix the drift, or re-snapshot via `dynamo-tpu lint --wire "
+        "--update-baseline` and add a justification "
+        "(docs/static_analysis.md#wire-plane)."
+    )
+
+
+def test_wire_gate_is_fast(real):
+    """Acceptance bound: the fourth gate's fact collection stays ≤5s
+    (it shares core.parse_module's cache with the other passes)."""
+    _, _, elapsed = real
+    assert elapsed <= 5.0, f"wire fact collection took {elapsed:.1f}s"
+
+
+def test_manifest_accepted_entries_justified_and_live(real):
+    """Every accepted entry carries a real justification and still
+    matches a current finding (no stale grandfathering)."""
+    facts, intrinsic, _ = real
+    manifest = WireManifest.load(DEFAULT_WIRE_MANIFEST_PATH)
+    for e in manifest.accepted:
+        assert e.get("justification", "").strip() not in (
+            "", "TODO: justify"), (
+            f"accepted entry {e['message']}:{e['rule']}[{e['key']}] "
+            "needs a one-line justification"
+        )
+    keys = {f.accept_key
+            for f in check_wire(facts, manifest, intrinsic)}
+    stale = [e for e in manifest.accepted
+             if (e["message"], e["rule"], e["key"]) not in keys]
+    assert not stale, (
+        "accepted entries no longer match any finding (re-snapshot "
+        "with --update-baseline): "
+        + str([(e["message"], e["rule"], e["key"]) for e in stale])
+    )
+
+
+def test_extraction_covers_the_core_planes(real):
+    """The extractor keeps seeing the channels the repo actually has:
+    the coordinator command+WAL planes, the TCP endpoint frame plane,
+    the KV transfer plane, the DTKVP1 persist header and the router
+    event subject."""
+    facts, _, _ = real
+    names = set(facts)
+    for needle in (
+        "transports.coordinator/op",
+        "transports.coordinator/t",
+        "transports.tcp/type",
+        "kv.transfer/op",
+        "kv.persist/-",
+        "subject:events_subject/kind",
+    ):
+        assert any(needle in n for n in names), (needle, sorted(names))
+    # the coordinator WAL and the persist header are durable + versioned
+    wal = next(n for n in names if n.endswith("coordinator/t"))
+    assert facts[wal]["durable"] and facts[wal]["version_tagged"]
+
+
+# ------------------------------------------------------- rule fixtures ----
+
+
+@pytest.mark.parametrize("rule", ["WR001", "WR002", "WR003", "WR004",
+                                  "WR005", "WR006"])
+def test_rule_fixtures(rule):
+    n = rule[-3:].lstrip("0") or "0"
+    bad = FIXTURES / f"wr{int(n):03d}_bad.py"
+    good = FIXTURES / f"wr{int(n):03d}_good.py"
+    _, bad_findings = _fixture_findings(bad)
+    _, good_findings = _fixture_findings(good)
+    assert rule in _rules(bad_findings), (
+        f"{bad.name} should trip {rule}, got "
+        + str([f.render() for f in bad_findings]))
+    assert rule not in _rules(good_findings), (
+        f"{good.name} should be clean of {rule}, got "
+        + str([f.render() for f in good_findings]))
+
+
+def test_wr007_schema_drift_fixture_pair():
+    """Same module name under two fixture roots: a manifest snapshotted
+    from the base side flags only schema drift on the drift side."""
+    base_facts, _ = collect_wire_facts(
+        [FIXTURES / "wr007_base" / "proto.py"],
+        root=FIXTURES / "wr007_base")
+    drift_facts, _ = collect_wire_facts(
+        [FIXTURES / "wr007_drift" / "proto.py"],
+        root=FIXTURES / "wr007_drift")
+    manifest = WireManifest(messages=base_facts)
+    assert not check_wire(base_facts, manifest, [])
+    findings = check_wire(drift_facts, manifest, [])
+    assert [(f.rule, f.key) for f in findings] == [
+        ("WR007", "schema-drift")]
+
+
+def test_wr007_added_and_removed_message():
+    facts, _ = collect_wire_facts([FIXTURES / "wr001_good.py"],
+                                  root=FIXTURES)
+    # empty manifest: no WR007 (first snapshot is free)
+    assert not check_wire(facts, WireManifest(), [])
+    # manifest knows a channel that vanished -> removed; the current
+    # channel is new to it -> added
+    manifest = WireManifest(messages={"module:gone/-": {"schema": "x"}})
+    keys = {(f.rule, f.message, f.key)
+            for f in check_wire(facts, manifest, [])}
+    assert ("WR007", "module:gone/-", "removed") in keys
+    assert ("WR007", "module:wr001_good/kind", "added") in keys
+
+
+def test_rule_table_complete():
+    assert sorted(WIRE_RULES) == [f"WR00{i}" for i in range(1, 8)]
+
+
+# --------------------------------------------------- update + CLI contract ----
+
+
+def _args(**kw):
+    base = dict(paths=None, fmt="text", select=None, baseline=None,
+                no_baseline=False, update_baseline=False, root=None,
+                project=False, trace=False, wire=True, manifest=None)
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+def test_update_roundtrip_carries_justifications(tmp_path):
+    """finding -> exit 1 -> --update accepts it (TODO) -> justify ->
+    second --update carries the justification by key -> gate green."""
+    mpath = tmp_path / "manifest.json"
+    fixture = str(FIXTURES / "wr001_bad.py")
+    args = lambda **kw: _args(paths=[fixture], root=str(FIXTURES),
+                              manifest=str(mpath), **kw)
+    assert run_wire(args(), out=io.StringIO()) == 1          # WR001
+
+    assert run_wire(args(update_baseline=True),
+                    out=io.StringIO()) == 0
+    doc = json.loads(mpath.read_text())
+    assert "module:wr001_bad/kind" in doc["messages"]
+    assert [e["justification"] for e in doc["accepted"]] == [
+        "TODO: justify"]
+
+    doc["accepted"][0]["justification"] = "kept: debug metadata"
+    mpath.write_text(json.dumps(doc))
+    assert run_wire(args(), out=io.StringIO()) == 0  # accepted, no drift
+
+    assert run_wire(args(update_baseline=True),
+                    out=io.StringIO()) == 0
+    doc = json.loads(mpath.read_text())
+    assert [e["justification"] for e in doc["accepted"]] == [
+        "kept: debug metadata"]
+
+
+def test_json_output_stable_sorted(tmp_path):
+    mpath = tmp_path / "manifest.json"
+    outs = []
+    for _ in range(2):
+        out = io.StringIO()
+        run_wire(_args(paths=[str(FIXTURES / "wr003_bad.py")],
+                       root=str(FIXTURES), manifest=str(mpath),
+                       fmt="json"), out=out)
+        outs.append(out.getvalue())
+    assert outs[0] == outs[1]
+    doc = json.loads(outs[0])
+    assert {"findings", "accepted", "total", "messages"} <= set(doc)
+    assert doc["findings"] == sorted(
+        doc["findings"],
+        key=lambda f: (f["message"], f["rule"], f["key"]))
+
+
+def test_cli_routes_wire_flag(tmp_path):
+    """`dynamo-tpu lint --wire` reaches run_wire (not the file pass)."""
+    from dynamo_tpu.analysis.cli import run_lint
+
+    out = io.StringIO()
+    rc = run_lint(_args(paths=[str(FIXTURES / "wr001_good.py")],
+                        root=str(FIXTURES),
+                        manifest=str(tmp_path / "m.json")), out=out)
+    assert rc == 0
+    assert "wire finding" in out.getvalue()
+
+
+def test_manifest_filter_is_a_multiset():
+    f = WireFinding("m", "WR001", "k", "d")
+    m = WireManifest(accepted=[{"message": "m", "rule": "WR001",
+                                "key": "k"}])
+    assert m.filter([f]) == []
+    assert m.filter([f, f]) == [f]  # budget of one covers one

@@ -1,0 +1,104 @@
+"""Regenerate the committed golden wire-format fixtures.
+
+    python tests/wire_golden/generate.py
+
+Four byte-level recordings of the repo's cross-process formats, decoded
+by CURRENT code in tests/test_wire_golden.py — the backward-compat
+safety net the wire manifest's WR007 schema hashes can point at.  A
+diff in any of these files is a wire-format break: every peer (older
+worker, router, coordinator, persisted DTKVP1 snapshot on disk) speaks
+the committed bytes, not your new ones.
+
+Everything here is deterministic (fixed ids, fixed timestamps, fixed
+payloads) so regeneration is byte-stable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import struct
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO))
+
+from dynamo_tpu.llm.kv import persist  # noqa: E402
+from dynamo_tpu.llm.kv.events import KvStoredEvent, event_to_wire  # noqa: E402
+from dynamo_tpu.runtime.transports.framing import encode_frame  # noqa: E402
+from dynamo_tpu.runtime.transports.protocol import (  # noqa: E402
+    CoordOp,
+    FrameType,
+)
+
+OUT = Path(__file__).resolve().parent
+
+
+def tcp_sequence() -> bytes:
+    """A full endpoint exchange: request -> two items -> end, then a
+    health probe (ping/pong are header-only control frames)."""
+    frames = [
+        ({"type": FrameType.REQUEST, "req_id": 7, "subject": "gen"},
+         b'{"prompt":"hi"}'),
+        ({"type": FrameType.ITEM, "req_id": 7}, b'{"token":"a"}'),
+        ({"type": FrameType.ITEM, "req_id": 7}, b'{"token":"b"}'),
+        ({"type": FrameType.END, "req_id": 7}, b""),
+        ({"type": FrameType.PING, "req_id": 8}, b""),
+        ({"type": FrameType.PONG, "req_id": 8}, b""),
+    ]
+    return b"".join(encode_frame(h, p) for h, p in frames)
+
+
+def coordinator_command() -> bytes:
+    """One kv_put request frame, the coordinator's bread and butter."""
+    return encode_frame(
+        {"op": CoordOp.KV_PUT, "id": 42, "key": "instances/worker-0",
+         "value": {"host": "10.0.0.1", "port": 9000}},
+        b"",
+    )
+
+
+def router_kv_event() -> bytes:
+    """A stored-blocks router event on the persist tier (JSON line, the
+    shape recorder.py writes minus its local ts/v bookkeeping)."""
+    ev = KvStoredEvent(block_hashes=[111, 222], parent_hash=None,
+                      token_blocks=[[1, 2], [3, 4]], tier="persist")
+    return (json.dumps(event_to_wire(5, 3, ev),
+                       separators=(",", ":")) + "\n").encode()
+
+
+def dtkvp1_blob() -> bytes:
+    """A complete DTKVP1 block-group file: magic, little-endian u64
+    header length, header JSON, raw payload."""
+    payload = bytes(range(32))
+    header = {
+        "version": persist.FORMAT_VERSION,
+        "generation": "golden-gen",
+        "hashes": [12345, 67890],
+        "structure": {"kind": "list", "n": 1},
+        "leaves": [{"dtype": "uint8", "shape": [2, 16]}],
+        "payload_sha256": hashlib.sha256(payload).hexdigest(),
+        "created": 1700000000.0,
+    }
+    hj = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    return persist.MAGIC + struct.pack("<Q", len(hj)) + hj + payload
+
+
+FIXTURES = {
+    "tcp_sequence.bin": tcp_sequence,
+    "coordinator_command.bin": coordinator_command,
+    "router_kv_event.jsonl": router_kv_event,
+    "dtkvp1_blob.bin": dtkvp1_blob,
+}
+
+
+def main() -> None:
+    for name, fn in FIXTURES.items():
+        blob = fn()
+        (OUT / name).write_bytes(blob)
+        print(f"wrote {name}: {len(blob)} bytes")
+
+
+if __name__ == "__main__":
+    main()
