@@ -115,9 +115,16 @@ async def prefill_dispatch_stats(url):
                     "unified_prefill_tokens", "unified_budget_utilization",
                     "persist_hits_total", "persist_misses_total",
                     "persist_restored_tokens_total",
-                    "persist_spill_bytes_total", "persist_resident_bytes"):
+                    "persist_spill_bytes_total", "persist_resident_bytes",
+                    "host_gap_ms_per_turn"):
             if line.startswith(f"dynamo_tpu_engine_{key} "):
                 vals[key] = float(line.rsplit(" ", 1)[-1])
+        # measured DCN transfer bandwidth (EWMA) — keep the max over
+        # edges so one scalar summarizes the disagg KV hop
+        if line.startswith("dynamo_tpu_kv_transfer_mbps{") and 'path="dcn"' in line:
+            vals["transfer_mbps_dcn"] = max(
+                vals.get("transfer_mbps_dcn", 0.0),
+                float(line.rsplit(" ", 1)[-1]))
     dispatches = vals.get("prefill_dispatches_total", 0)
     if not dispatches:
         return None
@@ -159,6 +166,12 @@ async def prefill_dispatch_stats(url):
             "persist_resident_bytes": int(
                 vals.get("persist_resident_bytes", 0)),
         })
+    if "host_gap_ms_per_turn" in vals:
+        # the engine step timeline's headline: host wall per dispatching
+        # step outside dispatch+readback (ROADMAP item 3 before-number)
+        out["host_gap_ms_per_turn"] = round(vals["host_gap_ms_per_turn"], 3)
+    if "transfer_mbps_dcn" in vals:
+        out["transfer_mbps_dcn"] = round(vals["transfer_mbps_dcn"], 2)
     return out
 
 

@@ -662,6 +662,13 @@ class _ReadWalker:
                 continue
             if not isinstance(n, ast.Call):
                 continue
+            # dtspan envelope: tracing.extract(root) is an optional
+            # read of the trace-context field off the header
+            raw = dotted_name(n.func)
+            if (raw.rsplit(".", 1)[-1] == "extract"
+                    and "tracing" in raw
+                    and n.args and self.is_root(n.args[0])):
+                self.record("trace", False, tags, guarded)
             # consumed-domain contributions outside If tests handled by
             # analyze_test on the enclosing If; Compare nodes inside
             # expressions (return x == ...) are rare enough to skip.
@@ -708,6 +715,9 @@ class _Extractor:
         self.site_findings: list[WireFinding] = []
         self._profiles: dict[tuple[str, str], _Profile] = {}
         self.sink_params: set[tuple[str, str]] = set()
+        # (qualname, param) pairs a tracing.inject() call stamps the
+        # dtspan trace field onto before the frame is written
+        self.inject_params: set[tuple[str, str]] = set()
         self.callback_channels: dict[tuple[str, str], str] = {}
         self.frame_returners: set[str] = set()
         self.dict_returners: set[str] = set()
@@ -744,6 +754,13 @@ class _Extractor:
                 return self._as_dict_source(expr.func.value, fn, ctx,
                                             depth + 1)
             if canon == "json.dumps" and expr.args:
+                return self._as_dict_source(expr.args[0], fn, ctx,
+                                            depth + 1)
+            if canon.endswith("tracing.inject") and expr.args:
+                # dtspan envelope: inject(h) returns the same header
+                # with an optional trace-context field stamped on it —
+                # unwrap so the underlying dict literal still resolves
+                # (the caller tags the producer with the trace key)
                 return self._as_dict_source(expr.args[0], fn, ctx,
                                             depth + 1)
             if canon.endswith("asdict") or any(
@@ -785,7 +802,7 @@ class _Extractor:
         return None
 
     def add_producer(self, src, base: str, durable: bool,
-                     fallback_module: str):
+                     fallback_module: str, injected: bool = False):
         if src == "opaque":
             self.producers.append(_Producer(
                 fallback_module, base, {}, {}, opaque=True,
@@ -802,6 +819,10 @@ class _Extractor:
         for aug_node, aug_var, aug_ctx, aug_mod in src[5:]:
             _dict_augments(aug_node.body, aug_var, aug_ctx, aug_mod,
                            self.consts, keys, domains)
+        if injected:
+            # dtspan envelope: inject() stamps the trace context only
+            # when tracing is enabled AND a span is active — maybe
+            keys.setdefault("trace", "maybe")
         self.producers.append(_Producer(
             owner_mod, base, keys, domains, opaque=opaque,
             durable=durable))
@@ -823,20 +844,28 @@ class _Extractor:
 
     # --------------------------------------------------------- sink fixpoint
     def _sink_arg_exprs(self, call: ast.Call, fn: FunctionInfo, ctx):
-        """Expressions at header-sink positions of this call."""
+        """(expr, injected) pairs at header-sink positions of this
+        call; ``injected`` marks headers a ``tracing.inject`` stamps
+        the optional dtspan trace field onto en route to the wire."""
         out = []
+
+        def is_inject(e) -> bool:
+            return (isinstance(e, ast.Call)
+                    and dotted_name(e.func).rsplit(".", 1)[-1]
+                    == "inject")
+
         canon = self.canon(call, ctx)
         leaf = canon.rsplit(".", 1)[-1] if canon else ""
         if leaf == "write_frame" and len(call.args) >= 2:
-            out.append(call.args[1])
+            out.append((call.args[1], is_inject(call.args[1])))
         elif leaf == "encode_frame" and call.args:
-            out.append(call.args[0])
+            out.append((call.args[0], is_inject(call.args[0])))
         elif canon == "json.dumps" and call.args:
-            out.append(call.args[0])
+            out.append((call.args[0], False))
         for kw in call.keywords:
             if kw.arg == "header" and leaf in ("write_frame",
                                                "encode_frame"):
-                out.append(kw.value)
+                out.append((kw.value, is_inject(kw.value)))
         site = _classify_call(call, ctx)
         if site is not None and self.sink_params:
             for t in self.index.resolve(site, fn):
@@ -850,11 +879,34 @@ class _Extractor:
                     if (pi < len(params)
                             and (t.qualname, params[pi])
                             in self.sink_params):
-                        out.append(a)
+                        out.append((a, is_inject(a) or
+                                    (t.qualname, params[pi])
+                                    in self.inject_params))
                 for kw in call.keywords:
                     if kw.arg and (t.qualname, kw.arg) in self.sink_params:
-                        out.append(kw.value)
+                        out.append((kw.value, is_inject(kw.value) or
+                                    (t.qualname, kw.arg)
+                                    in self.inject_params))
         return out
+
+    def _build_inject_params(self):
+        """Function params a ``tracing.inject(param)`` call stamps the
+        dtspan trace field onto (the RPC-helper idiom: the header dict
+        arrives as a param, inject mutates it, write_frame sends it)."""
+        for fn in self.index.functions.values():
+            if fn.node is None:
+                continue
+            pnames = set(_param_names(fn.node))
+            for call in (n for n in ast.walk(fn.node)
+                         if isinstance(n, ast.Call)):
+                raw = dotted_name(call.func)
+                if (raw.rsplit(".", 1)[-1] == "inject"
+                        and "tracing" in raw
+                        and call.args
+                        and isinstance(call.args[0], ast.Name)
+                        and call.args[0].id in pnames):
+                    self.inject_params.add((fn.qualname,
+                                            call.args[0].id))
 
     def _build_sinks(self):
         changed = True
@@ -867,7 +919,7 @@ class _Extractor:
                 pnames = set(_param_names(fn.node))
                 for call in (n for n in ast.walk(fn.node)
                              if isinstance(n, ast.Call)):
-                    for expr in self._sink_arg_exprs(call, fn, ctx):
+                    for expr, _inj in self._sink_arg_exprs(call, fn, ctx):
                         name = _root_name(expr)
                         if (name and name in pnames
                                 and (fn.qualname, name)
@@ -958,6 +1010,7 @@ class _Extractor:
 
     # ------------------------------------------------------------ the pass
     def run(self):
+        self._build_inject_params()
         self._build_sinks()
         self._build_frame_returners()
         self._build_callbacks()
@@ -1103,13 +1156,14 @@ class _Extractor:
 
         # producers via header sinks
         sunk = self._sink_arg_exprs(call, fn, ctx)
-        for expr in sunk:
+        for expr, injected in sunk:
             src = self._as_dict_source(expr, fn, ctx)
             if src not in (None, "opaque"):
                 handled.add(id(src[0]))
             if canon == "json.dumps":
                 self._wr005(expr, src, fn, ctx)
-            self.add_producer(src, mod_base, False, fn.module)
+            self.add_producer(src, mod_base, False, fn.module,
+                              injected=injected)
 
         # producers via pub/sub publish
         if (isinstance(call.func, ast.Attribute)

@@ -176,6 +176,10 @@ def _build_local_engine(args) -> tuple[object, object]:
         # step per turn when both phases have work
         unified_token_dispatch=bool(
             getattr(args, "unified_token_dispatch", False)),
+        # dtspan profile hook: one jax.profiler capture over the first
+        # profile_steps device steps
+        profile_dir=(getattr(args, "profile_dir", None) or None),
+        profile_steps=int(getattr(args, "profile_steps", 8) or 8),
     )
     draft = None
     dpath = getattr(args, "spec_draft_model", None)
@@ -813,6 +817,26 @@ def _cmd_quantize(args) -> None:
              args.scheme, time.monotonic() - t0)
 
 
+async def _cmd_trace(args) -> None:
+    """Fetch one request's Chrome trace-event JSON from a frontend's
+    ``/debug/traces/{request_id}`` endpoint.  The output loads in
+    chrome://tracing and https://ui.perfetto.dev; the serving processes
+    must run with tracing on (``--trace`` or ``DYNAMO_TRACE=1``)."""
+    from aiohttp import ClientSession
+
+    url = f"{args.url.rstrip('/')}/debug/traces/{args.request_id}"
+    async with ClientSession() as s:
+        async with s.get(url) as resp:
+            body = await resp.text()
+            if resp.status != 200:
+                raise SystemExit(f"trace fetch failed ({resp.status}): {body}")
+    if args.out:
+        Path(args.out).write_text(body)
+        print(args.out)
+    else:
+        print(body)
+
+
 async def _cmd_models(args) -> None:
     """llmctl parity: manage ModelEntry records on the coordinator — plus
     ``push``/``pull``: model-artifact distribution through the blob store
@@ -942,6 +966,15 @@ def _parser() -> argparse.ArgumentParser:
     run.add_argument("--max-tokens", type=int, default=128)
     run.add_argument("--host", default="127.0.0.1")
     run.add_argument("--http-port", type=int, default=8080)
+    run.add_argument("--trace", action="store_true",
+                     help="enable the dtspan tracing plane (same as "
+                     "DYNAMO_TRACE=1): per-request spans, exported as "
+                     "Chrome trace JSON at /debug/traces/{request_id}")
+    run.add_argument("--profile-dir", default=None,
+                     help="wrap the first --profile-steps engine device "
+                     "steps in ONE jax.profiler capture written under "
+                     "this directory (keyed by first step id)")
+    run.add_argument("--profile-steps", type=int, default=8)
     common(run)
 
     serve = sub.add_parser("serve", help="serve a graph of @service components")
@@ -1067,6 +1100,19 @@ def _parser() -> argparse.ArgumentParser:
                         help="pull: cache directory override")
     common(models)
 
+    trace = sub.add_parser(
+        "trace",
+        help="fetch one request's Chrome trace-event JSON from a "
+        "frontend's /debug/traces endpoint (server must run with "
+        "--trace / DYNAMO_TRACE=1)",
+    )
+    trace.add_argument("request_id",
+                       help="response id or the caller's x-request-id")
+    trace.add_argument("--url", default="http://127.0.0.1:8080",
+                       help="frontend base URL")
+    trace.add_argument("-o", "--out", default=None,
+                       help="write the JSON here instead of stdout")
+
     from dynamo_tpu.analysis.cli import configure_parser as _lint_parser
 
     _lint_parser(sub.add_parser(
@@ -1098,6 +1144,10 @@ def main(argv: Optional[list[str]] = None) -> None:
         if "in" not in kv or "out" not in kv:
             raise SystemExit("run needs in=<...> and out=<...>")
         args.inp, args.out = kv["in"], kv["out"]
+        if getattr(args, "trace", False):
+            from dynamo_tpu.obs import tracing
+
+            tracing.enable(True)
         asyncio.run(_cmd_run(args))
     elif args.cmd == "serve":
         if args.graph == "-" and not args.package:
@@ -1125,6 +1175,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         asyncio.run(_cmd_mock_worker(args))
     elif args.cmd == "models":
         asyncio.run(_cmd_models(args))
+    elif args.cmd == "trace":
+        asyncio.run(_cmd_trace(args))
     elif args.cmd == "lint":
         from dynamo_tpu.analysis.cli import run_lint
 

@@ -21,6 +21,7 @@ from typing import AsyncIterator
 from dynamo_tpu.engine.core import EngineCore
 from dynamo_tpu.engine.request import EngineRequest
 from dynamo_tpu.llm.protocols import BackendInput, LLMEngineOutput
+from dynamo_tpu.obs import tracing
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 
 log = logging.getLogger("dynamo_tpu.engine")
@@ -109,6 +110,12 @@ class AsyncLLMEngine(AsyncEngine):
         def emit(out: LLMEngineOutput) -> None:
             loop.call_soon_threadsafe(out_q.put_nowait, out)
 
+        # dtspan: one span per engine-side generation, parented on the
+        # caller's context (HTTP root span or a TCP server hop) so the
+        # frontend's trace id continues through the engine.  The engine
+        # thread has no ambient contextvar — req.trace carries the pair.
+        span = tracing.start_span(
+            "engine.generate", attrs={"request_id": request.id})
         req = EngineRequest(
             request_id=request.id,
             prompt=list(inp.token_ids),
@@ -118,7 +125,10 @@ class AsyncLLMEngine(AsyncEngine):
             remote_prefill=remote_prefill,
             remote_decode=remote_decode,
             on_allocated=on_allocated,
+            trace=span.context(),
         )
+        if tracing.enabled():
+            tracing.collector.bind_request(request.id, span.trace_id)
         self.core.submit(req)
         self._wake.set()
 
@@ -132,6 +142,10 @@ class AsyncLLMEngine(AsyncEngine):
                 )
                 if get_task in done:
                     out = get_task.result()
+                    if (req.queue_wait_s is not None
+                            and "queue_wait_s" not in request.annotations):
+                        # surface admission wait for the HTTP histogram
+                        request.annotations["queue_wait_s"] = req.queue_wait_s
                     yield out
                     if out.finished:
                         return
@@ -156,3 +170,7 @@ class AsyncLLMEngine(AsyncEngine):
                 # consumer dropped the stream mid-generation
                 self.core.abort(req.request_id)
                 self._wake.set()
+            span.set(
+                finish=str(req.finish_reason) if req.finish_reason else "",
+                queue_wait_s=req.queue_wait_s or 0.0,
+            ).end()

@@ -114,6 +114,12 @@ class EngineConfig:
     prefill_buckets: list[int] = field(default_factory=list)
     # sharding: data/model axis sizes; 1,1 = single chip
     mesh_shape: tuple[int, int] = (1, 1)
+    # dtspan profile hook: when profile_dir is set, the engine wraps the
+    # first profile_steps device steps in ONE jax.profiler capture
+    # written under profile_dir/steps-<first step id>/ (CLI:
+    # --profile-dir / --profile-steps on serve/http)
+    profile_dir: Optional[str] = None
+    profile_steps: int = 8
     # rng
     seed: int = 0
 

@@ -34,8 +34,10 @@ import collections
 import concurrent.futures
 import dataclasses
 import logging
+import os
 import queue
 import threading
+import time
 from typing import Callable, Optional
 
 import jax
@@ -54,6 +56,7 @@ from dynamo_tpu.ops.block_copy import gather_blocks_padded, scatter_blocks_inpla
 from dynamo_tpu.llm.kv.block_manager import KvBlockManager, NoFreeBlocks
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput
 from dynamo_tpu.models.llama import LlamaModel
+from dynamo_tpu.obs.timeline import step_timeline
 from dynamo_tpu.tokens import TokenBlockSequence
 
 log = logging.getLogger("dynamo_tpu.engine")
@@ -512,6 +515,11 @@ class EngineCore:
         self.unified_budget_offered = 0  # flat-axis budget offered
         self.unified_budget_used = 0     # decode rows + prefill tokens
         self._last_was_prefill = False
+        # --profile-dir hook: one jax.profiler capture over the first
+        # config.profile_steps device steps, keyed by starting step id
+        self._profile_active = False
+        self._profile_done = False
+        self._profile_from_step = 0
 
     # ----------------------------------------------------------- step kernel
     def _step_impl(self, params, cache, *args, prefix_blocks=None,
@@ -909,16 +917,21 @@ class EngineCore:
         self._rng, rng = jax.random.split(self._rng)
         gkw = self._gram_kwargs(gram)
         gkw.update(extras or {})
+        step_timeline.mark("host_build")
         up, gkw = self._upload_dispatch(
             (tokens, positions, block_tables, seq_lens, slot_idx, last_idx,
              temp, top_k, top_p), gkw)
+        step_timeline.mark("upload")
         out, self.cache = self._step_fn(
             self.params, self.cache,
             *up[:6], rng, *up[6:],
             prefix_blocks=prefix_blocks, k_cand=k_cand, exact=exact, **gkw,
         )
+        step_timeline.mark("dispatch")
         self.steps += 1
-        return tuple(jax.device_get(out))
+        out = tuple(jax.device_get(out))
+        step_timeline.mark("readback")
+        return out
 
     def _run_multi_decode_step(self, tokens, positions, block_tables, seq_lens,
                                limits, temp, top_k, top_p, pen=None, gram=None,
@@ -932,7 +945,9 @@ class EngineCore:
                 temp, top_k, top_p] + (list(pen) if use_pen else [])
         gkw = self._gram_kwargs(gram)
         gkw.update(extras or {})
+        step_timeline.mark("host_build")
         up, gkw = self._upload_dispatch(host, gkw)
+        step_timeline.mark("upload")
         up = list(up)
         args = up[:5] + [rng] + up[5:]
         out, self.cache = self._multi_fn(
@@ -940,14 +955,18 @@ class EngineCore:
             num_steps=num_steps, k_cand=k_cand, exact=exact,
             use_penalties=use_pen, **gkw,
         )
+        step_timeline.mark("dispatch")
         self.steps += 1
         # ONE batched transfer: per-array np.asarray would issue a
         # device->host round trip per output (per-array latency is the
         # cost that matters on a remote-attached chip)
-        return tuple(jax.device_get(out))
+        out = tuple(jax.device_get(out))
+        step_timeline.mark("readback")
+        return out
 
     # ------------------------------------------------------- cross-thread API
     def submit(self, request: EngineRequest) -> None:
+        request.submitted_at = time.perf_counter()
         self.waiting.put(request)
 
     def abort(self, request_id: str) -> None:
@@ -1021,15 +1040,55 @@ class EngineCore:
             out.update(self.host_pool.stats())
         if self.persist_store is not None:
             out.update(self.persist_store.stats())
+        # step-timeline headline (process-global; obs/timeline.py)
+        out["host_gap_ms_per_turn"] = step_timeline.host_gap_ms_per_turn
         return out
 
     # -------------------------------------------------------------- main loop
     def step(self) -> bool:
-        """Run one scheduling iteration.  Returns False when idle."""
+        """Run one scheduling iteration.  Returns False when idle.
+
+        The body is wrapped in the dtspan step timeline (obs/timeline.py):
+        ``begin()`` opens the step, the scheduler and every dispatch
+        helper ``mark()`` their phase boundaries, ``end()`` attributes
+        the residue — so per-phase wall time sums to step wall time by
+        construction (the host-bubble before-number ROADMAP item 3
+        needs)."""
+        self._maybe_profile_start()
+        step_timeline.begin()
+        try:
+            return self._step_inner()
+        finally:
+            step_timeline.end()
+            self._maybe_profile_stop()
+
+    def _maybe_profile_start(self) -> None:
+        cfg = self.config
+        if not cfg.profile_dir or self._profile_done or self._profile_active:
+            return
+        path = os.path.join(cfg.profile_dir, f"steps-{self.steps:06d}")
+        os.makedirs(path, exist_ok=True)
+        jax.profiler.start_trace(path)
+        self._profile_active = True
+        self._profile_from_step = self.steps
+
+    def _maybe_profile_stop(self) -> None:
+        if not self._profile_active:
+            return
+        if (self.steps - self._profile_from_step
+                >= max(1, self.config.profile_steps)):
+            jax.profiler.stop_trace()
+            self._profile_active = False
+            self._profile_done = True
+
+    def _step_inner(self) -> bool:
         self._drain_offload()  # evictions from the previous step's tail
+        step_timeline.mark("kv_spill_restore")
         self._process_ops()
         self._process_aborts()
+        step_timeline.mark("host_ops")
         self._admit()
+        step_timeline.mark("admission")
         # slots not yet decoding (waiting on external KV, or mid-chunked-
         # prefill): honour aborts here — _append_token never runs for them,
         # so without this a cancelled long prompt would keep prefilling
@@ -1235,6 +1294,8 @@ class EngineCore:
             req.wait_upto = req.cached_tokens + alloc.joined_tokens
             self._reserve_own(req)
             req.slot = slot
+            if req.submitted_at:
+                req.queue_wait_s = time.perf_counter() - req.submitted_at
             req.state = (
                 RequestState.REMOTE_PREFILL if req.remote_prefill else RequestState.PREFILL
             )
@@ -1491,14 +1552,18 @@ class EngineCore:
         self._rng, rng = jax.random.split(self._rng)
         gkw = self._gram_kwargs(gram)
         gkw.update(extras or {})
+        step_timeline.mark("host_build")
         up, gkw = self._upload_dispatch(
             (tokens, positions, bt, seq_lens, slot_idx, seq_ids, starts,
              roff, last_idx, temp, top_k, top_p), gkw)
+        step_timeline.mark("upload")
         out, self.cache = self._ragged_fn(
             self.params, self.cache, *up[:9], rng, *up[9:],
             prefix_blocks=pb, k_cand=k_cand, exact=exact, **gkw,
         )
+        step_timeline.mark("dispatch")
         sampled, lps, cids, clps = jax.device_get(out)  # one batched pull
+        step_timeline.mark("readback")
         self.steps += 1
         self.prefill_steps += 1
         take_sum = sum(take for _, take, _ in sel)
@@ -1693,19 +1758,24 @@ class EngineCore:
 
         # growth allocations above may have evicted registered blocks
         # that this very dispatch writes into — offload them first
+        step_timeline.mark("host_build")
         self._drain_offload()
+        step_timeline.mark("kv_spill_restore")
         self._rng, rng = jax.random.split(self._rng)
         gkw = self._gram_kwargs(gram)
         gkw.update(extras)
         up, gkw = self._upload_dispatch(
             (tokens, positions, bt, seq_lens, slot_idx, seq_ids, starts,
              roff, last_idx, temp, top_k, top_p), gkw)
+        step_timeline.mark("upload")
         out, self.cache = self._unified_fn(
             self.params, self.cache, *up[:9], rng, *up[9:],
             row_tokens=d_region, prefix_blocks=pb, k_cand=k_cand,
             exact=exact, **gkw,
         )
+        step_timeline.mark("dispatch")
         sampled, lps, cids, clps = jax.device_get(out)  # one batched pull
+        step_timeline.mark("readback")
         self.steps += 1
         self.prefill_steps += 1
         self.decode_steps += 1
@@ -1823,18 +1893,22 @@ class EngineCore:
         last_idx = np.asarray([req.prompt_len - 1], np.int32)
         self._rng, rng = jax.random.split(self._rng)
         k_cand, exact = self._sampling_mode([req])
+        step_timeline.mark("host_build")
         up, _ = self._upload_dispatch((
             tokens, positions, last_idx,
             np.asarray([req.sampling.temperature], np.float32),
             np.asarray([req.sampling.top_k], np.int32),
             np.asarray([req.sampling.top_p], np.float32),
         ))
+        step_timeline.mark("upload")
         (sampled, lps, cids, clps), blocks = self._sp_fn(
             self.params, up[0], up[1], up[2], rng, up[3], up[4], up[5],
             nb=nb_pad, k_cand=k_cand, exact=exact,
         )
+        step_timeline.mark("dispatch")
         sampled, lps, cids, clps = jax.device_get(
             (sampled, lps, cids, clps))  # one batched transfer
+        step_timeline.mark("readback")
         nb = -(-req.prompt_len // bs)
         self.cache = scatter_blocks_inplace(
             self.cache, req.block_ids[:nb],
@@ -1990,18 +2064,23 @@ class EngineCore:
         blocks_used = max(1, -(-int(seq_lens.max()) // cfg.block_size))
         m_used = min(m, 1 << (blocks_used - 1).bit_length())
 
+        step_timeline.mark("host_build")
         self._drain_offload()
+        step_timeline.mark("kv_spill_restore")
         self._rng, rng = jax.random.split(self._rng)
         k_cand, exact = self._sampling_mode(rows)
         up, _ = self._upload_dispatch(
             (tokens, positions, bt[:, :m_used], seq_lens, slot_idx,
              temp, top_k, top_p, min_p, seeds, seed_rows))
+        step_timeline.mark("upload")
         verified, self.cache = self._spec_fn(
             self.params, self.cache,
             *up[:5], rng, *up[5:],
             k_cand=k_cand, exact=exact,
         )
+        step_timeline.mark("dispatch")
         verified = jax.device_get(verified)
+        step_timeline.mark("readback")
         self.steps += 1
         self.decode_steps += 1
         self.spec_steps += 1
@@ -2096,7 +2175,9 @@ class EngineCore:
             return
         # growth allocations above may have evicted registered blocks that
         # this very dispatch writes into — offload them first
+        step_timeline.mark("host_build")
         self._drain_offload()
+        step_timeline.mark("kv_spill_restore")
         k_cand, exact = self._sampling_mode(active)
         pen = self._penalty_buffers(active, k_steps)
         gram = None

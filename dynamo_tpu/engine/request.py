@@ -70,6 +70,15 @@ class EngineRequest:
     slot: int = -1
     finish_reason: Optional[FinishReason] = None
     abort_requested: bool = False
+    # dtspan trace context (trace_id, span_id) — the engine thread has
+    # no ambient contextvar, so spans it records for this request pass
+    # this pair as parent= explicitly (obs/tracing.py)
+    trace: Optional[tuple] = None
+    # queue-wait measurement: submit() stamps submitted_at
+    # (perf_counter); _admit computes queue_wait_s at slot assignment
+    # and the async engine surfaces it to the HTTP histogram
+    submitted_at: float = 0.0
+    queue_wait_s: Optional[float] = None
 
     @property
     def prompt_len(self) -> int:

@@ -15,32 +15,40 @@ from typing import Iterator
 from dynamo_tpu.engine.counters import counters as prefill_counters
 from dynamo_tpu.engine.counters import persist_counters
 from dynamo_tpu.fault.counters import counters as fault_counters
+from dynamo_tpu.obs.costs import transfer_costs
+from dynamo_tpu.obs.timeline import PHASES, step_timeline
 
 PREFIX = "dynamo_tpu_http_service"
 FAULT_PREFIX = "dynamo_tpu_fault"
 ENGINE_PREFIX = "dynamo_tpu_engine"
+KV_PREFIX = "dynamo_tpu_kv_transfer"
 
 # seconds; TTFT and whole-request durations share one ladder
 _BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+# finer ladder for per-token gaps — ITL sits well under the request
+# ladder's first bound on warm decode
+_ITL_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5)
 
 
 class Histogram:
     """Minimal Prometheus histogram (cumulative buckets + sum + count)."""
 
-    def __init__(self) -> None:
-        self.counts = [0] * (len(_BUCKETS) + 1)  # last = +Inf
+    def __init__(self, buckets: tuple = _BUCKETS) -> None:
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # last = +Inf
         self.total = 0.0
         self.n = 0
 
     def observe(self, v: float) -> None:
         # first bucket with bound >= v; past the ladder = the +Inf slot
-        self.counts[bisect.bisect_left(_BUCKETS, v)] += 1
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
         self.total += v
         self.n += 1
 
     def render(self, name: str, labels: str) -> Iterator[str]:
         cum = 0
-        for b, c in zip(_BUCKETS, self.counts):
+        for b, c in zip(self.buckets, self.counts):
             cum += c
             yield f'{name}_bucket{{{labels},le="{b}"}} {cum}'
         yield f'{name}_bucket{{{labels},le="+Inf"}} {self.n}'
@@ -56,6 +64,14 @@ class Metrics:
         self.inflight: dict[str, int] = defaultdict(int)
         self.tokens_out: dict[str, int] = defaultdict(int)
         self.ttft: dict[str, Histogram] = defaultdict(Histogram)
+        # per-token gap after the first token (the streaming-latency SLO
+        # metric TTFT says nothing about); multi-token emissions spread
+        # the emission gap evenly across their tokens
+        self.itl: dict[str, Histogram] = defaultdict(
+            lambda: Histogram(_ITL_BUCKETS))
+        # submit -> slot admission wait inside the engine (from
+        # EngineRequest.queue_wait_s via Context annotations)
+        self.queue_wait: dict[str, Histogram] = defaultdict(Histogram)
         # duration keyed by (model, status): near-zero error/disconnect
         # requests must not pull the success series' percentiles down
         self.duration: dict[tuple[str, str], Histogram] = defaultdict(Histogram)
@@ -90,6 +106,14 @@ class Metrics:
         lines.append(f"# TYPE {PREFIX}_ttft_seconds histogram")
         for model, h in sorted(self.ttft.items()):
             lines.extend(h.render(f"{PREFIX}_ttft_seconds",
+                                  f'model="{model}"'))
+        lines.append(f"# TYPE {PREFIX}_inter_token_seconds histogram")
+        for model, h in sorted(self.itl.items()):
+            lines.extend(h.render(f"{PREFIX}_inter_token_seconds",
+                                  f'model="{model}"'))
+        lines.append(f"# TYPE {PREFIX}_queue_wait_seconds histogram")
+        for model, h in sorted(self.queue_wait.items()):
+            lines.extend(h.render(f"{PREFIX}_queue_wait_seconds",
                                   f'model="{model}"'))
         lines.append(f"# TYPE {PREFIX}_request_seconds histogram")
         for (model, status), h in sorted(self.duration.items()):
@@ -153,6 +177,44 @@ class Metrics:
         lines.append(f"# TYPE {ENGINE_PREFIX}_persist_resident_bytes gauge")
         lines.append(f"{ENGINE_PREFIX}_persist_resident_bytes "
                      f"{persist_counters.resident_bytes}")
+        # dtspan engine step timeline: per-phase wall attribution plus the
+        # headline host bubble (ROADMAP item 3's committed before-number)
+        tl = step_timeline.snapshot()
+        lines.append(f"# TYPE {ENGINE_PREFIX}_steps_total counter")
+        lines.append(f"{ENGINE_PREFIX}_steps_total {tl['steps_total']}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_busy_steps_total counter")
+        lines.append(f"{ENGINE_PREFIX}_busy_steps_total "
+                     f"{tl['busy_steps_total']}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_step_wall_seconds_total counter")
+        lines.append(f"{ENGINE_PREFIX}_step_wall_seconds_total "
+                     f"{round(tl['wall_seconds_total'], 6)}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_step_phase_seconds_total counter")
+        for p in PHASES:
+            lines.append(
+                f'{ENGINE_PREFIX}_step_phase_seconds_total{{phase="{p}"}} '
+                f"{round(tl['phases'][p], 6)}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_host_gap_ms_per_turn gauge")
+        lines.append(f"{ENGINE_PREFIX}_host_gap_ms_per_turn "
+                     f"{round(tl['host_gap_ms_per_turn'], 6)}")
+        # measured KV-transfer costs per (src, dst, path) edge
+        costs = transfer_costs.snapshot()
+        if costs:
+            for metric, typ in (("calls_total", "counter"),
+                                ("bytes_total", "counter"),
+                                ("seconds_total", "counter"),
+                                ("mbps", "gauge"),
+                                ("latency_ms", "gauge")):
+                lines.append(f"# TYPE {KV_PREFIX}_{metric} {typ}")
+                for (src, dst, path), e in sorted(costs.items()):
+                    labels = f'src="{src}",dst="{dst}",path="{path}"'
+                    val = {
+                        "calls_total": e["calls"],
+                        "bytes_total": e["bytes"],
+                        "seconds_total": round(e["seconds"], 6),
+                        "mbps": round(e["ewma_mbps"], 6),
+                        "latency_ms": round(e["ewma_latency_s"] * 1e3, 6),
+                    }[metric]
+                    lines.append(f"{KV_PREFIX}_{metric}{{{labels}}} {val}")
         return "\n".join(lines) + "\n"
 
 
@@ -166,16 +228,38 @@ class InflightGuard:
         self._status = "error"
         self._t0 = time.monotonic()
         self._saw_first = False
+        self._last_tok = 0.0
         self._m.inflight[model] += 1
 
     def first_token(self) -> None:
         """Record TTFT once, at the first generated-token emission."""
         if not self._saw_first:
             self._saw_first = True
-            dt = time.monotonic() - self._t0
+            now = time.monotonic()
+            self._last_tok = now
+            dt = now - self._t0
             self._m.ttft[self.model].observe(dt)
             for listener in self._m.ttft_listeners:
                 listener(dt)
+
+    def tokens(self, k: int) -> None:
+        """Record a k-token emission: TTFT on the first, then the
+        emission gap spread as k equal inter-token observations (so the
+        histogram count tracks tokens, and multi-step decode bursts
+        don't read as one slow token)."""
+        if k <= 0:
+            return
+        if not self._saw_first:
+            self.first_token()
+            k -= 1
+            if k <= 0:
+                return
+        now = time.monotonic()
+        per = (now - self._last_tok) / k
+        h = self._m.itl[self.model]
+        for _ in range(k):
+            h.observe(per)
+        self._last_tok = now
 
     def ok(self) -> None:
         self._status = "success"

@@ -44,6 +44,8 @@ from dynamo_tpu.llm.openai import (
 )
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput
 from dynamo_tpu.llm.tool_calls import ToolCallParser
+from dynamo_tpu.obs import tracing
+from dynamo_tpu.obs.export import trace_for_request
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 
 log = logging.getLogger("dynamo_tpu.http")
@@ -106,6 +108,7 @@ class HttpService:
         self.app.router.add_post("/v1/completions", self._completions)
         self.app.router.add_get("/v1/models", self._models)
         self.app.router.add_get("/metrics", self._metrics)
+        self.app.router.add_get("/debug/traces/{request_id}", self._debug_trace)
         for p in ("/health", "/live", "/ready"):
             self.app.router.add_get(p, self._health)
 
@@ -144,6 +147,19 @@ class HttpService:
     async def _metrics(self, request: web.Request) -> web.Response:
         return web.Response(text=self.metrics.render(), content_type="text/plain")
 
+    async def _debug_trace(self, request: web.Request) -> web.Response:
+        """Chrome trace-event JSON for one request id (the response id,
+        or the caller's ``x-request-id`` when it sent one).  Load the
+        body in chrome://tracing or ui.perfetto.dev."""
+        rid = request.match_info["request_id"]
+        doc = trace_for_request(rid)
+        if doc is None:
+            return web.json_response(
+                {"error": f"no trace recorded for {rid!r}"
+                          " (is DYNAMO_TRACE=1 set?)"},
+                status=404)
+        return web.json_response(doc)
+
     async def _chat(self, request: web.Request) -> web.StreamResponse:
         return await self._serve(request, chat=True)
 
@@ -160,6 +176,14 @@ class HttpService:
 
         guard = None
         ticket = None
+        # client-supplied correlation id: accepted, propagated as the
+        # engine-side request id, and echoed back on every response
+        xrid = request.headers.get("x-request-id") or ""
+        # dtspan root: every downstream span (engine, coordinator hop,
+        # remote prefill, KV transfer) parents under this one trace
+        span = tracing.start_span(
+            "http.request",
+            attrs={"endpoint": endpoint, "request_id": xrid})
         try:
             parsed = parse_request(body, chat=chat)
             entry = self.manager.get(parsed.model)
@@ -184,6 +208,11 @@ class HttpService:
                         headers={"Retry-After": str(e.retry_after_s)})
             guard = self.metrics.guard(parsed.model, endpoint)
             rid = new_id("chatcmpl" if chat else "cmpl")
+            if tracing.enabled():
+                # findable under both the response id and the caller's id
+                tracing.collector.bind_request(rid, span.trace_id)
+                if xrid:
+                    tracing.collector.bind_request(xrid, span.trace_id)
             # n>1: fan out independent generations of the same prompt; the
             # engine's reserved-block registry (kv/block_manager.py) makes
             # them share ONE prefill — later admissions join the first
@@ -202,6 +231,11 @@ class HttpService:
                 ctxs = [Context(v) for v in variants]
             else:
                 ctxs = [Context(parsed) for _ in range(parsed.n)]
+            if xrid:
+                # the caller's id becomes the engine-visible request id
+                # (choice-suffixed for n>1 so ids stay unique)
+                for i, c in enumerate(ctxs):
+                    c.id = xrid if parsed.n == 1 else f"{xrid}-{i}"
             # per-request migration budget (fault plane): "x-migration-limit:
             # 0" opts a request out of mid-stream migration entirely
             mig_limit = request.headers.get("x-migration-limit")
@@ -213,8 +247,11 @@ class HttpService:
                     pass
             streams = [entry.engine.generate(c) for c in ctxs]
             if parsed.stream:
-                return await self._stream_response(request, ctxs, streams, rid, parsed, chat, guard)
-            return await self._unary_response(ctxs, streams, rid, parsed, chat, guard)
+                return await self._stream_response(
+                    request, ctxs, streams, rid, parsed, chat, guard,
+                    xrid=xrid)
+            return await self._unary_response(
+                ctxs, streams, rid, parsed, chat, guard, xrid=xrid)
         except OpenAIError as e:
             if guard:
                 guard.status("error")
@@ -228,6 +265,7 @@ class HttpService:
                 ticket.release()
             if guard:
                 guard.close()
+            span.end()
 
     # ------------------------------------------------------------- responders
     def _chunk(
@@ -259,15 +297,16 @@ class HttpService:
     async def _stream_response(
         self, request: web.Request, ctxs: list[Context],
         streams: list[AsyncIterator[LLMEngineOutput]],
-        rid: str, parsed, chat: bool, guard,
+        rid: str, parsed, chat: bool, guard, xrid: str = "",
     ) -> web.StreamResponse:
-        resp = web.StreamResponse(
-            headers={
-                "Content-Type": "text/event-stream",
-                "Cache-Control": "no-cache",
-                "Connection": "keep-alive",
-            }
-        )
+        headers = {
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+        }
+        if xrid:
+            headers["x-request-id"] = xrid
+        resp = web.StreamResponse(headers=headers)
         await resp.prepare(request)
         n = len(streams)
         n_out = 0
@@ -312,7 +351,7 @@ class HttpService:
                     live -= 1
                     continue
                 if out.token_ids:
-                    guard.first_token()
+                    guard.tokens(len(out.token_ids))
                 n_out += len(out.token_ids)
                 finish_override = None
                 if parsers[i] is not None:
@@ -344,6 +383,7 @@ class HttpService:
             await resp.write(SSE_DONE)
             guard.ok()
             self.metrics.tokens_out[parsed.model] += n_out
+            self._observe_queue_wait(parsed.model, ctxs)
         except (ConnectionResetError, asyncio.CancelledError):
             # client went away — stop the engine (ref: disconnect detection)
             for ctx in ctxs:
@@ -356,9 +396,15 @@ class HttpService:
         await resp.write_eof()
         return resp
 
+    def _observe_queue_wait(self, model: str, ctxs: list[Context]) -> None:
+        for c in ctxs:
+            qw = c.annotations.get("queue_wait_s")
+            if qw is not None:
+                self.metrics.queue_wait[model].observe(qw)
+
     async def _unary_response(
         self, ctxs: list[Context], streams: list[AsyncIterator[LLMEngineOutput]],
-        rid: str, parsed, chat: bool, guard,
+        rid: str, parsed, chat: bool, guard, xrid: str = "",
     ) -> web.Response:
         n = len(streams)
         texts: list[list[str]] = [[] for _ in range(n)]
@@ -369,7 +415,7 @@ class HttpService:
         async def collect(i: int, s: AsyncIterator[LLMEngineOutput]) -> None:
             async for out in s:
                 if out.token_ids:
-                    guard.first_token()
+                    guard.tokens(len(out.token_ids))
                 counts[i] += len(out.token_ids)
                 if out.text:
                     texts[i].append(out.text)
@@ -422,7 +468,12 @@ class HttpService:
                 resp["choices"].extend(piece["choices"])
         guard.ok()
         self.metrics.tokens_out[parsed.model] += n_out
+        self._observe_queue_wait(parsed.model, ctxs)
         migrated = max((c.annotations.get("migrations", 0) for c in ctxs),
                        default=0)
-        headers = {"x-migrated": str(migrated)} if migrated else None
-        return web.json_response(resp, headers=headers)
+        headers = {}
+        if migrated:
+            headers["x-migrated"] = str(migrated)
+        if xrid:
+            headers["x-request-id"] = xrid
+        return web.json_response(resp, headers=headers or None)

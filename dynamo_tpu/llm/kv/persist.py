@@ -58,6 +58,8 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from dynamo_tpu.engine.counters import persist_counters
+from dynamo_tpu.obs import tracing
+from dynamo_tpu.obs.costs import transfer_costs
 
 log = logging.getLogger("dynamo_tpu.kv.persist")
 
@@ -365,6 +367,9 @@ class PersistentKvStore:
         callers treat that as a miss."""
         if not seq_hashes:
             raise KeyError("empty load")
+        t0 = time.perf_counter()
+        span = tracing.start_span(
+            "kv.persist_restore", attrs={"blocks": len(seq_hashes)})
         with self._lock:
             now = self._clock()
             per_file: "OrderedDict[str, list[tuple[int, int]]]" = OrderedDict()
@@ -405,6 +410,14 @@ class PersistentKvStore:
                     for out, leaf in zip(out_leaves, leaves):
                         out[pos] = leaf[row]
         assert out_leaves is not None and structure is not None
+        nbytes = sum(leaf.nbytes for leaf in out_leaves)
+        # measured restore cost: disk → this worker's host pool ("persist"
+        # path in the per-(src,dst) table alongside ici/dcn transfers)
+        transfer_costs.record(
+            "disk", tracing.process_name(), "persist",
+            nbytes, time.perf_counter() - t0,
+        )
+        span.set(bytes=nbytes).end()
         return _unflatten(structure, out_leaves)
 
     # -------------------------------------------------------------- eviction

@@ -32,10 +32,13 @@ import asyncio
 import itertools
 import logging
 import os
+import time
 from typing import Awaitable, Callable, Optional
 
 import numpy as np
 
+from dynamo_tpu.obs import tracing
+from dynamo_tpu.obs.costs import transfer_costs
 from dynamo_tpu.runtime.transports.protocol import TransferOp
 from dynamo_tpu.runtime.transports.framing import (
     close_writer,
@@ -75,6 +78,13 @@ stats = {
     "local_write_calls": 0, "local_blocks": 0,
     "tcp_write_calls": 0, "tcp_blocks": 0,
 }
+
+
+def _arr_nbytes(arr) -> int:
+    """Total byte size of a block array or (data, scale) pair — works for
+    both ndarray and jax.Array parts (both expose ``nbytes``)."""
+    parts = arr if isinstance(arr, (tuple, list)) else [arr]
+    return sum(int(getattr(p, "nbytes", 0)) for p in parts)
 
 
 def _np_dtype(name: str):
@@ -172,6 +182,17 @@ class KvTransferServer:
                     break
                 h, payload = frame
                 op, rid = h.get("op"), h.get("id")
+                # dtspan: a traced sender's context continues through the
+                # receive-side apply (scatter waits for a step boundary, so
+                # this span measures the full transfer-visible latency)
+                trace = tracing.extract(h)
+                span = (
+                    tracing.start_span(
+                        f"kv.server.{op}", parent=trace,
+                        attrs={"request_id": h.get("request_id", ""),
+                               "bytes": len(payload)})
+                    if trace is not None else tracing.NOP_SPAN
+                )
                 try:
                     if op == TransferOp.WRITE_BLOCKS:
                         await self.write_sink(
@@ -195,6 +216,8 @@ class KvTransferServer:
                 except Exception as e:
                     log.exception("kv transfer op %s failed", op)
                     write_frame(writer, {"id": rid, "error": str(e)})
+                finally:
+                    span.end()
                 await writer.drain()
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
@@ -220,14 +243,39 @@ class LocalKvTransferClient:
     async def write_blocks(self, block_ids, arr, request_id=None) -> None:
         stats["local_write_calls"] += 1
         stats["local_blocks"] += len(block_ids)
-        await self._server.write_sink(
-            [int(b) for b in block_ids], arr, request_id
+        nbytes = _arr_nbytes(arr)
+        span = tracing.start_span(
+            "kv.write_blocks",
+            attrs={"path": "ici", "blocks": len(block_ids), "bytes": nbytes,
+                   "request_id": request_id or ""},
         )
+        t0 = time.perf_counter()
+        try:
+            await self._server.write_sink(
+                [int(b) for b in block_ids], arr, request_id
+            )
+        finally:
+            transfer_costs.record(
+                tracing.process_name(), self._server.url, "ici",
+                nbytes, time.perf_counter() - t0,
+            )
+            span.end()
 
     async def read_blocks(self, block_ids):
         if self._server.read_source is None:
             raise RuntimeError("read_blocks unsupported on this worker")
-        return await self._server.read_source([int(b) for b in block_ids])
+        span = tracing.start_span(
+            "kv.read_blocks", attrs={"path": "ici", "blocks": len(block_ids)})
+        t0 = time.perf_counter()
+        try:
+            out = await self._server.read_source([int(b) for b in block_ids])
+        finally:
+            span.end()
+        transfer_costs.record(
+            self._server.url, tracing.process_name(), "ici",
+            _arr_nbytes(out), time.perf_counter() - t0,
+        )
+        return out
 
     async def notify(self, request_id, first_token, error=None) -> None:
         await self._server.notify_cb(request_id, int(first_token), error)
@@ -282,6 +330,7 @@ class KvTransferClient:
     async def _call(self, header: dict, payload: bytes = b"") -> tuple[dict, bytes]:
         async with self._lock:  # strict request/reply per connection
             header["id"] = next(self._ids)
+            tracing.inject(header)  # dtspan: carry the caller's trace
             # bounded (DT005): the reply wait under the lock must not
             # wedge other transfers behind a dead-but-connected peer
             try:
@@ -312,20 +361,50 @@ class KvTransferClient:
         stats["tcp_write_calls"] += 1
         stats["tcp_blocks"] += len(block_ids)
         meta, data = pack_blocks(arr)
-        await self._call(
-            {
-                "op": TransferOp.WRITE_BLOCKS,
-                "block_ids": list(map(int, block_ids)),
-                "request_id": request_id,
-                **meta,
-            },
-            data,
+        dst = f"{self.host}:{self.port}"
+        span = tracing.start_span(
+            "kv.write_blocks",
+            attrs={"path": "dcn", "dst": dst, "blocks": len(block_ids),
+                   "bytes": len(data), "request_id": request_id or ""},
         )
+        t0 = time.perf_counter()
+        try:
+            await self._call(
+                {
+                    "op": TransferOp.WRITE_BLOCKS,
+                    "block_ids": list(map(int, block_ids)),
+                    "request_id": request_id,
+                    **meta,
+                },
+                data,
+            )
+        finally:
+            # the round-trip completes only after the receiver applied the
+            # scatter, so this measures effective (not raw-socket) bandwidth
+            transfer_costs.record(
+                tracing.process_name(), dst, "dcn",
+                len(data), time.perf_counter() - t0,
+            )
+            span.end()
 
     async def read_blocks(self, block_ids: list[int]) -> np.ndarray:
         """Pull blocks out of the peer's cache (NIXL READ)."""
-        resp, data = await self._call(
-            {"op": TransferOp.READ_BLOCKS, "block_ids": list(map(int, block_ids))}
+        src = f"{self.host}:{self.port}"
+        span = tracing.start_span(
+            "kv.read_blocks",
+            attrs={"path": "dcn", "src": src, "blocks": len(block_ids)},
+        )
+        t0 = time.perf_counter()
+        try:
+            resp, data = await self._call(
+                {"op": TransferOp.READ_BLOCKS,
+                 "block_ids": list(map(int, block_ids))}
+            )
+        finally:
+            span.end()
+        transfer_costs.record(
+            src, tracing.process_name(), "dcn",
+            len(data), time.perf_counter() - t0,
         )
         return unpack_blocks(resp, data)
 

@@ -37,6 +37,7 @@ from dynamo_tpu.llm.protocols import (
     SamplingOptions,
     StopConditions,
 )
+from dynamo_tpu.obs import tracing
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 from dynamo_tpu.tokens import TokenBlockSequence
 
@@ -62,6 +63,10 @@ class RemotePrefillRequest:
     skip_blocks: int           # leading blocks already resident on decode side
     transfer_url: str          # decode worker's KvTransferServer
     sampling: SamplingOptions = field(default_factory=SamplingOptions)
+    # dtspan trace context [trace_id, span_id] — optional; carries the
+    # decode side's trace across the durable queue so the prefill
+    # worker's spans land in the same trace (None when tracing is off)
+    trace: Optional[list] = None
 
     def to_wire(self) -> bytes:
         d = dataclasses.asdict(self)
@@ -232,6 +237,7 @@ class DecodeWorker(AsyncEngine):
             if alloc_fut in done:
                 block_ids, cached = alloc_fut.result()
                 bs = self.engine.core.config.block_size
+                ctx_pair = tracing.current()
                 await self.queue.push(
                     RemotePrefillRequest(
                         request_id=request.id,
@@ -240,6 +246,7 @@ class DecodeWorker(AsyncEngine):
                         skip_blocks=cached // bs,
                         transfer_url=self.transfer_url,
                         sampling=request.data.sampling,
+                        trace=list(ctx_pair) if ctx_pair else None,
                     )
                 )
             # stream everything the engine emits (first token arrives once a
@@ -306,6 +313,24 @@ class PrefillWorker:
                     log.exception("nack of %s failed", msg_id)
 
     async def handle(self, rpr: RemotePrefillRequest) -> None:
+        # dtspan: continue the decode side's trace across the queue hop —
+        # the engine.generate span below and the kv.write_blocks/notify
+        # spans all parent under this one
+        token = tracing.attach(rpr.trace)
+        span = (
+            tracing.start_span(
+                "disagg.prefill",
+                attrs={"request_id": rpr.request_id,
+                       "tokens": len(rpr.token_ids)})
+            if rpr.trace else tracing.NOP_SPAN
+        )
+        try:
+            await self._handle_inner(rpr)
+        finally:
+            span.end()
+            tracing.detach(token)
+
+    async def _handle_inner(self, rpr: RemotePrefillRequest) -> None:
         core = self.engine.core
         ctx: Context[BackendInput] = Context(
             BackendInput(

@@ -1,0 +1,33 @@
+"""dtspan — the request-tracing plane.
+
+Zero-dependency observability for the five-process serving path:
+
+- ``obs.tracing``: trace/span core with contextvar propagation, wire
+  inject/extract helpers, and a bounded per-process ring-buffer
+  collector.  Near-zero cost when disabled (one module-bool check, no
+  allocation on the token path).
+- ``obs.timeline``: the engine step timeline — per-phase wall-time
+  attribution for ``EngineCore.step`` (host scheduling, upload, jitted
+  dispatch, readback, post-processing).  Always on; a handful of
+  ``perf_counter`` calls per step.
+- ``obs.costs``: measured KV-transfer cost tables (EWMA per
+  (src, dst, path)) fed by spans around ICI/DCN transfers and persist
+  restores — the routing input NetKV-style transfer-aware disagg needs.
+- ``obs.export``: Chrome trace-event JSON (Perfetto-loadable) export.
+"""
+
+from dynamo_tpu.obs.tracing import (  # noqa: F401
+    attach,
+    collector,
+    current,
+    detach,
+    enable,
+    enabled,
+    extract,
+    inject,
+    set_process,
+    start_span,
+)
+from dynamo_tpu.obs.timeline import step_timeline  # noqa: F401
+from dynamo_tpu.obs.costs import transfer_costs  # noqa: F401
+from dynamo_tpu.obs.export import chrome_trace  # noqa: F401

@@ -1,0 +1,82 @@
+"""Measured KV-transfer cost tables.
+
+Every KV movement on the serving path records ``(src, dst, path,
+bytes, seconds)`` here:
+
+    path="ici"      LocalKvTransferClient — same-host/slice shortcut
+                    (in-process; the ICI/devicemem path on TPU)
+    path="dcn"      KvTransferClient over TCP — the cross-host DCN hop
+    path="persist"  persist-tier restore (shared-store read +
+                    restore-through-host)
+
+Per key the table keeps lifetime totals plus an EWMA of throughput
+(MB/s) and per-call latency — the measured cost term NetKV-style
+transfer-aware disagg routing needs (`overlap − kv_usage − slot_usage
+− transfer_cost`, ROADMAP item 1).  Exported on ``/metrics`` as
+
+    dynamo_tpu_kv_transfer_calls_total{src,dst,path}
+    dynamo_tpu_kv_transfer_bytes_total{src,dst,path}
+    dynamo_tpu_kv_transfer_seconds_total{src,dst,path}
+    dynamo_tpu_kv_transfer_mbps{src,dst,path}           (EWMA)
+    dynamo_tpu_kv_transfer_latency_ms{src,dst,path}     (EWMA)
+
+Process-global singleton, same idiom as ``engine/counters.py``: the
+kv layer records, the http layer renders, benchmarks read.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["TransferCostTable", "transfer_costs"]
+
+
+class TransferCostTable:
+    def __init__(self, alpha: float = 0.2) -> None:
+        self._alpha = alpha
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        """Test isolation hook."""
+        # (src, dst, path) -> dict of running stats
+        self.table: dict[tuple, dict] = {}
+
+    def record(self, src: str, dst: str, path: str,
+               nbytes: int, seconds: float) -> None:
+        if seconds <= 0:
+            seconds = 1e-9  # clock granularity floor; keep the sample
+        mbps = nbytes / seconds / 1e6
+        key = (src, dst, path)
+        a = self._alpha
+        with self._lock:
+            e = self.table.get(key)
+            if e is None:
+                self.table[key] = {
+                    "calls": 1, "bytes": nbytes, "seconds": seconds,
+                    "ewma_mbps": mbps, "ewma_latency_s": seconds,
+                }
+                return
+            e["calls"] += 1
+            e["bytes"] += nbytes
+            e["seconds"] += seconds
+            e["ewma_mbps"] = (1 - a) * e["ewma_mbps"] + a * mbps
+            e["ewma_latency_s"] = (1 - a) * e["ewma_latency_s"] + a * seconds
+
+    def cost_s(self, src: str, dst: str, path: str,
+               nbytes: int) -> float | None:
+        """Predicted seconds to move ``nbytes`` over a measured edge;
+        None when the edge has never been observed (caller falls back
+        to its static assumption)."""
+        with self._lock:
+            e = self.table.get((src, dst, path))
+            if e is None or e["ewma_mbps"] <= 0:
+                return None
+            return nbytes / (e["ewma_mbps"] * 1e6)
+
+    def snapshot(self) -> dict[tuple, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self.table.items()}
+
+
+transfer_costs = TransferCostTable()

@@ -1,0 +1,68 @@
+"""Chrome trace-event JSON export (Perfetto-loadable).
+
+Converts collector span records into the Trace Event Format that
+``chrome://tracing`` and https://ui.perfetto.dev both load: one
+complete event (``"ph": "X"``) per span plus process-name metadata
+events, timestamps in wall-clock microseconds (each process's
+monotonic clock is re-anchored via :data:`tracing.EPOCH_NS`, so spans
+collected from different processes line up on one axis).
+"""
+
+from __future__ import annotations
+
+from dynamo_tpu.obs import tracing
+
+__all__ = ["chrome_trace", "trace_for_request"]
+
+
+def _pid_for(proc: str, pids: dict) -> int:
+    if proc not in pids:
+        pids[proc] = len(pids) + 1
+    return pids[proc]
+
+
+def chrome_trace(spans: list[dict]) -> dict:
+    """Build a Chrome trace-event document from collector records
+    (``tracing.Collector`` dicts).  Spans from any mix of traces and
+    processes are accepted; each distinct ``proc`` gets its own track."""
+    pids: dict = {}
+    events = []
+    for s in spans:
+        pid = _pid_for(s.get("proc") or "proc", pids)
+        args = {
+            "trace_id": s["trace"],
+            "span_id": s["span"],
+        }
+        if s.get("parent"):
+            args["parent_id"] = s["parent"]
+        args.update(s.get("attrs") or {})
+        events.append({
+            "ph": "X",
+            "name": s["name"],
+            "cat": "dtspan",
+            "ts": (tracing.EPOCH_NS + s["ts"]) / 1e3,   # wall-clock us
+            "dur": s["dur"] / 1e3,                       # us
+            "pid": pid,
+            "tid": 1,
+            "args": args,
+        })
+    for proc, pid in pids.items():
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 1,
+            "args": {"name": proc},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def trace_for_request(request_id: str) -> dict | None:
+    """Chrome trace for one request id (backs
+    ``/debug/traces/{request_id}`` and the ``dynamo-tpu trace`` CLI);
+    None when the request was never traced or has aged out of the
+    ring."""
+    trace_id = tracing.collector.trace_for_request(request_id)
+    if trace_id is None:
+        return None
+    spans = tracing.collector.spans_for_trace(trace_id)
+    if not spans:
+        return None
+    return chrome_trace(spans)

@@ -1,0 +1,143 @@
+"""Engine step timeline: per-phase wall-time attribution for
+``EngineCore.step``.
+
+The model is mark-based: :meth:`StepTimeline.begin` opens a step,
+``mark(phase)`` attributes *all elapsed time since the previous mark*
+to ``phase``, and :meth:`end` attributes the residue to ``host_post``
+— so the phase sum equals the step wall time **by construction** (the
+>= 95 % acceptance bound holds with slack; the only loss is float
+rounding).
+
+Phases (what the marks mean, in step order):
+
+    kv_spill_restore  host<->device KV block traffic (_drain_offload)
+    host_ops          cross-thread op/abort queues
+    admission         _admit: block allocation, grammar budget, slots
+    host_build        numpy dispatch-operand builds (tokens, block
+                      tables, penalty buffers, grammar rows)
+    upload            the ONE batched jax.device_put per dispatch
+    dispatch          the jitted call itself (trace/en-queue; on CPU
+                      backends this includes compute)
+    readback          jax.device_get — blocks until device compute
+                      lands, so device time not overlapped with host
+                      work shows up here
+    host_post         sampled-token append, stop conditions, emit
+
+The headline derived number is **host_gap_ms_per_turn** — wall time
+per dispatching step spent *outside* dispatch+readback, i.e. the host
+bubble ROADMAP item 3 (double-buffered dispatch) must close.  The
+aggregates are always on (a handful of ``perf_counter`` calls per
+step, no allocation); full per-step records are kept only in a small
+ring buffer, and per-step *spans* are emitted only when the tracing
+plane is enabled.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+__all__ = ["StepTimeline", "step_timeline", "PHASES"]
+
+PHASES = (
+    "kv_spill_restore",
+    "host_ops",
+    "admission",
+    "host_build",
+    "upload",
+    "dispatch",
+    "readback",
+    "host_post",
+)
+
+_DISPATCH_PHASES = ("upload", "dispatch", "readback")
+
+
+class StepTimeline:
+    """Process-global (one engine thread writes, metrics readers read;
+    torn reads of monotonically-increasing floats are acceptable for
+    monitoring)."""
+
+    def __init__(self, keep_steps: int = 256) -> None:
+        self._lock = threading.Lock()
+        self.recent: deque = deque(maxlen=keep_steps)
+        self.reset()
+
+    def reset(self) -> None:
+        """Test isolation hook."""
+        self.steps_total = 0          # begin/end pairs seen
+        self.busy_steps_total = 0     # steps that ran >= 1 device dispatch
+        self.wall_s_total = 0.0       # busy-step wall time
+        self.phase_s_total = {p: 0.0 for p in PHASES}
+        self.host_gap_s_total = 0.0   # busy wall - dispatch - readback
+        self.ewma_wall_s = 0.0
+        self.ewma_host_gap_s = 0.0
+        self._alpha = 0.05
+        self._t0: Optional[float] = None
+        self._last = 0.0
+        self._phases: dict = {}
+
+    # ------------------------------------------------------------ hot path
+    def begin(self) -> None:
+        now = time.perf_counter()
+        self._t0 = now
+        self._last = now
+        self._phases = {}
+
+    def mark(self, phase: str) -> None:
+        if self._t0 is None:
+            return  # dispatch helper invoked outside step() (tests)
+        now = time.perf_counter()
+        self._phases[phase] = self._phases.get(phase, 0.0) + (now - self._last)
+        self._last = now
+
+    def end(self) -> None:
+        if self._t0 is None:
+            return
+        now = time.perf_counter()
+        phases = self._phases
+        phases["host_post"] = phases.get("host_post", 0.0) + (now - self._last)
+        wall = now - self._t0
+        self._t0 = None
+        busy = any(phases.get(p) for p in _DISPATCH_PHASES)
+        self.steps_total += 1
+        if not busy:
+            return  # idle polls would drown the per-turn numbers
+        gap = wall - phases.get("dispatch", 0.0) - phases.get("readback", 0.0)
+        self.busy_steps_total += 1
+        self.wall_s_total += wall
+        self.host_gap_s_total += gap
+        for p, v in phases.items():
+            self.phase_s_total[p] = self.phase_s_total.get(p, 0.0) + v
+        a = self._alpha
+        self.ewma_wall_s = wall if self.busy_steps_total == 1 else (
+            (1 - a) * self.ewma_wall_s + a * wall)
+        self.ewma_host_gap_s = gap if self.busy_steps_total == 1 else (
+            (1 - a) * self.ewma_host_gap_s + a * gap)
+        self.recent.append({"wall_s": wall, "phases": dict(phases)})
+
+    # ------------------------------------------------------------- readers
+    @property
+    def host_gap_ms_per_turn(self) -> float:
+        """Mean host bubble per dispatching step — the committed
+        before-number for ROADMAP item 3."""
+        if not self.busy_steps_total:
+            return 0.0
+        return self.host_gap_s_total / self.busy_steps_total * 1e3
+
+    def snapshot(self) -> dict:
+        """Dict for /metrics rendering and serve_bench banking."""
+        return {
+            "steps_total": self.steps_total,
+            "busy_steps_total": self.busy_steps_total,
+            "wall_seconds_total": self.wall_s_total,
+            "host_gap_ms_per_turn": self.host_gap_ms_per_turn,
+            "ewma_wall_ms": self.ewma_wall_s * 1e3,
+            "ewma_host_gap_ms": self.ewma_host_gap_s * 1e3,
+            "phases": {p: self.phase_s_total.get(p, 0.0) for p in PHASES},
+        }
+
+
+step_timeline = StepTimeline()

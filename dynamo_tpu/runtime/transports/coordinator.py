@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Callable, Optional
 
+from dynamo_tpu.obs import tracing
 from dynamo_tpu.runtime.transports.protocol import CoordOp
 from dynamo_tpu.runtime.transports.framing import (
     close_writer,
@@ -326,11 +327,21 @@ class CoordinatorServer:
                 if frame is None:
                     break
                 header, payload = frame
+                # dtspan: commands arriving inside a request trace get a
+                # server-side span (untraced commands pay one dict lookup)
+                trace = tracing.extract(header)
+                span = (
+                    tracing.start_span(f"coord.{header.get('op')}",
+                                       parent=trace)
+                    if trace is not None else tracing.NOP_SPAN
+                )
                 try:
                     await self._dispatch(conn_id, writer, header, payload)
                 except Exception as e:  # protocol-level error back to caller
                     log.exception("coordinator op failed: %s", header.get("op"))
                     await self._send(conn_id, writer, {"id": header.get("id"), "error": str(e)})
+                finally:
+                    span.end()
         finally:
             # connection-drop cleanup: leases, watches, subs, pending queue acks
             for lease_id in list(self._conn_leases.pop(conn_id, ())):
@@ -989,6 +1000,7 @@ class CoordinatorClient:
         epoch = self._epoch
         rid = next(self._ids)
         header["id"] = rid
+        tracing.inject(header)  # dtspan: carry the caller's trace context
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
         async with self._write_lock:

@@ -17,7 +17,15 @@ the AST, so a wrapper type would only add indirection on the hot path.
 
 from __future__ import annotations
 
-__all__ = ["CoordOp", "FrameType", "TransferOp"]
+__all__ = ["CoordOp", "FrameType", "TransferOp", "TRACE_FIELD"]
+
+# Optional trace-context header field (dtspan plane, obs/tracing.py):
+# value is a two-element ``[trace_id, span_id]`` list stamped by
+# ``obs.tracing.inject`` on TCP REQUEST frames, coordinator commands,
+# KV-transfer headers and remote-prefill queue payloads, and read back
+# by ``obs.tracing.extract`` on the consuming side.  Absent whenever
+# tracing is disabled — every consumer treats it as optional.
+TRACE_FIELD = "trace"
 
 
 class CoordOp:

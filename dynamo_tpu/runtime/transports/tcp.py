@@ -28,6 +28,7 @@ import logging
 import os
 from typing import Any, AsyncIterator, Callable, Optional
 
+from dynamo_tpu.obs import tracing
 from dynamo_tpu.runtime import serde
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 from dynamo_tpu.runtime.transports.protocol import FrameType
@@ -206,7 +207,8 @@ class EndpointTcpServer:
                 except (ConnectionResetError, RuntimeError):
                     pass
 
-        async def run_request(req_id: int, subject: str, data: Any) -> None:
+        async def run_request(req_id: int, subject: str, data: Any,
+                              trace=None) -> None:
             engine = self._engines.get(subject)
             if engine is None:
                 await send({"type": FrameType.ERROR, "req_id": req_id,
@@ -215,6 +217,11 @@ class EndpointTcpServer:
             ctx = Context(data)
             contexts[req_id] = ctx
             self._track(subject, +1)
+            # dtspan: continue the caller's trace across the wire — this
+            # task's contextvar carries it into engine.generate
+            tracing.attach(trace)
+            span = tracing.start_span(
+                f"tcp.request.{subject}", attrs={"request_id": ctx.id})
             try:
                 async for item in engine.generate(ctx):
                     await send({"type": FrameType.ITEM, "req_id": req_id}, serde.dumps(item))
@@ -223,6 +230,7 @@ class EndpointTcpServer:
                 log.exception("endpoint %s request failed", subject)
                 await send({"type": FrameType.ERROR, "req_id": req_id, "error": str(e)})
             finally:
+                span.end()
                 self._track(subject, -1)
                 contexts.pop(req_id, None)
                 tasks.pop(req_id, None)
@@ -238,7 +246,8 @@ class EndpointTcpServer:
                 if ftype == FrameType.REQUEST:
                     data = serde.loads(payload)
                     tasks[req_id] = asyncio.ensure_future(
-                        run_request(req_id, header.get("subject", ""), data)
+                        run_request(req_id, header.get("subject", ""), data,
+                                    trace=tracing.extract(header))
                     )
                 elif ftype == FrameType.STOP:
                     ctx = contexts.get(req_id)
@@ -484,12 +493,19 @@ class EndpointTcpClient(AsyncEngine):
         # entry and its queue leak forever
         self._streams[req_id] = q
         self._idle.clear()
+        # dtspan: the client-side half of the hop; inject() stamps this
+        # span's context on the REQUEST header so the server continues
+        # the same trace id
+        span = tracing.start_span(
+            f"tcp.call.{self.subject}", attrs={"request_id": request.id})
         try:
             await self._send(
-                {"type": FrameType.REQUEST, "req_id": req_id, "subject": self.subject},
+                tracing.inject({"type": FrameType.REQUEST, "req_id": req_id,
+                                "subject": self.subject}),
                 serde.dumps(request.data),
             )
         except BaseException:
+            span.end()
             self._streams.pop(req_id, None)
             if not self._streams:
                 # mirror the finally-block bookkeeping: without this a
@@ -526,6 +542,7 @@ class EndpointTcpClient(AsyncEngine):
                     raise item
                 yield item
         finally:
+            span.end()
             cancel_task.cancel()
             self._streams.pop(req_id, None)
             if not self._streams:

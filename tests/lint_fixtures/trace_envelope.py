@@ -1,0 +1,41 @@
+"""dtspan envelope fixture: tracing.inject/extract model the optional
+``trace`` wire field — maybe-produced on injected headers, optionally
+read by the extracting consumer, and never a WR001/WR002 finding."""
+from obs import tracing  # noqa: F401 (fixture; resolved by name only)
+
+
+def write_frame(writer, header, payload=b""):
+    writer.send(header)
+
+
+def read_frame(reader):
+    return reader.recv()
+
+
+def send_direct(writer):
+    # inject wrapping the literal at the sink position
+    write_frame(writer, tracing.inject({"op": "ping", "seq": 1}))
+
+
+def _call(writer, header):
+    # the RPC-helper idiom: header arrives as a param, inject mutates
+    # it, then the frame write sends it
+    header["id"] = 7
+    tracing.inject(header)
+    write_frame(writer, header)
+
+
+def send_via_helper(writer):
+    _call(writer, {"op": "pong", "seq": 2})
+
+
+def serve(reader):
+    frame = read_frame(reader)
+    header, payload = frame
+    trace = tracing.extract(header)
+    op = header.get("op")
+    if op == "ping":
+        return header["seq"], trace
+    elif op == "pong":
+        return header["seq"], trace
+    return None

@@ -141,6 +141,33 @@ def test_rule_fixtures(rule):
         + str([f.render() for f in good_findings]))
 
 
+def test_trace_envelope_field_modeled():
+    """dtspan envelope: ``tracing.inject`` marks an optional ``trace``
+    field on the producer (both the literal-at-sink and the
+    RPC-helper-param idiom) and ``tracing.extract`` counts as an
+    optional consumer read — recorded in the manifest, never WR001."""
+    facts, findings = _fixture_findings(FIXTURES / "trace_envelope.py")
+    ch = facts["module:trace_envelope/op"]
+    for variant in ("ping", "pong"):
+        assert ch["variants"][variant]["produced"]["trace"] == "maybe"
+        assert "trace" in ch["variants"][variant]["optional"]
+    assert not findings, [f.render() for f in findings]
+
+
+def test_trace_envelope_recorded_in_real_manifest(real):
+    """The live RPC planes that stamp the dtspan trace context carry it
+    in their committed contracts."""
+    facts, _, _ = real
+    for chan in ("transports.coordinator/op", "kv.transfer/op",
+                 "transports.tcp/type"):
+        name = next(n for n in facts if chan in n)
+        variants = facts[name]["variants"]
+        assert any(v["produced"].get("trace") == "maybe"
+                   for v in variants.values()), (name, variants)
+        assert any("trace" in v["optional"]
+                   for v in variants.values()), (name, variants)
+
+
 def test_wr007_schema_drift_fixture_pair():
     """Same module name under two fixture roots: a manifest snapshotted
     from the base side flags only schema drift on the drift side."""
