@@ -1201,6 +1201,23 @@ def main() -> None:
                ttft_isl=ttft_isl)
     _emit(res)
 
+    # dtperf reconciliation over everything the primary engine ran:
+    # roofline-predicted vs measured dispatch ms per jitted entrypoint
+    # kind, banked so cost-model drift shows up in the result history
+    try:
+        from dynamo_tpu.obs.perfmodel import perf_model
+
+        recon = [r for r in perf_model.reconcile() if r["dispatches"]]
+    except Exception:
+        recon = []
+    if recon:
+        print(f"# perf_model: {json.dumps(recon)}", file=sys.stderr)
+        ratios = {r["kind"]: r["error_ratio"] for r in recon
+                  if r["error_ratio"] is not None}
+        if ratios:
+            res["perf_model_error_ratio"] = ratios
+            _emit(res)
+
     # north-star TTFT at the FULL requested ISL when the throughput
     # config's cache clamped it: rebuild a smaller-batch engine sized for
     # the ISL (failure keeps the primary numbers — never lose the round)

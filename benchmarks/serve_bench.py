@@ -29,6 +29,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import aiohttp
 from aiohttp import ClientSession
 
 
@@ -175,6 +176,52 @@ async def prefill_dispatch_stats(url):
     return out
 
 
+async def perf_model_stats(url):
+    """Scrape the dtperf predicted-vs-measured reconciliation gauges
+    (dynamo_tpu_perf_* on /metrics): per-dispatch-kind roofline
+    prediction, measured mean dispatch ms, and the model-error ratio
+    (predicted/measured).  Returns None when the server doesn't expose
+    them or no dispatch ran."""
+    try:
+        async with ClientSession() as session:
+            async with session.get(f"{url}/metrics") as resp:
+                if resp.status != 200:
+                    return None
+                text = await resp.text()
+    except (OSError, aiohttp.ClientError):
+        return None  # non-dynamo endpoint / server already gone
+    rows: dict[str, dict] = {}
+    for line in text.splitlines():
+        if not line.startswith("dynamo_tpu_perf_") or "{" not in line:
+            continue
+        name = line[len("dynamo_tpu_perf_"):line.index("{")]
+        if name == "predicted_step_ms":
+            continue  # static manifest rows, not runtime reconciliation
+        labels, val = line[line.index("{") + 1:].rsplit(" ", 1)
+        kind = labels.split('kind="', 1)[-1].split('"', 1)[0]
+        rows.setdefault(kind, {})[name] = float(val)
+    rows = {k: v for k, v in rows.items() if v.get("dispatches_total")}
+    return rows or None
+
+
+def print_perf_table(rows, out=sys.stderr):
+    """Predicted-vs-measured dispatch table (one row per jitted
+    entrypoint kind) — the serve_bench readout of the dtperf loop."""
+    print("# dtperf predicted vs measured dispatch (per kind):", file=out)
+    print(f"# {'kind':<16} {'dispatches':>10} {'predicted_ms':>13} "
+          f"{'measured_ms':>12} {'pred/meas':>10}", file=out)
+    for kind in sorted(rows):
+        r = rows[kind]
+        def _f(key, fmt):
+            return format(r[key], fmt) if key in r else "-"
+        print(f"# {kind:<16} {int(r.get('dispatches_total', 0)):>10} "
+              f"{_f('predicted_dispatch_ms', '>13.4f'):>13} "
+              f"{_f('measured_dispatch_ms', '>12.4f'):>12} "
+              # significant digits: on CPU the ratio sits orders of
+              # magnitude below 1 and fixed decimals would print 0.0000
+              f"{_f('model_error_ratio', '>10.3g'):>10}", file=out)
+
+
 async def run(args):
     # Per-mode ISL calibration (ADVICE r5): the in-process modes
     # (--spawn-echo/--native) detokenize with WordLevel + WhitespaceSplit
@@ -201,6 +248,16 @@ async def run(args):
     prefill = await prefill_dispatch_stats(args.url)
     if prefill is not None:
         summary.update(prefill)
+    perf = await perf_model_stats(args.url)
+    if perf is not None:
+        print_perf_table(perf)
+        # bank the reconciliation alongside the measured numbers: one
+        # error-ratio per kind plus the worst-case, so regressions in
+        # the cost model itself show up in the banked history
+        ratios = {k: r["model_error_ratio"] for k, r in perf.items()
+                  if "model_error_ratio" in r}
+        if ratios:
+            summary["perf_model_error_ratio"] = ratios
     print(json.dumps(summary))
     return rows
 

@@ -44,10 +44,15 @@ def configure_parser(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "extracted cross-process message contracts, "
                         "producer/consumer drift) against the committed "
                         "wire manifest")
+    p.add_argument("--perf", action="store_true",
+                   help="run the perf-plane pass instead (PF001-PF004: "
+                        "jaxpr-walked roofline FLOPs/bytes, collective "
+                        "census, predicted step latency) against the "
+                        "committed perf manifest")
     p.add_argument("--all", action="store_true",
-                   help="run all four passes (per-file + project, trace, "
-                        "wire) in one process sharing the parse cache; "
-                        "exit 1 if any pass fails")
+                   help="run all five passes (per-file + project, trace, "
+                        "wire, perf) in one process sharing the parse "
+                        "cache; exit 1 if any pass fails")
     p.add_argument("--changed", action="store_true",
                    help="restrict the per-file pass to git-dirty files "
                         "(project/trace/wire passes stay whole-program); "
@@ -111,6 +116,12 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
         from dynamo_tpu.analysis.wirecheck import run_wire
 
         return run_wire(args, out)
+    if getattr(args, "perf", False):
+        # perf-plane pass: its unit is roofline-priced entrypoint
+        # jaxprs — same manifest contract, its own committed file
+        from dynamo_tpu.analysis.perfcheck import run_perf
+
+        return run_perf(args, out)
     paths = [Path(p) for p in (args.paths or [])]
     if args.root:
         root = Path(args.root)
@@ -194,12 +205,15 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
 
 
 def run_all(args: argparse.Namespace, out=None) -> int:
-    """All four passes in one process: per-file + project rules (one
+    """All five passes in one process: per-file + project rules (one
     ``ast.parse`` per file via ``core.parse_module``'s cache, which the
     wire pass shares), then the compile-plane trace audit, then the
-    wire-plane contract check.  Exit 1 if any pass has fresh findings;
-    ``--update-baseline`` rewrites all three committed baselines."""
+    wire-plane contract check, then the perf-plane roofline check
+    (which shares tracecheck's entrypoint registry).  Exit 1 if any
+    pass has fresh findings; ``--update-baseline`` rewrites all four
+    committed baselines."""
     out = out if out is not None else sys.stdout
+    from dynamo_tpu.analysis.perfcheck import run_perf
     from dynamo_tpu.analysis.tracecheck import run_trace
     from dynamo_tpu.analysis.wirecheck import run_wire
 
@@ -210,7 +224,8 @@ def run_all(args: argparse.Namespace, out=None) -> int:
     rc_file = run_lint(sub, out)
     rc_trace = run_trace(sub, out)
     rc_wire = run_wire(sub, out)
-    return max(rc_file, rc_trace, rc_wire)
+    rc_perf = run_perf(sub, out)
+    return max(rc_file, rc_trace, rc_wire, rc_perf)
 
 
 def main(argv: Optional[list[str]] = None) -> int:

@@ -56,6 +56,7 @@ from dynamo_tpu.ops.block_copy import gather_blocks_padded, scatter_blocks_inpla
 from dynamo_tpu.llm.kv.block_manager import KvBlockManager, NoFreeBlocks
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput
 from dynamo_tpu.models.llama import LlamaModel
+from dynamo_tpu.obs.perfmodel import perf_model
 from dynamo_tpu.obs.timeline import step_timeline
 from dynamo_tpu.tokens import TokenBlockSequence
 
@@ -922,12 +923,18 @@ class EngineCore:
             (tokens, positions, block_tables, seq_lens, slot_idx, last_idx,
              temp, top_k, top_p), gkw)
         step_timeline.mark("upload")
+        if perf_model.wants("step"):
+            perf_model.offer(
+                "step", self._step_fn,
+                (self.params, self.cache, *up[:6], rng, *up[6:]), kw=gkw,
+                statics=dict(prefix_blocks=prefix_blocks, k_cand=k_cand,
+                             exact=exact))
         out, self.cache = self._step_fn(
             self.params, self.cache,
             *up[:6], rng, *up[6:],
             prefix_blocks=prefix_blocks, k_cand=k_cand, exact=exact, **gkw,
         )
-        step_timeline.mark("dispatch")
+        step_timeline.mark("dispatch", kind="step")
         self.steps += 1
         out = tuple(jax.device_get(out))
         step_timeline.mark("readback")
@@ -950,12 +957,18 @@ class EngineCore:
         step_timeline.mark("upload")
         up = list(up)
         args = up[:5] + [rng] + up[5:]
+        if perf_model.wants("decode_multi"):
+            perf_model.offer(
+                "decode_multi", self._multi_fn,
+                (self.params, self.cache, *args), kw=gkw,
+                statics=dict(num_steps=num_steps, k_cand=k_cand,
+                             exact=exact, use_penalties=use_pen))
         out, self.cache = self._multi_fn(
             self.params, self.cache, *args,
             num_steps=num_steps, k_cand=k_cand, exact=exact,
             use_penalties=use_pen, **gkw,
         )
-        step_timeline.mark("dispatch")
+        step_timeline.mark("dispatch", kind="decode_multi")
         self.steps += 1
         # ONE batched transfer: per-array np.asarray would issue a
         # device->host round trip per output (per-array latency is the
@@ -1059,8 +1072,23 @@ class EngineCore:
         try:
             return self._step_inner()
         finally:
-            step_timeline.end()
+            step_timeline.end(trace=self._active_trace())
             self._maybe_profile_stop()
+
+    def _active_trace(self):
+        """(trace_id, span_id) of any traced request currently in a
+        slot — parents the per-step ``engine.step`` span (and its
+        dtperf counter track) under a live request trace.  None when
+        tracing is off or no slotted request carries a trace."""
+        from dynamo_tpu.obs import tracing
+
+        if not tracing.enabled():
+            return None
+        for req in self.slots:
+            trace = getattr(req, "trace", None)
+            if trace:
+                return trace
+        return None
 
     def _maybe_profile_start(self) -> None:
         cfg = self.config
@@ -1557,11 +1585,17 @@ class EngineCore:
             (tokens, positions, bt, seq_lens, slot_idx, seq_ids, starts,
              roff, last_idx, temp, top_k, top_p), gkw)
         step_timeline.mark("upload")
+        if perf_model.wants("prefill_ragged"):
+            perf_model.offer(
+                "prefill_ragged", self._ragged_fn,
+                (self.params, self.cache, *up[:9], rng, *up[9:]), kw=gkw,
+                statics=dict(prefix_blocks=pb, k_cand=k_cand,
+                             exact=exact))
         out, self.cache = self._ragged_fn(
             self.params, self.cache, *up[:9], rng, *up[9:],
             prefix_blocks=pb, k_cand=k_cand, exact=exact, **gkw,
         )
-        step_timeline.mark("dispatch")
+        step_timeline.mark("dispatch", kind="prefill_ragged")
         sampled, lps, cids, clps = jax.device_get(out)  # one batched pull
         step_timeline.mark("readback")
         self.steps += 1
@@ -1768,12 +1802,18 @@ class EngineCore:
             (tokens, positions, bt, seq_lens, slot_idx, seq_ids, starts,
              roff, last_idx, temp, top_k, top_p), gkw)
         step_timeline.mark("upload")
+        if perf_model.wants("unified"):
+            perf_model.offer(
+                "unified", self._unified_fn,
+                (self.params, self.cache, *up[:9], rng, *up[9:]), kw=gkw,
+                statics=dict(row_tokens=d_region, prefix_blocks=pb,
+                             k_cand=k_cand, exact=exact))
         out, self.cache = self._unified_fn(
             self.params, self.cache, *up[:9], rng, *up[9:],
             row_tokens=d_region, prefix_blocks=pb, k_cand=k_cand,
             exact=exact, **gkw,
         )
-        step_timeline.mark("dispatch")
+        step_timeline.mark("dispatch", kind="unified")
         sampled, lps, cids, clps = jax.device_get(out)  # one batched pull
         step_timeline.mark("readback")
         self.steps += 1
@@ -1901,11 +1941,17 @@ class EngineCore:
             np.asarray([req.sampling.top_p], np.float32),
         ))
         step_timeline.mark("upload")
+        if perf_model.wants("sp_prefill"):
+            perf_model.offer(
+                "sp_prefill", self._sp_fn,
+                (self.params, up[0], up[1], up[2], rng, up[3], up[4],
+                 up[5]),
+                statics=dict(nb=nb_pad, k_cand=k_cand, exact=exact))
         (sampled, lps, cids, clps), blocks = self._sp_fn(
             self.params, up[0], up[1], up[2], rng, up[3], up[4], up[5],
             nb=nb_pad, k_cand=k_cand, exact=exact,
         )
-        step_timeline.mark("dispatch")
+        step_timeline.mark("dispatch", kind="sp_prefill")
         sampled, lps, cids, clps = jax.device_get(
             (sampled, lps, cids, clps))  # one batched transfer
         step_timeline.mark("readback")
@@ -2073,12 +2119,17 @@ class EngineCore:
             (tokens, positions, bt[:, :m_used], seq_lens, slot_idx,
              temp, top_k, top_p, min_p, seeds, seed_rows))
         step_timeline.mark("upload")
+        if perf_model.wants("spec_verify"):
+            perf_model.offer(
+                "spec_verify", self._spec_fn,
+                (self.params, self.cache, *up[:5], rng, *up[5:]),
+                statics=dict(k_cand=k_cand, exact=exact))
         verified, self.cache = self._spec_fn(
             self.params, self.cache,
             *up[:5], rng, *up[5:],
             k_cand=k_cand, exact=exact,
         )
-        step_timeline.mark("dispatch")
+        step_timeline.mark("dispatch", kind="spec_verify")
         verified = jax.device_get(verified)
         step_timeline.mark("readback")
         self.steps += 1

@@ -16,12 +16,14 @@ from dynamo_tpu.engine.counters import counters as prefill_counters
 from dynamo_tpu.engine.counters import persist_counters
 from dynamo_tpu.fault.counters import counters as fault_counters
 from dynamo_tpu.obs.costs import transfer_costs
+from dynamo_tpu.obs.perfmodel import perf_model
 from dynamo_tpu.obs.timeline import PHASES, step_timeline
 
 PREFIX = "dynamo_tpu_http_service"
 FAULT_PREFIX = "dynamo_tpu_fault"
 ENGINE_PREFIX = "dynamo_tpu_engine"
 KV_PREFIX = "dynamo_tpu_kv_transfer"
+PERF_PREFIX = "dynamo_tpu_perf"
 
 # seconds; TTFT and whole-request durations share one ladder
 _BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
@@ -215,6 +217,41 @@ class Metrics:
                         "latency_ms": round(e["ewma_latency_s"] * 1e3, 6),
                     }[metric]
                     lines.append(f"{KV_PREFIX}_{metric}{{{labels}}} {val}")
+        # dtperf plane: roofline-predicted step latency per (entrypoint,
+        # config) from the committed perf manifest (JSON-only read — no
+        # tracing happens here), plus the runtime predicted-vs-measured
+        # reconciliation per live dispatch kind
+        try:
+            from dynamo_tpu.analysis.perfcheck import manifest_predictions
+
+            rows = manifest_predictions()
+        except Exception:
+            rows = []
+        if rows:
+            lines.append(f"# TYPE {PERF_PREFIX}_predicted_step_ms gauge")
+            for r in rows:
+                labels = (f'entrypoint="{r["entrypoint"]}",'
+                          f'config="{r["config"]}",'
+                          f'signature="{r["signature"]}",'
+                          f'bound="{r["bound"]}"')
+                lines.append(
+                    f"{PERF_PREFIX}_predicted_step_ms{{{labels}}} "
+                    f"{r['predicted_ms']}")
+        recon = perf_model.reconcile()
+        if recon:
+            for metric, field, typ in (
+                    ("predicted_dispatch_ms", "predicted_ms", "gauge"),
+                    ("measured_dispatch_ms", "measured_ms", "gauge"),
+                    ("dispatches_total", "dispatches", "counter"),
+                    ("model_error_ratio", "error_ratio", "gauge")):
+                rendered = [r for r in recon if r.get(field) is not None]
+                if not rendered:
+                    continue
+                lines.append(f"# TYPE {PERF_PREFIX}_{metric} {typ}")
+                for r in rendered:
+                    lines.append(
+                        f'{PERF_PREFIX}_{metric}{{kind="{r["kind"]}"}} '
+                        f"{r[field]}")
         return "\n".join(lines) + "\n"
 
 

@@ -12,8 +12,18 @@ Zero-dependency observability for the five-process serving path:
   ``perf_counter`` calls per step.
 - ``obs.costs``: measured KV-transfer cost tables (EWMA per
   (src, dst, path)) fed by spans around ICI/DCN transfers and persist
-  restores — the routing input NetKV-style transfer-aware disagg needs.
-- ``obs.export``: Chrome trace-event JSON (Perfetto-loadable) export.
+  restores — the routing input NetKV-style transfer-aware disagg
+  needs.  Never-observed edges fall back to the ``obs.topology``
+  bandwidth prior instead of a cold miss.
+- ``obs.topology``: the versioned per-topology hardware constants
+  table (v5e peaks, ICI/DCN link bandwidths) shared with the dtperf
+  lint plane; the committed perf manifest pins its version.
+- ``obs.perfmodel``: runtime reconciliation of the dtperf roofline —
+  engine dispatch sites offer their live signatures, predictions are
+  traced lazily, and ``/metrics`` exports the predicted-vs-measured
+  model-error gauge per dispatch kind.
+- ``obs.export``: Chrome trace-event JSON (Perfetto-loadable) export,
+  including the predicted-vs-measured dispatch counter track.
 """
 
 from dynamo_tpu.obs.tracing import (  # noqa: F401
@@ -30,4 +40,5 @@ from dynamo_tpu.obs.tracing import (  # noqa: F401
 )
 from dynamo_tpu.obs.timeline import step_timeline  # noqa: F401
 from dynamo_tpu.obs.costs import transfer_costs  # noqa: F401
+from dynamo_tpu.obs.perfmodel import perf_model  # noqa: F401
 from dynamo_tpu.obs.export import chrome_trace  # noqa: F401

@@ -64,15 +64,28 @@ class TransferCostTable:
             e["ewma_latency_s"] = (1 - a) * e["ewma_latency_s"] + a * seconds
 
     def cost_s(self, src: str, dst: str, path: str,
-               nbytes: int) -> float | None:
-        """Predicted seconds to move ``nbytes`` over a measured edge;
-        None when the edge has never been observed (caller falls back
-        to its static assumption)."""
+               nbytes: int) -> float:
+        """Predicted seconds to move ``nbytes`` over an edge.
+
+        Measured edges use the EWMA throughput.  Never-observed edges
+        fall back to the dtperf topology prior (derated link bandwidth
+        + hop latency, ``obs.topology.prior_cost_s``) so transfer-aware
+        routing always has a finite cost instead of a cold-miss
+        surprise; the first real transfer replaces the prior.  Use
+        :meth:`measured` to distinguish the two.
+        """
         with self._lock:
             e = self.table.get((src, dst, path))
             if e is None or e["ewma_mbps"] <= 0:
-                return None
+                from dynamo_tpu.obs.topology import prior_cost_s
+                return prior_cost_s(path, nbytes)
             return nbytes / (e["ewma_mbps"] * 1e6)
+
+    def measured(self, src: str, dst: str, path: str) -> bool:
+        """True when the edge has at least one recorded transfer (so
+        ``cost_s`` is measurement, not the topology prior)."""
+        with self._lock:
+            return (src, dst, path) in self.table
 
     def snapshot(self) -> dict[tuple, dict]:
         with self._lock:

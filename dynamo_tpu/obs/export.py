@@ -6,6 +6,12 @@ complete event (``"ph": "X"``) per span plus process-name metadata
 events, timestamps in wall-clock microseconds (each process's
 monotonic clock is re-anchored via :data:`tracing.EPOCH_NS`, so spans
 collected from different processes line up on one axis).
+
+``engine.step`` spans that carry the dtperf roofline envelope
+(``predicted_dispatch_ms`` / ``measured_dispatch_ms`` attrs, see
+``obs/timeline.py``) additionally emit a counter event (``"ph": "C"``)
+per step, so the predicted-vs-measured dispatch latency renders as a
+stacked counter track above the step spans.
 """
 
 from __future__ import annotations
@@ -46,6 +52,21 @@ def chrome_trace(spans: list[dict]) -> dict:
             "tid": 1,
             "args": args,
         })
+        attrs = s.get("attrs") or {}
+        if "measured_dispatch_ms" in attrs:
+            # dtperf counter track: predicted-vs-measured dispatch ms
+            counter = {"measured": attrs["measured_dispatch_ms"]}
+            if "predicted_dispatch_ms" in attrs:
+                counter["predicted"] = attrs["predicted_dispatch_ms"]
+            events.append({
+                "ph": "C",
+                "name": "dispatch_ms (dtperf predicted vs measured)",
+                "cat": "dtperf",
+                "ts": (tracing.EPOCH_NS + s["ts"]) / 1e3,
+                "pid": pid,
+                "tid": 1,
+                "args": counter,
+            })
     for proc, pid in pids.items():
         events.append({
             "ph": "M", "name": "process_name", "pid": pid, "tid": 1,

@@ -30,6 +30,15 @@ aggregates are always on (a handful of ``perf_counter`` calls per
 step, no allocation); full per-step records are kept only in a small
 ring buffer, and per-step *spans* are emitted only when the tracing
 plane is enabled.
+
+The dispatch mark additionally takes the **dispatch kind** (``step``,
+``decode_multi``, ``prefill_ragged``, ``unified``, ``sp_prefill``,
+``spec_verify``) so measured dispatch seconds split per jitted
+entrypoint — the denominator of the dtperf predicted-vs-measured
+model-error gauge (``obs/perfmodel.py``).  When tracing is enabled,
+``end`` also emits one ``engine.step`` span per busy step carrying the
+phase breakdown and the roofline-predicted dispatch envelope, which
+the Chrome export renders as a predicted-vs-measured counter track.
 """
 
 from __future__ import annotations
@@ -74,10 +83,16 @@ class StepTimeline:
         self.host_gap_s_total = 0.0   # busy wall - dispatch - readback
         self.ewma_wall_s = 0.0
         self.ewma_host_gap_s = 0.0
+        # measured dispatch time split by jitted-entrypoint kind — the
+        # denominator of the dtperf model-error gauge
+        self.dispatch_kind_s: dict[str, float] = {}
+        self.dispatch_kind_n: dict[str, int] = {}
         self._alpha = 0.05
         self._t0: Optional[float] = None
+        self._t0_ns = 0
         self._last = 0.0
         self._phases: dict = {}
+        self._step_kinds: dict = {}
 
     # ------------------------------------------------------------ hot path
     def begin(self) -> None:
@@ -85,21 +100,32 @@ class StepTimeline:
         self._t0 = now
         self._last = now
         self._phases = {}
+        self._step_kinds = {}
+        self._t0_ns = time.monotonic_ns()
 
-    def mark(self, phase: str) -> None:
+    def mark(self, phase: str, kind: Optional[str] = None) -> None:
         if self._t0 is None:
             return  # dispatch helper invoked outside step() (tests)
         now = time.perf_counter()
-        self._phases[phase] = self._phases.get(phase, 0.0) + (now - self._last)
+        delta = now - self._last
+        self._phases[phase] = self._phases.get(phase, 0.0) + delta
+        if kind is not None:
+            self.dispatch_kind_s[kind] = \
+                self.dispatch_kind_s.get(kind, 0.0) + delta
+            self.dispatch_kind_n[kind] = \
+                self.dispatch_kind_n.get(kind, 0) + 1
+            self._step_kinds[kind] = \
+                self._step_kinds.get(kind, 0.0) + delta
         self._last = now
 
-    def end(self) -> None:
+    def end(self, trace: Optional[tuple] = None) -> None:
         if self._t0 is None:
             return
         now = time.perf_counter()
         phases = self._phases
         phases["host_post"] = phases.get("host_post", 0.0) + (now - self._last)
         wall = now - self._t0
+        t0_ns = self._t0_ns
         self._t0 = None
         busy = any(phases.get(p) for p in _DISPATCH_PHASES)
         self.steps_total += 1
@@ -117,6 +143,50 @@ class StepTimeline:
         self.ewma_host_gap_s = gap if self.busy_steps_total == 1 else (
             (1 - a) * self.ewma_host_gap_s + a * gap)
         self.recent.append({"wall_s": wall, "phases": dict(phases)})
+        self._emit_step_span(trace, t0_ns, wall, phases)
+
+    # ----------------------------------------------------------- trace emit
+    def _emit_step_span(self, trace: Optional[tuple], t0_ns: int,
+                        wall: float, phases: dict) -> None:
+        """One ``engine.step`` span per busy step when the tracing
+        plane is on: phase breakdown, per-kind dispatch ms, and the
+        roofline-predicted dispatch envelope (the Chrome export turns
+        the predicted/measured pair into a counter track)."""
+        from dynamo_tpu.obs import tracing
+
+        if not tracing.enabled():
+            return
+        kinds = dict(self._step_kinds)
+        attrs: dict = {
+            "phases_ms": {
+                p: round(v * 1e3, 3) for p, v in sorted(phases.items())
+            },
+            "dispatch_kinds": sorted(kinds),
+            "measured_dispatch_ms": round(
+                sum(kinds.values()) * 1e3, 3),
+        }
+        # predicted envelope: lazy roofline per offered kind — only
+        # priced under tracing (first read traces the jaxpr once)
+        try:
+            from dynamo_tpu.obs.perfmodel import perf_model
+
+            preds = [perf_model.predicted_ms(k) for k in kinds]
+            if preds and all(p is not None for p in preds):
+                attrs["predicted_dispatch_ms"] = round(sum(preds), 3)
+        except Exception:
+            pass  # monitoring must never break the step loop
+        trace_id, parent = (trace if trace else
+                            (tracing.new_trace_id(), None))
+        tracing.collector.add({
+            "name": "engine.step",
+            "trace": trace_id,
+            "span": tracing._new_span_id(),
+            "parent": parent,
+            "ts": t0_ns,
+            "dur": int(wall * 1e9),
+            "proc": tracing.process_name(),
+            "attrs": attrs,
+        })
 
     # ------------------------------------------------------------- readers
     @property
@@ -137,6 +207,13 @@ class StepTimeline:
             "ewma_wall_ms": self.ewma_wall_s * 1e3,
             "ewma_host_gap_ms": self.ewma_host_gap_s * 1e3,
             "phases": {p: self.phase_s_total.get(p, 0.0) for p in PHASES},
+            "dispatch_kinds": {
+                k: {
+                    "seconds": self.dispatch_kind_s[k],
+                    "count": self.dispatch_kind_n.get(k, 0),
+                }
+                for k in sorted(self.dispatch_kind_s)
+            },
         }
 
 

@@ -1,0 +1,131 @@
+"""Versioned per-topology hardware constants (dtperf).
+
+One table, two consumers:
+
+- ``analysis/perfcheck.py`` (the perf lint plane) folds these into the
+  roofline model: predicted step latency is
+  ``max(FLOPs/peak_flops, bytes/peak_bw) + sum(collective costs)``
+  where the collective terms come from mesh axis sizes and the link
+  bandwidths below.
+- ``obs/costs.py`` seeds never-observed (src, dst, path) transfer
+  edges with a bandwidth prior so transfer-aware routing has a cost
+  estimate before the first measured transfer replaces it (EWMA).
+
+The table is *versioned*: ``CONSTANTS_VERSION`` is recorded in the
+committed ``analysis/perf_manifest.json`` header, and the perf plane
+raises PF001 (key ``"constants"``) whenever the committed version and
+this module disagree — so a constants tweak re-trips the latency gate
+explicitly instead of silently moving every baseline.
+
+Numbers are public datasheet / round-2 bench figures for TPU v5e
+(197 bf16 TFLOP/s per chip, 16 GiB HBM @ 819 GB/s, 4x ICI links);
+DCN assumes a 25 Gbps NIC and the persist tier a shared-store read at
+~1 GB/s.  They are deliberately coarse — the model's job is to rank
+and gate, and its calibration is itself observable through the
+predicted-vs-measured gauge on ``/metrics``.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "CONSTANTS_VERSION",
+    "DEFAULT_TOPOLOGY",
+    "TOPOLOGIES",
+    "collective_cost_s",
+    "path_prior_bw",
+    "prior_cost_s",
+]
+
+# Bump on ANY numeric change below; the perf manifest header pins it.
+CONSTANTS_VERSION = "v5e-2026.08.1"
+
+DEFAULT_TOPOLOGY = "v5e"
+
+TOPOLOGIES: dict[str, dict] = {
+    "v5e": {
+        # Per-chip peak compute by accumulation input dtype, FLOP/s.
+        "peak_flops": {
+            "bfloat16": 197e12,
+            "float16": 197e12,
+            "float32": 98.5e12,   # MXU halves throughput at f32
+            "int8": 394e12,
+            "int4": 394e12,       # v5e has no 4-bit MXU mode; int8 rate
+        },
+        "default_flops": 197e12,
+        # HBM: 16 GiB @ 819 GB/s per chip.
+        "hbm_bytes": 16 << 30,
+        "hbm_bw": 819e9,
+        # ICI: 4 links/chip in a 2D torus, ~50 GB/s per link per
+        # direction (1600 Gbps aggregate).
+        "ici_bw": 50e9,
+        "ici_latency_s": 1e-6,
+        # DCN: 25 Gbps NIC -> ~3.125 GB/s, plus TCP hop latency.
+        "dcn_bw": 3.125e9,
+        "dcn_latency_s": 50e-6,
+        # Persist tier: shared-store read + restore-through-host.
+        "persist_bw": 1e9,
+        "persist_latency_s": 1e-3,
+    },
+}
+
+# Derate applied to *priors* for never-measured transfer edges: real
+# transfers pay serialization / host hops the link number ignores, so
+# the prior deliberately under-promises until a measurement lands.
+_PRIOR_EFFICIENCY = 0.6
+
+# Transfer-path name (obs/costs.py vocabulary) -> constants keys.
+_PATH_KEYS = {
+    "ici": ("ici_bw", "ici_latency_s"),
+    "dcn": ("dcn_bw", "dcn_latency_s"),
+    "persist": ("persist_bw", "persist_latency_s"),
+}
+
+
+def path_prior_bw(path: str, topology: str = DEFAULT_TOPOLOGY) -> float:
+    """Derated bytes/s prior for a transfer path; unknown paths get
+    the slowest (persist) prior so they are never free."""
+    topo = TOPOLOGIES[topology]
+    bw_key, _ = _PATH_KEYS.get(path, _PATH_KEYS["persist"])
+    return topo[bw_key] * _PRIOR_EFFICIENCY
+
+
+def prior_cost_s(path: str, nbytes: int,
+                 topology: str = DEFAULT_TOPOLOGY) -> float:
+    """Heuristic seconds to move ``nbytes`` over a never-measured
+    path: latency floor + derated-bandwidth term."""
+    topo = TOPOLOGIES[topology]
+    bw_key, lat_key = _PATH_KEYS.get(path, _PATH_KEYS["persist"])
+    return topo[lat_key] + nbytes / (topo[bw_key] * _PRIOR_EFFICIENCY)
+
+
+def collective_cost_s(op: str, axis_size: int, payload_bytes: int,
+                      topology: str = DEFAULT_TOPOLOGY,
+                      link: str = "ici") -> float:
+    """Analytic cost of one collective over a ring of ``axis_size``
+    chips moving ``payload_bytes`` (per-shard payload).
+
+    Ring algorithms: all-reduce moves 2(n-1)/n of the payload over the
+    bottleneck link, all-gather / reduce-scatter / all-to-all move
+    (n-1)/n, a ppermute shift moves the payload once.  Each ring step
+    pays one link-latency hop.
+    """
+    if axis_size <= 1:
+        return 0.0
+    topo = TOPOLOGIES[topology]
+    bw_key, lat_key = _PATH_KEYS.get(link, _PATH_KEYS["ici"])
+    bw, lat = topo[bw_key], topo[lat_key]
+    n = axis_size
+    if op in ("psum", "all_reduce", "psum_scatter_gather"):
+        traffic = 2.0 * (n - 1) / n * payload_bytes
+        hops = 2 * (n - 1)
+    elif op in ("all_gather", "reduce_scatter", "psum_scatter",
+                "all_to_all"):
+        traffic = (n - 1) / n * payload_bytes
+        hops = n - 1
+    elif op == "ppermute":
+        traffic = float(payload_bytes)
+        hops = 1
+    else:  # unknown collective: charge a full all-reduce
+        traffic = 2.0 * (n - 1) / n * payload_bytes
+        hops = 2 * (n - 1)
+    return traffic / bw + hops * lat

@@ -179,9 +179,22 @@ def test_transfer_cost_table():
     assert e["ewma_mbps"] == pytest.approx(150.0)  # 0.5*100 + 0.5*200
     # prediction uses the EWMA throughput
     assert t.cost_s("a", "b", "dcn", 15_000_000) == pytest.approx(0.1)
-    assert t.cost_s("a", "b", "ici", 1) is None  # unmeasured edge
+    # unmeasured edge falls back to the dtperf topology prior: finite,
+    # positive, and exactly the derated-link formula
+    from dynamo_tpu.obs.topology import prior_cost_s
+
+    assert not t.measured("a", "b", "ici")
+    prior = t.cost_s("a", "b", "ici", 1 << 20)
+    assert prior == pytest.approx(prior_cost_s("ici", 1 << 20))
+    assert 0 < prior < 1.0
+    # unknown path names get the slowest (persist) prior, never free
+    assert t.cost_s("a", "b", "???", 1 << 20) == pytest.approx(
+        prior_cost_s("persist", 1 << 20))
     t.record("a", "b", "ici", 100, 0.0)  # zero-duration clamped, kept
     assert t.snapshot()[("a", "b", "ici")]["seconds"] > 0
+    assert t.measured("a", "b", "ici")
+    # a measured edge now uses the EWMA, not the prior
+    assert t.cost_s("a", "b", "ici", 1 << 20) != pytest.approx(prior)
 
 
 # --------------------------------------------------------- chrome export ----
