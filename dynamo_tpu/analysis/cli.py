@@ -49,18 +49,23 @@ def configure_parser(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "jaxpr-walked roofline FLOPs/bytes, collective "
                         "census, predicted step latency) against the "
                         "committed perf manifest")
+    p.add_argument("--shard", action="store_true",
+                   help="run the sharding-plane pass instead (SH001-SH005: "
+                        "SPMD placement census, per-chip memory model, "
+                        "implicit-reshard and donation-sharding probes) "
+                        "against the committed shard manifest")
     p.add_argument("--all", action="store_true",
-                   help="run all five passes (per-file + project, trace, "
-                        "wire, perf) in one process sharing the parse "
-                        "cache; exit 1 if any pass fails")
+                   help="run all six passes (per-file + project, trace, "
+                        "wire, perf, shard) in one process sharing the "
+                        "parse cache; exit 1 if any pass fails")
     p.add_argument("--changed", action="store_true",
                    help="restrict the per-file pass to git-dirty files "
                         "(project/trace/wire passes stay whole-program); "
                         "fast pre-commit mode")
     p.add_argument("--manifest", default=None, metavar="PATH",
                    help="manifest file (default: the committed "
-                        "analysis/trace_manifest.json or "
-                        "wire_manifest.json; --trace/--wire only)")
+                        "analysis/trace_manifest.json, wire_manifest.json "
+                        "or shard_manifest.json; single-plane modes only)")
     p.add_argument("--select", default=None, metavar="DT001,DT102",
                    help="comma-separated rule codes to run (default: all)")
     p.add_argument("--baseline", default=None, metavar="PATH",
@@ -122,6 +127,12 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
         from dynamo_tpu.analysis.perfcheck import run_perf
 
         return run_perf(args, out)
+    if getattr(args, "shard", False):
+        # sharding-plane pass: its unit is array placements under the
+        # canonical audit mesh — same manifest contract again
+        from dynamo_tpu.analysis.shardcheck import run_shard
+
+        return run_shard(args, out)
     paths = [Path(p) for p in (args.paths or [])]
     if args.root:
         root = Path(args.root)
@@ -205,15 +216,22 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
 
 
 def run_all(args: argparse.Namespace, out=None) -> int:
-    """All five passes in one process: per-file + project rules (one
+    """All six passes in one process: per-file + project rules (one
     ``ast.parse`` per file via ``core.parse_module``'s cache, which the
     wire pass shares), then the compile-plane trace audit, then the
     wire-plane contract check, then the perf-plane roofline check
-    (which shares tracecheck's entrypoint registry).  Exit 1 if any
-    pass has fresh findings; ``--update-baseline`` rewrites all four
-    committed baselines."""
+    (which shares tracecheck's entrypoint registry), then the
+    sharding-plane placement audit.  Exit 1 if any pass has fresh
+    findings; ``--update-baseline`` rewrites all five committed
+    baselines."""
     out = out if out is not None else sys.stdout
+    # the shard probes need >= 4 devices, and the device count can only
+    # be forced BEFORE any pass initializes the jax backend
+    from dynamo_tpu.analysis.shardcheck import ensure_audit_devices
+
+    ensure_audit_devices()
     from dynamo_tpu.analysis.perfcheck import run_perf
+    from dynamo_tpu.analysis.shardcheck import run_shard
     from dynamo_tpu.analysis.tracecheck import run_trace
     from dynamo_tpu.analysis.wirecheck import run_wire
 
@@ -225,7 +243,8 @@ def run_all(args: argparse.Namespace, out=None) -> int:
     rc_trace = run_trace(sub, out)
     rc_wire = run_wire(sub, out)
     rc_perf = run_perf(sub, out)
-    return max(rc_file, rc_trace, rc_wire, rc_perf)
+    rc_shard = run_shard(sub, out)
+    return max(rc_file, rc_trace, rc_wire, rc_perf, rc_shard)
 
 
 def main(argv: Optional[list[str]] = None) -> int:

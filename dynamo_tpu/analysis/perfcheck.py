@@ -478,7 +478,9 @@ def _ring_attention_entrypoint(axis_size: int = 4) -> Optional[Entrypoint]:
     from jax.sharding import PartitionSpec as P
 
     try:
-        mesh = jax.sharding.AbstractMesh((("sp", axis_size),))
+        from dynamo_tpu.utils.mesh import AXIS_SP, abstract_mesh
+
+        mesh = abstract_mesh(axis_size, (AXIS_SP,))
     except Exception:
         return None
     if hasattr(jax, "shard_map"):
@@ -490,8 +492,8 @@ def _ring_attention_entrypoint(axis_size: int = 4) -> Optional[Entrypoint]:
 
     from dynamo_tpu.ops.ring_attention import ring_attention_inner
 
-    inner = functools.partial(ring_attention_inner, axis_name="sp")
-    seq, pos = P(None, "sp", None, None), P(None, "sp")
+    inner = functools.partial(ring_attention_inner, axis_name=AXIS_SP)
+    seq, pos = P(None, AXIS_SP, None, None), P(None, AXIS_SP)
     try:
         wrapped = smap(inner, mesh=mesh,
                        in_specs=(seq, seq, seq, pos, pos),

@@ -124,27 +124,68 @@ class JitPerCall(Rule):
         )
 
 
+# Debug/callback APIs that smuggle a host round trip into compiled
+# code: each firing stalls the dispatch queue exactly like an explicit
+# device_get, but survives jit so it ships to production silently.
+_HOST_SYNC_FNS = frozenset({
+    "jax.debug.print",
+    "jax.debug.callback",
+    "jax.experimental.io_callback",
+    "jax.pure_callback",
+})
+_HOST_CALLBACK_PREFIX = "jax.experimental.host_callback."
+
+
 @register
 class DeviceGetInLoop(Rule):
-    """DT102 — ``jax.device_get``/``block_until_ready`` inside a Python
-    loop.  Each call is a device→host round trip that serialises the
+    """DT102 — host round trips on the hot path.  Two shapes:
+
+    ``jax.device_get``/``block_until_ready`` inside a Python loop —
+    each call is a device→host round trip that serialises the
     pipelined dispatch queue; on a remote-attached TPU the per-call
     latency dominates.  Batch the pulls: stack outputs device-side and
     issue ONE device_get per step, the way engine/core.py's decode path
-    does (its blessed batched-pull sites are loop-free)."""
+    does (its blessed batched-pull sites are loop-free).
+
+    ``jax.debug.print`` / ``jax.debug.callback`` / ``io_callback`` /
+    ``pure_callback`` / ``host_callback.*`` inside a loop OR inside a
+    jit-compiled function — these survive compilation, so a debug print
+    left in a jitted step fn costs a host callback on EVERY step in
+    production.  Gate them behind a config flag at trace time (so the
+    compiled program omits them) or delete before committing."""
 
     code = "DT102"
     name = "device-get-in-loop"
     summary = (
-        "per-iteration device_get/block_until_ready: serialise into one "
+        "per-iteration device_get/block_until_ready (or a debug/host "
+        "callback reachable from compiled code): serialise into one "
         "batched pull per step"
     )
     interests = (ast.Call,)
 
     def visit(self, node: ast.Call, ctx: ModuleContext) -> Iterable[Finding]:
+        fn = ctx.call_name(node)
+        if fn in _HOST_SYNC_FNS or fn.startswith(_HOST_CALLBACK_PREFIX):
+            func = ctx.current_func
+            in_jitted = (
+                func is not None
+                and getattr(func, "name", None) in ctx.jit.jitted_fns
+            )
+            if ctx.loop_depth > 0 or in_jitted:
+                where = (
+                    "inside a jit-compiled function"
+                    if in_jitted else "inside a loop"
+                )
+                yield ctx.finding(
+                    self, node,
+                    f"{fn.rsplit('.', 1)[-1]} {where}: the callback "
+                    "survives compilation and fires a host round trip "
+                    "every execution — gate it behind a debug flag at "
+                    "trace time or remove it",
+                )
+            return
         if ctx.loop_depth <= 0:
             return
-        fn = ctx.call_name(node)
         is_pull = fn in ("jax.device_get", "jax.block_until_ready") or (
             isinstance(node.func, ast.Attribute)
             and node.func.attr == "block_until_ready"

@@ -1,0 +1,736 @@
+"""Sharding-plane static analysis (dtshard): SPMD placement audit.
+
+The five existing planes see source (rules/project), traces
+(tracecheck), wire contracts (wirecheck) and priced jaxprs (perfcheck)
+— none of them sees *where arrays land*.  Yet every ROADMAP item that
+scales past one chip lives or dies on placement: a param or KV pool
+the specs silently replicate costs per-chip HBM on every device, a
+GSPMD-inserted all-gather reshards a hot path the program never asked
+to gather, and a donated buffer whose output sharding differs from its
+input sharding is copied, not aliased.  With hardware down (ROADMAP
+standing note) these CPU-side placement facts are the only guard on
+multi-chip behavior.
+
+The plane audits THREE fact families under one canonical audit mesh
+(``utils/mesh.py``; (data=1, model=4) — the single-host v5e-4 TP
+shape), sharing tracecheck's entrypoint registry:
+
+- **placement census** (no devices needed — pure PartitionSpec math
+  over an ``AbstractMesh``): for each model rig of the registry's
+  config matrix, every param and KV-cache leaf gets its pruned spec,
+  global bytes, and per-chip resident bytes
+  (``global / prod(mesh axis sizes named in the spec)``) — the
+  sharding-aware successor of tracecheck's global TR007 picture — plus
+  a replication census of leaves the model axis never splits.
+- **entrypoint coverage**: every registered (entrypoint, config) pair
+  maps onto its placement rig, and its representative signature's arg
+  leaves are classified against the rig's param/cache leaf sets to
+  give per-chip argument bytes per dispatch.
+- **compile probes** (need ≥ 4 CPU devices —
+  ``XLA_FLAGS=--xla_force_host_platform_device_count``, forced by
+  :func:`ensure_audit_devices` before the backend initializes): the
+  two model decode forwards are jitted with their real shardings under
+  the real mesh, compiled, and the optimized HLO's collectives are
+  counted and cross-referenced against the *user program's* collective
+  primitives (dtperf's PF002 vocabulary) — what remains is what GSPMD
+  *inserted*.  Inserted all-gather / all-to-all on the decode path is
+  an implicit reshard (SH002); the probes also read the compiled
+  output sharding of every donated cache leaf and compare it with the
+  requested input sharding (SH005 — donation only aliases when the
+  shardings agree; a mismatch means a full copy per step, the
+  per-shard extension of TR004).
+
+Rules (committed ``shard_manifest.json``, same justification /
+``--update-baseline`` contract as the trace/wire/perf manifests):
+
+- SH001 large-array-replicated: a leaf above the size floor that the
+  model axis never splits.  The absorbed-MLA latent cache fires this
+  by construction (one shared latent row, nothing head-sharded) — its
+  accepted entry pins ROADMAP item 5's premise (TPLA, arxiv
+  2508.15881) until the latent-sharding refactor lands, at which point
+  the stale entry re-trips the gate.
+- SH002 implicit-reshard: GSPMD-inserted all-gather/all-to-all on a
+  decode probe (count-keyed like PF002, so a new reshard invalidates
+  the accepted entry).
+- SH003 per-chip-hbm-over-budget: params + KV pool per-chip resident
+  bytes against the per-chip budget (per-chip successor of TR007).
+- SH004 placement-drift: spec-table hash drift vs the committed
+  manifest, and added/removed fact entries (resolved by fixing the
+  specs or re-snapshotting with ``--update-baseline``).
+- SH005 donated-buffer-sharding-mismatch: a donated cache leaf whose
+  compiled output sharding is not equivalent to its input sharding.
+
+CPU caveat (recorded in the manifest header): the probes audit the
+XLA *fallback* lowerings — the Pallas kernels keep the paged cache
+resident on-chip on TPU, so fallback-only gathers are justified
+accepted entries, not fixes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+from pathlib import Path
+from typing import Callable, Optional
+
+from dynamo_tpu.analysis.tracecheck import (
+    HBM_BUDGET_FRACTION,
+    V5E_HBM_BYTES,
+    Entrypoint,
+    Manifest,
+    TraceFinding,
+    _bytes_of,
+    _iter_subjaxprs,
+    _tiny_model_config,
+    build_registry,
+)
+
+__all__ = [
+    "AUDIT_MESH_SHAPE",
+    "DEFAULT_MANIFEST_PATH",
+    "SHARD_RULES",
+    "check_shard_facts",
+    "collect_shard_facts",
+    "ensure_audit_devices",
+    "leaf_per_chip_bytes",
+    "run_shard",
+]
+
+DEFAULT_MANIFEST_PATH = Path(__file__).parent / "shard_manifest.json"
+
+# The audit mesh: (data, model) sizes.  dp=1, tp=4 is the single-host
+# v5e-4 deployment shape — the smallest mesh where every TP split and
+# every replication cost is visible.  Axis NAMES come from
+# utils/mesh.py so the specs audited here are provably the specs the
+# engine lowers under.
+AUDIT_MESH_SHAPE = (1, 4)
+
+SHARD_RULES = {
+    "SH001": ("large-array-replicated",
+              "param/KV leaf above the size floor is replicated across "
+              "the model axis (full copy in every chip's HBM)"),
+    "SH002": ("implicit-reshard",
+              "GSPMD-inserted all-gather/all-to-all on a decode probe "
+              "that the user program never asked for"),
+    "SH003": ("per-chip-hbm-over-budget",
+              "params + KV pool per-chip resident bytes exceed the "
+              "per-chip HBM budget (sharding-aware TR007)"),
+    "SH004": ("placement-drift",
+              "placement spec table changed vs the committed shard "
+              "manifest"),
+    "SH005": ("donated-sharding-mismatch",
+              "donated buffer's compiled output sharding differs from "
+              "its input sharding — donation copies instead of "
+              "aliasing (per-shard extension of TR004)"),
+}
+
+# SH001 size floors: absolute (real deployments) OR a fraction of the
+# rig's per-chip total (so the tiny test rigs exhibit the same
+# findings their full-size counterparts would).
+SH001_MIN_BYTES = 1 << 20
+SH001_MIN_FRACTION = 0.05
+
+_MANIFEST_NOTE = (
+    "CPU-derived placement facts under the canonical (data=1, model=4) "
+    "audit mesh (utils/mesh.py axis names).  Census/per-chip figures "
+    "are pure PartitionSpec math over an AbstractMesh; the SH002/SH005 "
+    "probes compile the decode forwards on forced virtual CPU devices "
+    "and therefore audit the XLA FALLBACK lowerings — the Pallas "
+    "kernels keep the paged cache on-chip on TPU, so fallback-only "
+    "gathers are accepted with that justification, not fixed."
+)
+
+
+def _shard_header() -> dict:
+    from dynamo_tpu.utils.mesh import MESH_AXES
+
+    return {
+        "note": _MANIFEST_NOTE,
+        "audit_mesh": dict(zip(MESH_AXES, AUDIT_MESH_SHAPE)),
+        "hbm_budget": {
+            "chip": "v5e",
+            "bytes": int(V5E_HBM_BYTES * HBM_BUDGET_FRACTION),
+        },
+    }
+
+
+def ensure_audit_devices(minimum: int = 4) -> None:
+    """Force the virtual CPU device count BEFORE the jax backend
+    initializes (utils/platform.py) and verify the probes have a mesh
+    to compile under.  A backend already initialized with fewer
+    devices cannot be re-forced — fail with the remedy."""
+    from dynamo_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(minimum)
+    import jax
+
+    if len(jax.devices()) < minimum:
+        raise RuntimeError(
+            f"shard plane needs >= {minimum} devices but the jax "
+            f"backend initialized with {len(jax.devices())} — run the "
+            "lint CLI in a fresh process, or export "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={minimum}"
+            " before anything imports jax"
+        )
+
+
+# -------------------------------------------------------- per-chip math ----
+
+
+def _spec_axis_names(spec) -> list[str]:
+    names: list[str] = []
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        names.extend(entry if isinstance(entry, tuple) else (entry,))
+    return names
+
+
+def leaf_per_chip_bytes(spec, nbytes: int, mesh_shape: dict) -> int:
+    """Per-chip resident bytes of one leaf: global bytes divided by the
+    product of the mesh-axis sizes its (pruned) spec names.  Exact for
+    pruned specs — prune_specs only keeps axes that divide the dim."""
+    div = 1
+    for nm in _spec_axis_names(spec):
+        div *= int(mesh_shape.get(nm, 1))
+    return -(-int(nbytes) // div)
+
+
+def _audit_mesh():
+    from dynamo_tpu.utils.mesh import MESH_AXES, abstract_mesh
+
+    return abstract_mesh(AUDIT_MESH_SHAPE, MESH_AXES)
+
+
+def _spec_str(spec) -> str:
+    return "P(" + ", ".join(
+        repr(e) if not isinstance(e, tuple) else repr(tuple(e))
+        for e in tuple(spec)
+    ) + ")"
+
+
+# ------------------------------------------------------------ model rigs ----
+
+
+def _tiny_deepseek_config():
+    """Same dims as tracecheck's tiny-mla entrypoint — the absorbed-MLA
+    rig whose latent cache is the plane's headline SH001 finding."""
+    from dynamo_tpu.models.deepseek import DeepseekConfig
+
+    return DeepseekConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+        qk_nope_head_dim=8, qk_rope_head_dim=4, v_head_dim=8,
+        kv_lora_rank=16, intermediate_size=64, moe_intermediate_size=32,
+        n_routed_experts=4, num_experts_per_tok=2,
+        first_k_dense_replace=1, dtype="bfloat16",
+    )
+
+
+def _llama3b_config():
+    from dynamo_tpu.models.config import ModelConfig
+
+    return ModelConfig(
+        vocab_size=128256, hidden_size=3072, intermediate_size=8192,
+        num_layers=28, num_heads=24, num_kv_heads=8, head_dim=128,
+        max_position_embeddings=8192, dtype="bfloat16",
+    )
+
+
+def _model_rigs() -> list[dict]:
+    """One rig per registry config tag: model + shape-only params/cache
+    + pruned specs under the audit mesh.  num_blocks/block_size match
+    the tracecheck entrypoints of the same tag, so the coverage pass
+    can classify their arg leaves exactly."""
+    import jax
+
+    from dynamo_tpu.models.deepseek import DeepseekModel
+    from dynamo_tpu.models.llama import LlamaModel
+    from dynamo_tpu.models.quant import prune_specs
+
+    amesh = _audit_mesh()
+    rigs: list[dict] = []
+
+    def add(tag, model, cache, quant_cache, budget=None):
+        params = jax.eval_shape(model.init_params, jax.random.PRNGKey(0))
+        specs = prune_specs(params, model.partition_specs(), amesh)
+        cspec = prune_specs(cache, model.cache_spec(quant_cache), amesh)
+        rigs.append(dict(tag=tag, model=model, params=params,
+                         cache=cache, specs=specs, cspec=cspec,
+                         budget=budget))
+
+    tiny = LlamaModel(_tiny_model_config())
+    add("tiny-llama", tiny,
+        jax.eval_shape(lambda: tiny.init_kv_cache(64, 8)), False)
+    # int8 rig: same bf16 params (the engine entrypoints of this tag
+    # quantize only the cache), QuantKvCache data+scale pools
+    add("tiny-llama-int8", tiny,
+        jax.eval_shape(lambda: tiny.init_kv_cache(64, 8, "int8")), True)
+    mla = DeepseekModel(_tiny_deepseek_config())
+    add("tiny-mla", mla,
+        jax.eval_shape(lambda: mla.init_kv_cache(16, 8)), False)
+    big = LlamaModel(_llama3b_config())
+    add("llama3b-v5e", big,
+        jax.eval_shape(lambda: big.init_kv_cache(4096, 16)), False,
+        budget=int(V5E_HBM_BYTES * HBM_BUDGET_FRACTION))
+    return rigs
+
+
+def _leaf_table(tree, specs, mesh_shape: dict, prefix: str) -> dict:
+    """{leaf name: placement fact} over one (pytree, spec-pytree)."""
+    import jax
+    import jax.tree_util as jtu
+
+    from jax.sharding import PartitionSpec as P
+
+    leaves = jtu.tree_flatten_with_path(tree)[0]
+    spec_leaves = jtu.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, P))[0]
+    if len(leaves) != len(spec_leaves):
+        raise ValueError(
+            f"{prefix}: {len(leaves)} leaves vs {len(spec_leaves)} specs"
+        )
+    model_axis_size = mesh_shape.get(_model_axis(), 1)
+    out: dict[str, dict] = {}
+    for (path, leaf), (_, spec) in zip(leaves, spec_leaves):
+        name = prefix + jtu.keystr(path)
+        nbytes = _bytes_of(leaf)
+        per_chip = leaf_per_chip_bytes(spec, nbytes, mesh_shape)
+        out[name] = {
+            "shape": list(leaf.shape),
+            "dtype": str(leaf.dtype),
+            "spec": _spec_str(spec),
+            "bytes_global": nbytes,
+            "bytes_per_chip": per_chip,
+            # replicated across the model axis: the TP mesh never
+            # splits this leaf — every chip holds a full copy
+            "replicated_model": (
+                model_axis_size > 1
+                and _model_axis() not in _spec_axis_names(spec)
+            ),
+        }
+    return out
+
+
+def _model_axis() -> str:
+    from dynamo_tpu.utils.mesh import AXIS_MODEL
+
+    return AXIS_MODEL
+
+
+def _placement_facts(rig: dict) -> dict:
+    from dynamo_tpu.utils.mesh import MESH_AXES
+
+    mesh_shape = dict(zip(MESH_AXES, AUDIT_MESH_SHAPE))
+    leaves = {}
+    leaves.update(_leaf_table(rig["params"], rig["specs"], mesh_shape,
+                              "params"))
+    leaves.update(_leaf_table(rig["cache"], rig["cspec"], mesh_shape,
+                              "cache"))
+    params_pc = sum(v["bytes_per_chip"] for k, v in leaves.items()
+                    if k.startswith("params"))
+    cache_pc = sum(v["bytes_per_chip"] for k, v in leaves.items()
+                   if k.startswith("cache"))
+    total_pc = params_pc + cache_pc
+    replicated_pc = sum(v["bytes_per_chip"] for v in leaves.values()
+                        if v["replicated_model"])
+    payload = tuple(sorted(
+        (k, v["spec"], tuple(v["shape"]), v["dtype"])
+        for k, v in leaves.items()
+    )) + (tuple(sorted(mesh_shape.items())),)
+    return {
+        "mesh": mesh_shape,
+        "leaves": leaves,
+        "params_bytes_per_chip": params_pc,
+        "cache_bytes_per_chip": cache_pc,
+        "total_bytes_per_chip": total_pc,
+        "replicated_bytes_per_chip": replicated_pc,
+        "budget_bytes": rig["budget"],
+        "spec_hash": hashlib.sha256(
+            repr(payload).encode()).hexdigest()[:16],
+    }
+
+
+# --------------------------------------------------- entrypoint coverage ----
+
+
+_TAG_RE = re.compile(r"\[([^\]]+)\]$")
+
+
+def _coverage_facts(registry: list[Entrypoint],
+                    placements: dict[str, dict]) -> dict:
+    """Per registered (entrypoint, config) pair: its placement rig and
+    the per-chip bytes of its representative signature's args, with
+    each arg leaf classified against the rig's param/cache leaf sets by
+    (shape, dtype).  Unmatched leaves (token buffers, tables) are small
+    and replicated — they count at global size."""
+    import jax
+
+    lookup: dict[str, dict[tuple, tuple[str, int]]] = {}
+    for pname, p in placements.items():
+        tag = _TAG_RE.search(pname).group(1)
+        table: dict[tuple, tuple[str, int]] = {}
+        for lname, leaf in p["leaves"].items():
+            key = (tuple(leaf["shape"]), leaf["dtype"])
+            kind = "params" if lname.startswith("params") else "cache"
+            table.setdefault(key, (kind, leaf["bytes_per_chip"]))
+        lookup[tag] = table
+    out: dict[str, dict] = {}
+    for ep in registry:
+        m = _TAG_RE.search(ep.name)
+        tag = m.group(1) if m else None
+        table = lookup.get(tag, {})
+        sig = ep.build(**ep.representatives[0])
+        matched = {"params": 0, "cache": 0, "other": 0}
+        pc_bytes = 0
+        for leaf in jax.tree.leaves(sig.args):
+            key = (tuple(leaf.shape), str(leaf.dtype))
+            hit = table.get(key)
+            if hit is None:
+                matched["other"] += 1
+                pc_bytes += _bytes_of(leaf)
+            else:
+                matched[hit[0]] += 1
+                pc_bytes += hit[1]
+        out[ep.name] = {
+            "placement": f"placement[{tag}]" if tag in lookup else None,
+            "signature": sig.label,
+            "arg_leaves": sum(matched.values()),
+            "matched": matched,
+            "arg_bytes_per_chip": pc_bytes,
+        }
+    return out
+
+
+# --------------------------------------------------------- compile probes ----
+
+
+# dtperf's PF002 vocabulary (perfcheck._COLLECTIVE_PRIMS): the user
+# program's collectives, counted at jaxpr level so the probes can
+# subtract them from what the compiled HLO contains.
+def _user_collectives(fn: Callable, args) -> dict[str, int]:
+    import jax
+
+    from dynamo_tpu.analysis.perfcheck import _COLLECTIVE_PRIMS
+
+    counts: dict[str, int] = {}
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name in _COLLECTIVE_PRIMS:
+                counts[eqn.primitive.name] = (
+                    counts.get(eqn.primitive.name, 0) + 1
+                )
+            for sub in _iter_subjaxprs(eqn):
+                walk(sub)
+
+    walk(jax.make_jaxpr(fn)(*args).jaxpr)
+    return counts
+
+
+_HLO_COLLECTIVE_RE = re.compile(
+    r"\b(all-gather|all-reduce|all-to-all|collective-permute)"
+    r"(?:-start)?\("
+)
+
+# HLO opcode -> the jaxpr primitives that legitimately lower to it
+# (shared vocabulary with dtperf's collective census)
+_HLO_TO_PRIMS = {
+    "all-gather": ("all_gather",),
+    "all-to-all": ("all_to_all",),
+    "all-reduce": ("psum", "pmax", "pmin"),
+    "collective-permute": ("ppermute", "pbroadcast"),
+}
+
+
+def _hlo_collectives(text: str) -> dict[str, int]:
+    counts: dict[str, int] = {}
+    for m in _HLO_COLLECTIVE_RE.finditer(text):
+        counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+    return counts
+
+
+def _named(mesh, spec_tree):
+    import jax
+
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def _probe_decode(rig: dict, mesh, m: int) -> dict:
+    """Compile the rig's decode forward with its real shardings under
+    the real mesh and extract the SH002/SH005 facts: optimized-HLO
+    collective census minus the user program's collectives (what GSPMD
+    *inserted*), and the compiled output sharding of every donated
+    cache leaf vs its requested input sharding."""
+    import jax
+    import jax.numpy as jnp
+
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    model, params, cache = rig["model"], rig["params"], rig["cache"]
+    b, i32 = 1, jnp.int32
+
+    def fwd(p, tokens, positions, c, bt, lens, slots):
+        return model.forward(p, tokens, positions, c, bt, lens, slots)
+
+    rep = NamedSharding(mesh, P())
+    in_shardings = (
+        _named(mesh, rig["specs"]), rep, rep,
+        _named(mesh, rig["cspec"]), rep, rep, rep,
+    )
+    args = (params,
+            jax.ShapeDtypeStruct((b, 1), i32),
+            jax.ShapeDtypeStruct((b, 1), i32),
+            cache,
+            jax.ShapeDtypeStruct((b, m), i32),
+            jax.ShapeDtypeStruct((b,), i32),
+            jax.ShapeDtypeStruct((b, 1), i32))
+    compiled = jax.jit(
+        fwd, in_shardings=in_shardings, donate_argnums=(3,),
+    ).lower(*args).compile()
+
+    hlo = _hlo_collectives(compiled.as_text())
+    user = _user_collectives(lambda *a: fwd(*a), args)
+    inserted: dict[str, int] = {}
+    for op, count in sorted(hlo.items()):
+        expected = sum(user.get(p, 0) for p in _HLO_TO_PRIMS[op])
+        if count > expected:
+            inserted[op] = count - expected
+
+    # donated cache leaves: compiled OUTPUT sharding must be equivalent
+    # to the requested input sharding or donation degenerates to a copy
+    import jax.tree_util as jtu
+
+    out_leaves = jax.tree.leaves(compiled.output_shardings)
+    out_avals = jax.tree.leaves(jax.eval_shape(
+        lambda *a: fwd(*a), *args))
+    cache_in = jtu.tree_flatten_with_path(
+        _named(mesh, rig["cspec"]),
+        is_leaf=lambda x: isinstance(x, NamedSharding))[0]
+    donated = []
+    for path, want in cache_in:
+        name = "cache" + jtu.keystr(path)
+        cache_leaf = jtu.tree_flatten_with_path(cache)[0]
+        shape = dict(
+            ("cache" + jtu.keystr(p), l) for p, l in cache_leaf
+        )[name]
+        match = None
+        for got, aval in zip(out_leaves, out_avals):
+            if tuple(aval.shape) == tuple(shape.shape) and \
+                    str(aval.dtype) == str(shape.dtype):
+                match = want.is_equivalent_to(got, len(shape.shape))
+                break
+        donated.append({
+            "leaf": name,
+            "in_spec": _spec_str(want.spec),
+            "matches_output": bool(match),
+        })
+    return {
+        "mesh": {k: int(v) for k, v in mesh.shape.items()},
+        "hlo_collectives": hlo,
+        "user_collectives": user,
+        "inserted": inserted,
+        "donated": donated,
+    }
+
+
+def _probe_facts() -> dict:
+    from dynamo_tpu.models.deepseek import DeepseekModel
+    from dynamo_tpu.models.llama import LlamaModel
+    from dynamo_tpu.models.quant import prune_specs
+    from dynamo_tpu.utils.mesh import MESH_AXES, build_mesh
+
+    import jax
+
+    mesh = build_mesh(AUDIT_MESH_SHAPE, MESH_AXES)
+    amesh = _audit_mesh()
+    out: dict[str, dict] = {}
+
+    tiny = LlamaModel(_tiny_model_config())
+    rig = dict(
+        model=tiny,
+        params=jax.eval_shape(tiny.init_params, jax.random.PRNGKey(0)),
+        cache=jax.eval_shape(lambda: tiny.init_kv_cache(64, 8)),
+    )
+    rig["specs"] = prune_specs(rig["params"], tiny.partition_specs(),
+                               amesh)
+    rig["cspec"] = prune_specs(rig["cache"], tiny.cache_spec(False),
+                               amesh)
+    out["probe.llama.decode[tiny-llama]"] = _probe_decode(rig, mesh, 16)
+
+    mla = DeepseekModel(_tiny_deepseek_config())
+    rig = dict(
+        model=mla,
+        params=jax.eval_shape(mla.init_params, jax.random.PRNGKey(0)),
+        cache=jax.eval_shape(lambda: mla.init_kv_cache(16, 8)),
+    )
+    rig["specs"] = prune_specs(rig["params"], mla.partition_specs(),
+                               amesh)
+    rig["cspec"] = prune_specs(rig["cache"], mla.cache_spec(False),
+                               amesh)
+    out["probe.deepseek.decode[tiny-mla]"] = _probe_decode(rig, mesh, 8)
+    return out
+
+
+# -------------------------------------------------------------- collect ----
+
+
+def collect_shard_facts(
+        registry: Optional[list[Entrypoint]] = None) -> dict:
+    """The full sharding-plane fact snapshot: placement census per rig,
+    coverage per registered entrypoint, and the two compile probes.
+    Census/coverage are pure spec math (no devices); the probes need
+    :func:`ensure_audit_devices` to have run first."""
+    facts: dict[str, dict] = {}
+    rigs = _model_rigs()
+    placements = {
+        f"placement[{rig['tag']}]": _placement_facts(rig) for rig in rigs
+    }
+    facts.update(placements)
+    registry = registry if registry is not None else build_registry()
+    facts.update(_coverage_facts(registry, placements))
+    facts.update(_probe_facts())
+    return facts
+
+
+# ---------------------------------------------------------------- check ----
+
+
+def check_shard_facts(facts: dict,
+                      manifest: Manifest) -> list[TraceFinding]:
+    """Findings = placement drift (SH004, resolved by fixing specs or
+    re-snapshotting) + intrinsic placement defects (SH001/2/3/5,
+    acceptable with a justification)."""
+    findings: list[TraceFinding] = []
+    known = manifest.entrypoints
+    for name in sorted(set(facts) - set(known)):
+        findings.append(TraceFinding(
+            name, "SH004", "added",
+            "fact entry not in the committed shard manifest — audit it "
+            "and re-snapshot (`dynamo-tpu lint --shard "
+            "--update-baseline`)",
+        ))
+    for name in sorted(set(known) - set(facts)):
+        findings.append(TraceFinding(
+            name, "SH004", "removed",
+            "manifest entry no longer produced — re-snapshot if the "
+            "removal is intended",
+        ))
+    for name, f in sorted(facts.items()):
+        committed = known.get(name)
+        if name.startswith("placement["):
+            if committed is not None and \
+                    f["spec_hash"] != committed.get("spec_hash"):
+                findings.append(TraceFinding(
+                    name, "SH004", "specs",
+                    "placement spec table drifted from the manifest "
+                    f"(hash {committed.get('spec_hash')} -> "
+                    f"{f['spec_hash']}) — an array's sharding, shape "
+                    "or dtype changed; verify the placement, then "
+                    "re-snapshot",
+                ))
+            floor = max(
+                int(SH001_MIN_FRACTION * f["total_bytes_per_chip"]), 1)
+            for lname, leaf in sorted(f["leaves"].items()):
+                if not leaf["replicated_model"]:
+                    continue
+                if leaf["bytes_global"] < SH001_MIN_BYTES and \
+                        leaf["bytes_per_chip"] < floor:
+                    continue
+                findings.append(TraceFinding(
+                    name, "SH001", lname,
+                    f"{lname} {leaf['shape']} {leaf['dtype']} "
+                    f"({leaf['bytes_global']:,} B) is replicated "
+                    "across the model axis — every chip holds a full "
+                    f"copy (spec {leaf['spec']}); shard it or accept "
+                    "with a justification",
+                ))
+            budget = f.get("budget_bytes")
+            if budget and f["total_bytes_per_chip"] > budget:
+                findings.append(TraceFinding(
+                    name, "SH003", "total",
+                    f"per-chip resident {f['total_bytes_per_chip']:,} B"
+                    f" (params {f['params_bytes_per_chip']:,} + KV "
+                    f"{f['cache_bytes_per_chip']:,}) exceeds the "
+                    f"per-chip budget {budget:,} B",
+                ))
+        elif name.startswith("probe."):
+            for op, count in sorted(f.get("inserted", {}).items()):
+                if op not in ("all-gather", "all-to-all"):
+                    # inserted all-reduce is the expected TP pattern
+                    # (row-parallel matmul partial sums); permutes are
+                    # halo exchanges — recorded in facts, not findings
+                    continue
+                findings.append(TraceFinding(
+                    name, "SH002", f"{op}x{count}",
+                    f"{count} GSPMD-inserted {op}(s) on the decode "
+                    "probe not present in the user program — an "
+                    "implicit reshard on the hot path; fix the specs "
+                    "or accept with a justification (count-keyed: a "
+                    "new reshard re-trips the gate)",
+                ))
+            for d in f.get("donated", []):
+                if not d["matches_output"]:
+                    findings.append(TraceFinding(
+                        name, "SH005", d["leaf"],
+                        f"donated {d['leaf']} (in {d['in_spec']}) "
+                        "compiles to a DIFFERENT output sharding — "
+                        "the donation reshards/copies every step "
+                        "instead of aliasing",
+                    ))
+    return sorted(findings)
+
+
+# ------------------------------------------------------------------ CLI ----
+
+
+def run_shard(args, out) -> int:
+    """`dynamo-tpu lint --shard`: text or stable JSON, exit 1 on any
+    non-accepted finding, `--update-baseline` re-snapshots the manifest
+    (carrying justifications by key)."""
+    ensure_audit_devices()
+    manifest_path = Path(
+        getattr(args, "manifest", None) or DEFAULT_MANIFEST_PATH
+    )
+    manifest = Manifest.load(manifest_path)
+    facts = collect_shard_facts()
+    findings = check_shard_facts(facts, manifest)
+
+    if getattr(args, "update_baseline", False):
+        intrinsic = [f for f in findings
+                     if f.rule in ("SH001", "SH002", "SH003", "SH005")]
+        m = Manifest.from_facts(facts, intrinsic, manifest)
+        m.header = _shard_header()
+        m.save(manifest_path)
+        print(
+            f"shard manifest updated: {len(facts)} entries, "
+            f"{len(intrinsic)} accepted finding"
+            f"{'' if len(intrinsic) == 1 else 's'} -> {manifest_path}",
+            file=out,
+        )
+        return 0
+
+    fresh = manifest.filter(findings)
+    n_accepted = len(findings) - len(fresh)
+    if getattr(args, "fmt", "text") == "json":
+        doc = {
+            "findings": [f.to_json() for f in fresh],
+            "accepted": n_accepted,
+            "total": len(findings),
+            "entries": sorted(facts),
+        }
+        print(json.dumps(doc, indent=2, sort_keys=True), file=out)
+    else:
+        for f in fresh:
+            print(f.render(), file=out)
+        print(
+            f"{len(fresh)} shard finding{'s' if len(fresh) != 1 else ''}"
+            f" ({n_accepted} accepted) over {len(facts)} entries",
+            file=out,
+        )
+    return 1 if fresh else 0

@@ -114,11 +114,8 @@ def _build_local_engine(args) -> tuple[object, object]:
     # created — loading/quantizing weights initializes the backend, and
     # jax.distributed.initialize must run first for jax.devices() to be
     # global (runtime/multihost.py)
-    from dynamo_tpu.runtime.multihost import (
-        MultiHostSpec,
-        bootstrap,
-        global_mesh,
-    )
+    from dynamo_tpu.runtime.multihost import MultiHostSpec, bootstrap
+    from dynamo_tpu.utils.mesh import MESH_AXES, build_mesh
 
     nnodes = int(getattr(args, "nnodes", 1) or 1)
     if nnodes > 1:
@@ -144,7 +141,7 @@ def _build_local_engine(args) -> tuple[object, object]:
     tp = int(getattr(args, "tp", 1) or 1)
     dp = int(getattr(args, "dp", 1) or 1)
     if tp * dp > 1:
-        mesh = global_mesh((dp, tp), ("data", "model"))
+        mesh = build_mesh((dp, tp), MESH_AXES)
 
     cfg = EngineConfig(
         max_batch_size=args.max_batch_size,

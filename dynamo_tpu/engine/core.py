@@ -57,6 +57,7 @@ from dynamo_tpu.llm.kv.block_manager import KvBlockManager, NoFreeBlocks
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput
 from dynamo_tpu.models.llama import LlamaModel
 from dynamo_tpu.obs.perfmodel import perf_model
+from dynamo_tpu.utils.mesh import AXIS_DATA
 from dynamo_tpu.obs.timeline import step_timeline
 from dynamo_tpu.tokens import TokenBlockSequence
 
@@ -458,8 +459,8 @@ class EngineCore:
         if (
             mesh is not None
             and config.sp_prefill_threshold > 0
-            and "data" in mesh.axis_names
-            and mesh.shape["data"] > 1
+            and AXIS_DATA in mesh.axis_names
+            and mesh.shape[AXIS_DATA] > 1
         ):
             if not hasattr(model, "forward_seq_parallel") or not getattr(
                     model, "supports_seq_parallel", True):
@@ -472,7 +473,7 @@ class EngineCore:
                     f"{type(model).__name__} does not support seq-parallel "
                     "prefill (this config); disable sp_prefill_threshold"
                 )
-            self._sp_size = mesh.shape["data"]
+            self._sp_size = mesh.shape[AXIS_DATA]
             self._sp_fn = jax.jit(
                 self._sp_impl, static_argnames=("nb", "k_cand", "exact")
             )
@@ -579,7 +580,7 @@ class EngineCore:
         follow-up scatter is a resident-layout write).  With the int8
         cache the blocks are quantized here, in the same dispatch."""
         hidden, kv = self.model.forward_seq_parallel(
-            params, tokens, positions, self.mesh, sp_axis="data"
+            params, tokens, positions, self.mesh, sp_axis=AXIS_DATA
         )
         last_h = hidden[jnp.arange(1), last_idx]
         logits = self.model.compute_logits(params, last_h)

@@ -52,6 +52,9 @@ from dynamo_tpu.models.llama import (
     rms_norm,
     rope_inv_freq,
 )
+# canonical axis names (utils/mesh.py) — same alias convention as llama.py
+from dynamo_tpu.utils.mesh import AXIS_MODEL as _TP
+from dynamo_tpu.utils.mesh import AXIS_SP
 from dynamo_tpu.ops.paged_attention import (
     paged_attention_layer,
     write_kv_cache_layer,
@@ -277,37 +280,37 @@ class DeepseekModel:
                 "attn_norm": P(None, None), "mlp_norm": P(None, None),
                 "kv_a": P(None, None, None),
                 "kv_a_norm": P(None, None),
-                "kv_b": P(None, None, "model"),
-                "wo": P(None, "model", None),
+                "kv_b": P(None, None, _TP),
+                "wo": P(None, _TP, None),
             }
             if cfg.q_lora_rank is None:
-                p["wq"] = P(None, None, "model")
+                p["wq"] = P(None, None, _TP)
             else:
                 p.update(q_a=P(None, None, None), q_a_norm=P(None, None),
-                         q_b=P(None, None, "model"))
+                         q_b=P(None, None, _TP))
             return p
 
         dense_layers = attn(cfg.first_k_dense_replace)
         dense_layers.update(
-            w_gate=P(None, None, "model"), w_up=P(None, None, "model"),
-            w_down=P(None, "model", None),
+            w_gate=P(None, None, _TP), w_up=P(None, None, _TP),
+            w_down=P(None, _TP, None),
         )
         moe_layers = attn(cfg.num_layers - cfg.first_k_dense_replace)
         moe_layers.update(
             router=P(None, None, None),
-            w_gate=P(None, None, None, "model"),
-            w_up=P(None, None, None, "model"),
-            w_down=P(None, None, "model", None),
-            shared_gate=P(None, None, "model"),
-            shared_up=P(None, None, "model"),
-            shared_down=P(None, "model", None),
+            w_gate=P(None, None, None, _TP),
+            w_up=P(None, None, None, _TP),
+            w_down=P(None, None, _TP, None),
+            shared_gate=P(None, None, _TP),
+            shared_up=P(None, None, _TP),
+            shared_down=P(None, _TP, None),
         )
         return {
             "embed": P(None, None),
             "dense_layers": dense_layers,
             "moe_layers": moe_layers,
             "final_norm": P(None),
-            "lm_head": P(None, "model"),
+            "lm_head": P(None, _TP),
         }
 
     def cache_spec(self, quant: bool = False):
@@ -319,10 +322,10 @@ class DeepseekModel:
             data = P(None, None, None, None, None)
             scale_head = None
         else:
-            data = P(None, None, None, None, "model")
+            data = P(None, None, None, None, _TP)
             # scale-pool head axis shards only when tile-exact (see
             # LlamaModel.cache_spec for the padded-axis rationale)
-            scale_head = ("model" if self.config.num_kv_heads % 8 == 0
+            scale_head = (_TP if self.config.num_kv_heads % 8 == 0
                           else None)
         if not quant:
             return data
@@ -558,7 +561,7 @@ class DeepseekModel:
         return self.config.attn_impl == "absorbed"
 
     def forward_seq_parallel(self, params, tokens, positions, mesh,
-                             sp_axis: str = "sp"):
+                             sp_axis: str = AXIS_SP):
         """Long-context MLA prefill with ring attention (context
         parallelism), the engine's SP path for prompts beyond one chip's
         comfort (EngineConfig.sp_prefill_threshold).

@@ -35,6 +35,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+# canonical axis names (utils/mesh.py): _TP is the tensor-parallel mesh
+# axis every spec below shards over — shardcheck audits specs under the
+# same constants, so a renamed axis breaks loudly instead of replicating
+from dynamo_tpu.utils.mesh import AXIS_MODEL as _TP
+from dynamo_tpu.utils.mesh import AXIS_SP
+
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.models.quant import (
     QTensor,
@@ -246,15 +252,15 @@ class LlamaModel:
         cfg = self.config
         layers = {
             "attn_norm": P(None, None),
-            "wq": P(None, None, "model"),
-            "wk": P(None, None, "model"),
-            "wv": P(None, None, "model"),
-            "wo": P(None, "model", None),
+            "wq": P(None, None, _TP),
+            "wk": P(None, None, _TP),
+            "wv": P(None, None, _TP),
+            "wo": P(None, _TP, None),
             "mlp_norm": P(None, None),
         }
         if cfg.attention_bias:
             layers.update(
-                bq=P(None, "model"), bk=P(None, "model"), bv=P(None, "model")
+                bq=P(None, _TP), bk=P(None, _TP), bv=P(None, _TP)
             )
         if cfg.qk_norm:
             layers.update(q_norm=P(None, None), k_norm=P(None, None))
@@ -272,15 +278,15 @@ class LlamaModel:
             # devices whose experts draw no tokens.)
             layers.update(
                 router=P(None, None, None),
-                w_gate=P(None, None, None, "model"),
-                w_up=P(None, None, None, "model"),
-                w_down=P(None, None, "model", None),
+                w_gate=P(None, None, None, _TP),
+                w_up=P(None, None, None, _TP),
+                w_down=P(None, None, _TP, None),
             )
         else:
             layers.update(
-                w_gate=P(None, None, "model"),
-                w_up=P(None, None, "model"),
-                w_down=P(None, "model", None),
+                w_gate=P(None, None, _TP),
+                w_up=P(None, None, _TP),
+                w_down=P(None, _TP, None),
             )
         specs = {
             "embed": P(None, None),
@@ -288,7 +294,7 @@ class LlamaModel:
             "final_norm": P(None),
         }
         if not cfg.tie_word_embeddings:
-            specs["lm_head"] = P(None, "model")
+            specs["lm_head"] = P(None, _TP)
         return specs
 
     def cache_spec(self, quant: bool = False):
@@ -300,12 +306,12 @@ class LlamaModel:
         padded head axis replicates instead, since an even split of the
         padded axis would put different heads on a shard than the data's
         head-major lane split does."""
-        data = P(None, None, None, None, "model")
+        data = P(None, None, None, None, _TP)
         if not quant:
             return data
         from dynamo_tpu.ops.kv_quant import QuantKvCache
 
-        head_axis = "model" if self.config.num_kv_heads % 8 == 0 else None
+        head_axis = _TP if self.config.num_kv_heads % 8 == 0 else None
         return QuantKvCache(data, P(None, None, None, head_axis, None))
 
     # --------------------------------------------------------------- kv cache
@@ -470,7 +476,7 @@ class LlamaModel:
         tokens: jax.Array,      # [B, S] int32, S sharded over mesh[sp_axis]
         positions: jax.Array,   # [B, S] int32 global positions
         mesh: jax.sharding.Mesh,
-        sp_axis: str = "sp",
+        sp_axis: str = AXIS_SP,
     ) -> tuple[jax.Array, jax.Array]:
         """Long-context prefill with ring attention (context parallelism).
 

@@ -28,8 +28,12 @@ predicted-vs-measured gauge on ``/metrics``.
 from __future__ import annotations
 
 __all__ = [
+    "AXIS_DATA",
+    "AXIS_MODEL",
+    "AXIS_SP",
     "CONSTANTS_VERSION",
     "DEFAULT_TOPOLOGY",
+    "MESH_AXES",
     "TOPOLOGIES",
     "collective_cost_s",
     "path_prior_bw",
@@ -38,6 +42,16 @@ __all__ = [
 
 # Bump on ANY numeric change below; the perf manifest header pins it.
 CONSTANTS_VERSION = "v5e-2026.08.1"
+
+# Canonical mesh axis names — the single source both the runtime
+# (engine CLI, multihost bootstrap, ring attention) and the sharding
+# lint plane (analysis/shardcheck.py) build meshes from, so the specs
+# shardcheck audits are provably the specs the engine lowers under.
+# Construction lives in dynamo_tpu/utils/mesh.py (build_mesh).
+AXIS_DATA = "data"    # DP / sequence-parallel axis: spans hosts (DCN)
+AXIS_MODEL = "model"  # TP axis: last mesh axis, intra-host over ICI
+AXIS_SP = "sp"        # standalone seq-parallel axis (ring-attention rigs)
+MESH_AXES = (AXIS_DATA, AXIS_MODEL)  # the engine's (dp, tp) mesh layout
 
 DEFAULT_TOPOLOGY = "v5e"
 

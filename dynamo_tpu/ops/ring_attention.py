@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from dynamo_tpu.ops.paged_attention import softcap
+from dynamo_tpu.utils.mesh import AXIS_SP
 
 __all__ = ["ring_attention", "ring_attention_inner"]
 
@@ -115,7 +116,7 @@ def ring_attention(
     kv_pos: jax.Array,
     *,
     mesh: jax.sharding.Mesh,
-    axis: str = "sp",
+    axis: str = AXIS_SP,
     causal: bool = True,
     sm_scale: Optional[float] = None,
     logit_cap: Optional[float] = None,
@@ -124,6 +125,15 @@ def ring_attention(
     """Sequence-parallel attention: inputs sharded on their seq axis over
     ``mesh[axis]``; output keeps that sharding.  q/k/v: [B, S, H, D] global;
     q_pos/kv_pos: [B, S] global positions."""
+    if axis not in mesh.axis_names:
+        # a renamed/missing axis must fail HERE: a PartitionSpec naming an
+        # axis the mesh doesn't have would otherwise silently replicate the
+        # sequence on every chip and psum(1) over a size-1 axis would make
+        # the ring degenerate to a single (wrong) step
+        raise ValueError(
+            f"ring_attention axis {axis!r} not in mesh axes "
+            f"{tuple(mesh.axis_names)}"
+        )
     inner = functools.partial(
         ring_attention_inner, axis_name=axis, causal=causal,
         sm_scale=sm_scale, logit_cap=logit_cap, window=window,

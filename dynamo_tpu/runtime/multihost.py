@@ -152,15 +152,9 @@ def global_mesh(shape: tuple[int, ...], axis_names: tuple[str, ...]):
     jax.devices() ordering: devices of one process are contiguous, so the
     LAST mesh axes land within a host (put "model"/TP there — its
     collectives then ride intra-host ICI; "data"/DP spans hosts over DCN,
-    the scaling-book layout)."""
-    import math
+    the scaling-book layout).  Thin alias over the central constructor
+    (utils/mesh.py) so runtime and the sharding lint plane provably
+    build the same mesh."""
+    from dynamo_tpu.utils.mesh import build_mesh
 
-    import jax
-    import numpy as np
-
-    devs = jax.devices()
-    need = math.prod(shape)
-    if need > len(devs):
-        raise ValueError(f"mesh {shape} needs {need} devices, have {len(devs)}")
-    arr = np.array(devs[:need]).reshape(shape)
-    return jax.sharding.Mesh(arr, axis_names)
+    return build_mesh(shape, axis_names)

@@ -14,3 +14,15 @@ def wait_each(step_outputs):
     for out in step_outputs:
         out.block_until_ready()
     return step_outputs
+
+
+@jax.jit
+def step_with_debug_print(x):
+    jax.debug.print("x = {}", x)
+    return x * 2
+
+
+def log_each(step_outputs):
+    for out in step_outputs:
+        jax.debug.callback(print, out)
+    return step_outputs

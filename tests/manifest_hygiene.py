@@ -1,0 +1,39 @@
+"""Shared manifest-hygiene assertion for the gated analysis planes.
+
+The trace, wire, perf and shard planes all commit a manifest whose
+``accepted`` entries follow one contract: every entry carries a real
+justification (no blank, no ``TODO: justify`` left by
+``--update-baseline``) and still matches a finding the checker produces
+TODAY — an entry whose finding disappeared is stale grandfathering and
+must be pruned by re-snapshotting.  Each plane's gate test had grown
+its own copy of that assertion (drifting on the entity field name:
+trace/perf/shard key entries on ``entrypoint``, wire on ``message``);
+this helper is the single parameterized implementation they all call.
+"""
+
+from __future__ import annotations
+
+
+def assert_manifest_hygiene(manifest, findings, *,
+                            entity_field: str = "entrypoint") -> None:
+    """Assert every ``manifest.accepted`` entry is justified and live.
+
+    ``manifest`` needs an ``accepted`` list of dicts keyed on
+    (``entity_field``, ``rule``, ``key``); ``findings`` is the CURRENT
+    full finding list (pre-filter) whose elements expose
+    ``accept_key`` tuples in the same shape.
+    """
+    for e in manifest.accepted:
+        assert e.get("justification", "").strip() not in (
+            "", "TODO: justify"), (
+            f"accepted entry {e[entity_field]}:{e['rule']}[{e['key']}] "
+            "needs a one-line justification"
+        )
+    keys = {f.accept_key for f in findings}
+    stale = [e for e in manifest.accepted
+             if (e[entity_field], e["rule"], e["key"]) not in keys]
+    assert not stale, (
+        "accepted entries no longer match any finding (re-snapshot with "
+        "--update-baseline): "
+        + str([(e[entity_field], e["rule"], e["key"]) for e in stale])
+    )

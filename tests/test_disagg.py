@@ -302,12 +302,12 @@ def test_disagg_sharded_decode_matches_local(setup, force_tcp):
     host blocks onto the mesh (each shard keeps its kv heads) and decode
     must still reproduce the local greedy tokens (VERDICT r2 weak #7)."""
     import jax
-    from jax.sharding import Mesh
+    from dynamo_tpu.utils.mesh import MESH_AXES, build_mesh
 
     model, params = setup
     rng = np.random.default_rng(11)
     prompt = rng.integers(1, 128, size=28).tolist()
-    mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2), ("data", "model"))
+    mesh = build_mesh((1, 2), MESH_AXES)
 
     async def go():
         srv = await CoordinatorServer(port=0).start()

@@ -418,11 +418,10 @@ def test_json_mode_under_tp_mesh(setup):
     replicated tables, one valid JSON out."""
     import jax
     import numpy as np_
-    from jax.sharding import Mesh
+    from dynamo_tpu.utils.mesh import MESH_AXES, build_mesh
 
     model, params, grammar, toks = setup
-    mesh = Mesh(np_.array(jax.devices()[:2]).reshape(1, 2),
-                ("data", "model"))
+    mesh = build_mesh((1, 2), MESH_AXES)
     cfg = EngineConfig(
         max_batch_size=2, max_model_len=128, block_size=8, num_blocks=64,
         prefill_buckets=[16, 32, 64, 128], decode_steps=4,

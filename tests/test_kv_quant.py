@@ -279,7 +279,7 @@ def test_engine_int8_cache_sharded_mesh():
     """Quantized cache under a TP mesh: the data+scale pair shards along
     kv heads (cache_spec(quant=True)) and the engine decodes."""
     import numpy as np_
-    from jax.sharding import Mesh
+    from dynamo_tpu.utils.mesh import MESH_AXES, build_mesh
 
     from dynamo_tpu.engine import EngineConfig, EngineCore
     from dynamo_tpu.engine.request import EngineRequest
@@ -294,7 +294,7 @@ def test_engine_int8_cache_sharded_mesh():
     )
     model = LlamaModel(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
-    mesh = Mesh(np_.array(jax.devices()[:2]).reshape(1, 2), ("data", "model"))
+    mesh = build_mesh((1, 2), MESH_AXES)
     core = EngineCore(
         model, params,
         EngineConfig(max_batch_size=2, max_model_len=64, block_size=8,
@@ -375,12 +375,12 @@ def test_host_offload_with_int8_cache():
 def test_sp_prefill_with_int8_cache():
     """Seq-parallel long prefill quantizes its blocks in-dispatch and the
     follow-up decode matches the non-SP int8 engine."""
-    from jax.sharding import Mesh
+    from dynamo_tpu.utils.mesh import MESH_AXES, build_mesh
 
     from dynamo_tpu.engine import EngineConfig, EngineCore
 
     model, params = _tiny_model()
-    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("data", "model"))
+    mesh = build_mesh((2, 2), MESH_AXES)
 
     def run(sp_threshold):
         core = EngineCore(

@@ -109,7 +109,9 @@ def test_quantized_init_params_structure_matches():
 def test_align_specs_and_sharded_engine_step():
     """Quantized params shard over a real mesh and serve through the
     engine: align_specs must fan each PartitionSpec into (q, scale)."""
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dynamo_tpu.utils.mesh import MESH_AXES, build_mesh
 
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.core import EngineCore
@@ -119,8 +121,7 @@ def test_align_specs_and_sharded_engine_step():
     cfg = ModelConfig.tiny(num_kv_heads=4)  # 4 kv heads shard over model=2
     model = LlamaModel(cfg)
     qparams = model.quantize_params(model.init_params(jax.random.PRNGKey(5)))
-    devs = np.array(jax.devices()[:2]).reshape(1, 2)
-    mesh = Mesh(devs, ("data", "model"))
+    mesh = build_mesh((1, 2), MESH_AXES)
 
     specs = align_specs(qparams, model.partition_specs())
     assert isinstance(specs["layers"]["wq"], QTensor)

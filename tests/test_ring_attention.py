@@ -10,6 +10,7 @@ import pytest
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.models.llama import LlamaModel
 from dynamo_tpu.ops.ring_attention import ring_attention
+from dynamo_tpu.utils.mesh import AXIS_SP, MESH_AXES, build_mesh
 
 
 def dense_causal(q, k, v, q_pos, kv_pos, scale):
@@ -27,7 +28,7 @@ def dense_causal(q, k, v, q_pos, kv_pos, scale):
 
 @pytest.fixture(scope="module")
 def mesh():
-    return jax.sharding.Mesh(np.array(jax.devices()[:8]), ("sp",))
+    return build_mesh(8, (AXIS_SP,))
 
 
 def test_ring_matches_dense(mesh):
@@ -135,7 +136,6 @@ def test_engine_sp_prefill_matches_plain_engine():
     the sequence sharded over mesh["data"], and greedy decode afterwards
     matches a plain single-dispatch engine exactly."""
     import jax
-    from jax.sharding import Mesh
 
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.core import EngineCore
@@ -149,9 +149,7 @@ def test_engine_sp_prefill_matches_plain_engine():
     )
     model = LlamaModel(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
-    mesh = Mesh(
-        np.array(jax.devices()[:4]).reshape(2, 2), ("data", "model")
-    )
+    mesh = build_mesh((2, 2), MESH_AXES)
 
     def run_engine(sp_threshold):
         ecfg = EngineConfig(
@@ -228,7 +226,6 @@ def test_deepseek_engine_sp_prefill_matches_plain_engine():
     """Engine-level MLA SP prefill: a long DeepSeek prompt prefills in one
     ring dispatch and greedy decode afterwards matches the plain engine."""
     import jax
-    from jax.sharding import Mesh
 
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.core import EngineCore
@@ -236,9 +233,7 @@ def test_deepseek_engine_sp_prefill_matches_plain_engine():
     from dynamo_tpu.llm.protocols import SamplingOptions, StopConditions
 
     cfg, model, params = _tiny_deepseek()
-    mesh = Mesh(
-        np.array(jax.devices()[:4]).reshape(2, 2), ("data", "model")
-    )
+    mesh = build_mesh((2, 2), MESH_AXES)
 
     def run_engine(sp_threshold):
         ecfg = EngineConfig(
@@ -272,7 +267,6 @@ def test_deepseek_expanded_rejects_sp_at_construction():
     first long prompt mid-serving."""
     import jax
     import pytest
-    from jax.sharding import Mesh
 
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.core import EngineCore
@@ -281,8 +275,7 @@ def test_deepseek_expanded_rejects_sp_at_construction():
     cfg.attn_impl = "expanded"
     model = type(model)(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
-    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
-                ("data", "model"))
+    mesh = build_mesh((2, 2), MESH_AXES)
     with pytest.raises(ValueError, match="seq-parallel"):
         EngineCore(model, params,
                    EngineConfig(max_batch_size=2, max_model_len=256,
@@ -315,3 +308,18 @@ def test_seq_parallel_sliding_window_matches_paged(mesh):
     np.testing.assert_allclose(np.asarray(hidden_sp),
                                np.asarray(hidden_paged),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_renamed_axis_fails_loudly(mesh):
+    """A mesh without the requested axis must raise at the call — before
+    this check, a PartitionSpec naming a nonexistent axis silently
+    replicated the sequence on every device (satellite fix for the
+    string-literal spec duplication)."""
+    q = jnp.zeros((1, 16, 4, 8), jnp.bfloat16)
+    kv = jnp.zeros((1, 16, 2, 8), jnp.bfloat16)
+    pos = jnp.arange(16, dtype=jnp.int32)[None, :]
+    with pytest.raises(ValueError, match="not in mesh axes"):
+        ring_attention(q, kv, kv, pos, pos, mesh=mesh, axis="seq")
+    # the canonical-name default works against the canonical mesh
+    out = ring_attention(q, kv, kv, pos, pos, mesh=mesh)
+    assert out.shape == q.shape

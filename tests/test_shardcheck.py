@@ -1,0 +1,357 @@
+"""Sharding-plane static analysis (dtshard) tests: THE sixth tier-1
+gate (zero non-accepted findings over the placement/coverage/probe
+facts against the committed shard manifest), the per-chip byte model
+against a forced-4-device oracle (``addressable_shards`` nbytes must
+equal the spec math exactly, sharded AND replicated), the SH001-SH005
+drift rules on the committed ``tests/lint_fixtures/sh_*_facts.json``
+fixture pair, an injected implicit reshard provably caught as SH002,
+the ROADMAP-item-5 pin (the absorbed-MLA latent cache's SH001/SH005
+acceptances re-trip the gate if removed), registry coverage, and the
+manifest/CLI contract (``--update-baseline`` justification carry,
+stable JSON, run_lint routing).
+"""
+
+import argparse
+import io
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dynamo_tpu.analysis import shardcheck as sc
+from dynamo_tpu.analysis.shardcheck import (
+    AUDIT_MESH_SHAPE,
+    DEFAULT_MANIFEST_PATH,
+    check_shard_facts,
+    collect_shard_facts,
+    leaf_per_chip_bytes,
+    run_shard,
+)
+from dynamo_tpu.analysis.tracecheck import Manifest, build_registry
+from dynamo_tpu.utils.mesh import AXIS_MODEL, MESH_AXES, build_mesh
+
+FIXTURES = Path(__file__).parent / "lint_fixtures"
+
+
+def _load_facts(name):
+    return json.loads((FIXTURES / name).read_text())
+
+
+# ------------------------------------------------------------- the gate ----
+
+
+@pytest.fixture(scope="module")
+def real_facts():
+    # conftest already forces >= 4 virtual CPU devices, so the probes
+    # compile under the real (1, 4) audit mesh here
+    return collect_shard_facts()
+
+
+def test_shard_gate_zero_nonaccepted_findings(real_facts):
+    """THE tier-1 shard-plane gate: placements, coverage and probes are
+    clean against the committed shard manifest.  If this fails you
+    either fix the placement regression (preferred) or, for an intended
+    change, re-snapshot with `dynamo-tpu lint --shard --update-baseline`
+    and justify any new replication/reshard entry."""
+    manifest = Manifest.load(DEFAULT_MANIFEST_PATH)
+    assert manifest.entrypoints, "shard manifest missing or empty"
+    findings = check_shard_facts(real_facts, manifest)
+    fresh = manifest.filter(findings)
+    assert not fresh, (
+        "non-accepted shard-plane findings:\n  "
+        + "\n  ".join(f.render() for f in fresh)
+        + "\nFix the placement, or re-snapshot via `dynamo-tpu lint "
+        "--shard --update-baseline` and justify "
+        "(docs/static_analysis.md#sharding-plane)."
+    )
+
+
+def test_manifest_accepted_entries_justified_and_live(real_facts):
+    from manifest_hygiene import assert_manifest_hygiene
+
+    manifest = Manifest.load(DEFAULT_MANIFEST_PATH)
+    assert_manifest_hygiene(
+        manifest, check_shard_facts(real_facts, manifest))
+
+
+def test_manifest_header_records_mesh_and_cpu_caveat():
+    """The committed header pins the audit mesh and the CPU-fallback
+    caveat (the probes see XLA fallback lowerings, not the Pallas TPU
+    kernels), so accepted SH002 entries carry their context."""
+    doc = json.loads(DEFAULT_MANIFEST_PATH.read_text())
+    h = doc["header"]
+    assert h["audit_mesh"] == dict(zip(MESH_AXES, AUDIT_MESH_SHAPE))
+    assert "CPU" in h["note"] and "Pallas" in h["note"]
+    assert h["hbm_budget"]["bytes"] > 0
+
+
+def test_manifest_covers_every_registered_pair(real_facts):
+    """Acceptance floor: every (entrypoint, config) pair tracecheck
+    registers has a committed coverage entry mapped onto a live
+    placement rig, with classified per-chip argument bytes."""
+    manifest = Manifest.load(DEFAULT_MANIFEST_PATH)
+    names = {ep.name for ep in build_registry()}
+    assert names <= set(manifest.entrypoints)
+    assert names <= set(real_facts)
+    for name in names:
+        cov = real_facts[name]
+        assert cov["placement"] in real_facts, name
+        assert cov["arg_leaves"] > 0 and cov["arg_bytes_per_chip"] > 0
+        assert cov["matched"]["params"] + cov["matched"]["cache"] > 0, (
+            f"{name}: no arg leaf matched its rig's param/cache tables"
+        )
+
+
+def test_mla_latent_cache_pin_retrips_if_unaccepted(real_facts):
+    """ROADMAP item 5's tripwire, both halves: the absorbed-MLA latent
+    cache is a justified SH001 acceptance citing the latent-sharding
+    work (TPLA, arxiv 2508.15881), its donation penalty is the matching
+    SH005 acceptance, and stripping either from the manifest re-trips
+    the gate — the premise cannot silently rot."""
+    manifest = Manifest.load(DEFAULT_MANIFEST_PATH)
+    pins = [e for e in manifest.accepted
+            if e["entrypoint"] == "placement[tiny-mla]"
+            and e["rule"] == "SH001" and e["key"] == "cache"]
+    assert pins and "2508.15881" in pins[0]["justification"]
+    assert any(e["entrypoint"] == "probe.deepseek.decode[tiny-mla]"
+               and e["rule"] == "SH005" for e in manifest.accepted)
+
+    stripped = Manifest(
+        entrypoints=manifest.entrypoints, header=manifest.header,
+        accepted=[e for e in manifest.accepted
+                  if not (e["entrypoint"] == "placement[tiny-mla]"
+                          and e["key"] == "cache")],
+    )
+    fresh = stripped.filter(check_shard_facts(real_facts, stripped))
+    assert any(f.entrypoint == "placement[tiny-mla]"
+               and f.rule == "SH001" and f.key == "cache"
+               for f in fresh), "SH001 latent-cache pin did not re-trip"
+
+
+# ---------------------------------------------------- per-chip byte oracle ----
+
+
+def test_per_chip_bytes_match_real_device_shards_exactly():
+    """The 4-device oracle: device_put a known array sharded and
+    replicated under the real audit mesh; ``addressable_shards`` nbytes
+    must equal ``leaf_per_chip_bytes``'s spec math EXACTLY."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    mesh = build_mesh(AUDIT_MESH_SHAPE, MESH_AXES)
+    mesh_shape = dict(zip(MESH_AXES, AUDIT_MESH_SHAPE))
+    x = jnp.ones((8, 128), jnp.float32)
+
+    sharded = jax.device_put(x, NamedSharding(mesh, P(None, AXIS_MODEL)))
+    want = leaf_per_chip_bytes(P(None, AXIS_MODEL), x.nbytes, mesh_shape)
+    assert want == x.nbytes // 4
+    for shard in sharded.addressable_shards:
+        assert shard.data.nbytes == want
+
+    replicated = jax.device_put(x, NamedSharding(mesh, P(None, None)))
+    want = leaf_per_chip_bytes(P(None, None), x.nbytes, mesh_shape)
+    assert want == x.nbytes
+    for shard in replicated.addressable_shards:
+        assert shard.data.nbytes == want
+
+
+def test_leaf_per_chip_bytes_spec_shapes():
+    """None / single-axis / tuple-of-axes spec entries all divide
+    correctly; unknown axis names divide by 1."""
+    ms = {"data": 2, "model": 4}
+    from jax.sharding import PartitionSpec as P
+
+    assert leaf_per_chip_bytes(P(None, None), 800, ms) == 800
+    assert leaf_per_chip_bytes(P("model", None), 800, ms) == 200
+    assert leaf_per_chip_bytes(P(("data", "model"),), 800, ms) == 100
+    assert leaf_per_chip_bytes(P("nope"), 800, ms) == 800
+
+
+def test_injected_reshard_is_caught_as_sh002():
+    """Force GSPMD to insert an all-gather the user program never asked
+    for (elementwise fn, model-sharded input, replicated output) and
+    prove the probe arithmetic classifies it as an implicit reshard."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    mesh = build_mesh(AUDIT_MESH_SHAPE, MESH_AXES)
+
+    def f(x):
+        return x * 2.0
+
+    args = (jax.ShapeDtypeStruct((8, 128), jnp.float32),)
+    compiled = jax.jit(
+        f,
+        in_shardings=NamedSharding(mesh, P(AXIS_MODEL, None)),
+        out_shardings=NamedSharding(mesh, P(None, None)),
+    ).lower(*args).compile()
+    hlo = sc._hlo_collectives(compiled.as_text())
+    user = sc._user_collectives(f, args)
+    assert not user
+    assert hlo.get("all-gather", 0) >= 1
+
+    facts = {"probe.injected[fix]": {
+        "mesh": {"data": 1, "model": 4},
+        "hlo_collectives": hlo,
+        "user_collectives": user,
+        "inserted": hlo,
+        "donated": [],
+    }}
+    findings = check_shard_facts(facts, Manifest(entrypoints=facts))
+    assert any(f.rule == "SH002" and f.key.startswith("all-gather")
+               for f in findings)
+
+
+# ---------------------------------------------- drift rules (fixture pair) ----
+
+
+def test_fixture_baseline_is_clean():
+    """Good case: facts identical to the committed baseline produce
+    zero findings (the replicated cache leaf sits below both SH001
+    floors, the probe inserted nothing, the donation aliases)."""
+    base = _load_facts("sh_baseline_facts.json")
+    manifest = Manifest(entrypoints=base)
+    assert check_shard_facts(base, manifest) == []
+
+
+def test_fixture_regression_fires_every_rule():
+    """Bad case: the regressed fixture (cache grown past the SH001
+    floor and the budget, spec hash drifted, three inserted all-gathers,
+    donation no longer aliasing) demonstrably fails every rule."""
+    base = _load_facts("sh_baseline_facts.json")
+    bad = _load_facts("sh_regressed_facts.json")
+    manifest = Manifest(entrypoints=base)
+    findings = check_shard_facts(bad, manifest)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert set(by_rule) == {"SH001", "SH002", "SH003", "SH004", "SH005"}
+    assert by_rule["SH001"][0].key == "cache"
+    assert by_rule["SH002"][0].key == "all-gatherx3"
+    assert by_rule["SH003"][0].key == "total"
+    assert by_rule["SH004"][0].key == "specs"
+    assert by_rule["SH005"][0].key == "cache"
+
+
+def test_added_and_removed_entries_fire_sh004():
+    base = _load_facts("sh_baseline_facts.json")
+    manifest = Manifest(entrypoints=base)
+    placement_only = {"placement[fix]": base["placement[fix]"]}
+    f1 = check_shard_facts(placement_only, manifest)
+    assert any(f.rule == "SH004" and f.key == "removed"
+               and f.entrypoint == "probe.fix.decode[fix]" for f in f1)
+    grown = dict(base)
+    grown["placement[new]"] = base["placement[fix]"]
+    f2 = check_shard_facts(grown, manifest)
+    assert any(f.rule == "SH004" and f.key == "added"
+               and f.entrypoint == "placement[new]" for f in f2)
+
+
+def test_sh002_acceptance_is_count_keyed():
+    """An accepted reshard entry covers exactly its op x count; a new
+    inserted gather at the same probe re-trips the gate (like PF002)."""
+    bad = _load_facts("sh_regressed_facts.json")
+    manifest = Manifest(entrypoints=bad, accepted=[
+        {"entrypoint": "probe.fix.decode[fix]", "rule": "SH002",
+         "key": "all-gatherx3", "justification": "fallback lowering"},
+        {"entrypoint": "placement[fix]", "rule": "SH001",
+         "key": "cache", "justification": "by design"},
+        {"entrypoint": "placement[fix]", "rule": "SH003",
+         "key": "total", "justification": "tiny rig, fake budget"},
+        {"entrypoint": "probe.fix.decode[fix]", "rule": "SH005",
+         "key": "cache", "justification": "replicated pool copy"},
+    ])
+    assert not manifest.filter(check_shard_facts(bad, manifest))
+    mutated = json.loads(json.dumps(bad))
+    mutated["probe.fix.decode[fix]"]["inserted"]["all-gather"] = 4
+    fresh = manifest.filter(check_shard_facts(mutated, manifest))
+    assert any(f.rule == "SH002" and f.key == "all-gatherx4"
+               for f in fresh)
+
+
+# --------------------------------------------------- update + CLI contract ----
+
+
+def _args(**kw):
+    base = dict(paths=None, fmt="text", select=None, baseline=None,
+                no_baseline=False, update_baseline=False, root=None,
+                project=False, trace=False, wire=False, perf=False,
+                shard=True, manifest=None)
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+@pytest.fixture()
+def fixture_facts(monkeypatch):
+    """Route run_shard at the committed fixture facts so the CLI
+    contract tests don't pay the real multi-second collection."""
+    base = _load_facts("sh_baseline_facts.json")
+    monkeypatch.setattr(sc, "collect_shard_facts", lambda: base)
+    monkeypatch.setattr(sc, "ensure_audit_devices", lambda *a, **k: None)
+    return base
+
+
+def test_update_roundtrip_carries_justifications(tmp_path, fixture_facts):
+    """finding -> exit 1 -> --update accepts (TODO) -> justify ->
+    second --update carries the justification by key -> gate green; the
+    header pins the audit mesh, not tracecheck's trace header."""
+    mpath = tmp_path / "manifest.json"
+    args = _args(manifest=str(mpath))
+    assert run_shard(args, out=io.StringIO()) == 1  # SH004 added x2
+
+    assert run_shard(_args(manifest=str(mpath), update_baseline=True),
+                     out=io.StringIO()) == 0
+    doc = json.loads(mpath.read_text())
+    assert doc["header"]["audit_mesh"] == dict(
+        zip(MESH_AXES, AUDIT_MESH_SHAPE))
+    assert set(doc["entrypoints"]) == set(fixture_facts)
+    assert doc["accepted"] == []  # baseline fixture has no intrinsics
+    assert run_shard(args, out=io.StringIO()) == 0
+
+    # intrinsic findings flow through the justification carry
+    bad = _load_facts("sh_regressed_facts.json")
+    import dynamo_tpu.analysis.shardcheck as mod
+
+    mod.collect_shard_facts, saved = (lambda: bad), mod.collect_shard_facts
+    try:
+        assert run_shard(_args(manifest=str(mpath), update_baseline=True),
+                         out=io.StringIO()) == 0
+        doc = json.loads(mpath.read_text())
+        assert [e["justification"] for e in doc["accepted"]] == \
+            ["TODO: justify"] * 4
+        doc["accepted"][0]["justification"] = "kept: tiny rig"
+        mpath.write_text(json.dumps(doc))
+        assert run_shard(_args(manifest=str(mpath), update_baseline=True),
+                         out=io.StringIO()) == 0
+        doc = json.loads(mpath.read_text())
+        assert "kept: tiny rig" in [
+            e["justification"] for e in doc["accepted"]]
+    finally:
+        mod.collect_shard_facts = saved
+
+
+def test_json_output_stable_sorted(tmp_path, fixture_facts):
+    mpath = tmp_path / "manifest.json"
+    outs = []
+    for _ in range(2):
+        out = io.StringIO()
+        rc = run_shard(_args(manifest=str(mpath), fmt="json"), out=out)
+        assert rc == 1
+        outs.append(out.getvalue())
+    assert outs[0] == outs[1], "shard JSON output must be stable"
+    doc = json.loads(outs[0])
+    keys = [(f["entrypoint"], f["rule"], f["key"]) for f in doc["findings"]]
+    assert keys == sorted(keys)
+    assert doc["total"] == len(doc["findings"]) + doc["accepted"]
+
+
+def test_cli_routes_shard_flag(tmp_path, fixture_facts):
+    """`dynamo-tpu lint --shard` reaches the shard-plane pass through
+    the shared lint CLI (run_lint routing)."""
+    from dynamo_tpu.analysis.cli import run_lint
+
+    out = io.StringIO()
+    rc = run_lint(_args(manifest=str(tmp_path / "m.json")), out=out)
+    assert rc == 1 and "SH00" in out.getvalue()

@@ -93,22 +93,12 @@ def test_trace_gate_zero_nonaccepted_findings(real_facts):
 
 def test_manifest_accepted_entries_justified_and_live(real_facts):
     """Every accepted entry carries a real justification and still
-    matches a current finding (no stale grandfathering)."""
+    matches a current finding (no stale grandfathering) — shared
+    contract in tests/manifest_hygiene.py."""
+    from manifest_hygiene import assert_manifest_hygiene
+
     manifest = Manifest.load(DEFAULT_MANIFEST_PATH)
-    for e in manifest.accepted:
-        assert e.get("justification", "").strip() not in (
-            "", "TODO: justify"), (
-            f"accepted entry {e['entrypoint']}:{e['rule']}[{e['key']}] "
-            "needs a one-line justification"
-        )
-    keys = {f.accept_key for f in check_facts(real_facts, manifest)}
-    stale = [e for e in manifest.accepted
-             if (e["entrypoint"], e["rule"], e["key"]) not in keys]
-    assert not stale, (
-        "accepted entries no longer match any finding (re-snapshot with "
-        "--update-baseline): "
-        + str([(e["entrypoint"], e["rule"], e["key"]) for e in stale])
-    )
+    assert_manifest_hygiene(manifest, check_facts(real_facts, manifest))
 
 
 def test_manifest_header_records_cpu_derivation():

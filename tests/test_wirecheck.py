@@ -81,24 +81,16 @@ def test_wire_gate_is_fast(real):
 
 def test_manifest_accepted_entries_justified_and_live(real):
     """Every accepted entry carries a real justification and still
-    matches a current finding (no stale grandfathering)."""
+    matches a current finding (no stale grandfathering) — shared
+    contract in tests/manifest_hygiene.py (wire keys entries on the
+    message name, not an entrypoint)."""
+    from manifest_hygiene import assert_manifest_hygiene
+
     facts, intrinsic, _ = real
     manifest = WireManifest.load(DEFAULT_WIRE_MANIFEST_PATH)
-    for e in manifest.accepted:
-        assert e.get("justification", "").strip() not in (
-            "", "TODO: justify"), (
-            f"accepted entry {e['message']}:{e['rule']}[{e['key']}] "
-            "needs a one-line justification"
-        )
-    keys = {f.accept_key
-            for f in check_wire(facts, manifest, intrinsic)}
-    stale = [e for e in manifest.accepted
-             if (e["message"], e["rule"], e["key"]) not in keys]
-    assert not stale, (
-        "accepted entries no longer match any finding (re-snapshot "
-        "with --update-baseline): "
-        + str([(e["message"], e["rule"], e["key"]) for e in stale])
-    )
+    assert_manifest_hygiene(
+        manifest, check_wire(facts, manifest, intrinsic),
+        entity_field="message")
 
 
 def test_extraction_covers_the_core_planes(real):
