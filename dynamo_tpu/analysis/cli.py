@@ -54,9 +54,20 @@ def configure_parser(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "SPMD placement census, per-chip memory model, "
                         "implicit-reshard and donation-sharding probes) "
                         "against the committed shard manifest")
+    p.add_argument("--proto", action="store_true",
+                   help="run the protocol-plane pass instead (PR001-PR005: "
+                        "deterministic-schedule model checking + crash-point "
+                        "exploration of the coordinator/queue/drain/persist "
+                        "protocols) against the committed proto manifest")
+    p.add_argument("--replay", default=None, metavar="TOKEN",
+                   help="with --proto: re-execute one recorded "
+                        "interleaving from a dtp1. replay token (as "
+                        "printed by a failing exploration or the nightly "
+                        "sweep) instead of sweeping; exit 1 if it still "
+                        "violates")
     p.add_argument("--all", action="store_true",
-                   help="run all six passes (per-file + project, trace, "
-                        "wire, perf, shard) in one process sharing the "
+                   help="run all seven passes (per-file + project, trace, "
+                        "wire, perf, shard, proto) in one process sharing the "
                         "parse cache; exit 1 if any pass fails")
     p.add_argument("--changed", action="store_true",
                    help="restrict the per-file pass to git-dirty files "
@@ -133,6 +144,13 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
         from dynamo_tpu.analysis.shardcheck import run_shard
 
         return run_shard(args, out)
+    if getattr(args, "proto", False):
+        # protocol-plane pass: its unit is deterministic protocol
+        # scenarios (real coordinator/transport code under a seeded
+        # scheduler) — same manifest contract, its own committed file
+        from dynamo_tpu.analysis.protocheck import run_proto
+
+        return run_proto(args, out)
     paths = [Path(p) for p in (args.paths or [])]
     if args.root:
         root = Path(args.root)
@@ -216,14 +234,14 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
 
 
 def run_all(args: argparse.Namespace, out=None) -> int:
-    """All six passes in one process: per-file + project rules (one
+    """All seven passes in one process: per-file + project rules (one
     ``ast.parse`` per file via ``core.parse_module``'s cache, which the
     wire pass shares), then the compile-plane trace audit, then the
     wire-plane contract check, then the perf-plane roofline check
     (which shares tracecheck's entrypoint registry), then the
-    sharding-plane placement audit.  Exit 1 if any pass has fresh
-    findings; ``--update-baseline`` rewrites all five committed
-    baselines."""
+    sharding-plane placement audit, then the protocol-plane
+    deterministic exploration.  Exit 1 if any pass has fresh findings;
+    ``--update-baseline`` rewrites all six committed baselines."""
     out = out if out is not None else sys.stdout
     # the shard probes need >= 4 devices, and the device count can only
     # be forced BEFORE any pass initializes the jax backend
@@ -231,6 +249,7 @@ def run_all(args: argparse.Namespace, out=None) -> int:
 
     ensure_audit_devices()
     from dynamo_tpu.analysis.perfcheck import run_perf
+    from dynamo_tpu.analysis.protocheck import run_proto
     from dynamo_tpu.analysis.shardcheck import run_shard
     from dynamo_tpu.analysis.tracecheck import run_trace
     from dynamo_tpu.analysis.wirecheck import run_wire
@@ -244,7 +263,8 @@ def run_all(args: argparse.Namespace, out=None) -> int:
     rc_wire = run_wire(sub, out)
     rc_perf = run_perf(sub, out)
     rc_shard = run_shard(sub, out)
-    return max(rc_file, rc_trace, rc_wire, rc_perf, rc_shard)
+    rc_proto = run_proto(sub, out)
+    return max(rc_file, rc_trace, rc_wire, rc_perf, rc_shard, rc_proto)
 
 
 def main(argv: Optional[list[str]] = None) -> int:

@@ -19,11 +19,30 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 from typing import Callable, Optional
 
 log = logging.getLogger("dynamo_tpu.fault")
 
-__all__ = ["FaultInjector"]
+__all__ = ["FaultInjector", "CRASH_OPS"]
+
+# The shared crash-op vocabulary: every fault this injector can produce,
+# named.  The protocol plane (analysis/protocheck.py) drives the same ops
+# against its in-memory deterministic transport, and the fault soak picks
+# from them with a seeded RNG — one fault surface, two harnesses.
+#
+#   kill   — process death: RST every connection, stop listening
+#            (kill_tcp_server / MemNet server teardown)
+#   sever  — cut one peer's transport at an exact outbound frame
+#            (sever_after / MemNet conn sever triggers)
+#   drop   — swallow N outbound frames of one type (drop_frames)
+#   stall  — control-plane brownout: dispatch frozen until release
+#            (stall_coordinator)
+#   crash  — durability-boundary death: SimulatedCrash raised at a WAL
+#            append/fsync/compact or frame-send label (the coordinator's
+#            crash_hook seam; protocol plane only — a real process can't
+#            un-crash, the model checker can)
+CRASH_OPS = ("kill", "sever", "drop", "stall", "crash")
 
 
 def _tcp_server(target):
@@ -32,9 +51,22 @@ def _tcp_server(target):
 
 
 class FaultInjector:
-    def __init__(self) -> None:
+    """``seed=`` makes every choice the injector itself takes (which op,
+    which frame ordinal) deterministic: two injectors built with the same
+    seed produce the same fault sequence, so a soak failure replays."""
+
+    def __init__(self, seed: Optional[int] = None) -> None:
         self._hooked = []  # (server, prior_hook)
         self._stalls = []  # release callables
+        self.seed = seed
+        self.rng = random.Random(seed)
+
+    def choose_op(self, ops: Optional[tuple[str, ...]] = None) -> str:
+        """Seeded pick from the crash-op vocabulary (soak-loop driver)."""
+        pool = [op for op in (ops or CRASH_OPS) if op in CRASH_OPS]
+        if not pool:
+            raise ValueError(f"no valid crash ops in {ops!r}")
+        return self.rng.choice(pool)
 
     # ---------------------------------------------------------- worker death
     async def kill_tcp_server(self, target) -> None:

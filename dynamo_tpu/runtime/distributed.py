@@ -43,8 +43,12 @@ class Instance:
 
 
 class DistributedRuntime:
-    def __init__(self, config: Optional[RuntimeConfig] = None):
+    def __init__(self, config: Optional[RuntimeConfig] = None, *, net=None):
         self.config = config or RuntimeConfig()
+        # connection factory threaded into every transport this runtime
+        # opens (transports/net.py); None = real sockets.  The protocol
+        # plane injects its in-memory deterministic transport here.
+        self._net = net
         self.coordinator: Optional[CoordinatorClient] = None
         self._tcp_server: Optional[EndpointTcpServer] = None
         self.primary_lease: Optional[int] = None
@@ -58,12 +62,13 @@ class DistributedRuntime:
         self._on_shutdown: list[Callable[[], Any]] = []
 
     @classmethod
-    async def connect(cls, config: Optional[RuntimeConfig] = None) -> "DistributedRuntime":
-        rt = cls(config)
+    async def connect(cls, config: Optional[RuntimeConfig] = None, *,
+                      net=None) -> "DistributedRuntime":
+        rt = cls(config, net=net)
         # reconnect=True: a coordinator restart re-registers this runtime's
         # leases, discovery keys, watches and subs automatically
         rt.coordinator = await CoordinatorClient(
-            rt.config.coordinator_url, reconnect=True
+            rt.config.coordinator_url, reconnect=True, net=net
         ).connect()
         rt.primary_lease = await rt.coordinator.lease_create(rt.config.lease_ttl_s)
         return rt
@@ -106,7 +111,7 @@ class DistributedRuntime:
         distributed.rs)."""
         if self._tcp_server is None:
             self._tcp_server = await EndpointTcpServer(
-                host=self.config.host, port=self.config.port
+                host=self.config.host, port=self.config.port, net=self._net
             ).start()
         return self._tcp_server
 
@@ -368,7 +373,8 @@ class Client(AsyncEngine):
             raise KeyError(f"instance {instance_id:x} of {self.endpoint.url} not found")
         conn = self._conns.get(instance_id)
         if conn is None:
-            conn = EndpointTcpClient(inst.host, inst.port, inst.subject)
+            conn = EndpointTcpClient(inst.host, inst.port, inst.subject,
+                                     net=self.endpoint.runtime._net)
             self._conns[instance_id] = conn
         return conn
 

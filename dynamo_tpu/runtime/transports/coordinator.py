@@ -44,6 +44,7 @@ from dynamo_tpu.runtime.transports.framing import (
     read_frame,
     write_frame,
 )
+from dynamo_tpu.runtime.transports.net import DEFAULT_NET
 
 log = logging.getLogger("dynamo_tpu.coordinator")
 
@@ -96,10 +97,18 @@ class CoordinatorServer:
     re-register through the reconnecting client."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 data_dir: Optional[str] = None):
+                 data_dir: Optional[str] = None, *, net=None):
         self.host = host
         self.port = port
         self._data_dir = Path(data_dir) if data_dir else None
+        self._net = net if net is not None else DEFAULT_NET
+        # protocol-plane seam: when set, called with a crash-point label
+        # ("wal.append.kv", "wal.fsync.qpush", "frame.send.watch_event",
+        # ...) at every durability and send boundary.  The checker's hook
+        # raises SimulatedCrash at a chosen (label, occurrence) to model a
+        # process death there; production leaves it None — one attribute
+        # test per boundary, nothing else.
+        self.crash_hook: Optional[Callable[[str], None]] = None
         self._wal = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._kv: dict[str, Any] = {}
@@ -163,6 +172,8 @@ class CoordinatorServer:
             return
         self._wal.write(json.dumps(rec, separators=(",", ":")) + "\n")
         self._wal.flush()
+        if self.crash_hook is not None:
+            self.crash_hook(f"wal.append.{rec.get('t')}")
 
     async def _log_durable(self, rec: dict) -> None:
         """Log + fsync for records whose reply promises durability (queue
@@ -174,6 +185,8 @@ class CoordinatorServer:
         self._log(rec)
         fd = self._wal.fileno()
         await asyncio.get_running_loop().run_in_executor(None, os.fsync, fd)
+        if self.crash_hook is not None:
+            self.crash_hook(f"wal.fsync.{rec.get('t')}")
 
     def _recover(self) -> None:
         """Replay the WAL, then rewrite it compacted (current state only)."""
@@ -222,6 +235,8 @@ class CoordinatorServer:
                 self._queues[q].append(_QueueItem(mid, payload, {"queue": q}))
         self._ids = itertools.count(max(max_id + 1, self._id_epoch()))
         # compact: snapshot current state, drop the acked/deleted history
+        if self.crash_hook is not None:
+            self.crash_hook("wal.compact.write")
         tmp = path.with_suffix(".tmp")
         with tmp.open("w") as f:
             # version tag first (wirecheck WR004): an old server replaying
@@ -245,6 +260,8 @@ class CoordinatorServer:
             # replaces — flush+fsync file, then fsync the dir after rename
             f.flush()
             os.fsync(f.fileno())
+        if self.crash_hook is not None:
+            self.crash_hook("wal.compact.rename")
         tmp.replace(path)
         # GC blob-dir litter: temp files from crashed uploads, and payload
         # files no surviving index record references
@@ -263,14 +280,16 @@ class CoordinatorServer:
             os.fsync(dir_fd)
         finally:
             os.close(dir_fd)
+        if self.crash_hook is not None:
+            self.crash_hook("wal.compact.done")
         self._wal = path.open("a")
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> "CoordinatorServer":
         if self._data_dir is not None:
             self._recover()
-        self._server = await asyncio.start_server(self._handle, self.host, self.port)
-        self.port = self._server.sockets[0].getsockname()[1]
+        self._server, self.port = await self._net.start_server(
+            self._handle, self.host, self.port)
         self._expiry_task = asyncio.ensure_future(self._expiry_loop())
         log.info("coordinator listening on %s:%s", self.host, self.port)
         return self
@@ -374,6 +393,9 @@ class CoordinatorServer:
         lock = self._write_locks.get(conn_id)
         if lock is None:
             return
+        if self.crash_hook is not None:
+            self.crash_hook(
+                f"frame.send.{header.get('op') or 'reply'}")
         async with lock:
             try:
                 write_frame(writer, header, payload)
@@ -504,11 +526,20 @@ class CoordinatorServer:
                 item = await self._queue_take(queue, timeout)
                 if item is None:
                     await self._send(conn_id, writer, {"id": rid, "ok": False, "empty": True})
-                else:
-                    item.header["conn_id"] = conn_id
-                    self._pending_acks[(queue, item.msg_id)] = item
-                    await self._send(conn_id, writer,
-                                     {"id": rid, "ok": True, "msg_id": item.msg_id}, item.payload)
+                    return
+                if conn_id not in self._write_locks:
+                    # the puller's connection died while we waited: its
+                    # cleanup sweep (the _handle finally) has already run,
+                    # so registering into _pending_acks now would strand
+                    # the item forever — no conn-drop pass will ever
+                    # redeliver it.  Found by the protocol plane's
+                    # queue-sever exploration (no_lost_messages).
+                    self._queue_deliver(queue, item)
+                    return
+                item.header["conn_id"] = conn_id
+                self._pending_acks[(queue, item.msg_id)] = item
+                await self._send(conn_id, writer,
+                                 {"id": rid, "ok": True, "msg_id": item.msg_id}, item.payload)
 
             self._spawn(_pull())
 
@@ -749,12 +780,13 @@ class CoordinatorClient:
     caller code.  In-flight calls at the moment of disconnect still raise
     ConnectionError; callers retry (the workers' pull loops already do)."""
 
-    def __init__(self, url: str, reconnect: bool = False):
+    def __init__(self, url: str, reconnect: bool = False, *, net=None):
         # url: tcp://host:port
         hostport = url.split("//", 1)[-1]
         host, port = hostport.rsplit(":", 1)
         self.host, self.port = host, int(port)
         self.reconnect = reconnect
+        self._net = net if net is not None else DEFAULT_NET
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._ids = itertools.count(1)
@@ -790,7 +822,7 @@ class CoordinatorClient:
         self._epoch = 0  # bumped on every disconnect; guards stale writes
 
     async def connect(self) -> "CoordinatorClient":
-        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._reader, self._writer = await self._net.open_connection(self.host, self.port)
         self._connected.set()
         self._ready.set()
         self._read_task = asyncio.ensure_future(self._read_loop())
@@ -876,7 +908,7 @@ class CoordinatorClient:
                                   exc_info=True)
                     self._writer = None
                 try:
-                    self._reader, self._writer = await asyncio.open_connection(
+                    self._reader, self._writer = await self._net.open_connection(
                         self.host, self.port
                     )
                 except OSError:

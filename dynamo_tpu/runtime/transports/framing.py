@@ -21,7 +21,8 @@ _HDR = struct.Struct(">II")
 
 MAX_FRAME = 1 << 30  # 1 GiB guard
 
-__all__ = ["write_frame", "read_frame", "close_writer", "FrameError"]
+__all__ = ["write_frame", "read_frame", "close_writer", "decode_frames",
+           "FrameError"]
 
 
 class FrameError(Exception):
@@ -54,6 +55,34 @@ def encode_frame(header: dict[str, Any], payload: bytes = b"") -> bytes:
 
 def write_frame(writer: asyncio.StreamWriter, header: dict[str, Any], payload: bytes = b"") -> None:
     writer.write(encode_frame(header, payload))
+
+
+def decode_frames(data: bytes) -> list[tuple[dict, bytes]]:
+    """Decode a captured byte stream into its complete frames.
+
+    Offline twin of ``read_frame`` for recorded transcripts (the
+    protocol plane's channel recorder, wire-fixture tests).  A trailing
+    partial frame — a transcript cut mid-frame by a sever or crash — is
+    ignored rather than an error; a malformed complete frame still
+    raises ``FrameError``.
+    """
+    frames: list[tuple[dict, bytes]] = []
+    off = 0
+    while off + _HDR.size <= len(data):
+        hlen, plen = _HDR.unpack_from(data, off)
+        if hlen > MAX_FRAME or plen > MAX_FRAME:
+            raise FrameError(f"oversized frame: header={hlen} payload={plen}")
+        end = off + _HDR.size + hlen + plen
+        if end > len(data):
+            break  # torn tail
+        hdr = data[off + _HDR.size:off + _HDR.size + hlen]
+        try:
+            header = json.loads(hdr)
+        except json.JSONDecodeError as e:
+            raise FrameError(f"bad frame header: {e}") from e
+        frames.append((header, data[end - plen:end] if plen else b""))
+        off = end
+    return frames
 
 
 async def read_frame(reader: asyncio.StreamReader) -> Optional[tuple[dict, bytes]]:

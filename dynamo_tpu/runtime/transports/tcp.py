@@ -37,6 +37,7 @@ from dynamo_tpu.runtime.transports.framing import (
     read_frame,
     write_frame,
 )
+from dynamo_tpu.runtime.transports.net import DEFAULT_NET
 
 log = logging.getLogger("dynamo_tpu.tcp")
 
@@ -75,9 +76,10 @@ class EndpointTcpServer:
     """Serves registered AsyncEngines over TCP; one server per process,
     engines keyed by endpoint name (subject)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *, net=None):
         self.host = host
         self.port = port
+        self._net = net if net is not None else DEFAULT_NET
         self._engines: dict[str, AsyncEngine] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set[asyncio.StreamWriter] = set()
@@ -117,22 +119,32 @@ class EndpointTcpServer:
     async def wait_idle(self, subject: str, timeout: float = 30.0) -> bool:
         """Block until no request for ``subject`` is in flight (True), or
         the timeout lapses with streams still live (False)."""
-        ev = self._idle_events.setdefault(subject, asyncio.Event())
-        ev.clear()
-        # re-check after registering (no await in between): the last
-        # stream may have finished before the event existed to be set
-        if self._inflight.get(subject, 0) <= 0:
-            return True
-        try:
-            await asyncio.wait_for(ev.wait(), timeout)
-            return True
-        except asyncio.TimeoutError:
-            return self._inflight.get(subject, 0) <= 0
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while True:
+            ev = self._idle_events.setdefault(subject, asyncio.Event())
+            ev.clear()
+            # re-check after registering (no await in between): the last
+            # stream may have finished before the event existed to be set
+            if self._inflight.get(subject, 0) <= 0:
+                return True
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                return False
+            try:
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                return self._inflight.get(subject, 0) <= 0
+            # set() resolved our wait, but a request admitted between the
+            # set and this wakeup may have re-cleared the event — loop and
+            # re-read the live count instead of trusting the stale wake
+            # (drain returning True with a live stream; found by the
+            # protocol plane's drain exploration, drain_zero_inflight)
 
     async def start(self) -> "EndpointTcpServer":
         if self._server is None:
-            self._server = await asyncio.start_server(self._handle, self.host, self.port)
-            self.port = self._server.sockets[0].getsockname()[1]
+            self._server, self.port = await self._net.start_server(
+                self._handle, self.host, self.port)
         return self
 
     async def stop(self) -> None:
@@ -283,10 +295,11 @@ class EndpointTcpServer:
 class EndpointTcpClient(AsyncEngine):
     """Client-side AsyncEngine proxy for one remote endpoint."""
 
-    def __init__(self, host: str, port: int, subject: str):
+    def __init__(self, host: str, port: int, subject: str, *, net=None):
         self.host = host
         self.port = port
         self.subject = subject
+        self._net = net if net is not None else DEFAULT_NET
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._ids = itertools.count(1)
@@ -323,7 +336,7 @@ class EndpointTcpClient(AsyncEngine):
                     self._reader = self._writer = None
                 try:
                     self._reader, self._writer = await asyncio.wait_for(
-                        asyncio.open_connection(self.host, self.port),
+                        self._net.open_connection(self.host, self.port),
                         _DIAL_TIMEOUT_S,
                     )
                 except asyncio.TimeoutError:

@@ -1,6 +1,6 @@
 #!/bin/sh
 # Convenience wrapper for the static-analysis suite (docs/static_analysis.md).
-# One process, ALL SIX passes (dynamo-tpu lint --all), sharing one
+# One process, ALL SEVEN passes (dynamo-tpu lint --all), sharing one
 # ast.parse per file across the per-file, project and wire passes:
 #   1+2. per-file rules (DT001-DT104) + interprocedural project pass
 #        (DT005-DT009)
@@ -13,19 +13,25 @@
 #   6.   sharding-plane placement audit (SH001-SH005) against the
 #        committed analysis/shard_manifest.json (forces 4 virtual CPU
 #        devices before the jax backend initializes)
+#   7.   protocol-plane exploration (PR001-PR005) against the committed
+#        analysis/proto_manifest.json (deterministic scheduler + crash
+#        points over the real control-plane code; DTPROTO_BUDGET=1 in
+#        the gate, crank it for deeper sweeps)
 #   scripts/lint.sh                      # lint dynamo_tpu/, human output
 #   scripts/lint.sh --format json        # stable JSON (one doc per pass)
 #   scripts/lint.sh --changed            # pre-commit mode: per-file rules
 #                                        # on git-dirty files only; the
 #                                        # project/trace/wire/perf/shard
-#                                        # passes stay whole-program
+#                                        # passes stay whole-program and
+#                                        # proto re-explores only the
+#                                        # affected scenarios
 #   scripts/lint.sh --update-baseline    # rebuild analysis/baseline.json
-#                                        # AND all four manifests
+#                                        # AND all five manifests
 #                                        # (justifications carried by key)
 #   scripts/lint.sh --select DT005       # one rule (project codes route
 #                                        # to the project registry; the
-#                                        # trace/wire/perf/shard passes
-#                                        # ignore it)
+#                                        # trace/wire/perf/shard/proto
+#                                        # passes ignore it)
 # Exit code 1 on any non-baselined finding from any pass.
 cd "$(dirname "$0")/.." || exit 2
 exec python -m dynamo_tpu lint --all "$@"
