@@ -26,6 +26,8 @@ DYNAMO_BENCH_PREFILL_CHUNK, DYNAMO_BENCH_PREFILL_BUDGET,
 DYNAMO_BENCH_UNIFIED (1 = unified mixed prefill+decode dispatch),
 DYNAMO_BENCH_PERSIST (1 = persistent prefix-cache tier cold-vs-warm
 restart TTFT phase; DYNAMO_BENCH_PERSIST_MODEL / _ISL size it),
+DYNAMO_BENCH_STREAM (1 = streamed-vs-blocking disagg handoff TTFT
+phase; DYNAMO_BENCH_STREAM_MODEL / _ISL size it),
 DYNAMO_BENCH_TTFT_ISL,
 DYNAMO_BENCH_TTFT_BATCH (north-star TTFT phase batch, default 8),
 DYNAMO_BENCH_QUANT (int8|none, weights),
@@ -903,6 +905,128 @@ def _persist_phase(on_accel: bool, block_size: int):
     }
 
 
+def _stream_phase(on_accel: bool, block_size: int):
+    """Streamed-vs-blocking disagg handoff TTFT: one decode worker + one
+    prefill worker in process (coordinator queue, forced-TCP transfer
+    wire), same seeded long prompt, KV handoff first blocking
+    (whole-cache push after prefill) then layer-wise streamed
+    (DYN_KV_STREAM path, llm/kv/stream.py).  Banked for the TPU tunnel's
+    return, per the ROADMAP standing note: on CPU the row establishes
+    plumbing + token parity, not a perf claim."""
+    import asyncio
+    import gc
+
+    import jax
+
+    from dynamo_tpu.engine import AsyncLLMEngine, EngineConfig, EngineCore
+    from dynamo_tpu.engine.counters import kv_stream_counters
+    from dynamo_tpu.llm.disagg_router import (
+        DisaggregatedRouter,
+        DisaggRouterConf,
+    )
+    from dynamo_tpu.llm.protocols import (
+        BackendInput,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.llm.workers import DecodeWorker, PrefillWorker
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.models.llama import LlamaModel
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.runtime.transports.coordinator import (
+        CoordinatorClient,
+        CoordinatorServer,
+    )
+
+    name = os.environ.get("DYNAMO_BENCH_STREAM_MODEL",
+                          "1b" if on_accel else "tiny")
+    mcfg = MODELS[name]
+    isl = int(os.environ.get("DYNAMO_BENCH_STREAM_ISL",
+                             "3000" if on_accel else "48"))
+    cfg = ModelConfig(**mcfg, dtype="bfloat16" if on_accel else "float32")
+    model = LlamaModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(13))
+    jax.block_until_ready(params)
+    # >=4 prefill chunks so >=3 chunks' layer frames can hide under the
+    # remaining compute; a single-chunk prefill degenerates to blocking
+    chunk = max(block_size, (isl // 4) // block_size * block_size)
+    max_len = (isl // block_size + 2) * block_size
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(1, cfg.vocab_size - 1, size=isl).tolist()
+    warm = rng.integers(1, cfg.vocab_size - 1, size=isl).tolist()
+
+    def build():
+        ecfg = EngineConfig(
+            max_batch_size=2, max_model_len=max_len, block_size=block_size,
+            num_blocks=4 * (max_len // block_size),
+            prefill_chunk_tokens=chunk,
+        )
+        return AsyncLLMEngine(
+            EngineCore(model, params, ecfg, eos_token_ids=[])).start()
+
+    async def ttft(stream: bool):
+        srv = await CoordinatorServer(port=0).start()
+        dec_e, pre_e = build(), build()
+        try:
+            c_dec = await CoordinatorClient(srv.url).connect()
+            c_pre = await CoordinatorClient(srv.url).connect()
+            worker = DecodeWorker(
+                dec_e, coordinator=c_dec, namespace="bench",
+                router=DisaggregatedRouter(
+                    DisaggRouterConf(max_local_prefill_length=0),
+                    namespace="bench"))
+            await worker.start()
+            prefill = PrefillWorker(pre_e, c_pre, "bench", stream=stream)
+            task = asyncio.ensure_future(prefill.run())
+            first, got = None, []
+            # warmup compiles both engines' executables; the second
+            # (measured) prompt sees steady-state handoff
+            for toks_in in (warm, prompt):
+                first, got = None, []
+                ctx = Context(BackendInput(
+                    token_ids=list(toks_in),
+                    sampling=SamplingOptions(temperature=0.0),
+                    stops=StopConditions(max_tokens=4, ignore_eos=True)))
+                t0 = time.perf_counter()
+                async for out in worker.generate(ctx):
+                    if out.token_ids and first is None:
+                        first = time.perf_counter() - t0
+                    got.extend(out.token_ids)
+                    if out.finished:
+                        break
+            prefill.request_stop()
+            await task
+            await worker.stop()
+            await c_dec.close()
+            await c_pre.close()
+            return (first or 0.0) * 1000, got
+        finally:
+            dec_e.shutdown()
+            pre_e.shutdown()
+            await srv.stop()
+
+    os.environ["DYN_KV_TRANSFER_FORCE_TCP"] = "1"  # real wire, not ICI
+    try:
+        kv_stream_counters.reset()
+        blocking_ms, blocking_toks = asyncio.run(ttft(stream=False))
+        streamed_ms, streamed_toks = asyncio.run(ttft(stream=True))
+    finally:
+        os.environ.pop("DYN_KV_TRANSFER_FORCE_TCP", None)
+        gc.collect()
+    return {
+        "model": name, "isl": isl, "block_size": block_size,
+        "prefill_chunk_tokens": chunk,
+        "ttft_blocking_ms": round(blocking_ms, 2),
+        "ttft_streamed_ms": round(streamed_ms, 2),
+        "blocking_over_streamed": (round(blocking_ms / streamed_ms, 2)
+                                   if streamed_ms else None),
+        "token_parity": blocking_toks == streamed_toks,
+        "stream_layers_sent": kv_stream_counters.layers_sent_total,
+        "stream_overlap_ratio": round(kv_stream_counters.overlap_ratio, 4),
+        "stream_fallbacks": kv_stream_counters.fallbacks_total,
+    }
+
+
 def main() -> None:
     cpu_mode = os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
     if cpu_mode:
@@ -1294,6 +1418,26 @@ def main() -> None:
         if persist:
             print(f"# persist: {json.dumps(persist)}", file=sys.stderr)
             res["persist"] = persist
+            _emit(res)
+
+    # streamed-vs-blocking disagg handoff TTFT (opt-in: four extra
+    # engine lifecycles + an in-process disagg pair).  Failure can't
+    # lose the round — the primary numbers are already banked.
+    if os.environ.get("DYNAMO_BENCH_STREAM", "0") == "1":
+        import gc
+
+        engine = model = params = None
+        gc.collect()
+        try:
+            stream = _stream_phase(on_accel, block_size)
+        except Exception:
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            stream = None
+        if stream:
+            print(f"# kv_stream: {json.dumps(stream)}", file=sys.stderr)
+            res["kv_stream"] = stream
             _emit(res)
     run_cancel()
 

@@ -126,6 +126,11 @@ async def prefill_dispatch_stats(url):
             vals["transfer_mbps_dcn"] = max(
                 vals.get("transfer_mbps_dcn", 0.0),
                 float(line.rsplit(" ", 1)[-1]))
+        # streamed KV handoff counters (layer-wise disagg push)
+        for key in ("sessions_total", "layers_sent_total", "bytes_total",
+                    "fallbacks_total", "overlap_ratio"):
+            if line.startswith(f"dynamo_tpu_kv_stream_{key} "):
+                vals[f"stream_{key}"] = float(line.rsplit(" ", 1)[-1])
     dispatches = vals.get("prefill_dispatches_total", 0)
     if not dispatches:
         return None
@@ -173,6 +178,19 @@ async def prefill_dispatch_stats(url):
         out["host_gap_ms_per_turn"] = round(vals["host_gap_ms_per_turn"], 3)
     if "transfer_mbps_dcn" in vals:
         out["transfer_mbps_dcn"] = round(vals["transfer_mbps_dcn"], 2)
+    if vals.get("stream_sessions_total", 0):
+        # layer-wise streamed handoff engaged (DYN_KV_STREAM=1): frames
+        # shipped under compute and the measured overlap win
+        out.update({
+            "kv_stream_sessions": int(vals["stream_sessions_total"]),
+            "kv_stream_layers_sent": int(
+                vals.get("stream_layers_sent_total", 0)),
+            "kv_stream_bytes": int(vals.get("stream_bytes_total", 0)),
+            "kv_stream_fallbacks": int(
+                vals.get("stream_fallbacks_total", 0)),
+            "kv_stream_overlap_ratio": round(
+                vals.get("stream_overlap_ratio", 0.0), 4),
+        })
     return out
 
 

@@ -613,6 +613,10 @@ _BUG_IMPLS: dict[str, dict[str, Any]] = {
     "no-synth-deletes": {"client": _NoSynthDeleteClient},
     "racy-drain": {"tcp_server": _RacyDrainTcpServer},
     "eager-known": {"replicator": _make_eager_known_replicator},
+    # kv.stream producer bug: notify the decode worker as soon as the
+    # session opens, before a single layer frame lands — the exact
+    # notify-races-KV hazard the stream_end ordering contract forbids
+    "notify-early": {"stream_notify_early": True},
 }
 
 
@@ -1065,6 +1069,122 @@ async def _run_kv_persist(h: Harness) -> None:
     await h.teardown()
 
 
+async def _run_kv_stream(h: Harness) -> None:
+    """Streamed layer-wise KV handoff (llm/kv/stream.py) under the sever
+    matrix: a two-chunk/two-layer session on conn 1, with the prefill
+    worker's fallback ladder (reconnect + whole-cache push + notify) on
+    any stream failure, plus a deliberately torn completion every run.
+    Invariant: the decode side either applies a sha-verified COMPLETE
+    cache or nothing — and notify never precedes the applied KV."""
+    try:
+        import numpy as np
+    except ImportError:   # pragma: no cover - numpy is baked into the image
+        h.notes["skipped"] = "numpy unavailable"
+        return
+    from dynamo_tpu.llm.kv.stream import KvStreamSession
+    from dynamo_tpu.llm.kv.transfer import KvTransferClient, KvTransferServer
+
+    ops: list[tuple] = []
+
+    async def sink(ids, arr, rid) -> None:
+        ops.append(("apply", rid, [int(b) for b in ids],
+                    np.asarray(arr).copy()))
+
+    async def notify(rid, first_token, error) -> None:
+        ops.append(("notify", rid, int(first_token), error))
+
+    srv = KvTransferServer(write_sink=sink, notify_cb=notify,
+                           host="mem", net=h.net)
+    await srv.start()
+    h.net.name_port(srv.port, "kvxfer")
+    url = f"tcp://mem:{srv.port}"
+
+    rng = np.random.default_rng(7)
+    chunks = [rng.standard_normal((2, 2, 3)).astype(np.float32)
+              for _ in range(2)]           # 2 chunks of [L=2, n=2, 3]
+    full = np.concatenate(chunks, axis=1)  # [L=2, n=4, 3]
+    spans = [[0, 1], [2, 3]]
+
+    async def close_quiet(cli) -> None:
+        try:
+            await asyncio.wait_for(cli.close(), 10.0)
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+
+    notify_early = h.pick("stream_notify_early", False)
+
+    async def streamed() -> bool:
+        cli = await KvTransferClient.connect(url, net=h.net,
+                                             force_tcp=True)
+        try:
+            sess = KvStreamSession(cli, "r1", num_layers=2,
+                                   session_id="s-r1")
+            await sess.begin()
+            if notify_early:
+                await cli.notify("r1", 7)
+            for ids, arr in zip(spans, chunks):
+                await sess.write_chunk(ids, arr, compute_live=True)
+            await sess.end()
+            if not notify_early:
+                await cli.notify("r1", 7)
+            return True
+        except (ConnectionError, RuntimeError, OSError,
+                asyncio.TimeoutError):
+            return False
+        finally:
+            await close_quiet(cli)
+
+    if not await streamed():
+        # fallback ladder, exactly as llm/workers.py runs it: fresh
+        # connection (the severed one is dead), whole-cache push, notify
+        cli = await KvTransferClient.connect(url, net=h.net,
+                                             force_tcp=True)
+        try:
+            await cli.write_blocks([0, 1, 2, 3], full, request_id="r1")
+            await cli.notify("r1", 7)
+        finally:
+            await close_quiet(cli)
+
+    # deliberately torn completion, every run: right frames, wrong sha —
+    # the END must be rejected and NOTHING applied for r2
+    torn_rejected = False
+    cli2 = await KvTransferClient.connect(url, net=h.net, force_tcp=True)
+    try:
+        sess2 = KvStreamSession(cli2, "r2", num_layers=2,
+                                session_id="s-r2")
+        await sess2.begin()
+        await sess2.write_chunk(spans[0], chunks[0], compute_live=False)
+        try:
+            await cli2.stream_end(
+                {"session": "s-r2", "frames": 2, "sha": "0" * 64})
+        except RuntimeError:
+            torn_rejected = True
+    finally:
+        await close_quiet(cli2)
+
+    applies = [o for o in ops if o[0] == "apply" and o[1] == "r1"]
+    h.check("stream_no_partial_admit",
+            all(o[2] == [0, 1, 2, 3] and np.array_equal(o[3], full)
+                for o in applies),
+            "decode admitted partial or wrong KV")
+    h.check("stream_delivered", len(applies) >= 1,
+            "no complete cache ever applied (stream AND fallback lost)")
+    first_apply = next((i for i, o in enumerate(ops)
+                        if o[0] == "apply" and o[1] == "r1"), None)
+    notifies = [i for i, o in enumerate(ops)
+                if o[0] == "notify" and o[1] == "r1"]
+    h.check("stream_notify_ordered",
+            bool(notifies) and first_apply is not None
+            and first_apply < notifies[0],
+            "notify raced ahead of the applied KV")
+    h.check("stream_torn_is_miss",
+            torn_rejected and not any(
+                o[0] == "apply" and o[1] == "r2" for o in ops),
+            "torn completion frame was admitted")
+    await srv.stop()
+    await h.teardown()
+
+
 # ----------------------------------------------------------- crash matrices
 
 
@@ -1103,6 +1223,23 @@ def _queue_plans(base: "RunResult", budget: int) -> list[CrashPlan]:
         cap = min(frames, 3 * budget)
         plans.extend(
             CrashPlan(kind="sever", conn=2, after_frames=k + 1,
+                      direction=direction)
+            for k in range(cap))
+    return plans
+
+
+def _stream_plans(base: "RunResult", budget: int) -> list[CrashPlan]:
+    # sever the streaming connection (conn 1: the producer dials first)
+    # at every complete frame, both directions — each c2s cut lands at a
+    # different layer-frame boundary of the session, each s2c cut drops
+    # a different ack/reply, so the matrix covers "torn at layer k" for
+    # every k plus "END applied but ack lost"
+    plans: list[CrashPlan] = []
+    for direction in ("s2c", "c2s"):
+        frames = base.frame_counts.get(f"kvxfer/1/{direction}", 0)
+        cap = min(frames, 6 * budget)
+        plans.extend(
+            CrashPlan(kind="sever", conn=1, after_frames=k + 1,
                       direction=direction)
             for k in range(cap))
     return plans
@@ -1194,6 +1331,17 @@ SCENARIOS: dict[str, Scenario] = {
                         "persist_no_duplicate_blocks",
                         "persist_sha_verified"),
             touches=("llm/kv/persist", "runtime/transports/coordinator"),
+        ),
+        Scenario(
+            name="kv.stream",
+            run=_run_kv_stream,
+            plans=_stream_plans,
+            seeds=3,
+            invariants=("stream_no_partial_admit", "stream_delivered",
+                        "stream_torn_is_miss", "stream_notify_ordered"),
+            touches=("llm/kv/stream", "llm/kv/transfer",
+                     "runtime/transports/framing",
+                     "runtime/transports/protocol"),
         ),
     ]
 }

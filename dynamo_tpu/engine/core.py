@@ -494,6 +494,12 @@ class EngineCore:
         # prefill-side held blocks: finished remote-decode prefills whose
         # blocks must survive until the transfer out completes
         self._held: dict[str, list[int]] = {}
+        # streamed-handoff commit hooks (llm/kv/stream.py): per request,
+        # fn(committed_block_ids, done) fired on the engine thread at each
+        # chunk boundary (jitted scan bodies preclude per-layer callbacks —
+        # chunk granularity is the documented fallback, docs/kv_streaming.md)
+        # and once more with done=True when the prefill completes
+        self._commit_hooks: dict[str, Callable[[list[int], bool], None]] = {}
         # perf counters
         self.steps = 0
         self.prefill_steps = 0
@@ -1472,6 +1478,7 @@ class EngineCore:
                 blk.parent_sequence_hash, list(blk.tokens),
             )
         req.committed_upto = done * bs
+        self._fire_commit_hook(req, done=False)
 
     def _run_prefill_batch(self, reqs: list[EngineRequest]) -> None:
         """Token-budget ragged prefill: pack up to ``prefill_token_budget``
@@ -1636,6 +1643,10 @@ class EngineCore:
             # blocks for transfer-out, free the slot (ref prefill_worker.py:148
             # runs generate(max_tokens=1, is_remote_decode=True))
             self._held[req.request_id] = list(req.block_ids)
+            # done=True covers ALL blocks, including the partial tail
+            # block _commit_prefill_blocks never reaches (it commits only
+            # FULL blocks) — the streamed handoff's final chunk rides here
+            self._fire_commit_hook(req, done=True)
             self.slots[req.slot] = None
             self._by_id.pop(req.request_id, None)
             req.state = RequestState.FINISHED
@@ -2411,6 +2422,36 @@ class EngineCore:
         if ids:
             self.block_manager.release(ids)
 
+    # --------------------------------------- streamed-handoff commit hooks
+    def register_commit_hook(
+        self, request_id: str, fn: Callable[[list[int], bool], None]
+    ) -> None:
+        """Streamed handoff (llm/kv/stream.py): call ``fn(block_ids,
+        done)`` on the engine thread after each prefill chunk commits —
+        ``block_ids`` is the CUMULATIVE list of this request's committed
+        local block ids, ``done=True`` on the final call (which includes
+        the partial tail block).  Per-layer callbacks are impossible
+        under the jitted scan body, so chunk-boundary granularity is the
+        documented fallback (docs/kv_streaming.md).  The hook is
+        auto-unregistered after the ``done`` call."""
+        self._commit_hooks[request_id] = fn
+
+    def unregister_commit_hook(self, request_id: str) -> None:
+        self._commit_hooks.pop(request_id, None)
+
+    def _fire_commit_hook(self, req: EngineRequest, done: bool) -> None:
+        fn = self._commit_hooks.get(req.request_id)
+        if fn is None:
+            return
+        bs = self.config.block_size
+        n = len(req.block_ids) if done else req.committed_upto // bs
+        try:
+            fn([int(b) for b in req.block_ids[:n]], done)
+        except Exception:
+            log.exception("commit hook failed for %s", req.request_id)
+        if done:
+            self._commit_hooks.pop(req.request_id, None)
+
     # ------------------------------------------------------ host offload tier
     @staticmethod
     def _persist_generation(model, cache_dtype) -> str:
@@ -2746,3 +2787,25 @@ class EngineCore:
         return len(
             self.block_manager.match_prefix(seq_hashes, prompt_len)
         ) * self.config.block_size
+
+    def persist_hit_blocks(self, seq_hashes: list[int]) -> int:
+        """How many prompt blocks the persist tier could restore locally —
+        the transfer-aware router's stream-vs-restore cost input.  0 when
+        no persist tier is configured.  Same staleness caveat as
+        :meth:`prefix_hit_tokens`: a heuristic input, not a guarantee."""
+        if self.persist_store is None or not seq_hashes:
+            return 0
+        try:
+            return len(self.persist_store.match_prefix(list(seq_hashes)))
+        except Exception:  # pragma: no cover - probe must never raise
+            return 0
+
+    def kv_bytes_per_block(self) -> int:
+        """Host-staged wire bytes one KV block occupies (all layers, both
+        K and V, all parts of a quantized pair) — the router's
+        transfer-cost size input.  Derived from the live cache pytree so
+        quantization/dtype changes are automatically reflected."""
+        leaves = jax.tree.leaves(self.cache)
+        # cache leaves are [L, n_blocks, ...]: bytes per block = leaf
+        # bytes / n_blocks, summed over parts
+        return sum(int(l.nbytes) // max(1, int(l.shape[1])) for l in leaves)

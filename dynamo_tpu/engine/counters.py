@@ -22,7 +22,8 @@ full the offered axis budget ran.
 
 from __future__ import annotations
 
-__all__ = ["PrefillCounters", "counters", "PersistCounters", "persist_counters"]
+__all__ = ["PrefillCounters", "counters", "PersistCounters", "persist_counters",
+           "KvStreamCounters", "kv_stream_counters"]
 
 
 class PrefillCounters:
@@ -134,3 +135,58 @@ class PersistCounters:
 
 
 persist_counters = PersistCounters()
+
+
+class KvStreamCounters:
+    """Streamed KV handoff (llm/kv/stream.py) counters.
+
+        dynamo_tpu_kv_stream_sessions_total     counter (STREAM_BEGINs sent)
+        dynamo_tpu_kv_stream_layers_sent_total  counter (WRITE_LAYER frames)
+        dynamo_tpu_kv_stream_bytes_total        counter (layer payload bytes)
+        dynamo_tpu_kv_stream_fallbacks_total    counter (sessions that fell
+                                                back to the whole-cache push)
+        dynamo_tpu_kv_stream_overlap_ratio      gauge
+
+    ``overlap_ratio`` is transfer seconds HIDDEN under prefill compute
+    (frames sent while later chunks were still computing) over total
+    streamed transfer seconds — 1.0 means the wire was entirely paid
+    for by compute, 0.0 means the stream degenerated to the blocking
+    schedule (e.g. single-chunk prefills).
+    """
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def record_session(self) -> None:
+        self.sessions_total += 1
+
+    def record_layer(self, nbytes: int, seconds: float,
+                     hidden: bool) -> None:
+        """One WRITE_LAYER frame acked: ``hidden`` marks frames sent
+        while the producer's prefill was still computing."""
+        self.layers_sent_total += 1
+        self.bytes_total += nbytes
+        self.transfer_seconds_total += seconds
+        if hidden:
+            self.hidden_seconds_total += seconds
+
+    def record_fallback(self) -> None:
+        self.fallbacks_total += 1
+
+    @property
+    def overlap_ratio(self) -> float:
+        if self.transfer_seconds_total <= 0:
+            return 0.0
+        return self.hidden_seconds_total / self.transfer_seconds_total
+
+    def reset(self) -> None:
+        """Test isolation hook — the counters are process-global."""
+        self.sessions_total = 0
+        self.layers_sent_total = 0
+        self.bytes_total = 0
+        self.fallbacks_total = 0
+        self.transfer_seconds_total = 0.0
+        self.hidden_seconds_total = 0.0
+
+
+kv_stream_counters = KvStreamCounters()

@@ -26,6 +26,11 @@ class DisaggRouterConf:
     max_local_prefill_length: int = 512
     # but never when the prefill queue is already this deep (backpressure)
     max_prefill_queue_size: int = 16
+    # ... nor when the KV handoff itself would cost more wall-clock than
+    # this (NetKV-style transfer-cost term: the predicted cost_s() of
+    # moving the request's KV from prefill to decode — obs/costs.py EWMA,
+    # topology prior on cold edges).  inf = transfer cost never vetoes.
+    max_transfer_cost_s: float = float("inf")
 
 
 class DisaggregatedRouter:
@@ -35,12 +40,25 @@ class DisaggregatedRouter:
         self._watch_id: Optional[int] = None
 
     def prefill_remote(
-        self, prefill_length: int, prefix_hit_length: int, queue_size: int = 0
+        self,
+        prefill_length: int,
+        prefix_hit_length: int,
+        queue_size: int = 0,
+        transfer_cost_s: float = 0.0,
     ) -> bool:
-        """True = enqueue remote prefill; False = prefill locally."""
+        """True = enqueue remote prefill; False = prefill locally.
+
+        ``transfer_cost_s`` is the predicted seconds to move this
+        request's KV from the prefill worker into this decode engine
+        over the CHEAPEST handoff path (stream over ICI/DCN vs
+        persist-tier restore — llm/kv/stream.py ``choose_handoff_path``);
+        a remote prefill whose handoff costs more than
+        ``max_transfer_cost_s`` stays local, because the transfer would
+        eat the TTFT the remote prefill was supposed to save."""
         return (
             prefill_length - prefix_hit_length > self.conf.max_local_prefill_length
             and queue_size < self.conf.max_prefill_queue_size
+            and transfer_cost_s <= self.conf.max_transfer_cost_s
         )
 
     # ------------------------------------------------------ dynamic config
@@ -59,6 +77,9 @@ class DisaggregatedRouter:
                     max_prefill_queue_size=int(
                         value.get("max_prefill_queue_size", self.conf.max_prefill_queue_size)
                     ),
+                    max_transfer_cost_s=float(
+                        value.get("max_transfer_cost_s", self.conf.max_transfer_cost_s)
+                    ),
                 )
                 log.info("disagg router conf updated: %s", self.conf)
 
@@ -73,5 +94,6 @@ class DisaggregatedRouter:
             {
                 "max_local_prefill_length": conf.max_local_prefill_length,
                 "max_prefill_queue_size": conf.max_prefill_queue_size,
+                "max_transfer_cost_s": conf.max_transfer_cost_s,
             },
         )

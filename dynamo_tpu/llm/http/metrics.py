@@ -13,7 +13,7 @@ from collections import defaultdict
 from typing import Iterator
 
 from dynamo_tpu.engine.counters import counters as prefill_counters
-from dynamo_tpu.engine.counters import persist_counters
+from dynamo_tpu.engine.counters import kv_stream_counters, persist_counters
 from dynamo_tpu.fault.counters import counters as fault_counters
 from dynamo_tpu.obs.costs import transfer_costs
 from dynamo_tpu.obs.perfmodel import perf_model
@@ -23,6 +23,7 @@ PREFIX = "dynamo_tpu_http_service"
 FAULT_PREFIX = "dynamo_tpu_fault"
 ENGINE_PREFIX = "dynamo_tpu_engine"
 KV_PREFIX = "dynamo_tpu_kv_transfer"
+STREAM_PREFIX = "dynamo_tpu_kv_stream"
 PERF_PREFIX = "dynamo_tpu_perf"
 
 # seconds; TTFT and whole-request durations share one ladder
@@ -179,6 +180,24 @@ class Metrics:
         lines.append(f"# TYPE {ENGINE_PREFIX}_persist_resident_bytes gauge")
         lines.append(f"{ENGINE_PREFIX}_persist_resident_bytes "
                      f"{persist_counters.resident_bytes}")
+        # streamed KV handoff (llm/kv/stream.py): layer frames shipped
+        # while prefill still computed, and how often the stream fell
+        # back to the blocking whole-cache push
+        lines.append(f"# TYPE {STREAM_PREFIX}_sessions_total counter")
+        lines.append(f"{STREAM_PREFIX}_sessions_total "
+                     f"{kv_stream_counters.sessions_total}")
+        lines.append(f"# TYPE {STREAM_PREFIX}_layers_sent_total counter")
+        lines.append(f"{STREAM_PREFIX}_layers_sent_total "
+                     f"{kv_stream_counters.layers_sent_total}")
+        lines.append(f"# TYPE {STREAM_PREFIX}_bytes_total counter")
+        lines.append(f"{STREAM_PREFIX}_bytes_total "
+                     f"{kv_stream_counters.bytes_total}")
+        lines.append(f"# TYPE {STREAM_PREFIX}_fallbacks_total counter")
+        lines.append(f"{STREAM_PREFIX}_fallbacks_total "
+                     f"{kv_stream_counters.fallbacks_total}")
+        lines.append(f"# TYPE {STREAM_PREFIX}_overlap_ratio gauge")
+        lines.append(f"{STREAM_PREFIX}_overlap_ratio "
+                     f"{round(kv_stream_counters.overlap_ratio, 6)}")
         # dtspan engine step timeline: per-phase wall attribution plus the
         # headline host bubble (ROADMAP item 3's committed before-number)
         tl = step_timeline.snapshot()

@@ -20,10 +20,30 @@ Wire protocol (two-part frames, framing.py):
   {op: "read_blocks", block_ids}     -> {ok, dtype, shape} + raw bytes
   {op: "notify", request_id, first_token, error?}            -> {ok}
 
+plus the streamed layer-wise handoff session (llm/kv/stream.py owns the
+session semantics; this module only moves its frames):
+  {op: "stream_begin", v, session, request_id, num_layers}       -> {ok}
+  {op: "write_layer", session, seq, chunk, layer, block_ids, …}
+                                                  + raw bytes    -> {ok}
+  {op: "stream_end", session, frames, sha}                       -> {ok}
+  {op: "stream_abort", session}                                  -> {ok}
+
 The ``write_blocks`` reply is sent only after the receiving engine applied
 the scatter at a step boundary — so ``notify`` ordered after it can never
 race the KV into a decode step (the reference gets this ordering from
-NIXL transfer-completion notifications).
+NIXL transfer-completion notifications).  The same holds for
+``stream_end``: its reply means the assembled cache is applied, so the
+producer's notify keeps the identical ordering contract on the streamed
+path.
+
+Both client surfaces — ``KvTransferClient`` (wire) and
+``LocalKvTransferClient`` (colocated fast path) — implement ONE
+protocol: identical method signatures, identical argument coercion
+(block ids to int, request ids to str), identical notify semantics.
+The local client used to hand its callers' objects straight to the
+server callbacks, so a non-string request id round-tripped differently
+than over JSON — the streaming assembler is tested against either
+surface, which only works because the two now agree.
 """
 
 from __future__ import annotations
@@ -39,6 +59,7 @@ import numpy as np
 
 from dynamo_tpu.obs import tracing
 from dynamo_tpu.obs.costs import transfer_costs
+from dynamo_tpu.runtime.transports.net import DEFAULT_NET
 from dynamo_tpu.runtime.transports.protocol import TransferOp
 from dynamo_tpu.runtime.transports.framing import (
     close_writer,
@@ -151,16 +172,33 @@ class KvTransferServer:
         read_source: Optional[Callable[[list[int]], Awaitable[np.ndarray]]] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        net=None,
     ):
+        from dynamo_tpu.llm.kv.stream import KvStreamAssembler
+
         self.write_sink = write_sink
         self.notify_cb = notify_cb
         self.read_source = read_source
         self.host, self.port = host, port
-        self._server: Optional[asyncio.AbstractServer] = None
+        self._net = net or DEFAULT_NET
+        self._server = None
+        # decode-side streamed-handoff assembler (llm/kv/stream.py):
+        # stream-session ops route here; a verified completion applies
+        # through the same write_sink as a whole-cache push
+        self.assembler = KvStreamAssembler(self._apply_stream)
+        # fault seam (fault/injector.py drop_frames / sever_after): called
+        # per inbound frame with {"type": op, **header} before dispatch;
+        # "drop" swallows the frame (no reply), "sever" cuts the conn —
+        # the deterministic mid-stream kill for the fallback-ladder tests
+        self.fault_hook: Optional[Callable[[dict], Optional[str]]] = None
+
+    async def _apply_stream(self, block_ids, arr, request_id) -> None:
+        await self.write_sink(block_ids, arr, request_id)
 
     async def start(self) -> "KvTransferServer":
-        self._server = await asyncio.start_server(self._handle, self.host, self.port)
-        self.port = self._server.sockets[0].getsockname()[1]
+        self._server, self.port = await self._net.start_server(
+            self._handle, self.host, self.port
+        )
         _LOCAL_ENDPOINTS[self.url] = self
         return self
 
@@ -182,6 +220,13 @@ class KvTransferServer:
                     break
                 h, payload = frame
                 op, rid = h.get("op"), h.get("id")
+                hook = self.fault_hook
+                if hook is not None:
+                    action = hook({"type": op, **h})
+                    if action == "drop":
+                        continue  # swallowed: no dispatch, no reply
+                    if action == "sever":
+                        break  # cut the transport mid-stream
                 # dtspan: a traced sender's context continues through the
                 # receive-side apply (scatter waits for a step boundary, so
                 # this span measures the full transfer-visible latency)
@@ -211,6 +256,15 @@ class KvTransferServer:
                             h["request_id"], h.get("first_token", -1), h.get("error")
                         )
                         write_frame(writer, {"id": rid, "ok": True})
+                    elif op in (
+                        TransferOp.STREAM_BEGIN,
+                        TransferOp.WRITE_LAYER,
+                        TransferOp.STREAM_END,
+                        TransferOp.STREAM_ABORT,
+                    ):
+                        extra = await self.assembler.handle(h, payload)
+                        write_frame(writer,
+                                    {"id": rid, "ok": True, **(extra or {})})
                     else:
                         write_frame(writer, {"id": rid, "error": f"unknown op {op!r}"})
                 except Exception as e:
@@ -226,33 +280,47 @@ class KvTransferServer:
 
 
 class LocalKvTransferClient:
-    """Colocated fast path: same interface as :class:`KvTransferClient`,
-    but ops invoke the target server's sinks directly — block arrays stay
-    ``jax.Array``s end to end, so the copy is device-to-device (ICI under
-    a sharded mesh, on-chip otherwise) with zero host staging or wire
-    serialization."""
+    """Colocated fast path: same protocol surface as
+    :class:`KvTransferClient` (identical signatures and coercions — the
+    unified-client contract in the module docstring), but ops invoke the
+    target server's sinks directly — block arrays stay ``jax.Array``s
+    end to end, so the copy is device-to-device (ICI under a sharded
+    mesh, on-chip otherwise) with zero host staging or wire
+    serialization.  Stream-session ops route into the same
+    :class:`~dynamo_tpu.llm.kv.stream.KvStreamAssembler` the wire path
+    uses, so the streamed handoff is testable against either surface."""
 
     is_local = True
 
     def __init__(self, server: "KvTransferServer"):
         self._server = server
 
+    @property
+    def url(self) -> str:
+        return self._server.url
+
     async def close(self) -> None:
         pass
 
-    async def write_blocks(self, block_ids, arr, request_id=None) -> None:
+    async def write_blocks(
+        self,
+        block_ids: list[int],
+        arr: np.ndarray,
+        request_id: Optional[str] = None,
+    ) -> None:
         stats["local_write_calls"] += 1
         stats["local_blocks"] += len(block_ids)
         nbytes = _arr_nbytes(arr)
+        rid = None if request_id is None else str(request_id)
         span = tracing.start_span(
             "kv.write_blocks",
             attrs={"path": "ici", "blocks": len(block_ids), "bytes": nbytes,
-                   "request_id": request_id or ""},
+                   "request_id": rid or ""},
         )
         t0 = time.perf_counter()
         try:
             await self._server.write_sink(
-                [int(b) for b in block_ids], arr, request_id
+                [int(b) for b in block_ids], arr, rid
             )
         finally:
             transfer_costs.record(
@@ -260,6 +328,25 @@ class LocalKvTransferClient:
                 nbytes, time.perf_counter() - t0,
             )
             span.end()
+
+    # ------------------------------------------- streamed handoff session
+    # Same assembler, same header schema as the wire — only the framing
+    # is skipped.  llm/kv/stream.py's KvStreamSession drives these.
+    async def stream_begin(self, header: dict) -> dict:
+        return await self._server.assembler.handle(
+            {**header, "op": TransferOp.STREAM_BEGIN})
+
+    async def write_layer(self, header: dict, payload: bytes) -> dict:
+        return await self._server.assembler.handle(
+            {**header, "op": TransferOp.WRITE_LAYER}, payload)
+
+    async def stream_end(self, header: dict) -> dict:
+        return await self._server.assembler.handle(
+            {**header, "op": TransferOp.STREAM_END})
+
+    async def stream_abort(self, header: dict) -> dict:
+        return await self._server.assembler.handle(
+            {**header, "op": TransferOp.STREAM_ABORT})
 
     async def read_blocks(self, block_ids):
         if self._server.read_source is None:
@@ -277,8 +364,15 @@ class LocalKvTransferClient:
         )
         return out
 
-    async def notify(self, request_id, first_token, error=None) -> None:
-        await self._server.notify_cb(request_id, int(first_token), error)
+    async def notify(
+        self, request_id: str, first_token: int, error: Optional[str] = None
+    ) -> None:
+        # same coercions a JSON round trip imposes on the wire client, so
+        # notify_cb sees one type signature regardless of surface
+        await self._server.notify_cb(
+            str(request_id), int(first_token),
+            None if error is None else str(error),
+        )
 
 
 class KvTransferClient:
@@ -290,29 +384,35 @@ class KvTransferClient:
 
     is_local = False
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, net=None):
         hostport = url.split("//", 1)[-1]
         host, port = hostport.rsplit(":", 1)
         self.host, self.port = host, int(port)
+        self._net = net or DEFAULT_NET
         self._reader = self._writer = None
         self._ids = itertools.count(1)
         self._lock = asyncio.Lock()
 
-    @classmethod
-    async def connect(cls, url: str):
-        # DYN_KV_TRANSFER_FORCE_TCP=1 disables the colocated shortcut
-        # (tests exercising the wire path; debugging)
-        import os
+    @property
+    def url(self) -> str:
+        return f"tcp://{self.host}:{self.port}"
 
+    @classmethod
+    async def connect(cls, url: str, *, net=None, force_tcp: bool = False):
+        # DYN_KV_TRANSFER_FORCE_TCP=1 / force_tcp=True disables the
+        # colocated shortcut (tests exercising the wire path; protocheck
+        # driving a MemNet server registered in _LOCAL_ENDPOINTS)
         local = (
             None
-            if os.environ.get("DYN_KV_TRANSFER_FORCE_TCP")
+            if force_tcp or os.environ.get("DYN_KV_TRANSFER_FORCE_TCP")
             else _LOCAL_ENDPOINTS.get(url)
         )
         if local is not None:
             return LocalKvTransferClient(local)
-        self = cls(url)
-        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self = cls(url, net=net)
+        self._reader, self._writer = await self._net.open_connection(
+            self.host, self.port
+        )
         return self
 
     async def close(self) -> None:
@@ -414,8 +514,31 @@ class KvTransferClient:
         await self._call(
             {
                 "op": TransferOp.NOTIFY,
-                "request_id": request_id,
+                "request_id": str(request_id),
                 "first_token": int(first_token),
                 "error": error,
             }
         )
+
+    # ------------------------------------------- streamed handoff session
+    # Thin framed carriers for llm/kv/stream.py's KvStreamSession: every
+    # op is a request/reply under the connection lock, so a rejected
+    # frame (torn seq, unknown session) surfaces to the producer
+    # immediately as RuntimeError and the fallback ladder engages before
+    # more layers are wasted on a dead session.
+    async def stream_begin(self, header: dict) -> dict:
+        resp, _ = await self._call({**header, "op": TransferOp.STREAM_BEGIN})
+        return resp
+
+    async def write_layer(self, header: dict, payload: bytes) -> dict:
+        resp, _ = await self._call(
+            {**header, "op": TransferOp.WRITE_LAYER}, payload)
+        return resp
+
+    async def stream_end(self, header: dict) -> dict:
+        resp, _ = await self._call({**header, "op": TransferOp.STREAM_END})
+        return resp
+
+    async def stream_abort(self, header: dict) -> dict:
+        resp, _ = await self._call({**header, "op": TransferOp.STREAM_ABORT})
+        return resp

@@ -96,7 +96,7 @@ class KvScheduler:
     """Combines worker metrics + overlap scores into routing decisions."""
 
     def __init__(self, selector: Optional[WorkerSelector] = None, block_size: int = 16,
-                 persist_weight: float = 1.0):
+                 persist_weight: float = 1.0, transfer_weight: float = 0.0):
         self.selector = selector or DefaultWorkerSelector()
         self.block_size = block_size
         # relative worth of a persistent-tier prefix block vs a device-
@@ -105,6 +105,11 @@ class KvScheduler:
         # load + scatter, so it scores at persist_weight/2.0 of a warm
         # hit.  0 disables persist-aware routing.
         self.persist_weight = persist_weight
+        # NetKV transfer-cost term (logit −= transfer_weight * cost_s per
+        # candidate, cost from obs/costs.py via the caller): a decode
+        # worker that is cheap to reach over ICI/DCN beats an equally
+        # loaded one behind an expensive hop.  0 (default) disables it.
+        self.transfer_weight = transfer_weight
         self._workers: dict[int, WorkerMetrics] = {}
         self._suspects: set[int] = set()
         self._hit_events: list[KVHitRateEvent] = []
@@ -135,7 +140,8 @@ class KvScheduler:
 
     # -------------------------------------------------------------- schedule
     def schedule(self, overlaps: dict[int, int], request_tokens: int,
-                 persist_overlaps: Optional[dict[int, int]] = None) -> int:
+                 persist_overlaps: Optional[dict[int, int]] = None,
+                 transfer_costs_s: Optional[dict[int, float]] = None) -> int:
         request_blocks = max(1, request_tokens // self.block_size)
         candidates = {w: m for w, m in self._workers.items()
                       if w not in self._suspects}
@@ -152,6 +158,19 @@ class KvScheduler:
                 if extra > 0:
                     eff[w] = (overlaps.get(w, 0)
                               + (self.persist_weight / 2.0) * extra)
+            overlaps = eff
+        # transfer-cost term, folded the same way: scaled so the
+        # selector's 2.0/request_blocks overlap normalization nets out
+        # to a logit delta of −transfer_weight * cost_s per candidate
+        # (llm/kv/stream.py choose_handoff_path supplies the per-worker
+        # predicted seconds).
+        if transfer_costs_s and self.transfer_weight > 0:
+            eff = dict(overlaps)
+            for w, cost in transfer_costs_s.items():
+                if cost > 0:
+                    eff[w] = (eff.get(w, 0)
+                              - (self.transfer_weight / 2.0) * cost
+                              * request_blocks)
             overlaps = eff
         # every worker suspect = probes failing cluster-wide (or the probe
         # plane itself broke): routing somewhere beats routing nowhere

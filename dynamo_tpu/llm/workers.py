@@ -27,9 +27,14 @@ import logging
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Optional
 
+import os
+
 from dynamo_tpu.engine.async_engine import AsyncLLMEngine
+from dynamo_tpu.engine.counters import kv_stream_counters
 from dynamo_tpu.llm.disagg_router import DisaggregatedRouter
+from dynamo_tpu.llm.kv.stream import KvStreamProducer, choose_handoff_path
 from dynamo_tpu.llm.kv.transfer import KvTransferClient, KvTransferServer
+from dynamo_tpu.obs.costs import transfer_costs
 from dynamo_tpu.llm.protocols import (
     BackendInput,
     FinishReason,
@@ -179,13 +184,14 @@ class DecodeWorker(AsyncEngine):
     # ---------------------------------------------------------------- routing
     _QUEUE_DEPTH_TTL = 0.1  # seconds; routing heuristic tolerates staleness
 
-    def _prefix_hit(self, token_ids: list[int]) -> int:
+    def _prefix_hit(self, token_ids: list[int]) -> tuple[int, list[int]]:
         # read-only dict probe against the block manager — GIL-safe from this
         # thread, at worst slightly stale, and avoids waiting out an engine
         # step boundary on the request's critical TTFT path
         core = self.engine.core
         seq = TokenBlockSequence(list(token_ids), core.config.block_size)
-        return core.prefix_hit_tokens(seq.sequence_hashes(), len(token_ids))
+        hashes = seq.sequence_hashes()
+        return core.prefix_hit_tokens(hashes, len(token_ids)), hashes
 
     async def _queue_depth(self) -> int:
         now = asyncio.get_running_loop().time()
@@ -194,12 +200,64 @@ class DecodeWorker(AsyncEngine):
             self._cached_depth_at = now
         return self._cached_depth
 
+    def _wire_edge(self) -> tuple[str, bool]:
+        """(src, is_local) of the inbound KV edge: prefer a measured edge
+        into our transfer endpoint (i.e. whichever prefill worker has
+        actually been feeding us — obs/costs.py learns src/path from
+        every push), defaulting to an unmeasured cross-host DCN edge so
+        cold routing uses the conservative topology prior."""
+        dst = self.transfer_url
+        for (src, d, path) in transfer_costs.snapshot():
+            if d == dst and path in ("ici", "dcn"):
+                return src, path == "ici"
+        return "prefill", False
+
+    def _handoff_cost(
+        self, token_ids: list[int], hit: int, hashes: list[int]
+    ) -> tuple[str, float]:
+        """NetKV-style transfer-cost term for the remote-prefill decision:
+        predicted seconds to land this request's KV in OUR cache, over
+        the cheapest of stream-over-the-wire vs persist-tier restore
+        (when the persist index says the prefix is resident here).  Same
+        thread-safety posture as ``_prefix_hit``: heuristic inputs."""
+        core = self.engine.core
+        bs = core.config.block_size
+        total_blocks = -(-len(token_ids) // bs)
+        remainder = max(0, total_blocks - hit // bs)
+        if remainder == 0:
+            return "ici", 0.0
+        nbytes = core.kv_bytes_per_block() * remainder
+        src, local = self._wire_edge()
+        return choose_handoff_path(
+            src, self.transfer_url, nbytes, local=local,
+            persist_resident_blocks=core.persist_hit_blocks(hashes),
+            total_blocks=remainder,
+        )
+
     async def _should_remote(self, token_ids: list[int]) -> bool:
         if self.queue is None:
             return False
-        hit = self._prefix_hit(token_ids)
+        hit, hashes = self._prefix_hit(token_ids)
         qsize = await self._queue_depth()
-        return self.router.prefill_remote(len(token_ids), hit, qsize)
+        path, cost = self._handoff_cost(token_ids, hit, hashes)
+        if path == "persist":
+            # the persist tier already holds (most of) this prefix
+            # locally — restoring beats shipping fresh KV over the wire,
+            # and the local prefill path is what triggers the restore
+            remote = False
+        else:
+            remote = self.router.prefill_remote(
+                len(token_ids), hit, qsize, transfer_cost_s=cost
+            )
+        # dtspan: the chosen handoff path + its predicted cost, on the
+        # request's own trace (generate() runs under the request span)
+        span = tracing.start_span(
+            "disagg.route",
+            attrs={"path": path, "cost_s": cost, "remote": remote,
+                   "prefix_hit": hit, "queue": qsize},
+        )
+        span.end()
+        return remote
 
     # --------------------------------------------------------------- generate
     def generate(self, request: Context[BackendInput]) -> AsyncIterator[LLMEngineOutput]:
@@ -276,13 +334,24 @@ class DecodeWorker(AsyncEngine):
 
 class PrefillWorker:
     """Pulls remote-prefill work, computes KV locally, pushes the blocks to
-    the decode worker and notifies (ref prefill_worker.py:119-177)."""
+    the decode worker and notifies (ref prefill_worker.py:119-177).
 
-    def __init__(self, engine: AsyncLLMEngine, coordinator, namespace: str = "default"):
+    With ``stream=True`` (or ``DYN_KV_STREAM=1``) the push is the
+    layer-wise streamed handoff (llm/kv/stream.py): a commit hook fires
+    per prefill chunk and each committed span's layers go on the wire
+    while later chunks still compute.  Any stream failure falls back to
+    the blocking whole-cache push below — the fallback ladder in
+    docs/kv_streaming.md."""
+
+    def __init__(self, engine: AsyncLLMEngine, coordinator, namespace: str = "default",
+                 stream: Optional[bool] = None):
         self.engine = engine
         self.queue = PrefillQueue(coordinator, namespace)
         self._stop = asyncio.Event()
         self.handled = 0
+        if stream is None:
+            stream = os.environ.get("DYN_KV_STREAM", "") == "1"
+        self.stream = bool(stream)
 
     def request_stop(self) -> None:
         self._stop.set()
@@ -340,13 +409,49 @@ class PrefillWorker:
             ),
             id=rpr.request_id,
         )
-        outs = [o async for o in self.engine.generate_ex(ctx, remote_decode=True)]
-        first_tokens = [t for o in outs for t in o.token_ids]
-        client = await KvTransferClient.connect(rpr.transfer_url)
+        client = None
+        producer: Optional[KvStreamProducer] = None
+        stream_task: Optional[asyncio.Task] = None
+        if self.stream:
+            # streamed handoff: dial the target and arm the commit hook
+            # BEFORE prefill starts, so even the FIRST chunk's layers go
+            # on the wire while later chunks compute.  The hook dict
+            # write is GIL-atomic (same posture as the routing probes).
+            client = await KvTransferClient.connect(rpr.transfer_url)
+            producer = KvStreamProducer(
+                self.engine, client, rpr.request_id,
+                remote_block_ids=list(rpr.block_ids),
+                skip_blocks=rpr.skip_blocks,
+            )
+            core.register_commit_hook(rpr.request_id, producer.on_commit)
+            stream_task = asyncio.ensure_future(producer.run())
         try:
-            if not first_tokens or any(
+            outs = [o async for o in self.engine.generate_ex(ctx, remote_decode=True)]
+            first_tokens = [t for o in outs for t in o.token_ids]
+            failed = not first_tokens or any(
                 o.finish_reason is FinishReason.ERROR for o in outs
-            ):
+            )
+            streamed = False
+            if stream_task is not None:
+                if failed:
+                    # a failed prefill never fires the done commit event —
+                    # the drain would wait forever; cancel it instead
+                    stream_task.cancel()
+                    await asyncio.gather(stream_task, return_exceptions=True)
+                else:
+                    streamed = await stream_task
+                stream_task = None
+                if not streamed and (failed or producer.failure is not None):
+                    # mid-stream sever / torn session / backpressure: the
+                    # connection may be dead — redial for the fallback
+                    # ladder (whole-cache push) and the notify
+                    if producer.failure is not None:
+                        kv_stream_counters.record_fallback()
+                    await client.close()
+                    client = None
+            if client is None:
+                client = await KvTransferClient.connect(rpr.transfer_url)
+            if failed:
                 await client.notify(rpr.request_id, -1, error="prefill failed")
                 return
             local_ids = core.held_blocks(rpr.request_id)
@@ -354,7 +459,9 @@ class PrefillWorker:
             if len(local_ids) != len(rpr.block_ids):
                 await client.notify(rpr.request_id, -1, error="block count mismatch")
                 return
-            if skip < len(local_ids):
+            if not streamed and skip < len(local_ids):
+                # blocking whole-cache push — the non-streamed default
+                # AND the streamed path's fallback.
                 # colocated target → device-side gather (blocks never leave
                 # the device; scatter-side device_put reshards over ICI).
                 # Remote target → host staging + TCP (the DCN path).
@@ -371,7 +478,12 @@ class PrefillWorker:
                 )
             await client.notify(rpr.request_id, first_tokens[0])
         finally:
-            await client.close()
+            core.unregister_commit_hook(rpr.request_id)
+            if stream_task is not None and not stream_task.done():
+                stream_task.cancel()
+                await asyncio.gather(stream_task, return_exceptions=True)
+            if client is not None:
+                await client.close()
             await self.engine.run_on_engine(
                 lambda: core.release_held(rpr.request_id)
             )

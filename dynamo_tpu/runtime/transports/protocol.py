@@ -93,8 +93,22 @@ class FrameType:
 
 
 class TransferOp:
-    """KV-block transfer plane (llm/kv/transfer.py) header ``op`` values."""
+    """KV-block transfer plane (llm/kv/transfer.py) header ``op`` values.
+
+    The ``STREAM_*``/``WRITE_LAYER`` quartet is the layer-wise streamed
+    handoff session (llm/kv/stream.py): a versioned ``STREAM_BEGIN``
+    opens a per-request session, ``WRITE_LAYER`` frames carry one
+    layer's blocks each under a per-session monotonic ``seq``, and
+    ``STREAM_END`` closes with a payload sha256 so a torn stream is a
+    verifiable miss — never silently-wrong KV.  ``STREAM_ABORT`` is the
+    producer-side give-up (fallback to whole-cache ``WRITE_BLOCKS``).
+    """
 
     WRITE_BLOCKS = "write_blocks"
     READ_BLOCKS = "read_blocks"
     NOTIFY = "notify"
+    # streamed layer-wise handoff session (llm/kv/stream.py)
+    STREAM_BEGIN = "stream_begin"
+    WRITE_LAYER = "write_layer"
+    STREAM_END = "stream_end"
+    STREAM_ABORT = "stream_abort"

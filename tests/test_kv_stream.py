@@ -1,0 +1,575 @@
+"""Streamed KV handoff plane tests (ISSUE 15).
+
+Covers the tentpole seams: layer-granular session parity over BOTH
+transfer-client surfaces (wire TCP and the colocated in-process path,
+including the quantized (data, scale) cache), the torn-stream = miss
+contract (bad sha / wrong frame count / out-of-order seq / version
+mismatch — the decode side never admits partial KV), transfer-aware
+routing (``choose_handoff_path`` both directions, the router's
+``max_transfer_cost_s`` veto, the scheduler's transfer-cost fold), the
+/metrics surface, and the acceptance e2e — a seeded in-process disagg
+request whose streamed handoff lands its first layer frame while the
+prefill engine is still computing (proved via dtspan timestamps) and
+produces token-identical output to the blocking whole-cache push, with
+a FaultInjector mid-stream sever falling back to parity.
+"""
+
+import asyncio
+import random
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.counters import kv_stream_counters
+from dynamo_tpu.llm.kv.stream import (
+    KvStreamSession,
+    choose_handoff_path,
+)
+from dynamo_tpu.llm.kv.transfer import (
+    KvTransferClient,
+    KvTransferServer,
+    LocalKvTransferClient,
+)
+from dynamo_tpu.obs import tracing
+from dynamo_tpu.obs.costs import transfer_costs
+from dynamo_tpu.runtime.transports.protocol import TransferOp
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+@pytest.fixture(autouse=True)
+def _stream_state():
+    """Per-test isolation for the process-global stream counters and
+    measured-cost tables both the plane and the router read."""
+    kv_stream_counters.reset()
+    transfer_costs.reset()
+    yield
+    kv_stream_counters.reset()
+    transfer_costs.reset()
+
+
+# ------------------------------------------------ session parity (unit) ----
+
+
+def _sink_server():
+    applied = []
+
+    async def sink(ids, arr, rid):
+        applied.append((list(ids), arr, rid))
+
+    async def notify(rid, first_token, error):
+        pass
+
+    return applied, KvTransferServer(write_sink=sink, notify_cb=notify)
+
+
+@pytest.mark.parametrize("surface", ["tcp", "local"])
+def test_stream_session_parity_both_surfaces(surface):
+    """The same KvStreamSession drives the unified quartet on either
+    client surface and the decode side admits one complete, bit-exact
+    cache — the bugfix satellite's contract (same signatures, same
+    notify semantics on both clients)."""
+    rng = np.random.default_rng(11)
+    chunks = [rng.standard_normal((2, 2, 3)).astype(np.float32)
+              for _ in range(2)]
+    full = np.concatenate(chunks, axis=1)
+
+    async def go():
+        applied, srv = _sink_server()
+        await srv.start()
+        try:
+            cli = await KvTransferClient.connect(
+                srv.url, force_tcp=(surface == "tcp"))
+            if surface == "local":
+                assert isinstance(cli, LocalKvTransferClient)
+            else:
+                assert not isinstance(cli, LocalKvTransferClient)
+            sess = KvStreamSession(cli, "req-1", num_layers=2)
+            await sess.begin()
+            for ids, arr in zip([[0, 1], [2, 3]], chunks):
+                await sess.write_chunk(ids, arr)
+            resp = await sess.end()
+            assert resp.get("applied_blocks") == 4
+            await cli.close()
+        finally:
+            await srv.stop()
+            await asyncio.sleep(0.05)  # let the handler task reap
+        return applied
+
+    applied = run(go())
+    ((ids, arr, rid),) = applied
+    assert ids == [0, 1, 2, 3] and rid == "req-1"
+    np.testing.assert_array_equal(arr, full)
+    assert kv_stream_counters.sessions_total == 1
+    assert kv_stream_counters.layers_sent_total == 4
+    assert kv_stream_counters.bytes_total == full.nbytes
+
+
+def test_stream_session_parity_int8_tuple():
+    """The quantized cache's (data, scale) pair rides the multi-part
+    frame header and reassembles into the same tuple-of-stacks."""
+    rng = np.random.default_rng(12)
+    data = rng.integers(-128, 128, size=(2, 4, 3)).astype(np.int8)
+    scale = rng.standard_normal((2, 4, 1)).astype(np.float32)
+
+    async def go():
+        applied, srv = _sink_server()
+        await srv.start()
+        try:
+            cli = await KvTransferClient.connect(srv.url, force_tcp=True)
+            sess = KvStreamSession(cli, "req-q", num_layers=2)
+            await sess.begin()
+            await sess.write_chunk(
+                [0, 1], (data[:, :2], scale[:, :2]))
+            await sess.write_chunk(
+                [2, 3], (data[:, 2:], scale[:, 2:]))
+            await sess.end()
+            await cli.close()
+        finally:
+            await srv.stop()
+            await asyncio.sleep(0.05)  # let the handler task reap
+        return applied
+
+    applied = run(go())
+    ((ids, arr, rid),) = applied
+    assert ids == [0, 1, 2, 3] and rid == "req-q"
+    assert isinstance(arr, tuple) and len(arr) == 2
+    np.testing.assert_array_equal(arr[0], data)
+    np.testing.assert_array_equal(arr[1], scale)
+    assert arr[0].dtype == np.int8
+
+
+# -------------------------------------------------- torn stream = miss ----
+
+
+def _torn_case(tamper):
+    """Run a 1-chunk/2-layer session, let ``tamper`` corrupt the
+    completion, and assert NOTHING was admitted."""
+    rng = np.random.default_rng(13)
+    chunk = rng.standard_normal((2, 2, 3)).astype(np.float32)
+
+    async def go():
+        applied, srv = _sink_server()
+        await srv.start()
+        try:
+            cli = await KvTransferClient.connect(srv.url, force_tcp=True)
+            sess = KvStreamSession(cli, "req-t", num_layers=2)
+            await sess.begin()
+            await sess.write_chunk([0, 1], chunk)
+            with pytest.raises(RuntimeError):
+                await tamper(cli, sess)
+            # the session is gone: a late END can never admit it either
+            with pytest.raises(RuntimeError):
+                await cli.stream_end({"session": sess.session_id,
+                                      "frames": 2,
+                                      "sha": sess._sha.hexdigest()})
+            await cli.close()
+            assert srv.assembler.completed == 0
+            assert srv.assembler.rejected >= 1
+        finally:
+            await srv.stop()
+            await asyncio.sleep(0.05)  # let the handler task reap
+        return applied
+
+    assert run(go()) == []
+
+
+def test_torn_bad_sha_is_miss():
+    async def tamper(cli, sess):
+        await cli.stream_end({"session": sess.session_id, "frames": 2,
+                              "sha": "0" * 64})
+
+    _torn_case(tamper)
+
+
+def test_torn_wrong_frame_count_is_miss():
+    async def tamper(cli, sess):
+        await cli.stream_end({"session": sess.session_id, "frames": 1,
+                              "sha": sess._sha.hexdigest()})
+
+    _torn_case(tamper)
+
+
+def test_torn_out_of_order_seq_is_miss():
+    async def tamper(cli, sess):
+        # a skipped sequence number = frames lost on the wire
+        await cli.write_layer(
+            {"session": sess.session_id, "seq": 7, "chunk": 1,
+             "layer": 0, "block_ids": [2], "dtype": "float32",
+             "shape": [1, 3]},
+            np.zeros((1, 3), np.float32).tobytes())
+
+    _torn_case(tamper)
+
+
+def test_stream_begin_version_mismatch_rejected():
+    async def go():
+        applied, srv = _sink_server()
+        await srv.start()
+        try:
+            cli = await KvTransferClient.connect(srv.url, force_tcp=True)
+            with pytest.raises(RuntimeError):
+                await cli.stream_begin({"v": 99, "session": "s",
+                                        "request_id": "r",
+                                        "num_layers": 1})
+            await cli.close()
+        finally:
+            await srv.stop()
+            await asyncio.sleep(0.05)  # let the handler task reap
+        return applied
+
+    assert run(go()) == []
+
+
+# --------------------------------------------- transfer-aware routing ----
+
+
+def test_choose_handoff_path_both_directions():
+    # measured fast DCN edge, nothing in persist -> stream over the wire
+    transfer_costs.record("p", "d", "dcn", 100_000_000, 0.1)  # 1 GB/s
+    path, cost = choose_handoff_path("p", "d", 8_000_000,
+                                     persist_resident_blocks=0,
+                                     total_blocks=4)
+    assert path == "dcn" and 0 < cost < 1.0
+
+    # slow wire + fast persist restore with a full resident prefix ->
+    # restore-from-persist wins (and the decode worker prefills locally)
+    transfer_costs.record("p2", "d", "dcn", 1_000_000, 1.0)  # 1 MB/s
+    transfer_costs.record("d", "d", "persist", 100_000_000, 0.1)
+    path2, cost2 = choose_handoff_path("p2", "d", 8_000_000,
+                                       persist_resident_blocks=4,
+                                       total_blocks=4)
+    assert path2 == "persist" and cost2 < cost_of_wire("p2", "d", 8_000_000)
+
+    # a partial persist hit still pays the wire for the remainder: with a
+    # glacial persist tier the wire keeps the whole transfer
+    transfer_costs.record("p3", "d3", "dcn", 100_000_000, 0.1)
+    transfer_costs.record("d3", "d3", "persist", 1_000_000, 10.0)
+    path3, _ = choose_handoff_path("p3", "d3", 8_000_000,
+                                   persist_resident_blocks=2,
+                                   total_blocks=4)
+    assert path3 == "dcn"
+
+
+def cost_of_wire(src, dst, nbytes):
+    return transfer_costs.cost_s(src, dst, "dcn", nbytes)
+
+
+def test_router_max_transfer_cost_vetoes_remote():
+    from dynamo_tpu.llm.disagg_router import (
+        DisaggregatedRouter,
+        DisaggRouterConf,
+    )
+
+    r = DisaggregatedRouter(DisaggRouterConf(max_local_prefill_length=0,
+                                             max_transfer_cost_s=0.5))
+    assert r.prefill_remote(100, 0, 0, transfer_cost_s=0.4) is True
+    assert r.prefill_remote(100, 0, 0, transfer_cost_s=0.6) is False
+    # default conf: transfer cost never vetoes
+    r2 = DisaggregatedRouter(DisaggRouterConf(max_local_prefill_length=0))
+    assert r2.prefill_remote(100, 0, 0, transfer_cost_s=1e9) is True
+
+
+def test_scheduler_transfer_cost_fold():
+    from dynamo_tpu.llm.kv_router.scheduler import (
+        DefaultWorkerSelector,
+        KvScheduler,
+        WorkerMetrics,
+    )
+
+    sched = KvScheduler(selector=DefaultWorkerSelector(random.Random(0)),
+                        block_size=16, transfer_weight=1.0)
+    sched.update_worker(WorkerMetrics(worker_id=1, request_total_slots=4))
+    sched.update_worker(WorkerMetrics(worker_id=2, request_total_slots=4))
+    # equally loaded, equal overlap: the expensive-to-reach worker loses
+    assert sched.schedule({}, 64,
+                          transfer_costs_s={1: 1.0, 2: 0.0}) == 2
+    # weight 0 disables the term: either is acceptable
+    sched0 = KvScheduler(selector=DefaultWorkerSelector(random.Random(0)),
+                         block_size=16, transfer_weight=0.0)
+    sched0.update_worker(WorkerMetrics(worker_id=1, request_total_slots=4))
+    sched0.update_worker(WorkerMetrics(worker_id=2, request_total_slots=4))
+    assert sched0.schedule({}, 64,
+                           transfer_costs_s={2: 1e9}) in (1, 2)
+
+
+# ------------------------------------------------------- /metrics surface ----
+
+
+def test_metrics_render_stream_counters():
+    from dynamo_tpu.llm.http.metrics import Metrics
+
+    kv_stream_counters.record_session()
+    kv_stream_counters.record_layer(100, 0.01, hidden=True)
+    kv_stream_counters.record_layer(100, 0.01, hidden=False)
+    kv_stream_counters.record_fallback()
+    text = Metrics().render()
+    assert "dynamo_tpu_kv_stream_sessions_total 1" in text
+    assert "dynamo_tpu_kv_stream_layers_sent_total 2" in text
+    assert "dynamo_tpu_kv_stream_bytes_total 200" in text
+    assert "dynamo_tpu_kv_stream_fallbacks_total 1" in text
+    assert "dynamo_tpu_kv_stream_overlap_ratio 0.5" in text
+
+
+# ------------------------------------------------- in-process disagg e2e ----
+
+
+@pytest.fixture(scope="module")
+def setup():
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.models.llama import LlamaModel
+    from dynamo_tpu.models.loader import load_params_from_state_dict
+
+    torch.manual_seed(0)
+    hf_cfg = LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        tie_word_embeddings=False,
+    )
+    hf = LlamaForCausalLM(hf_cfg).eval()
+    cfg = ModelConfig.from_hf_config(hf_cfg.to_dict(), dtype="float32")
+    model = LlamaModel(cfg)
+    params = load_params_from_state_dict(cfg, hf.state_dict())
+    return model, params
+
+
+@pytest.fixture()
+def force_tcp(monkeypatch):
+    """Pin the transfer plane to the wire path so the e2e exercises the
+    layer frames over DCN framing, not the in-process ICI shortcut."""
+    monkeypatch.setenv("DYN_KV_TRANSFER_FORCE_TCP", "1")
+
+
+@pytest.fixture()
+def traced():
+    was = tracing.enabled()
+    tracing.enable(True)
+    tracing.collector.reset()
+    yield tracing
+    tracing.enable(was)
+    tracing.collector.reset()
+
+
+def _make_engine(model, params, chunk=None, cache_dtype=None):
+    from dynamo_tpu.engine import AsyncLLMEngine, EngineConfig, EngineCore
+
+    cfg = EngineConfig(
+        max_batch_size=4,
+        max_model_len=128,
+        block_size=8,
+        num_blocks=64,
+        prefill_buckets=[16, 32, 64, 128],
+        **({"prefill_chunk_tokens": chunk} if chunk else {}),
+        **({"cache_dtype": cache_dtype} if cache_dtype else {}),
+    )
+    return AsyncLLMEngine(EngineCore(model, params, cfg)).start()
+
+
+def _make_ctx(prompt, n):
+    from dynamo_tpu.llm.protocols import (
+        BackendInput,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    return Context(
+        BackendInput(
+            token_ids=list(prompt),
+            sampling=SamplingOptions(temperature=0.0),
+            stops=StopConditions(max_tokens=n),
+        )
+    )
+
+
+async def _drain(engine_like, ctx):
+    toks = []
+    gen = engine_like.generate(ctx)
+    try:
+        async for out in gen:
+            toks.extend(out.token_ids)
+            if out.finished:
+                break
+    finally:
+        await gen.aclose()
+    return toks
+
+
+async def _disagg_run(model, params, prompt, n, *, stream, chunk=16,
+                      sever_at=None, cache_dtype=None):
+    """One in-process disagg generation: fresh coordinator + decode +
+    prefill pair, chunked prefill, streamed or blocking handoff, an
+    optional FaultInjector sever at the N-th layer frame.  Returns
+    (tokens, root span)."""
+    from dynamo_tpu.fault.injector import FaultInjector
+    from dynamo_tpu.llm.disagg_router import (
+        DisaggregatedRouter,
+        DisaggRouterConf,
+    )
+    from dynamo_tpu.llm.workers import DecodeWorker, PrefillWorker
+    from dynamo_tpu.runtime.transports.coordinator import (
+        CoordinatorClient,
+        CoordinatorServer,
+    )
+
+    ctx = _make_ctx(prompt, n)
+    srv = await CoordinatorServer(port=0).start()
+    decode_engine = _make_engine(model, params, cache_dtype=cache_dtype)
+    prefill_engine = _make_engine(model, params, chunk=chunk,
+                                  cache_dtype=cache_dtype)
+    injector = FaultInjector()
+    try:
+        c_dec = await CoordinatorClient(srv.url).connect()
+        c_pre = await CoordinatorClient(srv.url).connect()
+        worker = DecodeWorker(
+            decode_engine,
+            coordinator=c_dec,
+            namespace="kvs",
+            router=DisaggregatedRouter(
+                DisaggRouterConf(max_local_prefill_length=0),
+                namespace="kvs",
+            ),
+        )
+        await worker.start()
+        if sever_at is not None:
+            injector.sever_after(worker._transfer, sever_at,
+                                 ftype=TransferOp.WRITE_LAYER)
+        prefill = PrefillWorker(prefill_engine, c_pre, "kvs",
+                                stream=stream)
+        prefill_task = asyncio.ensure_future(prefill.run())
+
+        root = tracing.start_span("http.request",
+                                  attrs={"request_id": ctx.id})
+        toks = await _drain(worker, ctx)
+        root.end()
+        assert prefill.handled == 1
+        # let the prefill side's spans land in the collector
+        await asyncio.sleep(0.3)
+
+        prefill.request_stop()
+        await prefill_task
+        await worker.stop()
+        await c_dec.close()
+        await c_pre.close()
+        return toks, root
+    finally:
+        injector.release_all()
+        decode_engine.shutdown()
+        prefill_engine.shutdown()
+        await srv.stop()
+
+
+def _span_descendants(spans, root_id):
+    """Transitive children of ``root_id`` in one trace's span records."""
+    kids = {}
+    for s in spans:
+        kids.setdefault(s["parent"], []).append(s)
+    out, todo = [], [root_id]
+    while todo:
+        sid = todo.pop()
+        for s in kids.get(sid, []):
+            out.append(s)
+            todo.append(s["span"])
+    return out
+
+
+def test_disagg_streamed_parity_and_overlap(setup, force_tcp, traced):
+    """Acceptance: the streamed handoff is token-identical to the
+    blocking push AND genuinely overlaps — the first layer frame is on
+    the wire (server span opened) strictly before the prefill engine's
+    generate span closes, per dtspan timestamps."""
+    model, params = setup
+    prompt = np.random.default_rng(5).integers(1, 128, size=64).tolist()
+
+    toks_blocking, _ = run(_disagg_run(model, params, prompt, 6,
+                                       stream=False))
+    assert len(toks_blocking) == 6
+
+    kv_stream_counters.reset()
+    transfer_costs.reset()
+    tracing.collector.reset()
+    toks_streamed, root = run(_disagg_run(model, params, prompt, 6,
+                                          stream=True))
+    assert toks_streamed == toks_blocking
+
+    assert kv_stream_counters.sessions_total == 1
+    assert kv_stream_counters.fallbacks_total == 0
+    # 64 tokens / 16-token chunks / 8-token blocks, 2 layers: the cache
+    # crossed as layer frames, several chunks' worth
+    assert kv_stream_counters.layers_sent_total >= 4
+    assert kv_stream_counters.bytes_total > 0
+    # early chunks stream while later chunks compute: hidden seconds
+    assert kv_stream_counters.overlap_ratio > 0
+
+    spans = tracing.collector.spans_for_trace(root.trace_id)
+    names = [s["name"] for s in spans]
+    assert "kv.stream.produce" in names
+    assert "kv.server.write_layer" in names
+    assert "kv.server.stream_end" in names
+    assert "kv.write_blocks" not in names  # no blocking push happened
+    # the overlap proof: first layer frame lands server-side before the
+    # prefill engine's generate span (a descendant of disagg.prefill,
+    # unlike the decode engine's) closes
+    dp = next(s for s in spans if s["name"] == "disagg.prefill")
+    under_prefill = _span_descendants(spans, dp["span"])
+    eng = next(s for s in under_prefill if s["name"] == "engine.generate")
+    first_layer_ts = min(s["ts"] for s in spans
+                         if s["name"] == "kv.server.write_layer")
+    assert first_layer_ts < eng["ts"] + eng["dur"], (
+        "no layer frame hit the wire before prefill finished — "
+        "streaming degenerated into a post-hoc push"
+    )
+    # the streamed path recorded its own measured DCN edge
+    assert any(k[2] == "dcn" for k in transfer_costs.snapshot())
+
+
+def test_disagg_midstream_sever_falls_back_to_parity(setup, force_tcp,
+                                                     traced):
+    """A FaultInjector sever at the 2nd layer frame kills the stream
+    mid-session: the worker falls back to the blocking whole-cache push
+    on a fresh connection and the request still completes with
+    token-identical output; the fallback is counted."""
+    model, params = setup
+    prompt = np.random.default_rng(6).integers(1, 128, size=64).tolist()
+
+    toks_blocking, _ = run(_disagg_run(model, params, prompt, 6,
+                                       stream=False))
+    kv_stream_counters.reset()
+    toks_streamed, root = run(_disagg_run(model, params, prompt, 6,
+                                          stream=True, sever_at=2))
+    assert toks_streamed == toks_blocking
+    assert kv_stream_counters.fallbacks_total >= 1
+
+    spans = tracing.collector.spans_for_trace(root.trace_id)
+    names = [s["name"] for s in spans]
+    assert "kv.write_blocks" in names          # the fallback push
+    assert "kv.server.write_blocks" in names
+
+
+def test_disagg_streamed_parity_int8_cache(setup, force_tcp):
+    """Seeded parity with the quantized cache: the (data, scale) pair
+    streams as multi-part layer frames and decodes to the same tokens
+    as the blocking quantized push."""
+    model, params = setup
+    prompt = np.random.default_rng(7).integers(1, 128, size=48).tolist()
+
+    toks_blocking, _ = run(_disagg_run(model, params, prompt, 5,
+                                       stream=False, cache_dtype="int8"))
+    kv_stream_counters.reset()
+    toks_streamed, _ = run(_disagg_run(model, params, prompt, 5,
+                                       stream=True, cache_dtype="int8"))
+    assert toks_streamed == toks_blocking
+    assert len(toks_streamed) == 5
+    assert kv_stream_counters.sessions_total == 1
+    assert kv_stream_counters.fallbacks_total == 0
+    assert kv_stream_counters.layers_sent_total >= 2

@@ -578,11 +578,18 @@ def test_metrics_render_exports_perf_gauges():
     try:
         f = jax.jit(lambda x: x @ x)
         x = jnp.ones((32, 32), jnp.float32)
-        step_timeline.begin()
-        perf_model.offer("step", f, (x,))
-        f(x)
-        step_timeline.mark("dispatch", kind="step")
-        step_timeline.end()
+        # the timeline is process-global with engine-thread writers; a
+        # straggling engine thread from an earlier test calling
+        # begin()/end() between our marks silently swallows the
+        # dispatch sample — retry until our mark lands
+        for _ in range(5):
+            step_timeline.begin()
+            perf_model.offer("step", f, (x,))
+            f(x)
+            step_timeline.mark("dispatch", kind="step")
+            step_timeline.end()
+            if step_timeline.dispatch_kind_n.get("step"):
+                break
         text = Metrics().render()
         assert 'dynamo_tpu_perf_predicted_step_ms{entrypoint="' in text
         assert 'config="llama3b-v5e"' in text

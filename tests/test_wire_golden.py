@@ -150,6 +150,73 @@ def test_dtkvp1_blob_reencodes_byte_identical():
     assert persist.PersistentKvStore._encode(header, payload) == committed
 
 
+# ------------------------------------------------- KV stream session ----
+
+
+def test_kv_stream_session_decodes():
+    """The committed layer-wise handoff session: versioned begin, two
+    seq-numbered layer frames, completion frame whose sha covers every
+    payload byte in seq order."""
+    import hashlib as _hashlib
+
+    from dynamo_tpu.llm.kv.stream import STREAM_VERSION
+    from dynamo_tpu.llm.kv.transfer import unpack_blocks
+    from dynamo_tpu.runtime.transports.protocol import TransferOp
+
+    frames = _decode_frames((GOLDEN / "kv_stream_session.bin").read_bytes())
+    ops = [h["op"] for h, _ in frames]
+    assert ops == [TransferOp.STREAM_BEGIN, TransferOp.WRITE_LAYER,
+                   TransferOp.WRITE_LAYER, TransferOp.STREAM_END]
+    begin, _ = frames[0]
+    assert begin["v"] == STREAM_VERSION
+    assert begin["session"] == "golden-sess"
+    assert begin["request_id"] == "golden-req"
+    assert begin["num_layers"] == 2
+    sha = _hashlib.sha256()
+    for seq, (h, p) in enumerate(frames[1:3]):
+        assert h["seq"] == seq and h["layer"] == seq and h["chunk"] == 0
+        assert h["block_ids"] == [0]
+        arr = unpack_blocks(h, p)
+        assert arr.dtype.name == "float32" and arr.shape == (1, 8)
+        sha.update(p)
+    end, pend = frames[3]
+    assert pend == b""
+    assert end["frames"] == 2
+    assert end["sha"] == sha.hexdigest()
+
+
+def test_kv_stream_session_reencodes_byte_identical():
+    committed = (GOLDEN / "kv_stream_session.bin").read_bytes()
+    frames = _decode_frames(committed)
+    assert b"".join(encode_frame(h, p) for h, p in frames) == committed
+
+
+def test_kv_stream_session_admissible_by_current_assembler():
+    """The committed bytes constitute a session TODAY's assembler
+    verifies and admits whole — if this breaks, an in-flight stream
+    from an older prefill worker would turn into a miss (or worse)."""
+    import numpy as np
+
+    from dynamo_tpu.llm.kv.stream import KvStreamAssembler
+
+    frames = _decode_frames((GOLDEN / "kv_stream_session.bin").read_bytes())
+    applied = []
+
+    async def run():
+        async def sink(ids, arr, rid):
+            applied.append((list(ids), np.asarray(arr), rid))
+
+        asm = KvStreamAssembler(sink)
+        for h, p in frames:
+            await asm.handle(h, p)
+
+    asyncio.run(run())
+    ((ids, arr, rid),) = applied
+    assert ids == [0] and rid == "golden-req"
+    assert arr.shape == (2, 1, 8)
+    assert arr[1].sum() == 2 * arr[0].sum()
+
+
 def test_golden_fixtures_match_generator():
     """The committed bytes ARE what generate.py produces today — so a
     format change can't hide behind a stale regeneration."""

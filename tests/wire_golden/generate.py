@@ -24,12 +24,17 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parents[2]
 sys.path.insert(0, str(REPO))
 
+import numpy as np  # noqa: E402
+
 from dynamo_tpu.llm.kv import persist  # noqa: E402
 from dynamo_tpu.llm.kv.events import KvStoredEvent, event_to_wire  # noqa: E402
+from dynamo_tpu.llm.kv.stream import STREAM_VERSION  # noqa: E402
+from dynamo_tpu.llm.kv.transfer import pack_blocks  # noqa: E402
 from dynamo_tpu.runtime.transports.framing import encode_frame  # noqa: E402
 from dynamo_tpu.runtime.transports.protocol import (  # noqa: E402
     CoordOp,
     FrameType,
+    TransferOp,
 )
 
 OUT = Path(__file__).resolve().parent
@@ -85,11 +90,39 @@ def dtkvp1_blob() -> bytes:
     return persist.MAGIC + struct.pack("<Q", len(hj)) + hj + payload
 
 
+def kv_stream_session() -> bytes:
+    """A complete layer-wise KV handoff session for one 2-layer,
+    single-chunk cache: the versioned begin, two seq-numbered layer
+    frames, and the completion frame whose sha covers every payload
+    byte in seq order (the torn-stream = miss contract lives in these
+    bytes).  Header key order mirrors what KvStreamSession over
+    KvTransferClient actually writes: session fields, then op, then
+    the per-connection request id."""
+    layers = [np.arange(8, dtype=np.float32).reshape(1, 8) * (layer + 1)
+              for layer in range(2)]
+    sha = hashlib.sha256()
+    frames = [({"v": STREAM_VERSION, "session": "golden-sess",
+                "request_id": "golden-req", "num_layers": 2,
+                "op": TransferOp.STREAM_BEGIN, "id": 1}, b"")]
+    for layer, arr in enumerate(layers):
+        meta, data = pack_blocks(arr)
+        sha.update(data)
+        frames.append(({"session": "golden-sess", "seq": layer,
+                        "chunk": 0, "layer": layer, "block_ids": [0],
+                        **meta, "op": TransferOp.WRITE_LAYER,
+                        "id": 2 + layer}, data))
+    frames.append(({"session": "golden-sess", "frames": 2,
+                    "sha": sha.hexdigest(),
+                    "op": TransferOp.STREAM_END, "id": 4}, b""))
+    return b"".join(encode_frame(h, p) for h, p in frames)
+
+
 FIXTURES = {
     "tcp_sequence.bin": tcp_sequence,
     "coordinator_command.bin": coordinator_command,
     "router_kv_event.jsonl": router_kv_event,
     "dtkvp1_blob.bin": dtkvp1_blob,
+    "kv_stream_session.bin": kv_stream_session,
 }
 
 
