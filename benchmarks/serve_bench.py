@@ -390,6 +390,45 @@ async def run_with_native(args):
         engine.shutdown()
 
 
+def run_sim(args):
+    """Virtual-time mode (--sim): sweep one traffic family over the
+    load plane's offered-load levels instead of driving HTTP.  The
+    macro-simulation runs the real router/admission/planner code
+    against dtperf-modeled workers on a deterministic loop (see
+    dynamo_tpu/load), so the rows come out in milliseconds of virtual
+    time, seconds of wall clock, and are byte-reproducible per seed.
+    Emits the same row/summary schema as the live sweep —
+    ``concurrency`` carries the offered rps, rounded."""
+    from dynamo_tpu.load.sim import LOAD_LEVELS, run_cell
+
+    rows = []
+    for level in LOAD_LEVELS:
+        res = run_cell(args.sim, args.sim_topology, seed=args.sim_seed,
+                       level=level, target_requests=args.sim_target)
+        m = res["metrics"]
+        row = {
+            "concurrency": max(1, round(m["offered_rps"])),
+            "requests": m["requests"],
+            "output_tok_s": m["output_tok_s"],
+            "ttft_p50_ms": m["ttft_p50_ms"],
+            "ttft_p95_ms": m["ttft_p95_ms"],
+            "itl_mean_ms": m["itl_mean_ms"],
+            "level": level,
+            "shed_rate": m["shed_rate"],
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    best = max(rows, key=lambda r: r["output_tok_s"])
+    summary = {"metric": "serve_output_tok_s",
+               "value": best["output_tok_s"], "unit": "tok/s",
+               "best_concurrency": best["concurrency"],
+               "sim_family": args.sim,
+               "sim_topology": args.sim_topology,
+               "sim_seed": args.sim_seed}
+    print(json.dumps(summary))
+    return rows
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--url", default="http://127.0.0.1:8080")
@@ -409,8 +448,23 @@ def main(argv=None):
     p.add_argument("--native", default=None, metavar="MODEL",
                    help="boot the real engine at this geometry "
                         "(tiny|1b|8b|moe) behind an in-process server")
+    p.add_argument("--sim", default=None, metavar="FAMILY",
+                   help="macro-simulate this traffic family "
+                        "(steady|agentic|burst|failure) on the load "
+                        "plane's virtual clock instead of driving HTTP")
+    p.add_argument("--sim-topology", default="w4",
+                   help="with --sim: topology cell (w1|w4|w16)")
+    p.add_argument("--sim-seed", type=int, default=0,
+                   help="with --sim: deterministic-schedule seed")
+    p.add_argument("--sim-target", type=int, default=None,
+                   help="with --sim: requests at level 1.0 "
+                        "(default: the load plane's pinned target)")
     args = p.parse_args(argv)
     args._in_process = bool(args.native or args.spawn_echo)
+    if args.sim:
+        # the simulation owns its own deterministic loop — run it
+        # synchronously, never inside asyncio.run
+        return run_sim(args)
     if args.native:
         if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
             # the image's sitecustomize pins the TPU plugin through

@@ -59,16 +59,22 @@ def configure_parser(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "deterministic-schedule model checking + crash-point "
                         "exploration of the coordinator/queue/drain/persist "
                         "protocols) against the committed proto manifest")
+    p.add_argument("--load", action="store_true",
+                   help="run the scale-simulation pass instead (LD001-LD004: "
+                        "macro-simulated capacity sweep of the real control "
+                        "plane at virtual time — p99 TTFT / shed / knee per "
+                        "topology x load level) against the committed load "
+                        "manifest")
     p.add_argument("--replay", default=None, metavar="TOKEN",
-                   help="with --proto: re-execute one recorded "
-                        "interleaving from a dtp1. replay token (as "
-                        "printed by a failing exploration or the nightly "
+                   help="with --proto or --load: re-execute one recorded "
+                        "run from a dtp1. interleaving token or dtl1. cell "
+                        "token (as printed by a failing run or the nightly "
                         "sweep) instead of sweeping; exit 1 if it still "
                         "violates")
     p.add_argument("--all", action="store_true",
-                   help="run all seven passes (per-file + project, trace, "
-                        "wire, perf, shard, proto) in one process sharing the "
-                        "parse cache; exit 1 if any pass fails")
+                   help="run all eight passes (per-file + project, trace, "
+                        "wire, perf, shard, proto, load) in one process "
+                        "sharing the parse cache; exit 1 if any pass fails")
     p.add_argument("--changed", action="store_true",
                    help="restrict the per-file pass to git-dirty files "
                         "(project/trace/wire passes stay whole-program); "
@@ -151,6 +157,13 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
         from dynamo_tpu.analysis.protocheck import run_proto
 
         return run_proto(args, out)
+    if getattr(args, "load", False):
+        # scale-simulation pass: its unit is capacity cells (the real
+        # control plane macro-simulated at virtual time against the
+        # dtperf latency model) — same manifest contract, its own file
+        from dynamo_tpu.analysis.loadcheck import run_load
+
+        return run_load(args, out)
     paths = [Path(p) for p in (args.paths or [])]
     if args.root:
         root = Path(args.root)
@@ -234,20 +247,22 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
 
 
 def run_all(args: argparse.Namespace, out=None) -> int:
-    """All seven passes in one process: per-file + project rules (one
+    """All eight passes in one process: per-file + project rules (one
     ``ast.parse`` per file via ``core.parse_module``'s cache, which the
     wire pass shares), then the compile-plane trace audit, then the
     wire-plane contract check, then the perf-plane roofline check
     (which shares tracecheck's entrypoint registry), then the
     sharding-plane placement audit, then the protocol-plane
-    deterministic exploration.  Exit 1 if any pass has fresh findings;
-    ``--update-baseline`` rewrites all six committed baselines."""
+    deterministic exploration, then the scale-simulation capacity
+    sweep.  Exit 1 if any pass has fresh findings;
+    ``--update-baseline`` rewrites all the committed baselines."""
     out = out if out is not None else sys.stdout
     # the shard probes need >= 4 devices, and the device count can only
     # be forced BEFORE any pass initializes the jax backend
     from dynamo_tpu.analysis.shardcheck import ensure_audit_devices
 
     ensure_audit_devices()
+    from dynamo_tpu.analysis.loadcheck import run_load
     from dynamo_tpu.analysis.perfcheck import run_perf
     from dynamo_tpu.analysis.protocheck import run_proto
     from dynamo_tpu.analysis.shardcheck import run_shard
@@ -264,7 +279,9 @@ def run_all(args: argparse.Namespace, out=None) -> int:
     rc_perf = run_perf(sub, out)
     rc_shard = run_shard(sub, out)
     rc_proto = run_proto(sub, out)
-    return max(rc_file, rc_trace, rc_wire, rc_perf, rc_shard, rc_proto)
+    rc_load = run_load(sub, out)
+    return max(rc_file, rc_trace, rc_wire, rc_perf, rc_shard, rc_proto,
+               rc_load)
 
 
 def main(argv: Optional[list[str]] = None) -> int:

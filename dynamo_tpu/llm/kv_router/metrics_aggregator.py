@@ -16,7 +16,7 @@ import asyncio
 import json
 import logging
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from dynamo_tpu.llm.kv.events import event_from_wire
 from dynamo_tpu.llm.kv_router.publisher import events_subject, metrics_subject
@@ -37,25 +37,31 @@ class KvMetricsAggregator:
         scheduler: KvScheduler,
         namespace: str = "default",
         stale_after_s: float = 10.0,
+        clock: Callable[[], float] = time.monotonic,
     ):
         self.coord = coordinator
         self.scheduler = scheduler
         self.namespace = namespace
         self.stale_after_s = stale_after_s
+        # injectable clock: staleness reaping runs at DetLoop virtual
+        # time under the load plane's macro-simulation
+        self._clock = clock
         self._sub_id: Optional[int] = None
         self._reaper: Optional[asyncio.Task] = None
 
     def _on_metrics(self, subject: str, payload: bytes) -> None:
         try:
             d = json.loads(payload)
-            self.scheduler.update_worker(WorkerMetrics(**d))
+            m = WorkerMetrics(**d)
+            m.updated_at = self._clock()   # receipt time, aggregator clock
+            self.scheduler.update_worker(m)
         except Exception:
             log.exception("bad metrics payload on %s", subject)
 
     async def _reap_stale(self) -> None:
         while True:
             await asyncio.sleep(self.stale_after_s / 2)
-            now = time.monotonic()
+            now = self._clock()
             for wid, m in list(self.scheduler.workers().items()):
                 if now - m.updated_at > self.stale_after_s:
                     log.warning("worker %s metrics stale; dropping from scheduler", wid)

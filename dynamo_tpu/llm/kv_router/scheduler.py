@@ -139,39 +139,89 @@ class KvScheduler:
         return set(self._suspects)
 
     # -------------------------------------------------------------- schedule
+    def _fold_overlaps(self, overlaps: dict[int, int], request_blocks: int,
+                       persist_overlaps: Optional[dict[int, int]],
+                       transfer_costs_s: Optional[dict[int, float]],
+                       ) -> dict[int, float]:
+        """Fold persist-tier and transfer-cost terms into effective
+        overlap counts so the WorkerSelector protocol (and custom
+        selectors) stays unchanged.
+
+        Persistent-tier matches enter as a DISCOUNTED overlap term: only
+        the blocks persist offers beyond the device prefix count, scaled
+        so the selector's 2.0*overlap weight nets out to persist_weight
+        per persist block.  Transfer costs are scaled so the selector's
+        2.0/request_blocks overlap normalization nets out to a logit
+        delta of −transfer_weight * cost_s per candidate (llm/kv/
+        stream.py choose_handoff_path supplies the per-worker predicted
+        seconds)."""
+        eff: dict[int, float] = dict(overlaps)
+        if persist_overlaps and self.persist_weight > 0:
+            for w, p in persist_overlaps.items():
+                extra = p - overlaps.get(w, 0)
+                if extra > 0:
+                    eff[w] = (overlaps.get(w, 0)
+                              + (self.persist_weight / 2.0) * extra)
+        if transfer_costs_s and self.transfer_weight > 0:
+            for w, cost in transfer_costs_s.items():
+                if cost > 0:
+                    eff[w] = (eff.get(w, 0)
+                              - (self.transfer_weight / 2.0) * cost
+                              * request_blocks)
+        return eff
+
+    def score_candidates(self, overlaps: dict[int, int], request_tokens: int,
+                         persist_overlaps: Optional[dict[int, int]] = None,
+                         transfer_costs_s: Optional[dict[int, float]] = None,
+                         ) -> list[tuple[int, float, dict]]:
+        """Pure scoring seam: every non-suspect worker's logit with the
+        terms itemized, best first (ties broken by worker id — no RNG,
+        no state mutation, no hit events).
+
+        Returns ``[(worker_id, logit, breakdown)]`` where ``breakdown``
+        holds the additive terms {overlap, persist, transfer, kv_usage,
+        slot_usage} and ``logit == sum(breakdown.values())``, matching
+        the DefaultWorkerSelector cost model over folded overlaps
+        exactly.  The load plane asserts router-decision quality per
+        scenario on this surface, and a future global scheduler
+        (ROADMAP item 4) inherits it as its explainability contract."""
+        request_blocks = max(1, request_tokens // self.block_size)
+        scored: list[tuple[int, float, dict]] = []
+        for wid, m in self._workers.items():
+            if wid in self._suspects:
+                continue
+            overlap_term = 2.0 * overlaps.get(wid, 0) / request_blocks
+            persist_term = 0.0
+            if persist_overlaps and self.persist_weight > 0:
+                extra = persist_overlaps.get(wid, 0) - overlaps.get(wid, 0)
+                if extra > 0:
+                    persist_term = (self.persist_weight * extra
+                                    / request_blocks)
+            transfer_term = 0.0
+            if transfer_costs_s and self.transfer_weight > 0:
+                cost = transfer_costs_s.get(wid, 0.0)
+                if cost > 0:
+                    transfer_term = -self.transfer_weight * cost
+            breakdown = {
+                "overlap": overlap_term,
+                "persist": persist_term,
+                "transfer": transfer_term,
+                "kv_usage": -m.kv_usage,
+                "slot_usage": -m.slot_usage,
+            }
+            scored.append((wid, sum(breakdown.values()), breakdown))
+        scored.sort(key=lambda t: (-t[1], t[0]))
+        return scored
+
     def schedule(self, overlaps: dict[int, int], request_tokens: int,
                  persist_overlaps: Optional[dict[int, int]] = None,
                  transfer_costs_s: Optional[dict[int, float]] = None) -> int:
         request_blocks = max(1, request_tokens // self.block_size)
         candidates = {w: m for w, m in self._workers.items()
                       if w not in self._suspects}
-        # persistent-tier matches enter as a DISCOUNTED overlap term:
-        # only the blocks persist offers beyond the device prefix count,
-        # scaled so the selector's 2.0*overlap weight nets out to
-        # persist_weight per persist block.  Folding here keeps the
-        # WorkerSelector protocol (and custom selectors) unchanged.
         device_overlaps = overlaps
-        if persist_overlaps and self.persist_weight > 0:
-            eff = dict(overlaps)
-            for w, p in persist_overlaps.items():
-                extra = p - overlaps.get(w, 0)
-                if extra > 0:
-                    eff[w] = (overlaps.get(w, 0)
-                              + (self.persist_weight / 2.0) * extra)
-            overlaps = eff
-        # transfer-cost term, folded the same way: scaled so the
-        # selector's 2.0/request_blocks overlap normalization nets out
-        # to a logit delta of −transfer_weight * cost_s per candidate
-        # (llm/kv/stream.py choose_handoff_path supplies the per-worker
-        # predicted seconds).
-        if transfer_costs_s and self.transfer_weight > 0:
-            eff = dict(overlaps)
-            for w, cost in transfer_costs_s.items():
-                if cost > 0:
-                    eff[w] = (eff.get(w, 0)
-                              - (self.transfer_weight / 2.0) * cost
-                              * request_blocks)
-            overlaps = eff
+        overlaps = self._fold_overlaps(overlaps, request_blocks,
+                                       persist_overlaps, transfer_costs_s)
         # every worker suspect = probes failing cluster-wide (or the probe
         # plane itself broke): routing somewhere beats routing nowhere
         wid = self.selector.select(candidates or self._workers, overlaps,

@@ -1,0 +1,289 @@
+"""Simulated workers whose dispatch durations come from dtperf.
+
+``LatencyModel`` reads the committed ``analysis/perf_manifest.json``
+(the perf plane's predicted per-signature latencies) and turns it into
+per-token prefill and per-step decode costs.  That coupling is the
+point: a PR that regresses the predicted engine latencies moves every
+simulated capacity number, and the load gate (LD001) catches it — the
+macro-simulation inherits dtperf's sensitivity without re-measuring
+anything.
+
+The committed predictions price the tiny audit-rig model, so an
+explicit ``scale`` knob maps them to a production-class checkpoint:
+the *shape* (prefill:decode ratio, growth with tokens) comes from the
+manifest, the magnitude from scale.  Control-plane costs (the router's
+per-decision Python time) are NOT scaled — they are real wall costs
+independent of model size, which is exactly why the singleton router
+becomes the wall at high worker counts (ROADMAP item 1).
+
+``SimWorker`` consumes virtual time only: slot-gated admission,
+time-sliced decode (ITL grows with concurrent decodes on the chip),
+LRU KV eviction publishing REAL KvRemovedEvents, and a kill/restore
+surface for failure storms.  All cache traffic goes through the real
+``event_to_wire``/``event_from_wire`` codec so the router's indexer
+sees production-shaped event streams.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import statistics
+import time
+from pathlib import Path
+from typing import Callable, Optional, Sequence
+
+from dynamo_tpu.llm.kv.events import (
+    KvRemovedEvent,
+    KvStoredEvent,
+    event_to_wire,
+)
+from dynamo_tpu.llm.kv_router.scheduler import WorkerMetrics
+
+__all__ = ["LatencyModel", "SimWorker", "SimWorkerDied"]
+
+DEFAULT_PERF_MANIFEST = (
+    Path(__file__).resolve().parents[1] / "analysis" / "perf_manifest.json")
+
+# committed tiny-llama predictions (perf_manifest.json), used verbatim
+# when the manifest is missing or its keys moved — the sim must never
+# crash on a trimmed checkout
+_FALLBACK_PREFILL_MS_PER_TOKEN = 0.003022 / 64
+_FALLBACK_DECODE_MS_PER_STEP = 0.016498 / 16
+_DEFAULT_SCALE = 2000.0
+
+
+def _sig_params(sig: str) -> dict[str, float]:
+    out: dict[str, float] = {}
+    for part in sig.split(","):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            try:
+                out[k.strip()] = float(v)
+            except ValueError:
+                pass
+    return out
+
+
+def _per_unit_ms(entry: Optional[dict], param: str) -> Optional[float]:
+    """Median predicted total_ms per unit of ``param`` over an
+    entrypoint's signatures (robust to which shapes are committed)."""
+    if not entry:
+        return None
+    vals = []
+    for sig, rec in entry.get("signatures", {}).items():
+        n = _sig_params(sig).get(param)
+        total = (rec.get("predicted") or {}).get("total_ms")
+        if n and total:
+            vals.append(total / n)
+    return statistics.median(vals) if vals else None
+
+
+class LatencyModel:
+    """Virtual-time costs for one simulated deployment."""
+
+    def __init__(self, *, prefill_ms_per_token: float,
+                 decode_ms_per_step: float,
+                 router_ms_per_decision: float = 0.15,
+                 kv_bytes_per_block: int = 2 * 1024 * 1024,
+                 scale: float = _DEFAULT_SCALE):
+        self.prefill_ms_per_token = prefill_ms_per_token
+        self.decode_ms_per_step = decode_ms_per_step
+        self.router_ms_per_decision = router_ms_per_decision
+        self.kv_bytes_per_block = kv_bytes_per_block
+        self.scale = scale
+
+    @classmethod
+    def from_perf_manifest(cls, path: Optional[Path] = None,
+                           config: str = "tiny-llama",
+                           scale: Optional[float] = None,
+                           router_ms_per_decision: float = 0.15,
+                           ) -> "LatencyModel":
+        if scale is None:
+            scale = float(os.environ.get("DTLOAD_SCALE", "") or _DEFAULT_SCALE)
+        p = Path(path) if path is not None else DEFAULT_PERF_MANIFEST
+        prefill = decode = None
+        if p.is_file():
+            try:
+                entries = json.loads(p.read_text()).get("entrypoints", {})
+            except (json.JSONDecodeError, OSError):
+                entries = {}
+            prefill = _per_unit_ms(
+                entries.get(f"engine.prefill_ragged[{config}]"), "t")
+            decode = _per_unit_ms(
+                entries.get(f"engine.decode_multi[{config}]"), "k")
+        return cls(
+            prefill_ms_per_token=prefill or _FALLBACK_PREFILL_MS_PER_TOKEN,
+            decode_ms_per_step=decode or _FALLBACK_DECODE_MS_PER_STEP,
+            router_ms_per_decision=router_ms_per_decision,
+            scale=scale,
+        )
+
+    # ------------------------------------------------------------- durations
+    def prefill_s(self, new_tokens: int) -> float:
+        return max(0, new_tokens) * self.prefill_ms_per_token * self.scale / 1e3
+
+    def decode_step_s(self) -> float:
+        return self.decode_ms_per_step * self.scale / 1e3
+
+    def router_s(self) -> float:
+        return self.router_ms_per_decision / 1e3
+
+    def transfer_bytes(self, blocks: int) -> int:
+        return blocks * self.kv_bytes_per_block
+
+
+class SimWorkerDied(Exception):
+    """The worker was killed while serving (failure storm)."""
+
+
+class SimWorker:
+    """One simulated engine: ``slots`` concurrent requests, a
+    ``kv_blocks``-deep LRU device cache, decode time-sliced across the
+    requests actively decoding on the chip."""
+
+    def __init__(self, wid: int, lat: LatencyModel, *,
+                 publish: Callable[[dict], None],
+                 clock: Callable[[], float] = time.monotonic,
+                 slots: int = 8, kv_blocks: int = 4096,
+                 block_size: int = 16):
+        self.wid = wid
+        self.lat = lat
+        self.publish = publish
+        self.clock = clock
+        self.slots = slots
+        self.kv_blocks = kv_blocks
+        self.block_size = block_size
+        self.alive = True
+        self.completed = 0
+        self.tokens_out = 0
+        self.evicted_blocks = 0
+        self._sem = asyncio.Semaphore(slots)
+        self._active = 0
+        self._waiting = 0
+        self._decoding = 0
+        self._resident: dict[int, None] = {}   # insertion order = LRU order
+        self._event_id = 0
+
+    # -------------------------------------------------------------- KV cache
+    def _resident_prefix(self, hashes: Sequence[int]) -> int:
+        k = 0
+        for h in hashes:
+            if h not in self._resident:
+                break
+            k += 1
+            self._resident[h] = self._resident.pop(h)   # LRU touch
+        return k
+
+    def _emit(self, ev) -> None:
+        self._event_id += 1
+        self.publish(event_to_wire(self._event_id, self.wid, ev))
+
+    def _store(self, hashes: Sequence[int], known: int) -> None:
+        new = [h for h in hashes[known:] if h not in self._resident]
+        if new:
+            parent = hashes[known - 1] if known > 0 else None
+            for h in new:
+                self._resident[h] = None
+            self._emit(KvStoredEvent(block_hashes=new, parent_hash=parent))
+        if len(self._resident) > self.kv_blocks:
+            n_evict = len(self._resident) - self.kv_blocks
+            victims = list(self._resident)[:n_evict]
+            for h in victims:
+                del self._resident[h]
+            self.evicted_blocks += len(victims)
+            self._emit(KvRemovedEvent(block_hashes=victims))
+
+    # --------------------------------------------------------------- serving
+    def _check_alive(self) -> None:
+        if not self.alive:
+            raise SimWorkerDied(f"worker {self.wid} died mid-serve")
+
+    async def prefill(self, hashes: Sequence[int], isl_tokens: int,
+                      pre_delay_s: float = 0.0) -> int:
+        """Prefill only (disagg prefill role).  Returns the warm-prefix
+        block count it reused; publishes the new blocks as stored."""
+        self._waiting += 1
+        await self._sem.acquire()
+        self._waiting -= 1
+        self._active += 1
+        try:
+            self._check_alive()
+            known = self._resident_prefix(hashes)
+            new_tokens = isl_tokens - known * self.block_size
+            if pre_delay_s > 0:
+                await asyncio.sleep(pre_delay_s)
+            await asyncio.sleep(self.lat.prefill_s(new_tokens))
+            self._check_alive()
+            self._store(hashes, known)
+            return known
+        finally:
+            self._active -= 1
+            self._sem.release()
+
+    async def decode(self, hashes: Sequence[int], osl: int,
+                     pre_delay_s: float = 0.0,
+                     prefill_tokens: int = 0) -> tuple[float, float, int]:
+        """Hold a slot and decode ``osl`` tokens, time-sliced across the
+        chip's active decodes.  ``prefill_tokens`` > 0 folds a local
+        prefill in first (aggregated serving); 0 means the KV arrived by
+        transfer (disagg decode role).  Returns (t_first_token, t_done,
+        warm_prefix_blocks)."""
+        self._waiting += 1
+        await self._sem.acquire()
+        self._waiting -= 1
+        self._active += 1
+        try:
+            self._check_alive()
+            known = self._resident_prefix(hashes)
+            if pre_delay_s > 0:
+                await asyncio.sleep(pre_delay_s)
+            if prefill_tokens > 0:
+                new_tokens = max(0, prefill_tokens - known * self.block_size)
+                await asyncio.sleep(self.lat.prefill_s(new_tokens))
+                self._check_alive()
+            self._store(hashes, known)
+            step = self.lat.decode_step_s()
+            self._decoding += 1
+            try:
+                await asyncio.sleep(step * max(1, self._decoding))
+                t_first = self.clock()
+                # two chunks, concurrency resampled between them:
+                # scheduling-point economy over per-token fidelity
+                left = max(0, osl - 1)
+                for n in (left // 2, left - left // 2):
+                    if n:
+                        await asyncio.sleep(
+                            n * step * max(1, self._decoding))
+                    self._check_alive()
+            finally:
+                self._decoding -= 1
+            t_done = self.clock()
+            self.completed += 1
+            self.tokens_out += osl
+            return t_first, t_done, known
+        finally:
+            self._active -= 1
+            self._sem.release()
+
+    # --------------------------------------------------------------- control
+    def kill(self) -> None:
+        self.alive = False
+
+    def restore(self) -> None:
+        """Back from the dead, cache cold (the harness already tore the
+        worker out of the router index)."""
+        self._resident.clear()
+        self.alive = True
+
+    def metrics(self) -> WorkerMetrics:
+        return WorkerMetrics(
+            worker_id=self.wid,
+            request_active_slots=self._active,
+            request_total_slots=self.slots,
+            kv_active_blocks=len(self._resident),
+            kv_total_blocks=self.kv_blocks,
+            num_requests_waiting=self._waiting,
+            updated_at=self.clock(),
+        )

@@ -27,13 +27,20 @@ kv layer records, the http layer renders, benchmarks read.
 from __future__ import annotations
 
 import threading
+import time
+from typing import Callable
 
 __all__ = ["TransferCostTable", "transfer_costs"]
 
 
 class TransferCostTable:
-    def __init__(self, alpha: float = 0.2) -> None:
+    def __init__(self, alpha: float = 0.2,
+                 clock: Callable[[], float] = time.monotonic) -> None:
         self._alpha = alpha
+        # injectable so the load plane's macro-simulation can run the
+        # EWMAs at DetLoop virtual time instead of silently mixing
+        # wall-clock into a simulated trace
+        self._clock = clock
         self._lock = threading.Lock()
         self.reset()
 
@@ -49,12 +56,14 @@ class TransferCostTable:
         mbps = nbytes / seconds / 1e6
         key = (src, dst, path)
         a = self._alpha
+        now = self._clock()
         with self._lock:
             e = self.table.get(key)
             if e is None:
                 self.table[key] = {
                     "calls": 1, "bytes": nbytes, "seconds": seconds,
                     "ewma_mbps": mbps, "ewma_latency_s": seconds,
+                    "updated_at": now,
                 }
                 return
             e["calls"] += 1
@@ -62,6 +71,7 @@ class TransferCostTable:
             e["seconds"] += seconds
             e["ewma_mbps"] = (1 - a) * e["ewma_mbps"] + a * mbps
             e["ewma_latency_s"] = (1 - a) * e["ewma_latency_s"] + a * seconds
+            e["updated_at"] = now
 
     def cost_s(self, src: str, dst: str, path: str,
                nbytes: int) -> float:

@@ -46,7 +46,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Optional
+from typing import Callable, Optional
 
 __all__ = ["StepTimeline", "step_timeline", "PHASES"]
 
@@ -69,8 +69,12 @@ class StepTimeline:
     torn reads of monotonically-increasing floats are acceptable for
     monitoring)."""
 
-    def __init__(self, keep_steps: int = 256) -> None:
+    def __init__(self, keep_steps: int = 256,
+                 clock: Callable[[], float] = time.perf_counter) -> None:
         self._lock = threading.Lock()
+        # injectable so simulated engines (load plane) can stamp steps
+        # at virtual time; the default stays the high-resolution counter
+        self._clock = clock
         self.recent: deque = deque(maxlen=keep_steps)
         self.reset()
 
@@ -96,7 +100,7 @@ class StepTimeline:
 
     # ------------------------------------------------------------ hot path
     def begin(self) -> None:
-        now = time.perf_counter()
+        now = self._clock()
         self._t0 = now
         self._last = now
         self._phases = {}
@@ -106,7 +110,7 @@ class StepTimeline:
     def mark(self, phase: str, kind: Optional[str] = None) -> None:
         if self._t0 is None:
             return  # dispatch helper invoked outside step() (tests)
-        now = time.perf_counter()
+        now = self._clock()
         delta = now - self._last
         self._phases[phase] = self._phases.get(phase, 0.0) + delta
         if kind is not None:
@@ -121,7 +125,7 @@ class StepTimeline:
     def end(self, trace: Optional[tuple] = None) -> None:
         if self._t0 is None:
             return
-        now = time.perf_counter()
+        now = self._clock()
         phases = self._phases
         phases["host_post"] = phases.get("host_post", 0.0) + (now - self._last)
         wall = now - self._t0

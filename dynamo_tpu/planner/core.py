@@ -115,8 +115,12 @@ class PlannerLoop:
         stale_after_s: float = 15.0,
         actuators: tuple = (),
         mix_source: Optional[Callable[[], tuple[float, float]]] = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
         self.coord = coordinator
+        # injectable clock: metric freshness (stale_after_s) works at
+        # DetLoop virtual time under the load plane's macro-simulation
+        self._clock = clock
         self.namespace = namespace
         self.policy = policy or PlannerPolicy(config)
         self.prefill_component = prefill_component
@@ -141,7 +145,7 @@ class PlannerLoop:
     def _on_metrics(self, subject: str, payload: bytes) -> None:
         try:
             d = json.loads(payload)
-            d["_rx"] = time.monotonic()
+            d["_rx"] = self._clock()
             self._metrics[int(d["worker_id"])] = d
         except Exception:
             log.exception("bad kv_metrics payload on %s", subject)
@@ -159,7 +163,7 @@ class PlannerLoop:
         return ids
 
     def _samples(self, ids: list[int]) -> tuple[WorkerSample, ...]:
-        now = time.monotonic()
+        now = self._clock()
         out = []
         for wid in ids:
             m = self._metrics.get(wid)

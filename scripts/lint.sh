@@ -1,6 +1,6 @@
 #!/bin/sh
 # Convenience wrapper for the static-analysis suite (docs/static_analysis.md).
-# One process, ALL SEVEN passes (dynamo-tpu lint --all), sharing one
+# One process, ALL EIGHT passes (dynamo-tpu lint --all), sharing one
 # ast.parse per file across the per-file, project and wire passes:
 #   1+2. per-file rules (DT001-DT104) + interprocedural project pass
 #        (DT005-DT009)
@@ -17,20 +17,25 @@
 #        analysis/proto_manifest.json (deterministic scheduler + crash
 #        points over the real control-plane code; DTPROTO_BUDGET=1 in
 #        the gate, crank it for deeper sweeps)
+#   8.   scale-plane macro-simulation (LD001-LD004) against the
+#        committed analysis/load_manifest.json (the real
+#        router/admission/planner serving seeded traffic vs simulated
+#        workers at virtual time; DTLOAD_BUDGET=1 in the gate)
 #   scripts/lint.sh                      # lint dynamo_tpu/, human output
 #   scripts/lint.sh --format json        # stable JSON (one doc per pass)
 #   scripts/lint.sh --changed            # pre-commit mode: per-file rules
 #                                        # on git-dirty files only; the
 #                                        # project/trace/wire/perf/shard
-#                                        # passes stay whole-program and
-#                                        # proto re-explores only the
-#                                        # affected scenarios
+#                                        # passes stay whole-program, proto
+#                                        # re-explores only the affected
+#                                        # scenarios and load skips when no
+#                                        # plane input changed
 #   scripts/lint.sh --update-baseline    # rebuild analysis/baseline.json
-#                                        # AND all five manifests
+#                                        # AND all six manifests
 #                                        # (justifications carried by key)
 #   scripts/lint.sh --select DT005       # one rule (project codes route
 #                                        # to the project registry; the
-#                                        # trace/wire/perf/shard/proto
+#                                        # trace/wire/perf/shard/proto/load
 #                                        # passes ignore it)
 # Exit code 1 on any non-baselined finding from any pass.
 cd "$(dirname "$0")/.." || exit 2

@@ -1,0 +1,273 @@
+"""Scale-simulation plane (dtload) gate tests: THE eighth tier-1 gate
+(zero non-accepted findings from the pinned-seed capacity sweep against
+the committed load manifest), the LD001-LD004 rules over good/regressed
+fixture facts, an injected-latency regression provably tripping LD001
+end-to-end, the dtl1. replay-token roundtrip, and the CLI contract
+(--update-baseline refusal, --format json, --replay)."""
+
+import argparse
+import io
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from dynamo_tpu.analysis.loadcheck import (
+    DEFAULT_LOAD_MANIFEST_PATH,
+    LOAD_RULES,
+    LoadFinding,
+    LoadManifest,
+    check_load,
+    decode_token,
+    encode_token,
+    run_load,
+)
+from tests.manifest_hygiene import assert_manifest_hygiene
+
+FIXTURES = Path(__file__).parent / "lint_fixtures"
+
+
+def _fixture(name):
+    return json.loads((FIXTURES / name).read_text())
+
+
+# ------------------------------------------------------------- the gate ----
+
+
+@pytest.fixture(scope="module")
+def swept():
+    """The pinned-seed capacity sweep — the same grid ``dynamo-tpu lint
+    --load`` runs at budget 1."""
+    from dynamo_tpu.load.sim import sweep
+
+    t0 = time.perf_counter()
+    facts = sweep(budget=1, seed_base=0)
+    return facts, time.perf_counter() - t0
+
+
+def test_load_gate_zero_nonaccepted_findings(swept):
+    """THE tier-1 load-plane gate: the macro-simulated capacity surface
+    (p99 TTFT, shed rate, SLA knee, routing census per cell x level)
+    matches the committed load manifest.  If this fails, either fix the
+    capacity regression the finding's replay token reproduces
+    (preferred), or — for an accepted operating-point change —
+    re-snapshot with `dynamo-tpu lint --load --update-baseline` and
+    justify every accepted entry."""
+    facts, _ = swept
+    manifest = LoadManifest.load(DEFAULT_LOAD_MANIFEST_PATH)
+    findings = check_load(facts, manifest, drift=True)
+    fresh = manifest.filter(findings)
+    assert not fresh, "\n".join(f.render() for f in fresh)
+    assert_manifest_hygiene(manifest, findings, entity_field="scenario")
+
+
+def test_load_gate_is_fast(swept):
+    """The gate must stay cheap enough for tier-1: the whole pinned
+    sweep (10 cells x 3 levels + twin runs) under 15 seconds."""
+    _, elapsed = swept
+    assert elapsed < 15.0, f"pinned load sweep took {elapsed:.1f}s"
+
+
+def test_committed_surface_covers_grid(swept):
+    """The acceptance floor: >= 3 topologies x >= 3 scenario families,
+    every cell deterministic, every level present."""
+    facts, _ = swept
+    fams = {c.split("/")[0] for c in facts["cells"]}
+    topos = {c.split("/")[1] for c in facts["cells"]}
+    assert len(fams) >= 3 and len(topos) >= 3
+    for name, cell in facts["cells"].items():
+        assert cell["twin_match"], f"{name} nondeterministic"
+        assert set(cell["levels"]) == {"0.5", "1", "2"}, name
+
+
+# ------------------------------------------------------------ rule checks
+
+
+def test_clean_facts_produce_no_findings():
+    facts = _fixture("ld_baseline_facts.json")
+    manifest = LoadManifest(cells=facts["cells"])
+    assert check_load(facts, manifest, drift=True) == []
+
+
+def test_regressed_facts_trip_every_rule():
+    baseline = _fixture("ld_baseline_facts.json")
+    regressed = _fixture("ld_regressed_facts.json")
+    manifest = LoadManifest(cells=baseline["cells"])
+    findings = check_load(regressed, manifest, drift=True)
+    rules = {f.rule for f in findings}
+    assert rules == {"LD001", "LD002", "LD003", "LD004"}
+    keys = {(f.rule, f.key) for f in findings}
+    assert ("LD001", "p99:1") in keys      # 290ms vs 55ms committed
+    assert ("LD001", "shed:1") in keys     # +0.12 shed
+    assert ("LD001", "completed:2") in keys  # 100 vs 190 committed
+    assert ("LD002", "knee") in keys       # knee 2.0 -> 1.0
+    assert ("LD003", "determinism") in keys
+    assert ("LD004", "+census:worker_died") in keys
+
+
+def test_ld003_reported_even_without_drift():
+    """Nondeterminism is checked at every seed/budget — only the drift
+    rules are pinned-run-only."""
+    regressed = _fixture("ld_regressed_facts.json")
+    manifest = LoadManifest(cells=_fixture("ld_baseline_facts.json")["cells"])
+    findings = check_load(regressed, manifest, drift=False)
+    assert {f.rule for f in findings} == {"LD003"}
+
+
+def test_cell_set_drift():
+    baseline = _fixture("ld_baseline_facts.json")
+    manifest = LoadManifest(cells=baseline["cells"])
+    facts = {"cells": {"steady/w4": baseline["cells"]["steady/w4"],
+                       "new/w2": {"levels": {}, "census": {},
+                                  "twin_match": True, "knee_level": None}},
+             "params": baseline["params"]}
+    keys = {(f.rule, f.scenario, f.key)
+            for f in check_load(facts, manifest, drift=True)}
+    assert ("LD004", "new/w2", "+cell") in keys
+    gone = {"cells": {}, "params": baseline["params"]}
+    keys = {(f.rule, f.scenario, f.key)
+            for f in check_load(gone, manifest, drift=True)}
+    assert ("LD004", "steady/w4", "-cell") in keys
+
+
+def test_injected_regression_trips_ld001(swept):
+    """The acceptance proof: doubling the simulated decode latency is a
+    capacity regression the gate provably catches — the re-swept p99
+    TTFT blows past the committed surface and LD001 fires with a
+    replay token."""
+    from dynamo_tpu.load.sim import sweep
+    from dynamo_tpu.load.workers import LatencyModel
+
+    facts, _ = swept
+    manifest = LoadManifest(cells=facts["cells"])
+    base = LatencyModel.from_perf_manifest()
+    slow = LatencyModel(
+        prefill_ms_per_token=base.prefill_ms_per_token,
+        decode_ms_per_step=2 * base.decode_ms_per_step,
+        router_ms_per_decision=base.router_ms_per_decision)
+    cells = (("steady", "w4"), ("agentic", "w4"))
+    slow_facts = sweep(budget=1, seed_base=0, lat=slow, cells=cells)
+    findings = check_load(slow_facts, manifest, drift=True)
+    ld001 = [f for f in findings if f.rule == "LD001"]
+    assert ld001, "doubled decode latency must trip LD001"
+    assert any("replay dtl1." in f.detail for f in ld001)
+
+
+# ------------------------------------------------------------ replay token
+
+
+def test_token_roundtrip():
+    payload = {"family": "agentic", "topology": "w4", "level": 2.0,
+               "seed": 0, "target": 100}
+    tok = encode_token(payload)
+    assert tok.startswith("dtl1.")
+    assert decode_token(tok) == payload
+    with pytest.raises(ValueError):
+        decode_token("dtp1.notmine")
+
+
+def test_replay_runs_the_cell():
+    tok = encode_token({"family": "steady", "topology": "w1",
+                        "level": 0.5, "seed": 0, "target": 30})
+    out = io.StringIO()
+    rc = run_load(_args(replay=tok), out)
+    assert rc == 0
+    assert "steady/w1 level=0.5" in out.getvalue()
+
+
+def test_replay_rejects_foreign_tokens():
+    out = io.StringIO()
+    assert run_load(_args(replay="dtp1.abc"), out) == 2
+    assert "not a dtload replay token" in out.getvalue()
+
+
+# -------------------------------------------------------------- manifest
+
+
+def test_accepted_entry_budget_is_a_multiset():
+    f1 = LoadFinding("a/w1", "LD001", "p99:1", "x")
+    f2 = LoadFinding("a/w1", "LD001", "p99:1", "y")
+    m = LoadManifest(accepted=[{"scenario": "a/w1", "rule": "LD001",
+                                "key": "p99:1", "justification": "ok"}])
+    assert m.filter([f1, f2]) == [f2]  # one entry absorbs one finding
+
+
+def test_update_baseline_carries_justifications(tmp_path):
+    prev = LoadManifest(accepted=[{
+        "scenario": "a/w1", "rule": "LD001", "key": "p99:1",
+        "detail": "old", "justification": "known CPU jitter"}])
+    facts = {"cells": {"a/w1": {"levels": {}, "census": {},
+                                "twin_match": True, "knee_level": None}},
+             "params": {}}
+    f = LoadFinding("a/w1", "LD001", "p99:1", "new detail")
+    m = LoadManifest.from_facts(facts, [f], prev)
+    assert m.accepted[0]["justification"] == "known CPU jitter"
+    assert m.accepted[0]["detail"] == "new detail"
+    path = tmp_path / "m.json"
+    m.save(path)
+    again = LoadManifest.load(path)
+    assert again.accepted == m.accepted
+    assert again.cells == facts["cells"]
+
+
+def test_manifest_json_is_stable(tmp_path):
+    m = LoadManifest.load(DEFAULT_LOAD_MANIFEST_PATH)
+    p = tmp_path / "m.json"
+    m.save(p)
+    first = p.read_text()
+    LoadManifest.load(p).save(p)
+    assert p.read_text() == first
+
+
+def test_rule_registry_documented():
+    assert set(LOAD_RULES) == {"LD001", "LD002", "LD003", "LD004"}
+    assert all(LOAD_RULES[r] for r in LOAD_RULES)
+
+
+# ------------------------------------------------------------- CLI entry
+
+
+def _args(**kw):
+    base = dict(replay=None, manifest=None, root=None, changed=False,
+                update_baseline=False, fmt="text", load=True)
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+def test_run_load_clean_exit_zero():
+    out = io.StringIO()
+    rc = run_load(_args(), out)
+    assert rc == 0, out.getvalue()
+    assert "0 load findings" in out.getvalue()
+
+
+def test_run_load_json_output():
+    out = io.StringIO()
+    rc = run_load(_args(fmt="json"), out)
+    assert rc == 0
+    doc = json.loads(out.getvalue())
+    assert doc["findings"] == []
+    assert len(doc["cells"]) == 10
+    assert doc["runs"] > 0
+
+
+def test_update_baseline_refuses_non_pinned(monkeypatch, tmp_path):
+    monkeypatch.setenv("DTLOAD_BUDGET", "3")
+    out = io.StringIO()
+    rc = run_load(_args(update_baseline=True,
+                        manifest=str(tmp_path / "m.json")), out)
+    assert rc == 2
+    assert "refusing" in out.getvalue()
+    assert not (tmp_path / "m.json").exists()
+
+
+def test_non_pinned_run_skips_drift_rules(monkeypatch, tmp_path):
+    """A bigger budget or moved seed window explores freely: only LD003
+    can fire, so the nightly's extra seeds never produce drift noise."""
+    monkeypatch.setenv("DTLOAD_TARGET", "30")
+    out = io.StringIO()
+    rc = run_load(_args(manifest=str(tmp_path / "absent.json")), out)
+    # an absent manifest would mean +cell findings for every cell if
+    # drift ran; non-pinned must come back clean
+    assert rc == 0, out.getvalue()
