@@ -398,12 +398,26 @@ def run_sim(args):
     dynamo_tpu/load), so the rows come out in milliseconds of virtual
     time, seconds of wall clock, and are byte-reproducible per seed.
     Emits the same row/summary schema as the live sweep —
-    ``concurrency`` carries the offered rps, rounded."""
-    from dynamo_tpu.load.sim import LOAD_LEVELS, run_cell
+    ``concurrency`` carries the offered rps, rounded.
 
+    ``--sim-router-shards N`` swaps the singleton KV router for the
+    hash-partitioned sharded control plane (N scatter-gather index
+    replicas) and scrapes its counters into the summary."""
+    import dataclasses
+
+    from dynamo_tpu.engine.counters import kv_shard_counters
+    from dynamo_tpu.load.sim import LOAD_LEVELS, TOPOLOGIES, run_cell
+
+    topo = TOPOLOGIES[args.sim_topology]
+    shards = args.sim_router_shards
+    if shards and shards != topo.router_shards:
+        named = f"{args.sim_topology}r{shards}"
+        topo = TOPOLOGIES.get(named) or dataclasses.replace(
+            topo, name=named, router_shards=shards)
+    kv_shard_counters.reset()
     rows = []
-    for level in LOAD_LEVELS:
-        res = run_cell(args.sim, args.sim_topology, seed=args.sim_seed,
+    for level in topo.levels or LOAD_LEVELS:
+        res = run_cell(args.sim, topo, seed=args.sim_seed,
                        level=level, target_requests=args.sim_target)
         m = res["metrics"]
         row = {
@@ -423,8 +437,15 @@ def run_sim(args):
                "value": best["output_tok_s"], "unit": "tok/s",
                "best_concurrency": best["concurrency"],
                "sim_family": args.sim,
-               "sim_topology": args.sim_topology,
+               "sim_topology": topo.name,
                "sim_seed": args.sim_seed}
+    if topo.router_shards > 1:
+        sc = kv_shard_counters
+        summary["sim_router_shards"] = topo.router_shards
+        summary["shard_scatters_total"] = sc.scatters_total
+        summary["shard_gather_partial_total"] = sc.gather_partial_total
+        summary["shard_gather_partial_frac"] = round(
+            sc.gather_partial_frac, 4)
     print(json.dumps(summary))
     return rows
 
@@ -459,6 +480,10 @@ def main(argv=None):
     p.add_argument("--sim-target", type=int, default=None,
                    help="with --sim: requests at level 1.0 "
                         "(default: the load plane's pinned target)")
+    p.add_argument("--sim-router-shards", type=int, default=None,
+                   help="with --sim: partition the KV-router prefix "
+                        "index across N scatter-gather shards "
+                        "(default: the topology's own shard count)")
     args = p.parse_args(argv)
     args._in_process = bool(args.native or args.spawn_echo)
     if args.sim:

@@ -18,6 +18,11 @@ resulting capacity surface against the committed
            by justification — fix the leak)
     LD004  scenario census drift: the cell grid, load levels, or a
            cell's event census changed shape vs the manifest
+    LD005  shard scaling violated: a sharded-router cell (wNrK, K>1)
+           fails to knee strictly later than its singleton twin (wNr1),
+           or fails to sustain >= 2x the singleton's offered load
+           before its knee — the structural claim of the sharded
+           control plane (never acceptable by justification)
 
 Same contract as the other seven planes: accepted findings carry a
 one-line justification and match as a (scenario, rule, key) multiset;
@@ -25,7 +30,8 @@ one-line justification and match as a (scenario, rule, key) multiset;
 drift rules (LD001/LD002/LD004) only judge the pinned default sweep —
 DTLOAD_BUDGET/DTLOAD_SEED_BASE/DTLOAD_TARGET/DTLOAD_SCALE overrides
 explore more seeds or other operating points without drift noise
-(LD003 still applies: determinism must hold at every seed).
+(LD003 still applies: determinism must hold at every seed; LD005, like
+LD003, can never be baked into the baseline).
 
 Every LD001/LD002 finding carries a ``dtl1.`` replay token; ``lint
 --load --replay TOKEN`` re-runs exactly that cell and prints its
@@ -69,6 +75,8 @@ LOAD_RULES = {
     "LD002": "SLA knee moved to a lower offered-load level",
     "LD003": "same-seed twin runs diverged (nondeterminism)",
     "LD004": "cell grid / level / census drifted from the manifest",
+    "LD005": "sharded-router cell fails its scaling claim vs the "
+             "singleton twin (knee not later, or < 2x sustained load)",
 }
 
 # drift rules are resolved by re-snapshotting, not by justification
@@ -227,6 +235,46 @@ def _knee_rank(knee) -> float:
     return float("inf") if knee is None else float(knee)
 
 
+def _sustained_rps(cell_obs: dict) -> float:
+    """Highest offered load the cell held BEFORE its SLA knee (or over
+    the whole grid when it never kneed)."""
+    knee = _knee_rank(cell_obs.get("knee_level"))
+    held = [m.get("offered_rps", 0.0)
+            for lvl, m in cell_obs.get("levels", {}).items()
+            if float(lvl) < knee]
+    return max(held, default=0.0)
+
+
+def _shard_scaling(facts: dict) -> list[LoadFinding]:
+    """LD005: every sharded-router cell must beat its singleton twin —
+    the load manifest is the committed proof of ROADMAP item 1."""
+    findings: list[LoadFinding] = []
+    cells = facts["cells"]
+    for cell in sorted(cells):
+        family, topo = cell.split("/", 1)
+        base, _, k = topo.rpartition("r")
+        if not base or not k.isdigit() or int(k) <= 1:
+            continue
+        singleton = f"{family}/{base}r1"
+        if singleton not in cells:
+            continue
+        obs, ref = cells[cell], cells[singleton]
+        if _knee_rank(obs.get("knee_level")) <= \
+                _knee_rank(ref.get("knee_level")):
+            findings.append(LoadFinding(
+                cell, "LD005", "knee",
+                f"knee at level {obs.get('knee_level')} is not strictly "
+                f"later than the singleton twin's "
+                f"({ref.get('knee_level')})"))
+        held, ref_held = _sustained_rps(obs), _sustained_rps(ref)
+        if held < 2.0 * ref_held:
+            findings.append(LoadFinding(
+                cell, "LD005", "sustained",
+                f"sustains {held:.2f} rps before the knee vs singleton "
+                f"{ref_held:.2f} rps — below the 2x scaling claim"))
+    return findings
+
+
 def check_load(facts: dict, manifest: LoadManifest, *,
                drift: bool = True, seed_base: int = 0) -> list[LoadFinding]:
     """Diff an observed sweep against the committed surface."""
@@ -240,6 +288,9 @@ def check_load(facts: dict, manifest: LoadManifest, *,
                 "different canonical bytes"))
     if not drift:
         return findings
+    # the scaling claim is a property of the pinned surface itself, not
+    # a diff against the manifest — judged whenever drift rules are
+    findings.extend(_shard_scaling(facts))
     com_cells = manifest.cells
     for cell in sorted(set(facts["cells"]) - set(com_cells)):
         findings.append(LoadFinding(
@@ -376,7 +427,7 @@ def run_load(args, out) -> int:
             return 2
         return _replay(token, getattr(args, "fmt", "text"), out)
 
-    from dynamo_tpu.load.sim import CELLS, LOAD_LEVELS, sweep
+    from dynamo_tpu.load.sim import CELLS, sweep
 
     manifest_path = Path(
         getattr(args, "manifest", None) or DEFAULT_LOAD_MANIFEST_PATH)
@@ -392,18 +443,23 @@ def run_load(args, out) -> int:
     # seeds or a different target/scale legitimately move the surface
     findings = check_load(facts, manifest, drift=pinned,
                           seed_base=seed_base)
-    n_runs = len(facts["cells"]) * (len(LOAD_LEVELS) + 2 * budget - 1)
+    # per-cell level grids may differ (sharded-router cells sweep a
+    # wider ladder), so count from the observed facts
+    n_runs = sum(len(c.get("levels", {})) + 2 * budget - 1
+                 for c in facts["cells"].values())
 
     if getattr(args, "update_baseline", False):
         if not pinned:
             print("refusing to update the load manifest from a "
                   "non-default-budget/seed/target run", file=out)
             return 2
-        # LD003 is never baked into the baseline: a nondeterministic
-        # surface can't be a reference point
+        # LD003/LD005 are never baked into the baseline: neither a
+        # nondeterministic surface nor one that fails the sharding
+        # claim can be a reference point
         keep = [f for f in findings
-                if f.rule not in _DRIFT_RULES and f.rule != "LD003"]
-        ld3 = [f for f in findings if f.rule == "LD003"]
+                if f.rule not in _DRIFT_RULES
+                and f.rule not in ("LD003", "LD005")]
+        ld3 = [f for f in findings if f.rule in ("LD003", "LD005")]
         LoadManifest.from_facts(facts, keep, manifest).save(manifest_path)
         print(
             f"load manifest updated: {len(facts['cells'])} cell"
@@ -415,9 +471,9 @@ def run_load(args, out) -> int:
         if ld3:
             for f in ld3:
                 print(f.render(), file=out)
-            print(f"{len(ld3)} determinism finding"
+            print(f"{len(ld3)} determinism/scaling finding"
                   f"{'' if len(ld3) == 1 else 's'} NOT accepted — fix "
-                  "the leak", file=out)
+                  "the regression", file=out)
             return 1
         return 0
 

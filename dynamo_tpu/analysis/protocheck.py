@@ -289,6 +289,7 @@ class Harness:
         self.root = root
         self.data_dir = root / "coord"
         self.bug = bug
+        self.fault = crash          # any injected plan, crash or sever
         self.crash = crash if crash and crash.kind == "crash" else None
         if crash and crash.kind == "sever":
             net.sever_conn_after(crash.conn, crash.after_frames,
@@ -606,6 +607,35 @@ def _make_eager_known_replicator():
     return _EagerKnownReplicator
 
 
+def _make_stale_gen_replica():
+    from dynamo_tpu.llm.kv_router.shards.lifecycle import ShardReplica
+    from dynamo_tpu.llm.kv_router.shards.scatter import probe_shard
+    from dynamo_tpu.llm.kv_router.shards.wire import (
+        decode_scatter_request,
+        encode_scatter_reply,
+    )
+
+    class _StaleGenShardReplica(ShardReplica):
+        """Fence bug: echoes the REQUEST's generation in scatter replies
+        instead of the replica's own map generation.  A replica that
+        missed a membership change (partition, slow watch) forges
+        currency, and its pre-handoff holder data merges into gathers it
+        no longer has any right to answer."""
+
+        def _on_scatter(self, subject: str, payload: bytes) -> None:
+            try:
+                request_id, shard_id, seq_hashes, gen, reply_subject = (
+                    decode_scatter_request(payload))
+            except Exception:
+                return
+            reply = probe_shard(self.index.shard(shard_id), shard_id,
+                                self.index.n_shards, seq_hashes, gen)
+            self._spawn(self.coord.publish(
+                reply_subject, encode_scatter_reply(request_id, reply)))
+
+    return _StaleGenShardReplica
+
+
 _BUG_IMPLS: dict[str, dict[str, Any]] = {
     "reorder-truncate": {"server": _ReorderedTruncateServer},
     "stranded-pull": {"server": _StrandedPullServer},
@@ -617,6 +647,9 @@ _BUG_IMPLS: dict[str, dict[str, Any]] = {
     # session opens, before a single layer frame lands — the exact
     # notify-races-KV hazard the stream_end ordering contract forbids
     "notify-early": {"stream_notify_early": True},
+    # router.shard fence bug: a scatter reply that forges the gather's
+    # generation — the resurrected stale-shard-after-handoff class
+    "stale-generation": {"shard_replica": _make_stale_gen_replica},
 }
 
 
@@ -1185,6 +1218,208 @@ async def _run_kv_stream(h: Harness) -> None:
     await h.teardown()
 
 
+async def _run_router_shard(h: Harness) -> None:
+    """Sharded control plane (llm/kv_router/shards) under membership
+    churn on the real coordinator pub/sub plane: two replicas host a
+    4-shard partition fed from the live KV event stream; a third joins
+    (index handoff with generation fence), then one replica is
+    half-partitioned (serves scatters, sees neither events nor
+    membership) and declared dead.  Safety: a gather never merges a
+    reply whose generation it did not ask for, so scores never exceed
+    the singleton truth index — the stale-generation bug variant breaks
+    exactly this.  Liveness: a gather with missing shards still
+    completes, degraded."""
+    from dynamo_tpu.llm.kv.events import (
+        KvRemovedEvent,
+        KvStoredEvent,
+        event_to_wire,
+    )
+    from dynamo_tpu.llm.kv_router.indexer import KvIndexer
+    from dynamo_tpu.llm.kv_router.shards import (
+        PubSubShardClient,
+        ShardReplica,
+        gather_overlaps,
+        shard_of,
+    )
+    from dynamo_tpu.tokens import sequence_hashes
+
+    replica_cls = h.pick("shard_replica", None)
+    replica_cls = replica_cls() if callable(replica_cls) \
+        and replica_cls is not None else ShardReplica
+
+    srv, ok = await h.start_coordinator(durable=False)
+    if not ok:
+        await h.teardown()
+        return
+    n_shards = 4
+    clean = h.fault is None
+    ev_subject = "ns.kv_events.w"
+    net_errs = (ConnectionError, OSError, RuntimeError,
+                asyncio.TimeoutError)
+
+    async def up(replica: "ShardReplica") -> bool:
+        try:
+            await replica.start()
+            await replica.subscribe_events(ev_subject)
+            return True
+        except net_errs:
+            return False
+
+    ca, cb, cg = await h.client(), await h.client(), await h.client()
+    ra = replica_cls(ca, "ra", n_shards, namespace="ns")
+    rb = replica_cls(cb, "rb", n_shards, namespace="ns")
+    ra_ok, rb_ok = await up(ra), await up(rb)
+    await asyncio.sleep(1.0)
+    if clean:
+        h.check("shard_replicas_start", ra_ok and rb_ok,
+                "replica registration failed on a fault-free run")
+        h.check("shard_maps_converge",
+                ra.map.generation == rb.map.generation
+                and ra.map.owners == rb.map.owners,
+                f"maps diverge: ra gen {ra.map.generation} owners "
+                f"{ra.map.owners} vs rb gen {rb.map.generation} owners "
+                f"{rb.map.owners}")
+
+    # worker KV events on the live plane: w1 and w2 share a 3-block
+    # prefix, w1 continues for 3 more blocks; truth is a singleton
+    # KvIndexer fed the same logical events directly
+    truth = KvIndexer(use_native=False)
+    seq1 = sequence_hashes(list(range(1, 97)), 16)               # 6 blocks
+    seq2 = sequence_hashes(
+        list(range(1, 49)) + list(range(1000, 1048)), 16)        # 3+3 blocks
+    eid = 0
+    for wid, hashes in ((1, seq1), (2, seq2)):
+        ev = KvStoredEvent(block_hashes=list(hashes))
+        truth.apply_event(wid, ev)
+        eid += 1
+        st, _ = await h.op(cg.publish, ev_subject,
+                           event_to_wire(eid, wid, ev))
+    await asyncio.sleep(1.0)
+
+    query = list(seq1)
+    probes = []
+    for s in range(n_shards):
+        cli = PubSubShardClient(cg, "ns", s, "g")
+        try:
+            await cli.start()
+        except net_errs:
+            pass          # probes through a dead inbox just time out
+        probes.append(cli)
+
+    async def scatter(generation: int) -> dict:
+        async def one(cli):
+            try:
+                return await asyncio.wait_for(
+                    cli.probe(query, generation), 5.0)
+            except net_errs:
+                return None
+        results = await asyncio.gather(*(one(c) for c in probes))
+        return dict(enumerate(results))
+
+    def overcount(scores, ref) -> str:
+        bad = [(w, s, ref.scores.get(w, 0))
+               for w, s in scores.scores.items()
+               if s > ref.scores.get(w, 0)]
+        bad += [(w, s, ref.persist_scores.get(w, 0))
+                for w, s in scores.persist_scores.items()
+                if s > ref.persist_scores.get(w, 0)]
+        return ", ".join(f"w{w}: {s} > truth {t}" for w, s, t in bad)
+
+    tr = truth.find_matches(query)
+    gen1 = ra.map.generation
+    scores1, partial1 = gather_overlaps(query, n_shards,
+                                        await scatter(gen1), gen1)
+    if clean:
+        h.check("shard_gather_matches_truth",
+                not partial1 and scores1.scores == tr.scores
+                and scores1.persist_scores == tr.persist_scores,
+                f"clean gather {scores1.scores} (partial={partial1}) != "
+                f"singleton truth {tr.scores}")
+
+    # third replica joins: the ranges it inherits predate its event
+    # subscription, so every byte it serves for them arrived via the
+    # handoff frames its join triggered
+    cc = await h.client()
+    rc = replica_cls(cc, "rc", n_shards, namespace="ns")
+    rc_ok = await up(rc)
+    await asyncio.sleep(2.0)
+    gen2 = ra.map.generation
+    if clean:
+        h.check("shard_maps_converge",
+                rc_ok and ra.map.owners == rb.map.owners == rc.map.owners
+                and ra.map.generation == rb.map.generation
+                == rc.map.generation,
+                "maps did not reconverge after a join")
+        scores2, partial2 = gather_overlaps(query, n_shards,
+                                            await scatter(gen2), gen2)
+        h.check("shard_handoff_delivers",
+                not partial2 and scores2.scores == tr.scores,
+                f"post-join gather {scores2.scores} (partial={partial2}) "
+                f"!= truth {tr.scores} — moved ranges lost in handoff")
+
+    # half-partition the replica owning the query's 4th position: its
+    # scatter subscriptions stay live (it still answers probes) but it
+    # sees neither further events nor the membership change that
+    # declares it dead — the stale-shard-after-handoff surface
+    by_id = {"ra": ra, "rb": rb, "rc": rc}
+    victim = by_id.get(
+        ra.map.owner(shard_of(query[3], n_shards))) or rb
+    if victim._ev_sub is not None:
+        await h.op(victim.coord.unsubscribe, victim._ev_sub)
+        victim._ev_sub = None
+    if victim._watch_id is not None:
+        await h.op(victim.coord.unwatch, victim._watch_id)
+        victim._watch_id = None
+    if victim._lease is not None:
+        await h.op(victim.coord.lease_revoke, victim._lease)
+        victim._lease = None
+    await asyncio.sleep(2.0)
+    survivors = [r for r in (ra, rb, rc) if r is not victim]
+    gen3 = survivors[0].map.generation
+    if clean:
+        h.check("shard_rebind_after_death", gen3 != gen2,
+                "membership delete did not rebind the survivors")
+
+    # the dead replica's blocks age out of the workers: w1 evicts its
+    # tail — the removal reaches the survivors but NOT the partitioned
+    # victim, whose frozen index now overstates w1
+    rm = KvRemovedEvent(block_hashes=list(seq1[3:]))
+    truth.apply_event(1, rm)
+    eid += 1
+    await h.op(cg.publish, ev_subject, event_to_wire(eid, 1, rm))
+    await asyncio.sleep(1.0)
+    tr3 = truth.find_matches(query)
+    scores3, _partial3 = gather_overlaps(query, n_shards,
+                                         await scatter(gen3), gen3)
+    if clean:
+        # the victim still answers its old shards with its old
+        # generation; the fence must keep that data out of the merge
+        h.check("shard_no_stale_overcount",
+                not overcount(scores3, tr3),
+                f"stale shard data merged past the fence: "
+                f"{overcount(scores3, tr3)}")
+
+    # total outage: with every replica stopped, the scatter times out
+    # shard by shard and the gather still completes, fully degraded
+    for r in (ra, rb, rc):
+        try:
+            await asyncio.wait_for(r.stop(), 10.0)
+        except net_errs:
+            pass
+    scores4, partial4 = gather_overlaps(query, n_shards,
+                                        await scatter(gen3), gen3)
+    h.check("shard_gather_completes_degraded",
+            partial4 and not overcount(scores4, tr3),
+            f"all-shards-down gather: partial={partial4}, "
+            f"scores={scores4.scores}")
+    for cli in probes:
+        try:
+            await asyncio.wait_for(cli.stop(), 10.0)
+        except net_errs:
+            pass
+    await h.teardown()
+
+
 # ----------------------------------------------------------- crash matrices
 
 
@@ -1242,6 +1477,28 @@ def _stream_plans(base: "RunResult", budget: int) -> list[CrashPlan]:
             CrashPlan(kind="sever", conn=1, after_frames=k + 1,
                       direction=direction)
             for k in range(cap))
+    return plans
+
+
+def _shard_plans(base: "RunResult", budget: int) -> list[CrashPlan]:
+    # sever a replica's conn (2: clients dial ra, rb, gatherer, rc) and
+    # the gatherer's (3) at spread frame offsets, both directions —
+    # replica death lands mid-scatter, mid-handoff and mid-membership
+    # depending on the offset; the gatherer cut exercises partial
+    # gathers and probe-publish failures
+    plans: list[CrashPlan] = []
+    for conn in (2, 3):
+        for direction in ("s2c", "c2s"):
+            frames = base.frame_counts.get(f"coord/{conn}/{direction}", 0)
+            if not frames:
+                continue
+            cap = min(frames, 3 * budget)
+            cuts = sorted({max(1, (k + 1) * frames // (cap + 1))
+                           for k in range(cap)})
+            plans.extend(
+                CrashPlan(kind="sever", conn=conn, after_frames=n,
+                          direction=direction)
+                for n in cuts)
     return plans
 
 
@@ -1342,6 +1599,21 @@ SCENARIOS: dict[str, Scenario] = {
             touches=("llm/kv/stream", "llm/kv/transfer",
                      "runtime/transports/framing",
                      "runtime/transports/protocol"),
+        ),
+        Scenario(
+            name="router.shard",
+            run=_run_router_shard,
+            plans=_shard_plans,
+            seeds=3,
+            invariants=("shard_replicas_start", "shard_maps_converge",
+                        "shard_gather_matches_truth",
+                        "shard_handoff_delivers",
+                        "shard_rebind_after_death",
+                        "shard_no_stale_overcount",
+                        "shard_gather_completes_degraded"),
+            touches=("llm/kv_router/shards", "llm/kv_router/indexer",
+                     "utils/chash",
+                     "runtime/transports/coordinator"),
         ),
     ]
 }

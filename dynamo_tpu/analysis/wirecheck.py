@@ -509,6 +509,10 @@ class _ReadWalker:
         self.p = profile
         self.depth = depth
         self.aliases: dict[str, str] = {}    # local name -> disc key
+        # locals that ARE the root's payload (d = json.loads(root)):
+        # the decode-helper idiom, where the raw bytes arrive as a
+        # param and every key read happens on the parsed local
+        self.loads_roots: set[str] = set()
 
     # ------------------------------------------------------------ plumbing
     def run(self, body, tags=frozenset(), guarded=frozenset()):
@@ -561,7 +565,8 @@ class _ReadWalker:
 
     # ------------------------------------------------------------- pieces
     def is_root(self, expr) -> bool:
-        return isinstance(expr, ast.Name) and expr.id == self.root
+        return isinstance(expr, ast.Name) and (
+            expr.id == self.root or expr.id in self.loads_roots)
 
     def read_key_of(self, expr):
         """("key", required) if expr reads one key off the root."""
@@ -611,6 +616,10 @@ class _ReadWalker:
                 if key in DISC_KEYS:
                     self.aliases[t.id] = key
                     self.p.discs.add(key)
+            if (isinstance(t, ast.Name) and isinstance(v, ast.Call)
+                    and self.ext.canon(v, self.ctx) == "json.loads"
+                    and v.args and self.is_root(v.args[0])):
+                self.loads_roots.add(t.id)
         self.expr_scan(st, tags, guarded)
 
     def analyze_test(self, test):
@@ -785,6 +794,13 @@ class _Extractor:
                                 if isinstance(d, ast.Dict):
                                     return (d, v.id, t.node, tctx,
                                             t.module)
+                            if isinstance(v, (ast.Call, ast.BinOp)):
+                                # encode-helper idiom: the target
+                                # returns json.dumps({...}).encode()
+                                sub = self._as_dict_source(
+                                    v, t, tctx, depth + 1)
+                                if isinstance(sub, tuple):
+                                    return sub
             return "opaque"
         if isinstance(expr, ast.Name):
             a = self._local_assign(fn.node, expr.id)
@@ -1105,6 +1121,25 @@ class _Extractor:
         for name, base in roots:
             profile = _Profile()
             _ReadWalker(self, fn, ctx, name, profile).run(fn.node.body)
+            if not profile.empty:
+                self.consumers.append(_Consumer(fn.module, base,
+                                                profile))
+
+        # consumer: a subscribe-callback whose payload is parsed by a
+        # decode helper (the json.loads lives in the callee) — profile
+        # the param itself; callbacks that json.loads inline are
+        # already rooted above, so skip them to avoid double counting
+        for p in _param_names(fn.node):
+            base = self.callback_channels.get((fn.qualname, p))
+            if base is None:
+                continue
+            if any(isinstance(n, ast.Call)
+                   and self.canon(n, ctx) == "json.loads"
+                   and n.args and isinstance(n.args[0], ast.Name)
+                   and n.args[0].id == p
+                   for n in ast.walk(fn.node)):
+                continue
+            profile = self.param_profile(fn, p)
             if not profile.empty:
                 self.consumers.append(_Consumer(fn.module, base,
                                                 profile))

@@ -13,7 +13,8 @@ from collections import defaultdict
 from typing import Iterator
 
 from dynamo_tpu.engine.counters import counters as prefill_counters
-from dynamo_tpu.engine.counters import kv_stream_counters, persist_counters
+from dynamo_tpu.engine.counters import (kv_shard_counters, kv_stream_counters,
+                                        persist_counters)
 from dynamo_tpu.fault.counters import counters as fault_counters
 from dynamo_tpu.obs.costs import transfer_costs
 from dynamo_tpu.obs.perfmodel import perf_model
@@ -24,6 +25,7 @@ FAULT_PREFIX = "dynamo_tpu_fault"
 ENGINE_PREFIX = "dynamo_tpu_engine"
 KV_PREFIX = "dynamo_tpu_kv_transfer"
 STREAM_PREFIX = "dynamo_tpu_kv_stream"
+SHARD_PREFIX = "dynamo_tpu_kv_shard"
 PERF_PREFIX = "dynamo_tpu_perf"
 
 # seconds; TTFT and whole-request durations share one ladder
@@ -198,6 +200,40 @@ class Metrics:
         lines.append(f"# TYPE {STREAM_PREFIX}_overlap_ratio gauge")
         lines.append(f"{STREAM_PREFIX}_overlap_ratio "
                      f"{round(kv_stream_counters.overlap_ratio, 6)}")
+        # sharded control plane (llm/kv_router/shards/): scatter rounds,
+        # partial gathers (a shard missed its deadline or answered behind
+        # the generation fence), fan-out latency, per-shard index gauges
+        sc = kv_shard_counters
+        lines.append(f"# TYPE {SHARD_PREFIX}_scatters_total counter")
+        lines.append(f"{SHARD_PREFIX}_scatters_total {sc.scatters_total}")
+        lines.append(f"# TYPE {SHARD_PREFIX}_gather_partial_total counter")
+        lines.append(f"{SHARD_PREFIX}_gather_partial_total "
+                     f"{sc.gather_partial_total}")
+        lines.append(f"# TYPE {SHARD_PREFIX}_generation gauge")
+        lines.append(f"{SHARD_PREFIX}_generation {sc.generation}")
+        lines.append(f"# TYPE {SHARD_PREFIX}_fanout_latency_ms histogram")
+        for edge, count in zip(sc.FANOUT_BUCKETS_MS,
+                               sc.fanout_bucket_counts):
+            lines.append(
+                f'{SHARD_PREFIX}_fanout_latency_ms_bucket{{le="{edge}"}} '
+                f"{count}")
+        lines.append(f'{SHARD_PREFIX}_fanout_latency_ms_bucket{{le="+Inf"}} '
+                     f"{sc.scatters_total}")
+        lines.append(f"{SHARD_PREFIX}_fanout_latency_ms_sum "
+                     f"{round(sc.fanout_ms_sum, 6)}")
+        lines.append(f"{SHARD_PREFIX}_fanout_latency_ms_count "
+                     f"{sc.scatters_total}")
+        if sc.index_blocks:
+            lines.append(f"# TYPE {SHARD_PREFIX}_index_blocks gauge")
+            for shard_id, blocks in sorted(sc.index_blocks.items()):
+                lines.append(
+                    f'{SHARD_PREFIX}_index_blocks{{shard="{shard_id}"}} '
+                    f"{blocks}")
+            lines.append(f"# TYPE {SHARD_PREFIX}_resident_keys gauge")
+            for shard_id, keys in sorted(sc.resident_keys.items()):
+                lines.append(
+                    f'{SHARD_PREFIX}_resident_keys{{shard="{shard_id}"}} '
+                    f"{keys}")
         # dtspan engine step timeline: per-phase wall attribution plus the
         # headline host bubble (ROADMAP item 3's committed before-number)
         tl = step_timeline.snapshot()

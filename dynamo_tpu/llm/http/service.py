@@ -26,6 +26,7 @@ from typing import AsyncIterator, Optional
 
 from aiohttp import web
 
+from dynamo_tpu.llm.http.affinity import SessionAffinity
 from dynamo_tpu.llm.http.metrics import Metrics
 from dynamo_tpu.llm.model_card import ModelDeploymentCard
 from dynamo_tpu.llm.openai import (
@@ -91,9 +92,13 @@ class ModelManager:
 
 class HttpService:
     def __init__(self, manager: Optional[ModelManager] = None, host: str = "127.0.0.1", port: int = 8080,
-                 admission=None):
+                 admission=None, affinity: Optional[SessionAffinity] = None):
         self.manager = manager or ModelManager()
         self.metrics = Metrics()
+        # consistent-hash session affinity (llm/http/affinity.py): with N
+        # stateless frontends, route a multi-turn session to the replica
+        # whose persist tier is warm.  None = singleton frontend, no-op.
+        self.affinity = affinity
         # optional planner AdmissionController: per-tenant rate limits,
         # priority classes, deadline-aware shedding (429 + Retry-After).
         # Its wait estimates feed off this service's live TTFT plane.
@@ -179,6 +184,21 @@ class HttpService:
         # client-supplied correlation id: accepted, propagated as the
         # engine-side request id, and echoed back on every response
         xrid = request.headers.get("x-request-id") or ""
+        # session affinity: multi-turn callers tag their session so all
+        # turns land where the persist tier is warm
+        session = (request.headers.get("x-session-id")
+                   or body.get("session_id") or "")
+        affinity = None
+        if self.affinity is not None and session:
+            affinity = await self.affinity.resolve(session)
+            if not affinity.is_local and self.affinity.redirect \
+                    and affinity.redirect_url:
+                return web.json_response(
+                    {"redirect": "session affinity"},
+                    status=307,
+                    headers={"Location": affinity.redirect_url,
+                             "x-affinity-owner": affinity.owner,
+                             "x-affinity-source": affinity.source})
         # dtspan root: every downstream span (engine, coordinator hop,
         # remote prefill, KV transfer) parents under this one trace
         span = tracing.start_span(
@@ -247,11 +267,18 @@ class HttpService:
                     pass
             streams = [entry.engine.generate(c) for c in ctxs]
             if parsed.stream:
-                return await self._stream_response(
+                resp = await self._stream_response(
                     request, ctxs, streams, rid, parsed, chat, guard,
-                    xrid=xrid)
-            return await self._unary_response(
-                ctxs, streams, rid, parsed, chat, guard, xrid=xrid)
+                    xrid=xrid, affinity=affinity)
+            else:
+                resp = await self._unary_response(
+                    ctxs, streams, rid, parsed, chat, guard, xrid=xrid,
+                    affinity=affinity)
+            if self.affinity is not None and session:
+                # our persist tier is warm for this session now — record
+                # it so peers resolve future turns here on affinity miss
+                await self.affinity.note_served(session)
+            return resp
         except OpenAIError as e:
             if guard:
                 guard.status("error")
@@ -298,6 +325,7 @@ class HttpService:
         self, request: web.Request, ctxs: list[Context],
         streams: list[AsyncIterator[LLMEngineOutput]],
         rid: str, parsed, chat: bool, guard, xrid: str = "",
+        affinity=None,
     ) -> web.StreamResponse:
         headers = {
             "Content-Type": "text/event-stream",
@@ -306,6 +334,9 @@ class HttpService:
         }
         if xrid:
             headers["x-request-id"] = xrid
+        if affinity is not None and affinity.owner:
+            headers["x-affinity-owner"] = affinity.owner
+            headers["x-affinity-source"] = affinity.source
         resp = web.StreamResponse(headers=headers)
         await resp.prepare(request)
         n = len(streams)
@@ -405,6 +436,7 @@ class HttpService:
     async def _unary_response(
         self, ctxs: list[Context], streams: list[AsyncIterator[LLMEngineOutput]],
         rid: str, parsed, chat: bool, guard, xrid: str = "",
+        affinity=None,
     ) -> web.Response:
         n = len(streams)
         texts: list[list[str]] = [[] for _ in range(n)]
@@ -476,4 +508,7 @@ class HttpService:
             headers["x-migrated"] = str(migrated)
         if xrid:
             headers["x-request-id"] = xrid
+        if affinity is not None and affinity.owner:
+            headers["x-affinity-owner"] = affinity.owner
+            headers["x-affinity-source"] = affinity.source
         return web.json_response(resp, headers=headers or None)

@@ -123,6 +123,28 @@ class KvIndexer:
                 scores[w] = i + 1
         return scores
 
+    # Per-position probes for the sharded control plane (shards/): a
+    # gather walk asks the shard owning position i for exactly that
+    # hash's holder set instead of running a full find_matches.  Python
+    # path only — shard replicas are built with use_native=False, and
+    # the native index exposes no single-hash probe.
+    def holders_of(self, h: int) -> frozenset[int]:
+        """Device-tier workers holding block hash ``h``."""
+        if self._native is not None:
+            raise RuntimeError("holders_of: native index has no probe path")
+        return frozenset(self._holders.get(h, ()))
+
+    def persist_holders_of(self, h: int) -> frozenset[int]:
+        """Persist-tier workers holding block hash ``h``."""
+        return frozenset(self._persist_holders.get(h, ()))
+
+    @property
+    def resident_keys(self) -> int:
+        """Distinct block hashes resident across both tiers — the
+        /metrics per-shard gauge (persist keys that also exist on device
+        count once per tier; the gauge tracks index memory, not bytes)."""
+        return self.num_blocks + len(self._persist_holders)
+
     @property
     def num_blocks(self) -> int:
         if self._native is not None:

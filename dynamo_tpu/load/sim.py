@@ -38,6 +38,8 @@ from dynamo_tpu.llm.kv_router.scheduler import (
     DefaultWorkerSelector,
     KvScheduler,
 )
+from dynamo_tpu.llm.kv_router.shards.indexer import ShardedKvIndexer
+from dynamo_tpu.utils.chash import HashRing
 from dynamo_tpu.load.traffic import FAMILIES, generate
 from dynamo_tpu.load.workers import LatencyModel, SimWorker, SimWorkerDied
 from dynamo_tpu.obs.costs import TransferCostTable
@@ -76,27 +78,65 @@ class Topology:
     n_prefill: int = 0          # of n_workers, when disagg
     slots: int = 8
     kv_blocks: int = 4096
+    # sharded control plane (llm/kv_router/shards/): number of router
+    # replicas, each owning a hash partition of the prefix index and
+    # serializing only its own decisions.  1 = the singleton router.
+    router_shards: int = 1
+    # per-topology router decision cost override (ms).  The default
+    # LatencyModel prices a decision at its micro-benchmarked Python
+    # cost, where the pool is the wall at any modeled scale; the
+    # router-stress topologies below price it at the production-index
+    # per-decision cost instead (full radix walk + scoring over a large
+    # fleet) — the regime ROADMAP item 1 targets — so the singleton
+    # router IS the binding constraint and sharding is measurable.
+    router_ms: Optional[float] = None
+    # per-topology offered-load grid override (None = LOAD_LEVELS);
+    # the r-cells need headroom levels to locate each shard count's knee
+    levels: Optional[tuple[float, ...]] = None
 
     @property
     def n_decode(self) -> int:
         return self.n_workers - (self.n_prefill if self.disagg else 0)
 
 
+# offered-load grid for the router-stress cells: levels are priced off
+# the SINGLETON's capacity for every shard count (see _derive), so the
+# same level means the same absolute offered rps across r1/r2/r4 and
+# knee levels are directly comparable.  120ms/decision keeps the
+# singleton router wall ~10x below the pool wall, so every grid level
+# up to 8x stays in the router-bound regime and the knee movement is
+# attributable to sharding alone.
+ROUTER_STRESS_MS = 120.0
+SHARD_LEVELS: tuple[float, ...] = (0.5, 1.0, 2.0, 4.0, 8.0)
+
 TOPOLOGIES: dict[str, Topology] = {
     t.name: t for t in [
         Topology(name="w1", n_workers=1),
         Topology(name="w4", n_workers=4),
         Topology(name="w16", n_workers=16, disagg=True, n_prefill=4),
+        # router-stress trio: identical pool, identical SLA, identical
+        # offered-load pricing — only the shard count varies
+        Topology(name="w16r1", n_workers=16, disagg=True, n_prefill=4,
+                 router_shards=1, router_ms=ROUTER_STRESS_MS,
+                 levels=SHARD_LEVELS),
+        Topology(name="w16r2", n_workers=16, disagg=True, n_prefill=4,
+                 router_shards=2, router_ms=ROUTER_STRESS_MS,
+                 levels=SHARD_LEVELS),
+        Topology(name="w16r4", n_workers=16, disagg=True, n_prefill=4,
+                 router_shards=4, router_ms=ROUTER_STRESS_MS,
+                 levels=SHARD_LEVELS),
     ]
 }
 
 # the committed capacity grid: every family on every topology except the
-# steady floor twice over — 10 cells spanning 4 families x 3 topologies
+# steady floor twice over — 10 cells spanning 4 families x 3 topologies,
+# plus the sharded-router trio on the session-heavy agentic family
 CELLS: tuple[tuple[str, str], ...] = (
     ("steady", "w1"), ("steady", "w4"), ("steady", "w16"),
     ("agentic", "w1"), ("agentic", "w4"), ("agentic", "w16"),
     ("burst", "w4"), ("burst", "w16"),
     ("failure", "w4"), ("failure", "w16"),
+    ("agentic", "w16r1"), ("agentic", "w16r2"), ("agentic", "w16r4"),
 )
 
 LOAD_LEVELS: tuple[float, ...] = (0.5, 1.0, 2.0)
@@ -126,6 +166,12 @@ class _Derived:
     service_s: float
 
 
+def _router_s(topo: Topology, lat: LatencyModel) -> float:
+    """Per-decision router cost, honoring the topology override."""
+    return topo.router_ms / 1e3 if topo.router_ms is not None \
+        else lat.router_s()
+
+
 def _derive(spec, topo: Topology, lat: LatencyModel, level: float,
             target: int) -> _Derived:
     isl_tokens = spec.isl_blocks_mean * spec.block_size
@@ -135,11 +181,15 @@ def _derive(spec, topo: Topology, lat: LatencyModel, level: float,
     service_s = (lat.prefill_s(isl_tokens)
                  + spec.osl_mean * lat.decode_step_s() * topo.slots)
     pool_cap = topo.n_decode * topo.slots / service_s
-    router_cap = 1.0 / lat.router_s()
+    r_s = _router_s(topo, lat)
+    # deliberately SINGLETON-priced: router_cap ignores router_shards so
+    # one level is the same absolute offered rps on every shard count —
+    # the r-cells' knee comparison needs a common x-axis
+    router_cap = 1.0 / r_s
     sys_cap = min(pool_cap, 0.9 * router_cap)
     base = _UTILIZATION * sys_cap
     duration = target / base
-    sla = spec.sla_ttft_factor * (lat.router_s()
+    sla = spec.sla_ttft_factor * (r_s
                                   + lat.prefill_s(isl_tokens)
                                   + lat.decode_step_s())
     return _Derived(offered_rps=level * base, duration_s=duration,
@@ -211,7 +261,13 @@ def run_cell(family: str, topology: Union[str, Topology], *, seed: int,
 
     async def _main() -> None:
         clock = loop.time
-        indexer = KvIndexer(use_native=False)   # env-independent facts
+        n_shards = topo.router_shards
+        if n_shards > 1:
+            # the REAL sharded index: events split by hash ownership,
+            # lookups run the scatter-gather merge (shards/scatter.py)
+            indexer = ShardedKvIndexer(n_shards)
+        else:
+            indexer = KvIndexer(use_native=False)   # env-independent facts
 
         def publish(wire: dict) -> None:
             eid, wid, ev = event_from_wire(wire)
@@ -239,16 +295,31 @@ def run_cell(family: str, topology: Union[str, Topology], *, seed: int,
                                         clock=clock)
         for w in decode_workers.values():
             sched.update_worker(w.metrics())
-        router_lock = asyncio.Lock()
+        r_s = _router_s(topo, lat)
+        # one lock per router replica: each replica serializes its own
+        # decisions; sessions stick to a replica via the same consistent-
+        # hash ring the frontends use (utils/chash.py), so a multi-turn
+        # session's decisions stay ordered on one replica
+        router_locks = [asyncio.Lock() for _ in range(n_shards)]
+        if n_shards > 1:
+            ring = HashRing(f"replica-{i}" for i in range(n_shards))
+            replica_ix = {f"replica-{i}": i for i in range(n_shards)}
+
+            def replica_of(session) -> int:
+                return replica_ix[ring.lookup(f"session:{session}")]
+        else:
+            def replica_of(session) -> int:
+                return 0
         t0 = clock()
 
         async def route(req):
-            """The serialized singleton router: one decision at a time,
-            each consuming its modeled Python cost — the wall ROADMAP
-            item 1 predicts, now measurable as router_busy_frac."""
-            async with router_lock:
-                await asyncio.sleep(lat.router_s())
-                state["router_busy"] += lat.router_s()
+            """The serialized router: one decision at a time PER REPLICA,
+            each consuming its modeled cost — the singleton wall ROADMAP
+            item 1 predicts (measurable as router_busy_frac), and the
+            knob the sharded cells turn."""
+            async with router_locks[replica_of(req.session)]:
+                await asyncio.sleep(r_s)
+                state["router_busy"] += r_s
                 hashes = sequence_hashes(req.token_ids, bs)
                 match = indexer.find_matches(hashes)
                 tcosts = None
@@ -464,8 +535,14 @@ def run_cell(family: str, topology: Union[str, Topology], *, seed: int,
             state["top1"] / max(1, state["decisions"]), 4),
         "load_std": round(
             state["load_std_sum"] / max(1, state["load_std_n"]), 4),
-        "router_busy_frac": round(state["router_busy"] / span, 4),
+        # busy fraction of the AGGREGATE replica budget: span seconds of
+        # wall per replica — for shards=1 this is the singleton's
+        # serialized busy fraction, unchanged
+        "router_busy_frac": round(
+            state["router_busy"] / (span * topo.router_shards), 4),
     }
+    if topo.router_shards > 1:
+        metrics["router_shards"] = topo.router_shards
     out = {"metrics": metrics, "census": dict(sorted(census.items()))}
     if collect_decisions:
         out["decisions"] = decisions
@@ -506,10 +583,11 @@ def sweep(*, budget: int = 1, seed_base: int = 0,
     out_cells: dict[str, dict] = {}
     for family, topology in (cells or CELLS):
         name = f"{family}/{topology}"
+        grid = TOPOLOGIES[topology].levels or LOAD_LEVELS
         levels: dict[str, dict] = {}
         census: dict[str, int] = {}
         base_level1 = None
-        for level in LOAD_LEVELS:
+        for level in grid:
             res = run_cell(family, topology, seed=seed_base, level=level,
                            target_requests=target, lat=lat)
             levels[_lvl_key(level)] = res["metrics"]

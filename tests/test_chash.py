@@ -1,0 +1,154 @@
+"""Consistent-hash ring tests (utils/chash.py): the two quantitative
+properties both control-plane consumers lean on — bounded uniformity
+and minimal movement — plus cross-process determinism, and the seeded
+session-affinity e2e: a multi-turn session re-lands on the warm
+frontend after one frontend restart, via the content-addressed persist
+index (llm/http/affinity.py)."""
+
+import asyncio
+
+from dynamo_tpu.llm.http.affinity import LocalAffinityIndex, SessionAffinity
+from dynamo_tpu.utils.chash import HashRing
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# ------------------------------------------------------- ring properties ----
+
+
+def test_uniformity_bound():
+    """Key mass per node stays within the documented factor of fair
+    share across the node counts the control plane actually runs."""
+    keys = [f"key-{k}" for k in range(20000)]
+    for n in (2, 4, 8, 16, 64):
+        ring = HashRing(f"node-{i}" for i in range(n))
+        counts = {f"node-{i}": 0 for i in range(n)}
+        for k in keys:
+            counts[ring.lookup(k)] += 1
+        mean = len(keys) / n
+        # 64 vnodes holds ~1.35 at the fleet sizes the control plane
+        # actually runs (2-16); at 64 nodes the variance widens a bit
+        hi, lo = (1.35, 0.6) if n <= 16 else (1.5, 0.5)
+        assert max(counts.values()) / mean < hi, (n, counts)
+        assert min(counts.values()) / mean > lo, (n, counts)
+
+
+def test_minimal_movement_on_add():
+    nodes = [f"n{i}" for i in range(8)]
+    ring = HashRing(nodes)
+    keys = [f"key-{k}" for k in range(5000)]
+    before = {k: ring.lookup(k) for k in keys}
+    ring.add("n8")
+    moved = [k for k in keys if ring.lookup(k) != before[k]]
+    # every moved key moved TO the new node (nothing reshuffles between
+    # survivors), and only ~1/9 of the keyspace moved at all
+    assert moved and all(ring.lookup(k) == "n8" for k in moved)
+    assert len(moved) / len(keys) < 2 / 9
+
+
+def test_minimal_movement_on_remove():
+    nodes = [f"n{i}" for i in range(8)]
+    ring = HashRing(nodes)
+    keys = [f"key-{k}" for k in range(5000)]
+    before = {k: ring.lookup(k) for k in keys}
+    ring.remove("n3")
+    for k in keys:
+        if before[k] == "n3":
+            assert ring.lookup(k) != "n3"
+        else:
+            # keys not on the dead node's arcs do not move
+            assert ring.lookup(k) == before[k]
+
+
+def test_deterministic_across_build_orders():
+    keys = [f"key-{k}" for k in range(1000)]
+    a = HashRing(["alpha", "beta", "gamma", "delta"])
+    b = HashRing(["delta", "gamma", "beta", "alpha"])
+    assert [a.lookup(k) for k in keys] == [b.lookup(k) for k in keys]
+
+
+def test_remove_then_add_restores_ownership():
+    ring = HashRing([f"n{i}" for i in range(4)])
+    keys = [f"key-{k}" for k in range(2000)]
+    before = {k: ring.lookup(k) for k in keys}
+    ring.remove("n1")
+    ring.add("n1")
+    assert {k: ring.lookup(k) for k in keys} == before
+
+
+def test_edge_cases():
+    ring = HashRing()
+    assert ring.lookup("anything") is None
+    ring.remove("ghost")  # no-op
+    ring.add("solo")
+    assert ring.lookup("anything") == "solo"
+    ring.add("solo")  # idempotent
+    assert len(ring) == 1
+
+
+# ------------------------------------------------- session affinity e2e ----
+
+
+async def _affinity_e2e():
+    # one shared content-addressed index models the coordinator KV
+    # plane every frontend can reach
+    index = LocalAffinityIndex()
+    fids = ["fe-0", "fe-1", "fe-2"]
+    fes = {f: SessionAffinity(f, fids, persist_index=index) for f in fids}
+
+    # turn 1 of 32 seeded sessions: each lands on its ring owner, which
+    # records itself as the warm persist holder
+    sessions = [f"sess-{i}" for i in range(32)]
+    warm = {}
+    for s in sessions:
+        owner = fes["fe-0"].ring.lookup(s)
+        assert all(fe.ring.lookup(s) == owner for fe in fes.values())
+        d = await fes[owner].resolve(s)
+        assert d.is_local and d.source == "ring"
+        await fes[owner].note_served(s)
+        warm[s] = owner
+
+    # fe-2 restarts; the survivors see the membership delete
+    for f in ("fe-0", "fe-1"):
+        fes[f].remove_frontend("fe-2")
+    displaced = [s for s in sessions if warm[s] == "fe-2"]
+    assert displaced, "seeded sessions must exercise the restart"
+
+    # turn 2 during the outage: the recorded holder is gone, so the
+    # ring's stand-in serves and becomes the new warm holder
+    for s in displaced:
+        stand_in = fes["fe-0"].ring.lookup(s)
+        assert stand_in != "fe-2"
+        d = await fes[stand_in].resolve(s)
+        assert d.is_local and d.source == "ring"
+        await fes[stand_in].note_served(s)
+        warm[s] = stand_in
+
+    # fe-2 comes back cold and rejoins every ring
+    for f in ("fe-0", "fe-1"):
+        fes[f].add_frontend("fe-2")
+    fes["fe-2"] = SessionAffinity("fe-2", fids, persist_index=index)
+
+    # turn 3: the ring again names fe-2 for the displaced sessions, but
+    # any peer resolving the miss prefers the WARM stand-in recorded in
+    # the persist index — the session re-lands where its blocks are
+    for s in displaced:
+        assert fes["fe-2"].ring.lookup(s) == "fe-2"
+        resolver = "fe-0" if warm[s] != "fe-0" else "fe-1"
+        d = await fes[resolver].resolve(s)
+        assert d.owner == warm[s] and d.source == "persist"
+        assert not d.is_local
+
+    # undisturbed sessions still resolve to their original owner
+    for s in sessions:
+        if s in displaced:
+            continue
+        resolver = next(f for f in fids if f != warm[s])
+        d = await fes[resolver].resolve(s)
+        assert d.owner == warm[s]
+
+
+def test_session_relands_on_warm_frontend_after_restart():
+    run(_affinity_e2e())

@@ -1,0 +1,312 @@
+"""Sharded control-plane tests (llm/kv_router/shards/): partition
+correctness, sharded-vs-singleton equivalence, the content-addressed
+generation fence, index handoff, and the acceptance-criterion seeded
+deadline test — a shard that misses its gather deadline degrades the
+scores but never blocks placement."""
+
+import asyncio
+import time
+
+from dynamo_tpu.engine.counters import kv_shard_counters
+from dynamo_tpu.llm.kv.events import (
+    TIER_PERSIST,
+    KvRemovedEvent,
+    KvStoredEvent,
+)
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer
+from dynamo_tpu.llm.kv_router.scheduler import KvScheduler, WorkerMetrics
+from dynamo_tpu.llm.kv_router.shards import (
+    LocalShardClient,
+    ScatterGatherScheduler,
+    ShardedKvIndexer,
+    ShardMap,
+    gather_overlaps,
+    membership_generation,
+    probe_shard,
+    shard_of,
+    split_event,
+    split_hashes,
+)
+from dynamo_tpu.tokens import sequence_hashes
+
+BLOCK = 16
+
+
+def seq(tokens):
+    return sequence_hashes(list(tokens), BLOCK)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def _feed(indexers, worker_id, event):
+    for ix in indexers:
+        ix.apply_event(worker_id, event)
+
+
+# ----------------------------------------------------------- partitioning ----
+
+
+def test_shard_of_covers_and_is_stable():
+    hashes = seq(range(1, 16 * 40 + 1))
+    for n in (1, 2, 4, 7):
+        shards = {shard_of(h, n) for h in hashes}
+        assert shards <= set(range(n))
+        if n > 1:
+            assert len(shards) > 1, "chained keys must spray across shards"
+    # n_shards=1 degenerates to the singleton
+    assert all(shard_of(h, 1) == 0 for h in hashes)
+
+
+def test_split_hashes_partitions_exactly_and_preserves_order():
+    hashes = seq(range(1, 161))
+    parts = split_hashes(hashes, 4)
+    rebuilt = sorted(h for hs in parts.values() for h in hs)
+    assert rebuilt == sorted(hashes)
+    for s, hs in parts.items():
+        assert all(shard_of(h, 4) == s for h in hs)
+        assert hs == [h for h in hashes if shard_of(h, 4) == s]
+
+
+def test_split_event_stored_and_removed():
+    hashes = seq(range(1, 97))
+    tokens = [list(range(i * BLOCK, (i + 1) * BLOCK)) for i in range(6)]
+    ev = KvStoredEvent(block_hashes=list(hashes), parent_hash=None,
+                       token_blocks=tokens, tier=TIER_PERSIST)
+    parts = split_event(ev, 4)
+    seen = []
+    for s, sub in parts.items():
+        assert isinstance(sub, KvStoredEvent)
+        assert sub.tier == TIER_PERSIST
+        assert sub.parent_hash is None
+        # token blocks travel with their hash
+        by_hash = dict(zip(hashes, tokens))
+        assert sub.token_blocks == [by_hash[h] for h in sub.block_hashes]
+        seen.extend(sub.block_hashes)
+    assert sorted(seen) == sorted(hashes)
+
+    rparts = split_event(KvRemovedEvent(block_hashes=list(hashes)), 4)
+    assert sorted(h for e in rparts_values(rparts) for h in e.block_hashes) \
+        == sorted(hashes)
+
+    # single shard: identity, not a copy round-trip
+    assert split_event(ev, 1) == {0: ev}
+
+
+def rparts_values(parts):
+    for e in parts.values():
+        assert isinstance(e, KvRemovedEvent)
+        yield e
+
+
+# ------------------------------------------------------------ equivalence ----
+
+
+def _populate(indexers):
+    """Shared-prefix fleet: w1 holds all 8 blocks, w2 the first 4 (plus
+    the full prefix on its persist tier), w3 diverges after 2."""
+    base = list(range(1, 129))
+    _feed(indexers, 1, KvStoredEvent(block_hashes=list(seq(base))))
+    _feed(indexers, 2, KvStoredEvent(block_hashes=list(seq(base[:64]))))
+    _feed(indexers, 2, KvStoredEvent(block_hashes=list(seq(base)),
+                                     tier=TIER_PERSIST))
+    fork = base[:32] + list(range(1000, 1096))
+    _feed(indexers, 3, KvStoredEvent(block_hashes=list(seq(fork))))
+    # eviction: w1 drops its two tail blocks
+    _feed(indexers, 1, KvRemovedEvent(block_hashes=list(seq(base))[6:]))
+    return base, fork
+
+
+def test_sharded_matches_singleton():
+    singleton = KvIndexer(use_native=False)
+    sharded = ShardedKvIndexer(4)
+    base, fork = _populate([singleton, sharded])
+    for query in (seq(base), seq(base[:48]), seq(fork),
+                  seq(range(5000, 5064))):
+        want = singleton.find_matches(list(query))
+        got = sharded.find_matches(list(query))
+        assert got.scores == want.scores, query
+        assert got.persist_scores == want.persist_scores, query
+    assert sharded.workers() == singleton.workers()
+    assert sharded.num_blocks == singleton.num_blocks
+
+
+def test_gather_equals_inprocess_when_complete():
+    sharded = ShardedKvIndexer(4)
+    base, _ = _populate([sharded])
+    query = list(seq(base))
+    replies = {s: probe_shard(sharded.shard(s), s, 4, query, 7)
+               for s in range(4)}
+    scores, partial = gather_overlaps(query, 4, replies, 7)
+    assert not partial
+    assert scores.scores == sharded.find_matches(query).scores
+
+
+# ------------------------------------------------------- generation fence ----
+
+
+def test_membership_generation_is_content_addressed():
+    a = membership_generation(["r1", "r2"], 4)
+    assert membership_generation(["r2", "r1"], 4) == a
+    assert membership_generation(["r1", "r2", "r3"], 4) != a
+    assert membership_generation(["r1", "r2"], 8) != a
+    # ABA: the exact prior composition resurrects the prior generation
+    m = ShardMap.from_replicas(["r1", "r2"], 4)
+    m2 = m.rebind(["r1", "r2", "r3"]).rebind(["r1", "r2"])
+    assert m2.generation == m.generation
+    assert m2.owners == m.owners
+
+
+def test_shard_map_converges_across_histories():
+    """Two observers that reached the same membership through different
+    event orders agree on both ownership and the fence."""
+    via_join = ShardMap.from_replicas(["ra"], 4).rebind(["ra", "rb"])
+    via_snapshot = ShardMap.from_replicas(["ra", "rb"], 4)
+    assert via_join.generation == via_snapshot.generation
+    assert via_join.owners == via_snapshot.owners
+
+
+def test_moved_shards_minimal():
+    old = ShardMap.from_replicas(["ra", "rb"], 8)
+    new = old.rebind(["ra", "rb", "rc"])
+    moved = old.moved_shards(new)
+    assert all(new.owner(s) == "rc" for s in moved), \
+        "a join may only pull shards onto the joiner"
+    assert moved, "the ring must hand the joiner some shards"
+    assert len(moved) < 8, "a join must not reshuffle the whole map"
+
+
+def test_stale_generation_reply_is_fenced():
+    sharded = ShardedKvIndexer(4)
+    base, _ = _populate([sharded])
+    query = list(seq(base))
+    gen = 7
+    replies = {s: probe_shard(sharded.shard(s), s, 4, query, gen)
+               for s in range(4)}
+    full, partial = gather_overlaps(query, 4, replies, gen)
+    assert not partial
+
+    stale_shard = shard_of(query[0], 4)
+    replies[stale_shard] = probe_shard(sharded.shard(stale_shard),
+                                       stale_shard, 4, query, gen - 1)
+    fenced, partial = gather_overlaps(query, 4, replies, gen)
+    assert partial
+    # monotonic undercount: fencing can only lower scores, and the walk
+    # truncates at the fenced shard's first owned position
+    for tier in ("scores", "persist_scores"):
+        got, want = getattr(fenced, tier), getattr(full, tier)
+        assert all(got.get(w, 0) <= c for w, c in want.items())
+    assert fenced.scores == {}, "shard owning position 0 was fenced"
+
+
+# ---------------------------------------------------------------- handoff ----
+
+
+def test_handoff_export_import_roundtrip():
+    src = ShardedKvIndexer(4)
+    base, _ = _populate([src])
+    dst = ShardedKvIndexer(4)
+    for s in range(4):
+        device, persist = src.export_shard(s)
+        dst.import_shard(s, device, persist)
+    query = list(seq(base))
+    assert dst.find_matches(query).scores == src.find_matches(query).scores
+    assert dst.find_matches(query).persist_scores == \
+        src.find_matches(query).persist_scores
+
+
+# --------------------------------------------- deadline-degraded gather ----
+
+
+def _fleet_scheduler():
+    sched = KvScheduler()
+    for wid in (1, 2, 3):
+        sched.update_worker(WorkerMetrics(
+            worker_id=wid, request_active_slots=0, request_total_slots=8,
+            kv_active_blocks=0, kv_total_blocks=128))
+    return sched
+
+
+def test_deadline_miss_degrades_scores_never_blocks():
+    """Acceptance criterion: with one shard replica stalled past the
+    gather deadline, placement still completes — on degraded scores —
+    and the partial-gather counter records it."""
+    kv_shard_counters.reset()
+    n_shards = 4
+    sharded = ShardedKvIndexer(n_shards)
+    base, _ = _populate([sharded])
+    query = list(seq(base))
+
+    fast = [LocalShardClient(s, n_shards, sharded.shard(s))
+            for s in range(n_shards)]
+    full_gate = ScatterGatherScheduler(_fleet_scheduler(), fast, n_shards,
+                                       deadline_s=5.0, generation=0)
+    full, partial = run(full_gate.overlaps(query))
+    assert not partial and full.scores
+
+    # stall the shard owning the query's first position: the worst case
+    # for degradation, the walk truncates immediately for that tier
+    slow_shard = shard_of(query[0], n_shards)
+    slow = [LocalShardClient(s, n_shards, sharded.shard(s),
+                             delay_s=(0.5 if s == slow_shard else 0.0))
+            for s in range(n_shards)]
+    gate = ScatterGatherScheduler(_fleet_scheduler(), slow, n_shards,
+                                  deadline_s=0.02, generation=0)
+
+    t0 = time.perf_counter()
+    degraded, partial = run(gate.overlaps(query))
+    elapsed = time.perf_counter() - t0
+    assert partial
+    assert elapsed < 0.45, "gather must cut the stalled shard at the " \
+        "deadline, not wait it out"
+    for w, c in degraded.scores.items():
+        assert c <= full.scores.get(w, 0)
+
+    # and placement itself still completes on what survived
+    wid = run(gate.schedule(query, len(base)))
+    assert wid in (1, 2, 3)
+    assert kv_shard_counters.gather_partial_total >= 1
+    assert kv_shard_counters.scatters_total >= 2
+    assert 0.0 < kv_shard_counters.gather_partial_frac <= 1.0
+
+
+def test_replica_own_generation_wins_over_request():
+    """A LocalShardClient wired to the replica's own (lagging) view
+    answers with THAT generation — and the gatherer fences it."""
+    sharded = ShardedKvIndexer(4)
+    base, _ = _populate([sharded])
+    query = list(seq(base))
+    lagging = shard_of(query[0], 4)
+    clients = [
+        LocalShardClient(s, 4, sharded.shard(s),
+                         generation_fn=((lambda: 1) if s == lagging
+                                        else None))
+        for s in range(4)
+    ]
+    gate = ScatterGatherScheduler(_fleet_scheduler(), clients, 4,
+                                  deadline_s=5.0, generation=2)
+    scores, partial = run(gate.overlaps(query))
+    assert partial
+    assert scores.scores == {}
+
+
+# ------------------------------------------------------------- counters ----
+
+
+def test_shard_counters_surface():
+    kv_shard_counters.reset()
+    sharded = ShardedKvIndexer(2)
+    base, _ = _populate([sharded])
+    sharded.find_matches(list(seq(base)))
+    assert kv_shard_counters.scatters_total == 1
+    assert kv_shard_counters.last_fan_out == 2
+    assert sum(kv_shard_counters.fanout_bucket_counts) >= 1
+    sizes = sharded.shard_sizes()
+    assert len(sizes) == 2
+    assert kv_shard_counters.index_blocks == {
+        s: blocks for s, (blocks, _) in enumerate(sizes)}
+    kv_shard_counters.set_generation(99)
+    assert kv_shard_counters.generation == 99
+    kv_shard_counters.reset()
+    assert kv_shard_counters.gather_partial_frac == 0.0

@@ -64,21 +64,25 @@ def test_load_gate_zero_nonaccepted_findings(swept):
 
 def test_load_gate_is_fast(swept):
     """The gate must stay cheap enough for tier-1: the whole pinned
-    sweep (10 cells x 3 levels + twin runs) under 15 seconds."""
+    sweep (13 cells + twin runs) under 15 seconds."""
     _, elapsed = swept
     assert elapsed < 15.0, f"pinned load sweep took {elapsed:.1f}s"
 
 
 def test_committed_surface_covers_grid(swept):
     """The acceptance floor: >= 3 topologies x >= 3 scenario families,
-    every cell deterministic, every level present."""
+    every cell deterministic, every level present.  Sharded-router
+    cells (wNrK) sweep the wider ladder so the r4 knee has headroom
+    to show up strictly later than the singleton twin's."""
     facts, _ = swept
     fams = {c.split("/")[0] for c in facts["cells"]}
     topos = {c.split("/")[1] for c in facts["cells"]}
     assert len(fams) >= 3 and len(topos) >= 3
     for name, cell in facts["cells"].items():
         assert cell["twin_match"], f"{name} nondeterministic"
-        assert set(cell["levels"]) == {"0.5", "1", "2"}, name
+        want = ({"0.5", "1", "2", "4", "8"} if "r" in name.split("/")[1]
+                else {"0.5", "1", "2"})
+        assert set(cell["levels"]) == want, name
 
 
 # ------------------------------------------------------------ rule checks
@@ -113,6 +117,50 @@ def test_ld003_reported_even_without_drift():
     manifest = LoadManifest(cells=_fixture("ld_baseline_facts.json")["cells"])
     findings = check_load(regressed, manifest, drift=False)
     assert {f.rule for f in findings} == {"LD003"}
+
+
+def _shard_cell(knee, offered):
+    """Synthetic wNrK cell: per-level offered rps plus an SLA knee."""
+    levels = {str(lvl): {"offered_rps": rps, "ttft_p99_ms": 50.0,
+                         "shed_rate": 0.0, "completed": 100,
+                         "sla_ttft_ms": 280.0}
+              for lvl, rps in offered.items()}
+    return {"levels": levels, "census": {}, "twin_match": True,
+            "knee_level": knee}
+
+
+def test_ld005_shard_scaling_rule():
+    """The structural claim of the sharded control plane, judged on the
+    pinned surface itself (no manifest diff needed): a wNrK cell must
+    knee strictly later than its wNr1 twin AND sustain >= 2x the twin's
+    offered load first."""
+    ladder = {0.5: 1.3, 1.0: 2.6, 2.0: 5.2, 4.0: 10.5, 8.0: 21.0}
+    good = {
+        "cells": {
+            "agentic/w16r1": _shard_cell(2.0, {k: v for k, v in
+                                               ladder.items() if k <= 2}),
+            "agentic/w16r4": _shard_cell(8.0, ladder),
+        },
+        "params": {"target_requests": 100, "levels": sorted(ladder)},
+    }
+    manifest = LoadManifest(cells=good["cells"])
+    assert check_load(good, manifest, drift=True) == []
+
+    # r4 kneeing AT the twin's level, holding the twin's load: both keys
+    bad = json.loads(json.dumps(good))
+    bad["cells"]["agentic/w16r4"] = _shard_cell(
+        2.0, {k: v for k, v in ladder.items() if k <= 2})
+    keys = {(f.rule, f.scenario, f.key)
+            for f in check_load(bad, LoadManifest(cells=bad["cells"]),
+                                drift=True)}
+    assert ("LD005", "agentic/w16r4", "knee") in keys
+    assert ("LD005", "agentic/w16r4", "sustained") in keys
+
+    # a cell that never knees counts as strictly later than any twin
+    unkneed = json.loads(json.dumps(good))
+    unkneed["cells"]["agentic/w16r4"]["knee_level"] = None
+    assert check_load(unkneed, LoadManifest(cells=unkneed["cells"]),
+                      drift=True) == []
 
 
 def test_cell_set_drift():
@@ -221,7 +269,8 @@ def test_manifest_json_is_stable(tmp_path):
 
 
 def test_rule_registry_documented():
-    assert set(LOAD_RULES) == {"LD001", "LD002", "LD003", "LD004"}
+    assert set(LOAD_RULES) == {"LD001", "LD002", "LD003", "LD004",
+                               "LD005"}
     assert all(LOAD_RULES[r] for r in LOAD_RULES)
 
 
@@ -248,7 +297,7 @@ def test_run_load_json_output():
     assert rc == 0
     doc = json.loads(out.getvalue())
     assert doc["findings"] == []
-    assert len(doc["cells"]) == 10
+    assert len(doc["cells"]) == 13
     assert doc["runs"] > 0
 
 

@@ -207,6 +207,22 @@ def test_stranded_pull_is_caught_by_sever_matrix():
     assert any(v == "queue_no_lost" for v, _ in bad.violations)
 
 
+def test_stale_generation_shard_is_caught_and_replays():
+    """A shard replica that echoes the *request's* generation instead of
+    its own forges currency: after a handoff its pre-rebind holder data
+    passes the gather fence and inflates the merged overlap scores.  The
+    seeded schedule sweep catches the overcount and the finding's replay
+    token reproduces it exactly."""
+    rep = explore_scenario(SCENARIOS["router.shard"],
+                           bug="stale-generation")
+    bad = first_violation(rep)
+    assert bad is not None, "stale-generation shard went undetected"
+    assert any(v == "shard_no_stale_overcount" for v, _ in bad.violations)
+    again = replay_token(bad.token)
+    assert again.violations == bad.violations
+    assert again.trace == bad.trace
+
+
 # -------------------------------------------------- golden fixtures --------
 
 

@@ -217,6 +217,28 @@ def test_kv_stream_session_admissible_by_current_assembler():
     assert arr[1].sum() == 2 * arr[0].sum()
 
 
+# ------------------------------------------------- shard scatter reply ----
+
+
+def test_shard_scatter_reply_roundtrip():
+    """The sharded router's scatter reply decodes to the recorded
+    holder sets and re-encodes byte-identically — a frontend gathering
+    from an older replica (or vice versa) reads these exact bytes."""
+    from dynamo_tpu.llm.kv_router.shards.wire import (
+        decode_scatter_reply,
+        encode_scatter_reply,
+    )
+
+    blob = (GOLDEN / "shard_scatter_reply.bin").read_bytes()
+    request_id, reply = decode_scatter_reply(blob)
+    assert request_id == "golden-frontend:2:1"
+    assert reply.shard_id == 2
+    assert reply.generation == 123456789
+    assert reply.holders == {0: frozenset({0, 3}), 4: frozenset({1})}
+    assert reply.persist_holders == {4: frozenset({7})}
+    assert encode_scatter_reply(request_id, reply) == blob
+
+
 def test_golden_fixtures_match_generator():
     """The committed bytes ARE what generate.py produces today — so a
     format change can't hide behind a stale regeneration."""

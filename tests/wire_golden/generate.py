@@ -117,12 +117,29 @@ def kv_stream_session() -> bytes:
     return b"".join(encode_frame(h, p) for h, p in frames)
 
 
+def shard_scatter_reply() -> bytes:
+    """One scatter reply from the sharded router's gather path:
+    sorted-key JSON, holder maps as sorted [position, [workers]] pairs
+    so integer keys survive the round trip byte-identically."""
+    from dynamo_tpu.llm.kv_router.shards.scatter import ShardReply
+    from dynamo_tpu.llm.kv_router.shards.wire import encode_scatter_reply
+
+    reply = ShardReply(
+        shard_id=2,
+        generation=123456789,
+        holders={0: frozenset({3, 0}), 4: frozenset({1})},
+        persist_holders={4: frozenset({7})},
+    )
+    return encode_scatter_reply("golden-frontend:2:1", reply)
+
+
 FIXTURES = {
     "tcp_sequence.bin": tcp_sequence,
     "coordinator_command.bin": coordinator_command,
     "router_kv_event.jsonl": router_kv_event,
     "dtkvp1_blob.bin": dtkvp1_blob,
     "kv_stream_session.bin": kv_stream_session,
+    "shard_scatter_reply.bin": shard_scatter_reply,
 }
 
 
