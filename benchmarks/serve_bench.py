@@ -114,6 +114,9 @@ async def prefill_dispatch_stats(url):
                     "prefill_batch_occupancy", "prefill_budget_utilization",
                     "unified_dispatches_total", "unified_decode_rows",
                     "unified_prefill_tokens", "unified_budget_utilization",
+                    "lookahead_bursts_total", "lookahead_hits_total",
+                    "lookahead_mispredicts_total", "lookahead_commits_total",
+                    "lookahead_flushes_total", "lookahead_dispatch_depth",
                     "persist_hits_total", "persist_misses_total",
                     "persist_restored_tokens_total",
                     "persist_spill_bytes_total", "persist_resident_bytes",
@@ -154,6 +157,26 @@ async def prefill_dispatch_stats(url):
                 vals.get("unified_prefill_tokens", 0) / unified, 1),
             "unified_budget_utilization": vals.get(
                 "unified_budget_utilization", 0.0),
+        })
+    bursts = vals.get("lookahead_bursts_total", 0)
+    if bursts:
+        # double-buffered dispatch engaged: fused device turns per
+        # readback, the per-row prediction hit rate, and how often the
+        # speculative next-turn prebuild survived to commit
+        rows = vals.get("lookahead_hits_total", 0) + vals.get(
+            "lookahead_mispredicts_total", 0)
+        plans = vals.get("lookahead_commits_total", 0) + vals.get(
+            "lookahead_flushes_total", 0)
+        out.update({
+            "lookahead_bursts": int(bursts),
+            "lookahead_dispatch_depth": int(
+                vals.get("lookahead_dispatch_depth", 0)),
+            "lookahead_hit_rate": round(
+                vals.get("lookahead_hits_total", 0) / rows, 4)
+            if rows else 0.0,
+            "lookahead_commit_rate": round(
+                vals.get("lookahead_commits_total", 0) / plans, 4)
+            if plans else 0.0,
         })
     phits = vals.get("persist_hits_total", 0)
     pmiss = vals.get("persist_misses_total", 0)
@@ -372,6 +395,10 @@ async def run_with_native(args):
         # mixed turn); DYNAMO_UNIFIED_DISPATCH=1 to enable for a sweep
         unified_token_dispatch=bool(int(os.environ.get(
             "DYNAMO_UNIFIED_DISPATCH", "0"))),
+        # double-buffered dispatch (fused bursts + speculative next-turn
+        # prebuild, implies unified); DYNAMO_LOOKAHEAD=1 for a sweep
+        lookahead_dispatch=bool(int(os.environ.get(
+            "DYNAMO_LOOKAHEAD", "0"))),
         enable_prefix_reuse=False,
         cache_dtype="int8" if quant else None,
     )

@@ -173,6 +173,10 @@ def _build_local_engine(args) -> tuple[object, object]:
         # step per turn when both phases have work
         unified_token_dispatch=bool(
             getattr(args, "unified_token_dispatch", False)),
+        # double-buffered dispatch: fused bursts + speculative next-turn
+        # prebuild overlapped with device compute (implies unified)
+        lookahead_dispatch=bool(
+            getattr(args, "lookahead_dispatch", False)),
         # dtspan profile hook: one jax.profiler capture over the first
         # profile_steps device steps
         profile_dir=(getattr(args, "profile_dir", None) or None),
@@ -937,6 +941,15 @@ def _parser() -> argparse.ArgumentParser:
                      "axis, prefill chunks pack the remaining "
                      "--prefill-token-budget, which defaults to 1024 "
                      "when unset); see docs/engine_scheduling.md")
+    run.add_argument("--lookahead-dispatch", action="store_true",
+                     default=bool(int(os.environ.get(
+                         "DYNAMO_LOOKAHEAD", "0") or "0")),
+                     help="double-buffered dispatch: fuse mixed "
+                     "prefill+decode turns into multi-step bursts with "
+                     "ONE device readback, and prebuild the next turn's "
+                     "dispatch on the host while the device computes "
+                     "(implies --unified-token-dispatch; also "
+                     "DYNAMO_LOOKAHEAD=1); see docs/engine_scheduling.md")
     run.add_argument("--nnodes", type=int, default=1,
                      help="worker processes forming ONE mesh (multi-host)")
     run.add_argument("--node-rank", type=int, default=0)

@@ -54,6 +54,17 @@ class EngineConfig:
     # Default off until parity-gated (tests/test_unified_dispatch.py
     # pins seeded-stream parity vs the legacy paths).
     unified_token_dispatch: bool = False
+    # double-buffered dispatch (lookahead scheduler): overlap next-turn
+    # host scheduling with device compute.  Mixed prefill+decode turns
+    # fuse interactive_decode_steps unified turns into ONE dispatch with
+    # on-device stop/append (a burst needs a single trailing device_get),
+    # and while the device computes, the host speculatively prebuilds
+    # the NEXT turn's dispatch operands from predicted token counts
+    # (every active decode row yields exactly 1 token/turn unless a stop
+    # fires) — committed if the prediction held, flushed on mismatch.
+    # Implies unified_token_dispatch.  Default off until parity-gated
+    # (tests/test_lookahead_dispatch.py pins seeded-stream parity).
+    lookahead_dispatch: bool = False
     # decode burst length while prefill work is pending (admitted/waiting
     # requests or a mid-prefill slot).  Long bursts amortise dispatch
     # overhead but make a freshly-arrived prompt wait a whole burst
@@ -139,6 +150,11 @@ class EngineConfig:
                 self.block_size,
                 self.prefill_chunk_tokens // self.block_size * self.block_size,
             )
+        if self.lookahead_dispatch and not self.unified_token_dispatch:
+            # the lookahead scheduler is a layer over unified dispatch:
+            # the fused burst generalizes the unified mixed step, so the
+            # flag implies it (and inherits its budget defaulting below)
+            self.unified_token_dispatch = True
         if self.unified_token_dispatch and not self.prefill_token_budget:
             # the unified scheduler packs under prefill_token_budget; a
             # bare --unified-token-dispatch gets a sensible default

@@ -14,7 +14,7 @@ from typing import Iterator
 
 from dynamo_tpu.engine.counters import counters as prefill_counters
 from dynamo_tpu.engine.counters import (kv_shard_counters, kv_stream_counters,
-                                        persist_counters)
+                                        lookahead_counters, persist_counters)
 from dynamo_tpu.fault.counters import counters as fault_counters
 from dynamo_tpu.obs.costs import transfer_costs
 from dynamo_tpu.obs.perfmodel import perf_model
@@ -164,6 +164,29 @@ class Metrics:
         lines.append(f"# TYPE {ENGINE_PREFIX}_unified_budget_utilization gauge")
         lines.append(f"{ENGINE_PREFIX}_unified_budget_utilization "
                      f"{round(prefill_counters.unified_budget_utilization, 6)}")
+        # double-buffered dispatch (lookahead scheduler): fused bursts,
+        # per-row prediction hit/mispredict split, speculative next-turn
+        # prebuild commits/flushes, and the depth of the last burst
+        lc = lookahead_counters
+        lines.append(f"# TYPE {ENGINE_PREFIX}_lookahead_bursts_total counter")
+        lines.append(f"{ENGINE_PREFIX}_lookahead_bursts_total "
+                     f"{lc.bursts_total}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_lookahead_hits_total counter")
+        lines.append(f"{ENGINE_PREFIX}_lookahead_hits_total "
+                     f"{lc.hits_total}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_lookahead_mispredicts_total "
+                     f"counter")
+        lines.append(f"{ENGINE_PREFIX}_lookahead_mispredicts_total "
+                     f"{lc.mispredicts_total}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_lookahead_commits_total counter")
+        lines.append(f"{ENGINE_PREFIX}_lookahead_commits_total "
+                     f"{lc.commits_total}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_lookahead_flushes_total counter")
+        lines.append(f"{ENGINE_PREFIX}_lookahead_flushes_total "
+                     f"{lc.flushes_total}")
+        lines.append(f"# TYPE {ENGINE_PREFIX}_lookahead_dispatch_depth gauge")
+        lines.append(f"{ENGINE_PREFIX}_lookahead_dispatch_depth "
+                     f"{lc.dispatch_depth}")
         # persistent prefix-cache tier (llm/kv/persist.py): blocks/tokens
         # restored from disk instead of re-prefilled, spill volume, and
         # the store's current footprint

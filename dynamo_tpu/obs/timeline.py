@@ -18,14 +18,21 @@ Phases (what the marks mean, in step order):
     upload            the ONE batched jax.device_put per dispatch
     dispatch          the jitted call itself (trace/en-queue; on CPU
                       backends this includes compute)
+    overlap           host work performed *while the device computes*
+                      (lookahead dispatch: next-turn speculative build
+                      + waiting-queue drain between dispatch and
+                      readback) — concurrent with device time, so it
+                      is excluded from the host gap
     readback          jax.device_get — blocks until device compute
                       lands, so device time not overlapped with host
                       work shows up here
     host_post         sampled-token append, stop conditions, emit
 
 The headline derived number is **host_gap_ms_per_turn** — wall time
-per dispatching step spent *outside* dispatch+readback, i.e. the host
-bubble ROADMAP item 3 (double-buffered dispatch) must close.  The
+per dispatching step spent *outside* dispatch+overlap+readback, i.e.
+the host bubble ROADMAP item 3 (double-buffered dispatch) must close.
+Overlapped host work is not a bubble: the device is busy underneath
+it, so the phase-sum==wall invariant holds while the gap shrinks.  The
 aggregates are always on (a handful of ``perf_counter`` calls per
 step, no allocation); full per-step records are kept only in a small
 ring buffer, and per-step *spans* are emitted only when the tracing
@@ -57,6 +64,7 @@ PHASES = (
     "host_build",
     "upload",
     "dispatch",
+    "overlap",
     "readback",
     "host_post",
 )
@@ -84,7 +92,7 @@ class StepTimeline:
         self.busy_steps_total = 0     # steps that ran >= 1 device dispatch
         self.wall_s_total = 0.0       # busy-step wall time
         self.phase_s_total = {p: 0.0 for p in PHASES}
-        self.host_gap_s_total = 0.0   # busy wall - dispatch - readback
+        self.host_gap_s_total = 0.0   # busy wall - dispatch-overlap-readback
         self.ewma_wall_s = 0.0
         self.ewma_host_gap_s = 0.0
         # measured dispatch time split by jitted-entrypoint kind — the
@@ -135,7 +143,9 @@ class StepTimeline:
         self.steps_total += 1
         if not busy:
             return  # idle polls would drown the per-turn numbers
-        gap = wall - phases.get("dispatch", 0.0) - phases.get("readback", 0.0)
+        gap = (wall - phases.get("dispatch", 0.0)
+               - phases.get("overlap", 0.0)
+               - phases.get("readback", 0.0))
         self.busy_steps_total += 1
         self.wall_s_total += wall
         self.host_gap_s_total += gap

@@ -26,3 +26,14 @@ def log_each(step_outputs):
     for out in step_outputs:
         jax.debug.callback(print, out)
     return step_outputs
+
+
+def burst_decode(step_fn, state, k):
+    # the fused-burst anti-pattern: pulling every turn's sample back to
+    # the host re-serialises the k device turns the burst was meant to
+    # pipeline — one round trip per token instead of one per burst
+    tokens = []
+    for _ in range(k):
+        state, out = step_fn(state)
+        tokens.append(jax.device_get(out))
+    return state, tokens

@@ -23,3 +23,11 @@ def describe_batch(stats):
     # one-shot debug path, not a per-step sync
     jax.debug.print("batch stats {}", stats)
     return stats
+
+
+def burst_decode(step_fn, state, rng_keys):
+    # the fused-burst idiom (engine/core.py unified_burst_step): k
+    # device turns accumulate under one scan, the host sees ONE
+    # trailing pull for the whole burst
+    state, samples = jax.lax.scan(step_fn, state, rng_keys)
+    return state, jax.device_get(samples)
