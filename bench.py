@@ -264,36 +264,26 @@ def _probe_pallas_prefill(mcfg: dict, max_len: int, bs: int,
     token budget the ragged variant is probed too (a run that batches
     prefill dispatches the ragged kernel, not the single-sequence one)."""
     import jax
-    import jax.numpy as jnp
 
     try:
         from dynamo_tpu.ops.pallas.prefill_attention import (
             paged_prefill_attention, ragged_paged_prefill_attention,
         )
-
-        h, hk, hd, n, bt, lens = _probe_geometry(mcfg, 1, max_len, bs)
-        s = min(prefill_chunk or 512, max_len)
-        q = jnp.ones((1, s, h, hd), jnp.bfloat16)
-        kv = jnp.ones((1, s, hk, hd), jnp.bfloat16)
-        cache = jnp.zeros((1, n, 2, bs, hk * hd), jnp.bfloat16)
-        out = paged_prefill_attention(
-            q, kv, kv, cache, jnp.int32(0), bt[:1],
-            jnp.asarray([min(2 * bs + s, max_len)], jnp.int32),
-            jnp.asarray([min(2 * bs, max_len - s)], jnp.int32),
+        from dynamo_tpu.ops.pallas.registry import (
+            probe_prefill_inputs, probe_ragged_inputs,
         )
+
+        h, hk, hd, m, n, _ = _probe_geometry(mcfg, 1, max_len, bs)
+        s = min(prefill_chunk or 512, max_len)
+        out = paged_prefill_attention(
+            *probe_prefill_inputs(1, s, h, hk, hd, bs, n, m))
         jax.block_until_ready(out)
         if prefill_budget:
+            # two rows packed on one flat axis, each with a cached
+            # prefix (per-row DMA path)
             sr = min(prefill_budget, max_len)
-            pfx = min(2 * bs, max_len - sr)
-            q = jnp.ones((1, sr, h, hd), jnp.bfloat16)
-            kv = jnp.ones((1, sr, hk, hd), jnp.bfloat16)
-            bt2 = jnp.concatenate([bt[:1], bt[:1]], axis=0)
             out = ragged_paged_prefill_attention(
-                q, kv, kv, cache, jnp.int32(0), bt2,
-                jnp.asarray([sr // 2, pfx + sr // 2], jnp.int32),
-                jnp.asarray([0, pfx], jnp.int32),
-                jnp.asarray([0, sr // 2], jnp.int32),
-            )
+                *probe_ragged_inputs(sr, 2, h, hk, hd, bs, n, m))
             jax.block_until_ready(out)
     except Exception as e:  # pragma: no cover - hardware-specific
         print(f"# pallas prefill probe failed ({type(e).__name__}: "
@@ -318,20 +308,19 @@ def _probe_pallas_unified(mcfg: dict, batch: int, max_len: int, bs: int,
         from dynamo_tpu.ops.pallas.prefill_attention import (
             ragged_paged_prefill_attention,
         )
+        from dynamo_tpu.ops.pallas.registry import probe_ragged_inputs
 
-        h, hk, hd, n, bt, lens = _probe_geometry(mcfg, batch, max_len, bs)
-        lens = np.asarray(lens)
+        h, hk, hd, m, n, lens = _probe_geometry(mcfg, batch, max_len, bs)
         d_region = -(-batch // bs) * bs
         span = min(max(bs, prefill_budget - d_region), max_len - d_region)
         span = max(bs, span // bs * bs)
         t = d_region + span
         n_dec = min(batch, d_region)
-        q = jnp.ones((1, t, h, hd), jnp.bfloat16)
-        kv = jnp.ones((1, t, hk, hd), jnp.bfloat16)
-        cache = jnp.zeros((1, n, 2, bs, hk * hd), jnp.bfloat16)
         rows = n_dec + 1
-        # decode rows: full cached prefix ending mid-block; prefill row:
-        # a fresh block-aligned span with a 2-block cached prefix
+        args = list(probe_ragged_inputs(t, rows, h, hk, hd, bs, n, m))
+        # override the builder's uniform rows with the unified mixed
+        # layout — decode rows: full cached prefix ending mid-block;
+        # prefill row: a fresh block-aligned span with a 2-block prefix
         starts = np.concatenate([
             np.minimum(lens[:n_dec] - 1, max_len - 2),
             [min(2 * bs, max_len - span)],
@@ -340,11 +329,9 @@ def _probe_pallas_unified(mcfg: dict, batch: int, max_len: int, bs: int,
             starts[:n_dec] + 1, [starts[n_dec] + span]]).astype(np.int32)
         roff = np.concatenate([
             np.arange(n_dec), [d_region]]).astype(np.int32)
-        out = ragged_paged_prefill_attention(
-            q, kv, kv, cache, jnp.int32(0),
-            jnp.asarray(np.resize(np.asarray(bt), (rows, bt.shape[1]))),
-            jnp.asarray(seq_lens), jnp.asarray(starts), jnp.asarray(roff),
-        )
+        args[6:9] = [jnp.asarray(seq_lens), jnp.asarray(starts),
+                     jnp.asarray(roff)]
+        out = ragged_paged_prefill_attention(*args)
         jax.block_until_ready(out)
     except Exception as e:  # pragma: no cover - hardware-specific
         print(f"# pallas unified probe failed ({type(e).__name__}: "
@@ -357,17 +344,16 @@ def _probe_geometry(mcfg: dict, batch: int, max_len: int, bs: int):
     """Shared probe geometry: EXACTLY what the engine will run (model
     heads/head_dim, its block-table width, batch) — a differently-shaped
     probe could lower while the real executable hits a Mosaic limit
-    mid-measurement.  Returns (h, hk, hd, n, block_tables, seq_lens)."""
-    import jax.numpy as jnp
-
+    mid-measurement.  Returns ``(h, hk, hd, m, n, seq_lens)``; the probe
+    INPUTS themselves come from ``ops/pallas/registry.py``'s probe
+    builders, so bench probe coverage is registry coverage by
+    construction (the kernel plane's KN006 ``probe:<kernel>`` gate)."""
     hd = mcfg.get("head_dim", mcfg["hidden_size"] // mcfg["num_heads"])
     h, hk = mcfg["num_heads"], mcfg["num_kv_heads"]
     m = -(-max_len // bs)  # the engine's block-table width
     n = min(batch * m + 4, 4096)
-    bt = ((jnp.arange(batch, dtype=jnp.int32)[:, None] * m
-           + jnp.arange(m, dtype=jnp.int32)[None, :]) % n)
-    lens = jnp.full((batch,), min(4 * bs, max_len), jnp.int32)
-    return h, hk, hd, n, bt, lens
+    lens = np.full((batch,), min(4 * bs, max_len), np.int32)
+    return h, hk, hd, m, n, lens
 
 
 def _probe_pallas_decode(mcfg: dict, batch: int, max_len: int, bs: int) -> None:
@@ -375,17 +361,14 @@ def _probe_pallas_decode(mcfg: dict, batch: int, max_len: int, bs: int) -> None:
     on failure disable it (engine falls back to the XLA gather path)
     rather than crashing every respawn attempt identically."""
     import jax
-    import jax.numpy as jnp
 
     try:
         from dynamo_tpu.ops.pallas.decode_attention import paged_decode_attention
+        from dynamo_tpu.ops.pallas.registry import probe_decode_inputs
 
-        h, hk, hd, n, bt, lens = _probe_geometry(mcfg, batch, max_len, bs)
-        cache = jnp.zeros((1, n, 2, bs, hk * hd), jnp.bfloat16)
+        h, hk, hd, m, n, lens = _probe_geometry(mcfg, batch, max_len, bs)
         out = paged_decode_attention(
-            jnp.ones((batch, h, hd), jnp.bfloat16), cache, jnp.int32(0),
-            bt, lens,
-        )
+            *probe_decode_inputs(batch, h, hk, hd, bs, n, m, lens))
         jax.block_until_ready(out)
     except Exception as e:  # pragma: no cover - hardware-specific
         print(f"# pallas decode probe failed ({type(e).__name__}: "
@@ -435,7 +418,6 @@ def _probe_kv_quant(mcfg: dict, batch: int, max_len: int, bs: int,
     could lower while the real executable hits a Mosaic limit
     mid-measurement.  One layer keeps the probe cache small."""
     import jax
-    import jax.numpy as jnp
 
     if bs % 32:
         # ops/paged_attention.py routes partial-int8-tile caches to the
@@ -443,29 +425,20 @@ def _probe_kv_quant(mcfg: dict, batch: int, max_len: int, bs: int,
         # probe (which the run would never dispatch) veto it
         return True
     try:
-        from dynamo_tpu.ops.kv_quant import QuantKvCache, scale_tile
         from dynamo_tpu.ops.pallas.decode_attention import paged_decode_attention
         from dynamo_tpu.ops.pallas.prefill_attention import paged_prefill_attention
+        from dynamo_tpu.ops.pallas.registry import (
+            probe_decode_inputs,
+            probe_prefill_inputs,
+        )
 
-        h, hk, hd, n, bt, lens = _probe_geometry(mcfg, batch, max_len, bs)
-        hp, sp = scale_tile(hk, bs)
-        cache = QuantKvCache(
-            jnp.zeros((1, n, 2, bs, hk * hd), jnp.int8),
-            jnp.ones((1, n, 2, hp, sp), jnp.float32),
-        )
+        h, hk, hd, m, n, lens = _probe_geometry(mcfg, batch, max_len, bs)
         out = paged_decode_attention(
-            jnp.ones((batch, h, hd), jnp.bfloat16), cache, jnp.int32(0),
-            bt, lens,
-        )
+            *probe_decode_inputs(batch, h, hk, hd, bs, n, m, lens, quant=True))
         jax.block_until_ready(out)
         s = min(prefill_chunk or 512, max_len)
-        q = jnp.ones((1, s, h, hd), jnp.bfloat16)
-        kv = jnp.ones((1, s, hk, hd), jnp.bfloat16)
         out = paged_prefill_attention(
-            q, kv, kv, cache, jnp.int32(0), bt[:1],
-            jnp.asarray([min(2 * bs + s, max_len)], jnp.int32),
-            jnp.asarray([min(2 * bs, max_len - s)], jnp.int32),
-        )
+            *probe_prefill_inputs(1, s, h, hk, hd, bs, n, m, quant=True))
         jax.block_until_ready(out)
         return True
     except Exception as e:  # pragma: no cover - hardware-specific

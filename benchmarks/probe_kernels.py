@@ -6,6 +6,12 @@ Mosaic error — the fast iteration loop for kernel lowering issues that
 interpret-mode tests cannot catch (round 4 found two: partial-tile scale
 DMA slices, and the prefill kernel's sublane-indexed q/out slices).
 
+Probe INPUTS come from ``ops/pallas/registry.py``'s ``probe_*_inputs``
+builders — the same tensors bench.py's pre-run probes and the kernel
+plane's interpret audits consume — so a kernel this sweep exercises is
+by construction one the registry knows (``dynamo-tpu lint --kern``'s
+KN006 census flags any registered kernel that loses probe coverage).
+
 Usage:  python benchmarks/probe_kernels.py [bf16|int8|all] [8b|1b|probe]
 """
 
@@ -62,13 +68,17 @@ def main() -> None:
     geom = GEOMS[sys.argv[2] if len(sys.argv) > 2 else "8b"]
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
-    from dynamo_tpu.ops.kv_quant import QuantKvCache, scale_tile
     from dynamo_tpu.ops.pallas.decode_attention import (
         paged_decode_attention, paged_decode_attention_mq,
     )
     from dynamo_tpu.ops.pallas.prefill_attention import (
         paged_prefill_attention, ragged_paged_prefill_attention,
+    )
+    from dynamo_tpu.ops.pallas.registry import (
+        probe_decode_inputs, probe_int8_matmul_inputs, probe_prefill_inputs,
+        probe_ragged_inputs,
     )
 
     h, hk, d, batch, max_len, bs, s = (
@@ -76,18 +86,7 @@ def main() -> None:
         geom["bs"], geom["s"])
     m = -(-max_len // bs)
     n = min(batch * m + 4, 4096)
-    bt = ((jnp.arange(batch, dtype=jnp.int32)[:, None] * m
-           + jnp.arange(m, dtype=jnp.int32)[None, :]) % n)
-    lens = jnp.full((batch,), min(4 * bs, max_len), jnp.int32)
-
-    def mk_cache(quant: bool):
-        if not quant:
-            return jnp.zeros((1, n, 2, bs, hk * d), jnp.bfloat16)
-        hp, sp = scale_tile(hk, bs)
-        return QuantKvCache(
-            jnp.zeros((1, n, 2, bs, hk * d), jnp.int8),
-            jnp.ones((1, n, 2, hp, sp), jnp.float32),
-        )
+    lens = np.full((batch,), min(4 * bs, max_len), np.int32)
 
     def probe(label, fn):
         try:
@@ -103,59 +102,46 @@ def main() -> None:
                 traceback.print_exc()
             return False
 
+    def unified_inputs(quant: bool):
+        # unified mixed dispatch: a DECODE row (1 fresh token, start NOT
+        # block-aligned — the full-cached-prefix DMA path) ahead of a
+        # block-aligned prefill span on the same flat axis; the builder
+        # supplies tensors, only the row layout is overridden here
+        args = list(probe_ragged_inputs(bs + s, 2, h, hk, d, bs, n, m,
+                                        quant=quant))
+        args[6:9] = [jnp.asarray([2 * bs + 3 + 1, s], jnp.int32),  # seq_lens
+                     jnp.asarray([2 * bs + 3, 0], jnp.int32),      # starts
+                     jnp.asarray([0, bs], jnp.int32)]              # roff
+        return args
+
     variants = []
     for mode in (["bf16", "int8"] if which == "all" else [which]):
-        cache = mk_cache(mode == "int8")
+        q8 = mode == "int8"
         variants += [
-            (f"decode/{mode}", lambda cache=cache: paged_decode_attention(
-                jnp.ones((batch, h, d), jnp.bfloat16), cache, jnp.int32(0),
-                bt, lens)),
-            (f"mq/{mode}", lambda cache=cache: paged_decode_attention_mq(
-                jnp.ones((batch, 4, h, d), jnp.bfloat16), cache, jnp.int32(0),
-                bt, lens, jnp.maximum(lens - 4, 0))),
-            (f"prefill/{mode}", lambda cache=cache: paged_prefill_attention(
-                jnp.ones((1, s, h, d), jnp.bfloat16),
-                jnp.ones((1, s, hk, d), jnp.bfloat16),
-                jnp.ones((1, s, hk, d), jnp.bfloat16),
-                cache, jnp.int32(0), bt[:1],
-                jnp.asarray([min(2 * bs + s, max_len)], jnp.int32),
-                jnp.asarray([min(2 * bs, max_len - s)], jnp.int32))),
+            (f"decode/{mode}", lambda q8=q8: paged_decode_attention(
+                *probe_decode_inputs(batch, h, hk, d, bs, n, m, lens,
+                                     quant=q8))),
+            (f"mq/{mode}", lambda q8=q8: paged_decode_attention_mq(
+                *probe_decode_inputs(batch, h, hk, d, bs, n, m, lens,
+                                     quant=q8, s_q=4))),
+            (f"prefill/{mode}", lambda q8=q8: paged_prefill_attention(
+                *probe_prefill_inputs(1, s, h, hk, d, bs, n, m, quant=q8))),
             # token-budget ragged prefill: two rows packed on one flat
-            # axis, the second with a cached prefix (per-row DMA path)
-            (f"ragged/{mode}", lambda cache=cache: (
-                ragged_paged_prefill_attention(
-                    jnp.ones((1, s, h, d), jnp.bfloat16),
-                    jnp.ones((1, s, hk, d), jnp.bfloat16),
-                    jnp.ones((1, s, hk, d), jnp.bfloat16),
-                    cache, jnp.int32(0), bt[:2],
-                    jnp.asarray([s // 2, min(2 * bs, max_len - s) + s // 2],
-                                jnp.int32),            # seq_lens
-                    jnp.asarray([0, min(2 * bs, max_len - s)], jnp.int32),
-                    jnp.asarray([0, s // 2], jnp.int32)))),
-            # unified mixed dispatch: a DECODE row (1 fresh token, start
-            # NOT block-aligned — the full-cached-prefix DMA path) ahead
-            # of a block-aligned prefill span on the same flat axis
-            (f"unified/{mode}", lambda cache=cache: (
-                ragged_paged_prefill_attention(
-                    jnp.ones((1, bs + s, h, d), jnp.bfloat16),
-                    jnp.ones((1, bs + s, hk, d), jnp.bfloat16),
-                    jnp.ones((1, bs + s, hk, d), jnp.bfloat16),
-                    cache, jnp.int32(0), bt[:2],
-                    jnp.asarray([2 * bs + 3 + 1, s], jnp.int32),  # seq_lens
-                    jnp.asarray([2 * bs + 3, 0], jnp.int32),      # starts
-                    jnp.asarray([0, bs], jnp.int32)))),           # roff
+            # axis, each with a cached prefix (per-row DMA path)
+            (f"ragged/{mode}", lambda q8=q8: ragged_paged_prefill_attention(
+                *probe_ragged_inputs(s, 2, h, hk, d, bs, n, m, quant=q8))),
+            (f"unified/{mode}", lambda q8=q8: ragged_paged_prefill_attention(
+                *unified_inputs(q8))),
         ]
     # dequant-in-kernel int8 matmul at decode and prefill row counts
     from dynamo_tpu.ops.pallas.int8_matmul import int8_matmul
 
     wk, wn = hk * d * (h // hk), 14336  # 8B-ish ffn width
-    wq8 = jnp.ones((wk, wn), jnp.int8)
-    sc8 = jnp.ones((wn,), jnp.float32)
     for rows in (64, 512):
         variants.append((
             f"int8_matmul/m{rows}",
             lambda rows=rows: int8_matmul(
-                jnp.ones((rows, wk), jnp.bfloat16), wq8, sc8,
+                *probe_int8_matmul_inputs(rows, wk, wn),
                 out_dtype=jnp.bfloat16),
         ))
     # grouped-MoE ragged_dot lowering (Mixtral-ish shapes: E=8 experts,

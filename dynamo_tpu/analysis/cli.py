@@ -65,16 +65,23 @@ def configure_parser(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "plane at virtual time — p99 TTFT / shed / knee per "
                         "topology x load level) against the committed load "
                         "manifest")
+    p.add_argument("--kern", action="store_true",
+                   help="run the kernel-plane pass instead (KN001-KN006: "
+                        "static Pallas audit — VMEM budgets, index-map "
+                        "bounds/race proofs, NaN-canary padding oracles, "
+                        "kernel pricing + census) against the committed "
+                        "kern manifest")
     p.add_argument("--replay", default=None, metavar="TOKEN",
-                   help="with --proto or --load: re-execute one recorded "
-                        "run from a dtp1. interleaving token or dtl1. cell "
-                        "token (as printed by a failing run or the nightly "
-                        "sweep) instead of sweeping; exit 1 if it still "
-                        "violates")
+                   help="with --proto, --load or --kern: re-execute one "
+                        "recorded run from a dtp1. interleaving token, "
+                        "dtl1. cell token or dtk1. fuzz-geometry token (as "
+                        "printed by a failing run or the nightly sweep) "
+                        "instead of sweeping; exit 1 if it still violates")
     p.add_argument("--all", action="store_true",
-                   help="run all eight passes (per-file + project, trace, "
-                        "wire, perf, shard, proto, load) in one process "
-                        "sharing the parse cache; exit 1 if any pass fails")
+                   help="run all nine passes (per-file + project, trace, "
+                        "wire, perf, shard, proto, load, kern) in one "
+                        "process sharing the parse cache; exit 1 if any "
+                        "pass fails")
     p.add_argument("--changed", action="store_true",
                    help="restrict the per-file pass to git-dirty files "
                         "(project/trace/wire passes stay whole-program); "
@@ -164,6 +171,13 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
         from dynamo_tpu.analysis.loadcheck import run_load
 
         return run_load(args, out)
+    if getattr(args, "kern", False):
+        # kernel-plane pass: its unit is pallas_call sites under the
+        # audit geometry matrix (interpret-mode runs + spec-only
+        # traces) — same manifest contract, its own committed file
+        from dynamo_tpu.analysis.kerncheck import run_kern
+
+        return run_kern(args, out)
     paths = [Path(p) for p in (args.paths or [])]
     if args.root:
         root = Path(args.root)
@@ -247,21 +261,23 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
 
 
 def run_all(args: argparse.Namespace, out=None) -> int:
-    """All eight passes in one process: per-file + project rules (one
+    """All nine passes in one process: per-file + project rules (one
     ``ast.parse`` per file via ``core.parse_module``'s cache, which the
     wire pass shares), then the compile-plane trace audit, then the
     wire-plane contract check, then the perf-plane roofline check
     (which shares tracecheck's entrypoint registry), then the
     sharding-plane placement audit, then the protocol-plane
     deterministic exploration, then the scale-simulation capacity
-    sweep.  Exit 1 if any pass has fresh findings;
-    ``--update-baseline`` rewrites all the committed baselines."""
+    sweep, then the kernel-plane Pallas audit.  Exit 1 if any pass has
+    fresh findings; ``--update-baseline`` rewrites all the committed
+    baselines."""
     out = out if out is not None else sys.stdout
     # the shard probes need >= 4 devices, and the device count can only
     # be forced BEFORE any pass initializes the jax backend
     from dynamo_tpu.analysis.shardcheck import ensure_audit_devices
 
     ensure_audit_devices()
+    from dynamo_tpu.analysis.kerncheck import run_kern
     from dynamo_tpu.analysis.loadcheck import run_load
     from dynamo_tpu.analysis.perfcheck import run_perf
     from dynamo_tpu.analysis.protocheck import run_proto
@@ -280,8 +296,9 @@ def run_all(args: argparse.Namespace, out=None) -> int:
     rc_shard = run_shard(sub, out)
     rc_proto = run_proto(sub, out)
     rc_load = run_load(sub, out)
+    rc_kern = run_kern(sub, out)
     return max(rc_file, rc_trace, rc_wire, rc_perf, rc_shard, rc_proto,
-               rc_load)
+               rc_load, rc_kern)
 
 
 def main(argv: Optional[list[str]] = None) -> int:

@@ -62,10 +62,15 @@ Rules:
   grew beyond tolerance (decode-class dispatches live on this side of
   the roofline; bytes ARE their latency).
 
-Caveats (also recorded in the manifest header): all figures derive
-from the CPU lowering — Pallas kernels are priced via their XLA
-fallback jaxprs, fusion is assumed for elementwise chains, and
-``while`` trip counts are unknowable statically.  The model's job is
+Caveats (also recorded in the manifest header): roofline figures
+derive from the CPU lowering — fusion is assumed for elementwise
+chains, and ``while`` trip counts are unknowable statically.
+Pallas-backed ops are priced on BOTH sides of the dispatch decision:
+the roofline row walks the XLA fallback jaxpr CPU produces, and a
+``pallas_kernel`` row prices the registered kernel from
+``ops/pallas/registry.py``'s analytic cost table (the same table the
+kernel plane commits per-geometry into ``kern_manifest.json`` and the
+kernels pin on-device via ``cost_estimate=``).  The model's job is
 to *rank and gate*, not to be a simulator; its absolute calibration is
 itself observable at runtime through the predicted-vs-measured
 dispatch gauge (``obs/perfmodel.py``, ``/metrics``) and the
@@ -135,13 +140,26 @@ TRANSCENDENTAL_WEIGHT = 8
 
 _MANIFEST_NOTE = (
     "CPU-derived roofline facts (jax.make_jaxpr over ShapeDtypeStructs; "
-    "Pallas ops priced via their XLA fallback jaxprs; elementwise "
-    "chains assumed fused, while-loops charged one iteration): "
-    "predictions rank and gate relative changes — absolute calibration "
-    "is tracked at runtime by the predicted-vs-measured dispatch gauge "
-    "on /metrics and must be re-validated on-chip when the TPU tunnel "
-    "returns (ROADMAP standing note)."
+    "elementwise chains assumed fused, while-loops charged one "
+    "iteration): predictions rank and gate relative changes — absolute "
+    "calibration is tracked at runtime by the predicted-vs-measured "
+    "dispatch gauge on /metrics and must be re-validated on-chip when "
+    "the TPU tunnel returns (ROADMAP standing note).  Pallas-backed "
+    "ops carry BOTH sides of the dispatch decision: the roofline row "
+    "prices the XLA fallback jaxpr CPU lowers, and `pallas_kernel` "
+    "prices the registered kernel from ops/pallas/registry.py's "
+    "analytic cost table — the same table kerncheck commits "
+    "per-geometry into kern_manifest.json and the kernels pin "
+    "on-device via cost_estimate=."
 )
+
+# Entrypoints whose TPU path dispatches a registered Pallas kernel:
+# their signatures additionally get a `pallas_kernel` estimate from the
+# kernel registry's cost table.
+_PALLAS_PRICED = {
+    "ops.paged_attention_layer": "paged_decode_attention_mq",
+    "ops.ragged_prefill_attention": "ragged_paged_prefill_attention",
+}
 
 
 # ------------------------------------------------------------ cost walking ----
@@ -573,12 +591,47 @@ def build_perf_registry() -> list[Entrypoint]:
     return eps
 
 
+def _pallas_kernel_estimate(ep_name: str, sig: Signature) \
+        -> Optional[dict]:
+    """Price the kernel the TPU path dispatches for this signature from
+    the kernel registry's analytic cost table — dims read off the
+    signature's ShapeDtypeStructs, context at the worst-case static
+    bound (every row at full M*Bs), the same bound the kernels pin
+    on-device via ``cost_estimate=``.  Returns None for entrypoints
+    with no registered kernel."""
+    base = ep_name.partition("[")[0]
+    kernel = _PALLAS_PRICED.get(base)
+    if kernel is None:
+        return None
+    from dynamo_tpu.ops.pallas import registry as kreg
+
+    if base == "ops.paged_attention_layer":
+        q, cache, _, bt = sig.args[:4]
+        b, s_q, h, d = q.shape
+        # cache leaf layout: [L, N, 2, Bs, Hk*D] (models/llama.py)
+        bs, hkd = cache.shape[3], cache.shape[4]
+        cost = kreg.decode_kernel_cost(
+            b, s_q, h, hkd // d, d, bs, bt.shape[1],
+            [bt.shape[1] * bs] * b, cache_bytes=cache.dtype.itemsize)
+    else:  # ops.ragged_prefill_attention
+        q, _, _, cache, _, bt = sig.args[:6]
+        _, t, h, d = q.shape
+        bs, hkd = cache.shape[3], cache.shape[4]
+        cost = kreg.ragged_kernel_cost(
+            t, h, hkd // d, d, bs, bt.shape[1],
+            [bt.shape[1] * bs] * bt.shape[0],
+            cache_bytes=cache.dtype.itemsize)
+    return {"kernel": kernel, **cost}
+
+
 def collect_perf_facts(
         registry: Optional[list[Entrypoint]] = None) -> dict:
     """Roofline facts for every registered entrypoint, per
     representative signature (the same config matrix tracecheck
     eval-shapes).  Pure shape-level work: make_jaxpr over
-    ShapeDtypeStructs — no weights, no compiles, no model math."""
+    ShapeDtypeStructs — no weights, no compiles, no model math.
+    Pallas-backed ops get the registry's kernel pricing attached
+    alongside the fallback roofline (``pallas_kernel``)."""
     registry = registry if registry is not None else build_perf_registry()
     facts: dict[str, dict] = {}
     for ep in registry:
@@ -591,6 +644,9 @@ def collect_perf_facts(
             if sig is None:
                 continue
             est = estimate_callable(fn, sig.args, sig.statics)
+            kern = _pallas_kernel_estimate(ep.name, sig)
+            if kern is not None:
+                est["pallas_kernel"] = kern
             sigs[sig.label] = est
         facts[ep.name] = {"signatures": sigs}
     return facts

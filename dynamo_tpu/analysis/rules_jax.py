@@ -1,9 +1,11 @@
-"""JAX/TPU rules (DT101–DT104) for the engine hot path.
+"""JAX/TPU rules (DT101–DT105) for the engine hot path.
 
 These encode the discipline engine/core.py's step functions follow: jit
 once at init, donate the cache and never touch the stale buffer, pull
-results host-side in ONE batched device_get per step, and never leak
-tracers onto ``self`` from inside a jitted function.
+results host-side in ONE batched device_get per step, never leak
+tracers onto ``self`` from inside a jitted function, and route Pallas
+kernel geometry through the kernel registry so the kernel-plane audit
+(``dynamo-tpu lint --kern``) prices the shapes that actually ship.
 """
 
 from __future__ import annotations
@@ -305,3 +307,121 @@ class TracerOnSelf(Rule):
                         "the caller",
                     )
                     return
+
+
+_PALLAS_CALL = "jax.experimental.pallas.pallas_call"
+_BLOCKSPEC = "jax.experimental.pallas.BlockSpec"
+
+
+@register
+class PallasCallHygiene(Rule):
+    """DT105 — Pallas call sites bypassing the kernel registry.  The
+    kernel-plane audit (``dynamo-tpu lint --kern``, analysis/kerncheck)
+    prices every registered kernel's VMEM residency, index maps and
+    padding behaviour from ``ops/pallas/registry.py``'s tile table; a
+    call site that hardcodes its geometry (or pins ``interpret=True``)
+    drifts out from under that audit silently.  Three shapes, in any
+    module that calls ``pl.pallas_call``:
+
+    * ``interpret=True`` as a literal kwarg — interpret mode is a
+      debugging/audit device; a hardcoded literal ships the ~1000x
+      slower emulation path to serving.  Thread a parameter instead.
+    * integer literals > 1 in ``grid=`` or a ``BlockSpec`` block shape —
+      tile geometry must come from registry constants (or values derived
+      from them) so the kerncheck VMEM/index-map proofs cover the shapes
+      that actually run.  0 and 1 are structural (singleton/blocked-out
+      axes), not tile sizes, and stay allowed.
+    * an integer-literal default on a ``*_per_*`` parameter
+      (``blocks_per_chunk=4``) — same drift through the back door: the
+      default IS the served geometry, so it must be a registry name.
+    """
+
+    code = "DT105"
+    name = "pallas-geometry-bypass"
+    summary = (
+        "pallas_call geometry hardcoded at the call site (literal "
+        "interpret=True, literal grid/BlockSpec tile sizes, or int "
+        "defaults on *_per_* params) — route it through "
+        "ops/pallas/registry.py so the kernel-plane audit covers it"
+    )
+    interests = (ast.Module,)
+
+    def visit(self, node: ast.Module, ctx: ModuleContext) -> Iterable[Finding]:
+        calls = [n for n in ast.walk(node) if isinstance(n, ast.Call)]
+        psites = [c for c in calls if ctx.call_name(c) == _PALLAS_CALL]
+        if not psites:
+            return  # module doesn't build kernels — nothing to audit
+        for call in psites:
+            for kw in call.keywords:
+                if (
+                    kw.arg == "interpret"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                ):
+                    yield ctx.finding(
+                        self, kw.value,
+                        "pallas_call(interpret=True) hardcoded: the "
+                        "interpret emulator is audit-only and ~1000x "
+                        "slower — thread an `interpret: bool = False` "
+                        "parameter so serving code takes the compiled "
+                        "path",
+                    )
+                if kw.arg == "grid":
+                    yield from self._literal_dims(kw.value, "grid=", ctx)
+        for call in calls:
+            if ctx.call_name(call) == _BLOCKSPEC and call.args:
+                yield from self._literal_dims(
+                    call.args[0], "BlockSpec block shape", ctx
+                )
+        for fn in ast.walk(node):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._per_defaults(fn, ctx)
+
+    def _literal_dims(
+        self, value: ast.AST, where: str, ctx: ModuleContext
+    ) -> Iterable[Finding]:
+        dims = (
+            list(value.elts) if isinstance(value, ast.Tuple) else [value]
+        )
+        for d in dims:
+            if (
+                isinstance(d, ast.Constant)
+                and isinstance(d.value, int)
+                and not isinstance(d.value, bool)
+                and d.value > 1
+            ):
+                yield ctx.finding(
+                    self, d,
+                    f"integer literal {d.value} in {where}: tile "
+                    "geometry hardcoded at the call site escapes the "
+                    "kernel-plane audit — derive it from a registry "
+                    "constant (ops/pallas/registry.py)",
+                )
+
+    def _per_defaults(
+        self, fn: ast.AST, ctx: ModuleContext
+    ) -> Iterable[Finding]:
+        args = list(fn.args.posonlyargs) + list(fn.args.args)
+        defaults = list(fn.args.defaults)
+        paired = list(zip(args[len(args) - len(defaults):], defaults))
+        paired += [
+            (a, d)
+            for a, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults)
+            if d is not None
+        ]
+        for arg, default in paired:
+            if "_per_" not in arg.arg:
+                continue
+            if (
+                isinstance(default, ast.Constant)
+                and isinstance(default.value, int)
+                and not isinstance(default.value, bool)
+                and default.value > 1
+            ):
+                yield ctx.finding(
+                    self, default,
+                    f"{fn.name}({arg.arg}={default.value}): the default "
+                    "IS the served tile geometry — bind it to a "
+                    "registry constant so kerncheck's VMEM/index-map "
+                    "proofs cover what actually runs",
+                )

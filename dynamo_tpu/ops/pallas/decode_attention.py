@@ -48,6 +48,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from dynamo_tpu.ops.paged_attention import softcap
+from dynamo_tpu.ops.pallas.registry import (
+    DECODE_BLOCKS_PER_CHUNK,
+    DECODE_SEQS_PER_GROUP,
+    decode_cost_estimate,
+)
 
 __all__ = ["paged_decode_attention", "paged_decode_attention_mq"]
 
@@ -171,6 +176,15 @@ def _kernel_impl(
                 k = kvbuf[slot, j, :, 0].reshape(t, hkd).astype(jnp.float32)
                 v = kvbuf[slot, j, :, 1].reshape(t, hkd).astype(jnp.float32)
 
+                # Slots at/past seq_len hold whatever the pool holds (pad
+                # lanes of a live block, or a clamped re-fetch).  The score
+                # mask zeroes their P columns, but 0 * garbage-V is still
+                # garbage when the pool holds non-finite values — zero V
+                # rows (and the V scales below) for dead slots outright.
+                slot_pos = ci * t + jax.lax.broadcasted_iota(
+                    jnp.int32, (t, 1), 0)
+                v = jnp.where(slot_pos < seq_len, v, 0.0)
+
                 s = jax.lax.dot_general(
                     q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
                 )  # [H, T]
@@ -209,6 +223,10 @@ def _kernel_impl(
                     jax.lax.broadcasted_iota(jnp.int32, (rows, t), 0) // h
                 )
                 s = jnp.where((pos <= q_pos) & (pos < seq_len), s, NEG_INF)
+                if quant:
+                    # dead-slot V scales may be non-finite (pad lanes of
+                    # the scale tile) — see the V zeroing above
+                    scv = jnp.where(pos < seq_len, scv, 0.0)
 
                 m_prev = m_ref[j, :, :1]
                 m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -241,8 +259,8 @@ def paged_decode_attention(
     seq_lens: jax.Array,      # [B] int32
     sm_scale: float | None = None,
     logit_cap: float | None = None,
-    blocks_per_chunk: int = 4,
-    seqs_per_group: int = 8,
+    blocks_per_chunk: int = DECODE_BLOCKS_PER_CHUNK,
+    seqs_per_group: int = DECODE_SEQS_PER_GROUP,
     interpret: bool = False,
 ) -> jax.Array:
     """One decode step of attention for B sequences.  Returns [B, H, D]."""
@@ -269,8 +287,8 @@ def paged_decode_attention_mq(
     q0_pos: jax.Array,        # [B] int32 — absolute position of q[:, 0]
     sm_scale: float | None = None,
     logit_cap: float | None = None,
-    blocks_per_chunk: int = 4,
-    seqs_per_group: int = 8,
+    blocks_per_chunk: int = DECODE_BLOCKS_PER_CHUNK,
+    seqs_per_group: int = DECODE_SEQS_PER_GROUP,
     interpret: bool = False,
 ) -> jax.Array:
     """Multi-query flash decode: S queries per row (query j at position
@@ -342,12 +360,21 @@ def paged_decode_attention_mq(
         scratch_shapes=scratch,
     )
 
+    # Honest scheduling hint: seq_lens are dynamic, so price the static
+    # worst case (every row at full-table context).  None on older jax.
+    cost = decode_cost_estimate(
+        b, s_q, h, hk, d, bs, m, cache_bytes=data.dtype.itemsize,
+        quant=quant, blocks_per_chunk=blocks_per_chunk,
+        seqs_per_group=seqs_per_group)
+    cost_kw = {} if cost is None else {"cost_estimate": cost}
+
     out = pl.pallas_call(
         functools.partial(_kernel_quant if quant else _kernel, c=c, g=g,
                           s_q=s_q, hk=hk, logit_cap=logit_cap),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, rows, hkd), q.dtype),
         interpret=interpret,
+        **cost_kw,
     )(*operands)
 
     # Collapse the block-diagonal layout back to [B, S, H, D].

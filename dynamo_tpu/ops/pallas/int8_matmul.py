@@ -22,11 +22,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dynamo_tpu.ops.pallas.registry import (
+    INT8_MATMUL_BK,
+    INT8_MATMUL_BM,
+    INT8_MATMUL_BN,
+)
+
 __all__ = ["int8_matmul", "BM", "BN", "BK"]
 
-# default block sizes — exported so the routing precheck in models/quant.py
-# and the kernel's tiling asserts can never disagree
-BM, BN, BK = 128, 512, 512
+# default block sizes — owned by the kernel registry (the audit prices
+# against the same table); re-exported so the routing precheck in
+# models/quant.py and the kernel's tiling asserts can never disagree
+BM, BN, BK = INT8_MATMUL_BM, INT8_MATMUL_BN, INT8_MATMUL_BK
 
 
 def _kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, nk: int):

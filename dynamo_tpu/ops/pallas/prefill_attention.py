@@ -30,6 +30,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from dynamo_tpu.ops.paged_attention import softcap
+from dynamo_tpu.ops.pallas.registry import (
+    PREFILL_BLOCKS_PER_CHUNK,
+    PREFILL_ROWS_PER_CHUNK,
+    prefill_cost_estimate,
+    ragged_cost_estimate,
+)
 
 __all__ = ["paged_prefill_attention", "ragged_paged_prefill_attention"]
 
@@ -174,6 +180,15 @@ def _kernel_impl(
                 [scbuf[slot, i, 1][:hk, :bs] for i in range(c)], axis=-1)
         col = ci * t + jax.lax.broadcasted_iota(jnp.int32, (1, t), 1)
         allow = col < prefix                              # [1, T]
+        # dead prefix slots (past `prefix` in the tail block) may hold
+        # non-finite pool garbage; the score mask zeroes their P columns
+        # but 0 * NaN-V survives the PV product — zero V rows (and the V
+        # scales) for them outright
+        vmask = ci * t + jax.lax.broadcasted_iota(
+            jnp.int32, (t, 1), 0) < prefix
+        vc = jnp.where(vmask, vc, 0.0)
+        if quant:
+            scv = jnp.where(allow, scv, 0.0)
         for h in range(hk):  # static unroll over kv heads
             s_ = jax.lax.dot_general(
                 q_head(h), kc[:, h * d:(h + 1) * d],
@@ -200,6 +215,9 @@ def _kernel_impl(
         col = col0 + jax.lax.broadcasted_iota(jnp.int32, (1, tq), 1)
         # causal by fresh index + clip padding columns
         allow = (col <= ri * tq + rows) & (col < fresh)      # [TQ*G, TQ]
+        # fresh padding tokens may be non-finite — zero their V rows
+        vc = jnp.where(col0 + jax.lax.broadcasted_iota(
+            jnp.int32, (tq, 1), 0) < fresh, vc, 0.0)
         for h in range(hk):
             s_ = jax.lax.dot_general(
                 q_head(h), kc[:, h * d:(h + 1) * d],
@@ -237,10 +255,11 @@ def paged_prefill_attention(
     sm_scale: float | None = None,
     logit_cap: float | None = None,
     # 128 rows/chunk keeps scratch (acc + m/l at 128-lane padding) + the
-    # VMEM-resident fresh K/V comfortably under the ~16MB VMEM budget at
-    # S=2048, Hk*D=512
-    rows_per_chunk: int = 128,
-    blocks_per_chunk: int = 8,
+    # VMEM-resident fresh K/V well inside the per-core VMEM budget at
+    # S=2048, Hk*D=512 — machine-checked by kerncheck's `prefill-8b`
+    # geometry (KN001) against registry.VMEM_BUDGET_BYTES
+    rows_per_chunk: int = PREFILL_ROWS_PER_CHUNK,
+    blocks_per_chunk: int = PREFILL_BLOCKS_PER_CHUNK,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash prefill for S fresh tokens against fresh K/V + cached prefix.
@@ -308,6 +327,14 @@ def paged_prefill_attention(
         scratch_shapes=scratch,
     )
 
+    # Honest scheduling hint at the static worst case (full-table
+    # prefixes) — seq_lens/start are dynamic.  None on older jax.
+    cost = prefill_cost_estimate(
+        b, s, h, hk, d, bs, m, cache_bytes=data.dtype.itemsize,
+        quant=quant, rows_per_chunk=rows_per_chunk,
+        blocks_per_chunk=blocks_per_chunk)
+    cost_kw = {} if cost is None else {"cost_estimate": cost}
+
     out = pl.pallas_call(
         functools.partial(
             _kernel_quant if quant else _kernel,
@@ -317,6 +344,7 @@ def paged_prefill_attention(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hk, s, g * d), q.dtype),
         interpret=interpret,
+        **cost_kw,
     )(*operands)
     # [B, Hk, S, G*D] -> [B, S, H, D]
     return out.transpose(0, 2, 1, 3).reshape(b, s, h, d)
@@ -491,6 +519,14 @@ def _ragged_kernel_impl(
                     jnp.int32, (1, t_chunk), 1)
                 # only this row's queries see this row's prefix slots
                 allow = (col < prefix) & (sid_q == r)
+                # dead tail-block slots may be non-finite pool garbage —
+                # zero their V rows (and V scales); the score mask alone
+                # leaves 0 * NaN in the PV product
+                vmask = ci * t_chunk + jax.lax.broadcasted_iota(
+                    jnp.int32, (t_chunk, 1), 0) < prefix
+                vc = jnp.where(vmask, vc, 0.0)
+                if quant:
+                    scv = jnp.where(col < prefix, scv, 0.0)
                 for h in range(hk):
                     s_ = jax.lax.dot_general(
                         q_head(h), kc[:, h * d:(h + 1) * d],
@@ -519,6 +555,11 @@ def _ragged_kernel_impl(
         vc = v_ref[0, pl.ds(col0, tq)].astype(jnp.float32)
         col = col0 + jax.lax.broadcasted_iota(jnp.int32, (1, tq), 1)
         sid_c = sid_at(col)                                  # [1, TQ]
+        # packed-padding tokens (sid -1) may be non-finite — zero their
+        # V rows before the PV product
+        sid_v = sid_at(col0 + jax.lax.broadcasted_iota(
+            jnp.int32, (tq, 1), 0))
+        vc = jnp.where(sid_v >= 0, vc, 0.0)
         # same sequence + causal by flat index; padding queries (sid -1)
         # match nothing — fully-masked rows degenerate to a finite
         # uniform-weight PV mean (exp(NEG_INF - NEG_INF) = 1), which the
@@ -561,8 +602,8 @@ def ragged_paged_prefill_attention(
     row_offsets: jax.Array,   # [R] int32 — flat index of row's first token
     sm_scale: float | None = None,
     logit_cap: float | None = None,
-    rows_per_chunk: int = 128,
-    blocks_per_chunk: int = 8,
+    rows_per_chunk: int = PREFILL_ROWS_PER_CHUNK,
+    blocks_per_chunk: int = PREFILL_BLOCKS_PER_CHUNK,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash ragged (mixed-chunk) attention: T packed fresh tokens of up
@@ -635,6 +676,12 @@ def ragged_paged_prefill_attention(
         scratch_shapes=scratch,
     )
 
+    cost = ragged_cost_estimate(
+        t, r_rows, h, hk, d, bs, m, cache_bytes=data.dtype.itemsize,
+        quant=quant, rows_per_chunk=rows_per_chunk,
+        blocks_per_chunk=blocks_per_chunk)
+    cost_kw = {} if cost is None else {"cost_estimate": cost}
+
     out = pl.pallas_call(
         functools.partial(
             _ragged_kernel_quant if quant else _ragged_kernel,
@@ -644,6 +691,7 @@ def ragged_paged_prefill_attention(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((1, hk, t, g * d), q.dtype),
         interpret=interpret,
+        **cost_kw,
     )(*operands)
     # [1, Hk, T, G*D] -> [1, T, H, D]
     return out.transpose(0, 2, 1, 3).reshape(1, t, h, d)

@@ -1,0 +1,1067 @@
+"""Kernel registry — the single source of truth for Pallas kernel geometry.
+
+Every `pallas_call` site in ops/pallas/ is registered here together with
+the geometry matrix it is audited under (analysis/kerncheck.py, the
+dtkern plane) and probed under (benchmarks/probe_kernels.py, bench.py).
+The registry owns three things the kernels themselves must not:
+
+- **tile constants**: blocks-per-chunk / rows-per-chunk / matmul block
+  sizes.  The kernels import their defaults from here, so a tuning-knob
+  change is one edit that the audit, the probes and the serving path all
+  see (DT105 flags integer tile literals that bypass this table).
+- **the audit matrix**: per-kernel geometry cases, including the
+  adversarial ragged shapes (empty rows, 1-token decode rows,
+  non-block-divisible lengths, max-block rows, non-block-aligned decode
+  starts) that the NaN-canary padding oracles run against, plus
+  serving-scale spec-only cases that are shape-traced (jax.eval_shape)
+  for VMEM/pricing without executing.
+- **capture + pricing**: a `pallas_call` spy that records grid, specs,
+  scratch and operands at call time, and the analytic cost model (HBM
+  DMA bytes / FLOPs / transcendentals) shared between the kern-manifest
+  pricing facts and the `cost_estimate=` each attention kernel hands
+  XLA's scheduler.
+
+kerncheck turns the captures into KN001-KN006 facts; this module stays
+importable from ops/ (no analysis imports) and imports the kernels only
+lazily inside builders so the kernels can import the constants above.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import math
+
+__all__ = [
+    "DECODE_BLOCKS_PER_CHUNK",
+    "DECODE_SEQS_PER_GROUP",
+    "PREFILL_ROWS_PER_CHUNK",
+    "PREFILL_BLOCKS_PER_CHUNK",
+    "INT8_MATMUL_BM",
+    "INT8_MATMUL_BN",
+    "INT8_MATMUL_BK",
+    "V5E_VMEM_BYTES",
+    "VMEM_BUDGET_BYTES",
+    "KERNELS",
+    "audit_cases",
+    "fuzz_case",
+    "capture_pallas_calls",
+    "decode_kernel_cost",
+    "prefill_kernel_cost",
+    "ragged_kernel_cost",
+    "int8_matmul_cost",
+    "decode_cost_estimate",
+    "prefill_cost_estimate",
+    "ragged_cost_estimate",
+    "fallback_census",
+    "probe_coverage",
+    "quantize_audit_cache",
+]
+
+# ------------------------------------------------------- tile constants ----
+# The serving tile sizes.  decode: 4 blocks per DMA chunk x 8 sequences
+# per grid step fits the 8B bf16 KV working set; prefill: 128 query rows
+# per grid step keeps acc/m/l scratch + the VMEM-resident fresh K/V well
+# inside VMEM at S=2048.  int8_matmul: MXU-shaped (128, 512, 512).
+DECODE_BLOCKS_PER_CHUNK = 4
+DECODE_SEQS_PER_GROUP = 8
+PREFILL_ROWS_PER_CHUNK = 128
+PREFILL_BLOCKS_PER_CHUNK = 8
+INT8_MATMUL_BM = 128
+INT8_MATMUL_BN = 512
+INT8_MATMUL_BK = 512
+
+# v5e VMEM is 128 MiB per core (accelerator guide); budget 75% of it —
+# the compiler needs headroom for spills and the double-buffer pipeline.
+V5E_VMEM_BYTES = 128 * 1024 * 1024
+VMEM_BUDGET_BYTES = int(V5E_VMEM_BYTES * 0.75)
+
+# Pallas allocates two buffers per blocked operand (pipeline double
+# buffering); manual kvbuf scratch already carries its own factor 2.
+DOUBLE_BUFFER = 2
+
+# ------------------------------------------------------- kernel census ----
+# Every pallas_call site, plus the unified-kernel placeholder: ROADMAP
+# item 2 (Ragged Paged Attention, arxiv 2604.15464) replaces the
+# decode/ragged-prefill split with ONE kernel.  While `unified` has no
+# module, kerncheck's census reports the two-kernel split (KN006) — the
+# accepted manifest entry that landing item 2 re-trips.
+KERNELS = {
+    "paged_decode_attention_mq": {
+        "module": "dynamo_tpu.ops.pallas.decode_attention",
+        "placeholder": False,
+    },
+    "paged_prefill_attention": {
+        "module": "dynamo_tpu.ops.pallas.prefill_attention",
+        "placeholder": False,
+    },
+    "ragged_paged_prefill_attention": {
+        "module": "dynamo_tpu.ops.pallas.prefill_attention",
+        "placeholder": False,
+    },
+    "int8_matmul": {
+        "module": "dynamo_tpu.ops.pallas.int8_matmul",
+        "placeholder": False,
+    },
+    "unified_ragged_attention": {
+        "module": None,  # ROADMAP item 2 — not yet written
+        "placeholder": True,
+    },
+}
+
+
+# ------------------------------------------------------------- capture ----
+
+
+@contextlib.contextmanager
+def capture_pallas_calls(records: list):
+    """Monkeypatch `pl.pallas_call` on the shared pallas module with a
+    spy that records (kernel name, grid, specs, scratch, operand avals)
+    at call time and delegates to the real pallas_call.  The kernel
+    modules all hold the module object (`from jax.experimental import
+    pallas as pl`), so the attribute patch is visible to every site."""
+    import jax.experimental.pallas as plmod
+
+    real = plmod.pallas_call
+
+    def spy(kernel, **kw):
+        inner = real(kernel, **kw)
+
+        def wrapped(*operands):
+            records.append(_record_call(kernel, kw, operands))
+            return inner(*operands)
+
+        return wrapped
+
+    plmod.pallas_call = spy
+    try:
+        yield records
+    finally:
+        plmod.pallas_call = real
+
+
+def _kernel_name(kernel) -> str:
+    fn = getattr(kernel, "func", kernel)  # unwrap functools.partial
+    return getattr(fn, "__name__", repr(fn))
+
+
+def _record_call(kernel, kw: dict, operands) -> dict:
+    """Normalize one pallas_call into a plain capture record.  Works for
+    both concrete operands (eager interpret runs) and tracers (spec-only
+    jax.eval_shape runs) — only shape/dtype are read off the operands."""
+    gs = kw.get("grid_spec")
+    if gs is not None:
+        grid = tuple(gs.grid)
+        in_specs = list(gs.in_specs)
+        out_specs = gs.out_specs
+        scratch = list(getattr(gs, "scratch_shapes", ()) or ())
+        nsp = int(getattr(gs, "num_scalar_prefetch", 0) or 0)
+    else:
+        grid = kw.get("grid", ())
+        grid = (grid,) if isinstance(grid, int) else tuple(grid or ())
+        in_specs = list(kw.get("in_specs", ()) or ())
+        out_specs = kw.get("out_specs")
+        scratch = list(kw.get("scratch_shapes", ()) or ())
+        nsp = 0
+    out_specs = (
+        list(out_specs) if isinstance(out_specs, (list, tuple))
+        else [out_specs]
+    )
+    out_shape = kw.get("out_shape")
+    out_shapes = (
+        list(out_shape) if isinstance(out_shape, (list, tuple))
+        else [out_shape]
+    )
+    return {
+        "name": _kernel_name(kernel),
+        "grid": grid,
+        "num_scalar_prefetch": nsp,
+        "in_specs": in_specs,
+        "out_specs": out_specs,
+        "scratch": scratch,
+        "operands": [(tuple(o.shape), str(o.dtype)) for o in operands],
+        "out_shapes": [(tuple(o.shape), str(o.dtype)) for o in out_shapes],
+        "interpret": bool(kw.get("interpret", False)),
+    }
+
+
+# ------------------------------------------------------- pricing model ----
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def decode_kernel_cost(
+    b: int, s_q: int, h: int, hk: int, d: int, bs: int, m: int,
+    lens, cache_bytes: int = 2, quant: bool = False, q_bytes: int = 4,
+    blocks_per_chunk: int = DECODE_BLOCKS_PER_CHUNK,
+    seqs_per_group: int = DECODE_SEQS_PER_GROUP,
+) -> dict:
+    """Analytic cost of one flash-decode dispatch: per-group chunk DMA
+    (work proportional to the group max context, the kernel's actual
+    loop bound), blocked q/out traffic, QK+PV FLOPs and softmax exps.
+    ``lens`` is the per-row context; pass ``[m * bs] * b`` for the
+    worst-case static bound (cost_estimate=)."""
+    hkd = hk * d
+    rows = s_q * h
+    c = min(blocks_per_chunk, m)
+    g = max(1, seqs_per_group // s_q)
+    while b % g:
+        g -= 1
+    t = c * bs
+    block_bytes = 2 * bs * hkd * cache_bytes
+    if quant:
+        from dynamo_tpu.ops.kv_quant import scale_tile
+
+        hp, sp = scale_tile(hk, bs)
+        block_bytes += 2 * hp * sp * 4
+    lens = [int(x) for x in lens]
+    dma = flops = trans = 0
+    for gi in range(b // g):
+        grp_max = max(lens[gi * g:(gi + 1) * g])
+        chunks = _cdiv(grp_max, t) if grp_max > 0 else 0
+        dma += chunks * g * c * block_bytes
+        flops += chunks * g * 4 * rows * t * hkd  # QK + PV matmuls
+        trans += chunks * g * rows * t            # softmax exp
+    steps = b // g
+    dma += steps * g * rows * hkd * (4 + q_bytes)  # q (f32) in + out
+    return _cost_dict(dma, flops, trans)
+
+
+def prefill_kernel_cost(
+    b: int, s: int, h: int, hk: int, d: int, bs: int, m: int,
+    starts, cache_bytes: int = 2, quant: bool = False, q_bytes: int = 2,
+    rows_per_chunk: int = PREFILL_ROWS_PER_CHUNK,
+    blocks_per_chunk: int = PREFILL_BLOCKS_PER_CHUNK,
+) -> dict:
+    """Analytic cost of one flash-prefill dispatch.  Each of the S/TQ
+    row-chunks of a row re-streams that row's cached prefix (the kernel
+    restarts the prefix walk per grid step); the fresh phase is the
+    causal triangle.  ``starts`` is the per-row prefix length (pass
+    ``[m * bs] * b`` for the worst-case static bound)."""
+    g = h // hk
+    hkd = hk * d
+    tq = min(rows_per_chunk, s)
+    while s % tq:
+        tq //= 2
+    c = min(blocks_per_chunk, m)
+    t = c * bs
+    n_steps = s // tq
+    rows = tq * g
+    block_bytes = 2 * bs * hkd * cache_bytes
+    if quant:
+        from dynamo_tpu.ops.kv_quant import scale_tile
+
+        hp, sp = scale_tile(hk, bs)
+        block_bytes += 2 * hp * sp * 4
+    dma = flops = trans = 0
+    for start in [int(x) for x in starts]:
+        p = _cdiv(start, t)
+        dma += n_steps * p * c * block_bytes
+        flops += n_steps * p * hk * 4 * rows * t * d
+        trans += n_steps * p * hk * rows * t
+    # fresh phase: step ri visits ri+1 TQ-sized K/V chunks (causal)
+    tri = n_steps * (n_steps + 1) // 2
+    flops += b * tri * hk * 4 * rows * tq * d
+    trans += b * tri * hk * rows * tq
+    # blocked traffic: q/out per step; fresh K/V re-fetched per batch row
+    dma += b * n_steps * tq * hkd * g // hk * 0  # (kept explicit below)
+    dma += b * n_steps * (tq * g * d * hk // hk) * 0
+    dma += b * n_steps * tq * g * d * hk * 0
+    dma += b * n_steps * hk * tq * g * d * (q_bytes + q_bytes)  # q + out
+    dma += b * 2 * s * hkd * cache_bytes  # fresh K and V, once per row
+    return _cost_dict(dma, flops, trans)
+
+
+def ragged_kernel_cost(
+    t_tokens: int, h: int, hk: int, d: int, bs: int, m: int,
+    starts, cache_bytes: int = 2, quant: bool = False, q_bytes: int = 2,
+    rows_per_chunk: int = PREFILL_ROWS_PER_CHUNK,
+    blocks_per_chunk: int = PREFILL_BLOCKS_PER_CHUNK,
+) -> dict:
+    """Analytic cost of one ragged (mixed-chunk) dispatch: grid T/TQ;
+    EVERY grid step walks every overlapping row's prefix — the audit
+    prices the conservative bound where each step streams each row's
+    full prefix (the kernel skips non-overlapping rows, so the true
+    cost is lower for well-packed batches)."""
+    g = h // hk
+    hkd = hk * d
+    tq = min(rows_per_chunk, t_tokens)
+    while t_tokens % tq:
+        tq //= 2
+    c = min(blocks_per_chunk, m)
+    t = c * bs
+    n_steps = t_tokens // tq
+    rows = tq * g
+    block_bytes = 2 * bs * hkd * cache_bytes
+    if quant:
+        from dynamo_tpu.ops.kv_quant import scale_tile
+
+        hp, sp = scale_tile(hk, bs)
+        block_bytes += 2 * hp * sp * 4
+    dma = flops = trans = 0
+    for start in [int(x) for x in starts]:
+        p = _cdiv(start, t)
+        dma += n_steps * p * c * block_bytes
+        flops += n_steps * p * hk * 4 * rows * t * d
+        trans += n_steps * p * hk * rows * t
+    tri = n_steps * (n_steps + 1) // 2
+    flops += tri * hk * 4 * rows * tq * d
+    trans += tri * hk * rows * tq
+    dma += n_steps * hk * tq * g * d * (q_bytes + q_bytes)  # q + out
+    dma += 2 * t_tokens * hkd * cache_bytes  # packed fresh K and V
+    return _cost_dict(dma, flops, trans)
+
+
+def int8_matmul_cost(
+    m: int, k: int, n: int, x_bytes: int = 2, out_bytes: int = 2,
+    bm: int = INT8_MATMUL_BM, bn: int = INT8_MATMUL_BN,
+    bk: int = INT8_MATMUL_BK,
+) -> dict:
+    """Analytic cost of one dequant-in-kernel int8 matmul: the weight
+    tile streams as int8 (the whole point), x tiles re-stream per N
+    block, outputs write once."""
+    bm, bn, bk = min(bm, m), min(bn, n), min(bk, k)
+    gm, gn, gk = m // bm, n // bn, k // bk
+    dma = (
+        gm * gn * gk * (bm * bk * x_bytes + bk * bn * 1)  # x bf16 + w int8
+        + gm * gn * (bm * bn * out_bytes + bn * 4)        # out + scale
+    )
+    return _cost_dict(dma, 2 * m * n * k, 0)
+
+
+def _cost_dict(dma: int, flops: int, trans: int) -> dict:
+    return {
+        "hbm_bytes": int(dma),
+        "flops": int(flops),
+        "transcendentals": int(trans),
+        "intensity": round(flops / dma, 4) if dma else 0.0,
+    }
+
+
+def _cost_estimate(cost: dict):
+    """dict -> pl.CostEstimate (None when this jax predates it)."""
+    from jax.experimental import pallas as pl
+
+    ce = getattr(pl, "CostEstimate", None)
+    if ce is None:  # pragma: no cover - older jax
+        return None
+    return ce(
+        flops=cost["flops"],
+        transcendentals=cost["transcendentals"],
+        bytes_accessed=cost["hbm_bytes"],
+    )
+
+
+def decode_cost_estimate(b, s_q, h, hk, d, bs, m, cache_bytes, quant,
+                         blocks_per_chunk, seqs_per_group):
+    """Worst-case (full-table context) CostEstimate for the decode
+    pallas_call — seq_lens are dynamic at trace time, so the static
+    bound is every row at M*Bs context."""
+    return _cost_estimate(decode_kernel_cost(
+        b, s_q, h, hk, d, bs, m, [m * bs] * b, cache_bytes=cache_bytes,
+        quant=quant, blocks_per_chunk=blocks_per_chunk,
+        seqs_per_group=seqs_per_group,
+    ))
+
+
+def prefill_cost_estimate(b, s, h, hk, d, bs, m, cache_bytes, quant,
+                          rows_per_chunk, blocks_per_chunk):
+    return _cost_estimate(prefill_kernel_cost(
+        b, s, h, hk, d, bs, m, [m * bs] * b, cache_bytes=cache_bytes,
+        quant=quant, rows_per_chunk=rows_per_chunk,
+        blocks_per_chunk=blocks_per_chunk,
+    ))
+
+
+def ragged_cost_estimate(t_tokens, r_rows, h, hk, d, bs, m, cache_bytes,
+                         quant, rows_per_chunk, blocks_per_chunk):
+    return _cost_estimate(ragged_kernel_cost(
+        t_tokens, h, hk, d, bs, m, [m * bs] * r_rows,
+        cache_bytes=cache_bytes, quant=quant,
+        rows_per_chunk=rows_per_chunk, blocks_per_chunk=blocks_per_chunk,
+    ))
+
+
+# -------------------------------------------------- cross-plane census ----
+
+
+def fallback_census() -> dict:
+    """The XLA-fallback collective census the shard plane accepted: the
+    CPU decode probes gather the paged cache because the Pallas kernels
+    (which keep it on-chip) don't lower there.  kerncheck asserts these
+    stay in sync with shard_manifest.json's accepted SH002 entries
+    (KN006) — retiring a kernel, or landing the unified kernel, must
+    update BOTH planes deliberately."""
+    return {
+        "probe.llama.decode[tiny-llama]": {"all-gather": 6},
+        "probe.deepseek.decode[tiny-mla]": {"all-gather": 7},
+    }
+
+
+def probe_coverage() -> dict:
+    """kernel -> probed?  True when benchmarks/probe_kernels.py builds a
+    variant from this registry's probe builders (satellite: a registered
+    kernel without a probe is a KN006 finding).  Placeholders carry no
+    probe by definition."""
+    return {
+        name: (name in _PROBE_BUILDERS or meta["placeholder"])
+        for name, meta in KERNELS.items()
+    }
+
+
+# ------------------------------------------------------ input builders ----
+
+
+def quantize_audit_cache(cache, hk: int):
+    """f32 cache [L, N, 2, Bs, Hk*D] -> QuantKvCache with the canonical
+    token-minor tile-padded scale layout."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops.kv_quant import (
+        QuantKvCache,
+        pad_scales,
+        quantize_kv_rows,
+    )
+
+    L, n, _, bs, hkd = cache.shape
+    d = hkd // hk
+    q8, sc = quantize_kv_rows(cache.reshape(L, n, 2, bs, hk, d))
+    data = q8.reshape(L, n, 2, bs, hkd)
+    sc = jnp.swapaxes(sc, -1, -2)  # [..., Hk, Bs] token-minor
+    return QuantKvCache(data, pad_scales(sc))
+
+
+def _np():
+    import numpy as np
+
+    return np
+
+
+def _poison_cache(cache, bt, valid, bs):
+    """NaN-poison a f32/bf16 cache: every slot of every unreferenced
+    block, and every slot at/past ``valid[r]`` inside row r's blocks.
+    (valid = seq_len for decode, prefix start for prefill/ragged.)"""
+    np = _np()
+    c = np.asarray(cache, np.float32)
+    poisoned = np.full_like(c, np.nan)
+    for r in range(bt.shape[0]):
+        for ti in range(bt.shape[1]):
+            bid = int(bt[r, ti])
+            keep = max(0, min(bs, int(valid[r]) - ti * bs))
+            if keep:
+                poisoned[:, bid, :, :keep] = c[:, bid, :, :keep]
+    return poisoned
+
+
+def _poison_scales(scale, bt, valid, hk, bs):
+    """Same poison for the quant scale pool [L, N, 2, Hp, Sp]: the pad
+    lanes go NaN too — the kernels slice [:hk, :bs] value-level, and
+    that slice is what keeps the poison out."""
+    np = _np()
+    s = np.asarray(scale, np.float32)
+    poisoned = np.full_like(s, np.nan)
+    for r in range(bt.shape[0]):
+        for ti in range(bt.shape[1]):
+            bid = int(bt[r, ti])
+            keep = max(0, min(bs, int(valid[r]) - ti * bs))
+            if keep:
+                poisoned[:, bid, :, :hk, :keep] = s[:, bid, :, :hk, :keep]
+    return poisoned
+
+
+def _disjoint_tables(rows: int, m: int, n: int):
+    """One disjoint block-id table per row, skipping block 0 so the
+    clamp-path reads of padding table slots (which the engine leaves 0)
+    hit an unreferenced — poisoned — block if they ever load."""
+    np = _np()
+    assert rows * m + 1 <= n, (rows, m, n)
+    return (1 + np.arange(rows * m, dtype=np.int32)).reshape(rows, m)
+
+
+# Audit dims shared by the small attention cases: tiny enough for
+# interpret mode on CPU inside the tier-1 budget, shaped enough (GQA,
+# multi-block tables, two layers) to exercise every index path.
+_L, _BS, _HK, _D, _H, _M = 2, 8, 2, 16, 4, 4
+_HKD = _HK * _D
+
+
+def _decode_case(name: str, quant: bool, s_q: int = 1) -> dict:
+    import jax.numpy as jnp
+
+    np = _np()
+    b = 8 if s_q == 1 else 4
+    n = b * _M + 1
+    layer = 1
+    if s_q == 1:
+        # empty row, 1-token row, block-exact, non-divisible, max-table
+        lens = np.asarray([1, _M * _BS, 11, 0, _BS, 5, 17, 29], np.int32)
+    else:
+        # multi-query rows with non-block-aligned first-query positions
+        lens = np.asarray([7, _M * _BS, 2, 19], np.int32)
+
+    def build():
+        rng = np.random.default_rng(101 if quant else 100)
+        cache = jnp.asarray(
+            rng.normal(size=(_L, n, 2, _BS, _HKD)), jnp.float32)
+        bt = _disjoint_tables(b, _M, n)
+        q = jnp.asarray(rng.normal(size=(b, s_q, _H, _D)), jnp.float32)
+        kcache = quantize_audit_cache(cache, _HK) if quant else cache
+        return {
+            "q": q, "cache": kcache, "clean": cache,
+            "bt": jnp.asarray(bt), "bt_np": bt, "lens": jnp.asarray(lens),
+            "layer": jnp.int32(layer), "q0": jnp.asarray(lens - s_q),
+        }
+
+    def run(inp, poisoned: bool):
+        from dynamo_tpu.ops.kv_quant import QuantKvCache
+        from dynamo_tpu.ops.pallas.decode_attention import (
+            paged_decode_attention_mq,
+        )
+
+        cache = inp["cache"]
+        if poisoned:
+            if quant:
+                cache = QuantKvCache(cache.data, _np().asarray(
+                    _poison_scales(cache.scale, inp["bt_np"], lens,
+                                   _HK, _BS)))
+            else:
+                cache = _np().asarray(
+                    _poison_cache(cache, inp["bt_np"], lens, _BS),
+                    dtype=_np().float32)
+        return paged_decode_attention_mq.__wrapped__(
+            inp["q"], cache, inp["layer"], inp["bt"], inp["lens"],
+            inp["q0"], blocks_per_chunk=2, seqs_per_group=4,
+            interpret=True,
+        )
+
+    def oracle(inp):
+        import jax
+
+        from dynamo_tpu.ops.kv_quant import dequant_layer_slice
+        from dynamo_tpu.ops.paged_attention import paged_attention
+
+        np = _np()
+        cache = inp["cache"]
+        if quant:
+            data = jax.lax.dynamic_index_in_dim(
+                cache.data, inp["layer"], axis=0, keepdims=False)
+            sc = jax.lax.dynamic_index_in_dim(
+                cache.scale, inp["layer"], axis=0, keepdims=False)
+            layer_kv = dequant_layer_slice(data, sc, _HK)
+        else:
+            layer_kv = cache[layer]
+        kc = layer_kv[:, 0].reshape(n, _BS, _HK, _D)
+        vc = layer_kv[:, 1].reshape(n, _BS, _HK, _D)
+        positions = (lens - s_q)[:, None] + np.arange(s_q)[None, :]
+        ref = paged_attention(
+            inp["q"], kc, vc, inp["bt"],
+            inp["lens"], positions.astype(np.int32))
+        live = np.broadcast_to(
+            (lens >= s_q)[:, None, None, None], ref.shape).copy()
+        zero = np.broadcast_to(
+            (lens == 0)[:, None, None, None], ref.shape).copy()
+        return np.asarray(ref), live, zero
+
+    def pricing():
+        return decode_kernel_cost(
+            b, s_q, _H, _HK, _D, _BS, _M, lens, cache_bytes=1 if quant
+            else 4, quant=quant, blocks_per_chunk=2, seqs_per_group=4)
+
+    return {
+        "name": name, "kernel": "paged_decode_attention_mq",
+        "mode": "interpret", "atol": 2e-3 if quant else 2e-4,
+        "build": build, "run": run, "oracle": oracle, "pricing": pricing,
+    }
+
+
+def _prefill_case(name: str = "prefill-bf16") -> dict:
+    import jax.numpy as jnp
+
+    np = _np()
+    b, s, layer = 2, 16, 0
+    n = b * _M + 1
+    starts = np.asarray([8, 0], np.int32)   # 1-block prefix / no prefix
+    lens = np.asarray([24, 13], np.int32)   # row 1: 3 padding tail rows
+
+    def build():
+        rng = np.random.default_rng(200)
+        cache = jnp.asarray(
+            rng.normal(size=(_L, n, 2, _BS, _HKD)), jnp.float32)
+        bt = _disjoint_tables(b, _M, n)
+        q = jnp.asarray(rng.normal(size=(b, s, _H, _D)), jnp.float32)
+        k_new = jnp.asarray(rng.normal(size=(b, s, _HK, _D)), jnp.float32)
+        v_new = jnp.asarray(rng.normal(size=(b, s, _HK, _D)), jnp.float32)
+        return {
+            "q": q, "k": k_new, "v": v_new, "cache": cache,
+            "bt": jnp.asarray(bt), "bt_np": bt,
+            "lens": jnp.asarray(lens), "starts": jnp.asarray(starts),
+            "layer": jnp.int32(layer),
+        }
+
+    def run(inp, poisoned: bool):
+        from dynamo_tpu.ops.pallas.prefill_attention import (
+            paged_prefill_attention,
+        )
+
+        np = _np()
+        q, k, v, cache = inp["q"], inp["k"], inp["v"], inp["cache"]
+        if poisoned:
+            cache = np.asarray(
+                _poison_cache(cache, inp["bt_np"], starts, _BS),
+                np.float32)
+            fresh = (lens - starts)
+            qp, kp, vp = (np.asarray(x, np.float32).copy()
+                          for x in (q, k, v))
+            for r in range(b):
+                qp[r, fresh[r]:] = np.nan
+                kp[r, fresh[r]:] = np.nan
+                vp[r, fresh[r]:] = np.nan
+            q, k, v = qp, kp, vp
+        return paged_prefill_attention.__wrapped__(
+            q, k, v, cache, inp["layer"], inp["bt"], inp["lens"],
+            inp["starts"], rows_per_chunk=8, blocks_per_chunk=2,
+            interpret=True,
+        )
+
+    def oracle(inp):
+        import os
+
+        from dynamo_tpu.ops.paged_attention import prefill_attention
+
+        np = _np()
+        os.environ["DYNAMO_DISABLE_PALLAS_PREFILL"] = "1"
+        try:
+            ref = prefill_attention(
+                inp["q"], inp["k"], inp["v"], inp["cache"], inp["layer"],
+                inp["bt"], inp["lens"], inp["starts"], prefix_blocks=1)
+        finally:
+            os.environ.pop("DYNAMO_DISABLE_PALLAS_PREFILL", None)
+        fresh = lens - starts
+        idx = np.arange(s)
+        live = np.broadcast_to(
+            (idx[None, :] < fresh[:, None])[:, :, None, None],
+            ref.shape).copy()
+        # padding rows are finite garbage the caller discards (they
+        # still see the causal columns) — no zero claim
+        return np.asarray(ref), live, np.zeros_like(live)
+
+    def pricing():
+        return prefill_kernel_cost(
+            b, s, _H, _HK, _D, _BS, _M, starts, cache_bytes=4,
+            q_bytes=4, rows_per_chunk=8, blocks_per_chunk=2)
+
+    return {
+        "name": name, "kernel": "paged_prefill_attention",
+        "mode": "interpret", "atol": 2e-4,
+        "build": build, "run": run, "oracle": oracle, "pricing": pricing,
+    }
+
+
+# The adversarial ragged row set (ISSUE matrix): empty row, 1-token
+# decode row with a non-block-aligned start, non-block-divisible chunk,
+# max-block row at the full table context.
+_RAGGED_ROWS = (
+    # (start, fresh)
+    (8, 0),    # empty row: zero fresh tokens, span [x, x)
+    (11, 1),   # decode row: 1 token, start NOT block-aligned
+    (8, 13),   # non-block-divisible chunk length
+    (24, 8),   # max-block row: full M*Bs context
+)
+
+
+def _ragged_geometry(rows, tq: int = 8):
+    np = _np()
+    starts = np.asarray([r[0] for r in rows], np.int32)
+    fresh = np.asarray([r[1] for r in rows], np.int32)
+    lens = starts + fresh
+    roffs = np.concatenate([[0], np.cumsum(fresh)[:-1]]).astype(np.int32)
+    total = int(fresh.sum())
+    t_tokens = max(tq, _cdiv(total, tq) * tq)
+    sid = np.full(t_tokens, -1, np.int32)
+    for r in range(len(rows)):
+        sid[roffs[r]:roffs[r] + fresh[r]] = r
+    return starts, fresh, lens, roffs, sid, t_tokens
+
+
+def _ragged_case(name: str, quant: bool, rows=_RAGGED_ROWS,
+                 seed: int = 300, tq: int = 8) -> dict:
+    import jax.numpy as jnp
+
+    np = _np()
+    r_rows = len(rows)
+    starts, fresh, lens, roffs, sid, t_tokens = _ragged_geometry(rows, tq)
+    n = r_rows * _M + 1
+    layer = 1
+    prefix_blocks = int(_cdiv(int(starts.max()), _BS)) if len(rows) else 0
+
+    def build():
+        rng = np.random.default_rng(seed + (1 if quant else 0))
+        cache = jnp.asarray(
+            rng.normal(size=(_L, n, 2, _BS, _HKD)), jnp.float32)
+        bt = _disjoint_tables(r_rows, _M, n)
+        q = jnp.asarray(
+            rng.normal(size=(1, t_tokens, _H, _D)), jnp.float32)
+        k_new = jnp.asarray(
+            rng.normal(size=(1, t_tokens, _HK, _D)), jnp.float32)
+        v_new = jnp.asarray(
+            rng.normal(size=(1, t_tokens, _HK, _D)), jnp.float32)
+        kcache = quantize_audit_cache(cache, _HK) if quant else cache
+        return {
+            "q": q, "k": k_new, "v": v_new, "cache": kcache,
+            "clean": cache, "bt": jnp.asarray(bt), "bt_np": bt,
+            "lens": jnp.asarray(lens), "starts": jnp.asarray(starts),
+            "roffs": jnp.asarray(roffs),
+            "sid": jnp.asarray(sid[None, :]), "layer": jnp.int32(layer),
+        }
+
+    def run(inp, poisoned: bool):
+        from dynamo_tpu.ops.kv_quant import QuantKvCache
+        from dynamo_tpu.ops.pallas.prefill_attention import (
+            ragged_paged_prefill_attention,
+        )
+
+        np = _np()
+        q, k, v, cache = inp["q"], inp["k"], inp["v"], inp["cache"]
+        if poisoned:
+            if quant:
+                cache = QuantKvCache(cache.data, np.asarray(
+                    _poison_scales(cache.scale, inp["bt_np"], starts,
+                                   _HK, _BS)))
+            else:
+                cache = np.asarray(
+                    _poison_cache(cache, inp["bt_np"], starts, _BS),
+                    np.float32)
+            qp, kp, vp = (np.asarray(x, np.float32).copy()
+                          for x in (q, k, v))
+            pad = sid < 0
+            qp[0, pad] = np.nan
+            kp[0, pad] = np.nan
+            vp[0, pad] = np.nan
+            q, k, v = qp, kp, vp
+        return ragged_paged_prefill_attention.__wrapped__(
+            q, k, v, cache, inp["layer"], inp["bt"], inp["lens"],
+            inp["starts"], inp["roffs"], rows_per_chunk=tq,
+            blocks_per_chunk=2, interpret=True,
+        )
+
+    def oracle(inp):
+        import os
+
+        from dynamo_tpu.ops.paged_attention import ragged_prefill_attention
+
+        np = _np()
+        os.environ["DYNAMO_DISABLE_PALLAS_PREFILL"] = "1"
+        try:
+            ref = ragged_prefill_attention(
+                inp["q"], inp["k"], inp["v"], inp["cache"], inp["layer"],
+                inp["bt"], inp["lens"], inp["starts"], inp["roffs"],
+                inp["sid"], prefix_blocks)
+        finally:
+            os.environ.pop("DYNAMO_DISABLE_PALLAS_PREFILL", None)
+        live = np.broadcast_to(
+            (sid >= 0)[None, :, None, None], ref.shape).copy()
+        return np.asarray(ref), live, np.zeros_like(live)
+
+    def pricing():
+        return ragged_kernel_cost(
+            t_tokens, _H, _HK, _D, _BS, _M, starts,
+            cache_bytes=1 if quant else 4, quant=quant, q_bytes=4,
+            rows_per_chunk=tq, blocks_per_chunk=2)
+
+    return {
+        "name": name, "kernel": "ragged_paged_prefill_attention",
+        "mode": "interpret", "atol": 2e-3 if quant else 2e-4,
+        "build": build, "run": run, "oracle": oracle, "pricing": pricing,
+    }
+
+
+def _int8_matmul_case() -> dict:
+    import jax.numpy as jnp
+
+    np = _np()
+    m, k, n = 256, 1024, 1024  # grid (2, 2, 2): revisits the K axis
+
+    def build():
+        rng = np.random.default_rng(400)
+        x = jnp.asarray(rng.normal(size=(m, k)), jnp.bfloat16)
+        wq = jnp.asarray(
+            rng.integers(-127, 128, size=(k, n)), jnp.int8)
+        scale = jnp.asarray(
+            rng.uniform(0.01, 0.1, size=(n,)), jnp.float32)
+        return {"x": x, "wq": wq, "scale": scale}
+
+    def run(inp, poisoned: bool):
+        from dynamo_tpu.ops.pallas.int8_matmul import int8_matmul
+
+        return int8_matmul.__wrapped__(
+            inp["x"], inp["wq"], inp["scale"], interpret=True)
+
+    def oracle(inp):
+        np = _np()
+        x = np.asarray(inp["x"], np.float32)
+        w = np.asarray(inp["wq"], np.float32)
+        sc = np.asarray(inp["scale"], np.float32)
+        ref = (x @ w) * sc[None, :]
+        live = np.ones(ref.shape, bool)
+        return ref, live, np.zeros_like(live)
+
+    def pricing():
+        return int8_matmul_cost(m, k, n)
+
+    return {
+        "name": "int8-matmul", "kernel": "int8_matmul",
+        # bf16 x + K=1024 reduction: ~1.5% relative on O(100) outputs
+        "mode": "interpret", "atol": 8.0,
+        "build": build, "run": run, "oracle": oracle, "pricing": pricing,
+    }
+
+
+# ---------------------------------------------- serving-scale (spec) ----
+
+
+def _spec_decode_8b() -> dict:
+    """8B-serving decode shape, shape-traced only: VMEM budget and
+    pricing at the geometry that matters, without executing."""
+    b, h, hk, d, bs, n, m, L = 64, 32, 8, 128, 16, 4096, 128, 32
+
+    def build():
+        import jax
+
+        import jax.numpy as jnp
+
+        f = jax.ShapeDtypeStruct
+        return {
+            "q": f((b, 1, h, d), jnp.bfloat16),
+            "cache": f((L, n, 2, bs, hk * d), jnp.bfloat16),
+            "layer": f((), jnp.int32),
+            "bt": f((b, m), jnp.int32),
+            "lens": f((b,), jnp.int32),
+            "q0": f((b,), jnp.int32),
+        }
+
+    def run(inp, poisoned: bool):
+        import jax
+
+        from dynamo_tpu.ops.pallas.decode_attention import (
+            paged_decode_attention_mq,
+        )
+
+        fn = functools.partial(
+            paged_decode_attention_mq.__wrapped__, interpret=False)
+        return jax.eval_shape(
+            fn, inp["q"], inp["cache"], inp["layer"], inp["bt"],
+            inp["lens"], inp["q0"])
+
+    def pricing():
+        return decode_kernel_cost(
+            b, 1, h, hk, d, bs, m, [m * bs] * b, cache_bytes=2,
+            q_bytes=2)
+
+    return {
+        "name": "decode-8b", "kernel": "paged_decode_attention_mq",
+        "mode": "spec", "build": build, "run": run, "oracle": None,
+        "pricing": pricing,
+    }
+
+
+def _spec_prefill_8b() -> dict:
+    """S=2048 prefill at the documented serving tile (Hk*D=512), shape
+    traced: this is the case the rows_per_chunk=128 VMEM claim is
+    machine-checked against."""
+    b, s, h, hk, d, bs, n, m, L = 1, 2048, 32, 4, 128, 16, 4096, 128, 32
+
+    def build():
+        import jax
+
+        import jax.numpy as jnp
+
+        f = jax.ShapeDtypeStruct
+        return {
+            "q": f((b, s, h, d), jnp.bfloat16),
+            "k": f((b, s, hk, d), jnp.bfloat16),
+            "v": f((b, s, hk, d), jnp.bfloat16),
+            "cache": f((L, n, 2, bs, hk * d), jnp.bfloat16),
+            "layer": f((), jnp.int32),
+            "bt": f((b, m), jnp.int32),
+            "lens": f((b,), jnp.int32),
+            "starts": f((b,), jnp.int32),
+        }
+
+    def run(inp, poisoned: bool):
+        import jax
+
+        from dynamo_tpu.ops.pallas.prefill_attention import (
+            paged_prefill_attention,
+        )
+
+        fn = functools.partial(
+            paged_prefill_attention.__wrapped__, interpret=False)
+        return jax.eval_shape(
+            fn, inp["q"], inp["k"], inp["v"], inp["cache"], inp["layer"],
+            inp["bt"], inp["lens"], inp["starts"])
+
+    def pricing():
+        return prefill_kernel_cost(
+            b, s, h, hk, d, bs, m, [m * bs] * b, cache_bytes=2,
+            q_bytes=2)
+
+    return {
+        "name": "prefill-8b", "kernel": "paged_prefill_attention",
+        "mode": "spec", "build": build, "run": run, "oracle": None,
+        "pricing": pricing,
+    }
+
+
+def audit_cases() -> list[dict]:
+    """The committed audit matrix: every non-placeholder kernel x its
+    geometry cases.  Interpret cases run the NaN-canary differential on
+    CPU; spec cases shape-trace only (VMEM + pricing)."""
+    return [
+        _decode_case("decode-bf16", quant=False),
+        _decode_case("decode-int8", quant=True),
+        _decode_case("decode-mq-unaligned", quant=False, s_q=2),
+        _prefill_case(),
+        _ragged_case("ragged-bf16", quant=False),
+        _ragged_case("ragged-int8", quant=True),
+        _int8_matmul_case(),
+        _spec_decode_8b(),
+        _spec_prefill_8b(),
+    ]
+
+
+def fuzz_case(seed: int) -> dict:
+    """One seeded random ragged geometry for the nightly kern-fuzz
+    sweep: rows drawn from the adversarial families (empty / 1-token
+    decode / odd-length chunk / max-block), canary-checked against the
+    oracle.  Deterministic per seed — the replay token is just the
+    seed."""
+    np = _np()
+    rng = np.random.default_rng(seed)
+    r_rows = int(rng.integers(2, 6))
+    rows = []
+    for _ in range(r_rows):
+        kind = int(rng.integers(0, 4))
+        if kind == 0:    # empty row
+            rows.append((int(rng.integers(0, _M * _BS)), 0))
+        elif kind == 1:  # decode row, any (non-aligned) start
+            rows.append((int(rng.integers(0, _M * _BS - 1)), 1))
+        elif kind == 2:  # odd-length chunk from a block-aligned start
+            start = int(rng.integers(0, _M - 1)) * _BS
+            fresh = int(rng.integers(1, _M * _BS - start + 1))
+            rows.append((start, fresh))
+        else:            # max-block row
+            start = int(rng.integers(0, _M)) * _BS
+            rows.append((start, _M * _BS - start))
+    if all(f == 0 for _, f in rows):
+        rows[0] = (0, 1)  # at least one real token so T > 0
+    return _ragged_case(
+        f"fuzz[ragged-{seed}]", quant=bool(rng.integers(0, 2)),
+        rows=tuple(rows), seed=seed, tq=8)
+
+
+# ------------------------------------------------------ probe builders ----
+# bench.py and benchmarks/probe_kernels.py build their kernel probes
+# from these, so probe coverage is registry coverage by construction.
+
+
+def _probe_cache(rng, n, bs, hk, hd, dtype, quant):
+    import jax.numpy as jnp
+
+    cache = jnp.asarray(
+        rng.normal(size=(1, n, 2, bs, hk * hd)), dtype)
+    return quantize_audit_cache(cache, hk) if quant else cache
+
+
+def probe_decode_inputs(batch, h, hk, hd, bs, n, bt_width, lens,
+                        dtype=None, quant=False, s_q=0):
+    """Concrete decode-probe inputs at serving dims (bench.py's on-TPU
+    lowering probe and probe_kernels.py's sweep share this).  With
+    ``s_q > 0`` the multi-query shape is built instead: q gains a
+    per-row query axis and a sixth element — the context lengths
+    (``seq_lens - s_q``) the mq kernel takes — joins the tuple."""
+    import jax.numpy as jnp
+
+    np = _np()
+    dtype = dtype or jnp.bfloat16
+    rng = np.random.default_rng(0)
+    qshape = (batch, s_q, h, hd) if s_q else (batch, h, hd)
+    q = jnp.asarray(rng.normal(size=qshape), dtype)
+    cache = _probe_cache(rng, n, bs, hk, hd, dtype, quant)
+    bt = _probe_bt(batch, bt_width, n)
+    lens = jnp.asarray(lens, jnp.int32)
+    if s_q:
+        return q, cache, jnp.int32(0), bt, lens, \
+            jnp.maximum(lens - s_q, 0)
+    return q, cache, jnp.int32(0), bt, lens
+
+
+def probe_prefill_inputs(batch, s, h, hk, hd, bs, n, bt_width,
+                         dtype=None, quant=False):
+    import jax.numpy as jnp
+
+    np = _np()
+    dtype = dtype or jnp.bfloat16
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(batch, s, h, hd)), dtype)
+    k = jnp.asarray(rng.normal(size=(batch, s, hk, hd)), dtype)
+    v = jnp.asarray(rng.normal(size=(batch, s, hk, hd)), dtype)
+    cache = _probe_cache(rng, n, bs, hk, hd, dtype, quant)
+    # one cached block of prefix, clamped so prefix+fresh still fits
+    # the block table (s == bt_width * bs means no prefix room)
+    total = min(bs + s, bt_width * bs)
+    lens = jnp.full((batch,), total, jnp.int32)
+    starts = jnp.full((batch,), total - s, jnp.int32)
+    return q, k, v, cache, jnp.int32(0), _probe_bt(batch, bt_width, n), \
+        lens, starts
+
+
+def _probe_bt(rows, bt_width, n):
+    import jax.numpy as jnp
+
+    np = _np()
+    return jnp.asarray(
+        np.arange(rows * bt_width).reshape(rows, bt_width) % n,
+        jnp.int32)
+
+
+def probe_ragged_inputs(t_tokens, r_rows, h, hk, hd, bs, n, bt_width,
+                        dtype=None, quant=False):
+    import jax.numpy as jnp
+
+    np = _np()
+    dtype = dtype or jnp.bfloat16
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(1, t_tokens, h, hd)), dtype)
+    k = jnp.asarray(rng.normal(size=(1, t_tokens, hk, hd)), dtype)
+    v = jnp.asarray(rng.normal(size=(1, t_tokens, hk, hd)), dtype)
+    cache = _probe_cache(rng, n, bs, hk, hd, dtype, quant)
+    bt = _probe_bt(r_rows, bt_width, n)
+    per = t_tokens // r_rows
+    roffs = jnp.asarray(
+        np.arange(r_rows, dtype=np.int32) * per, jnp.int32)
+    # one cached block of prefix per row, clamped into the block table
+    start = max(0, min(bs, bt_width * bs - per))
+    starts = jnp.full((r_rows,), start, jnp.int32)
+    lens = starts + per
+    return q, k, v, cache, jnp.int32(0), bt, lens, starts, roffs
+
+
+def probe_int8_matmul_inputs(m, k, n):
+    import jax.numpy as jnp
+
+    np = _np()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(m, k)), jnp.bfloat16)
+    wq = jnp.asarray(rng.integers(-127, 128, size=(k, n)), jnp.int8)
+    scale = jnp.asarray(rng.uniform(0.01, 0.1, size=(n,)), jnp.float32)
+    return x, wq, scale
+
+
+_PROBE_BUILDERS = {
+    "paged_decode_attention_mq": probe_decode_inputs,
+    "paged_prefill_attention": probe_prefill_inputs,
+    "ragged_paged_prefill_attention": probe_ragged_inputs,
+    "int8_matmul": probe_int8_matmul_inputs,
+}

@@ -1,8 +1,8 @@
 #!/bin/sh
 # Convenience wrapper for the static-analysis suite (docs/static_analysis.md).
-# One process, ALL EIGHT passes (dynamo-tpu lint --all), sharing one
+# One process, ALL NINE passes (dynamo-tpu lint --all), sharing one
 # ast.parse per file across the per-file, project and wire passes:
-#   1+2. per-file rules (DT001-DT104) + interprocedural project pass
+#   1+2. per-file rules (DT001-DT105) + interprocedural project pass
 #        (DT005-DT009)
 #   3.   compile-plane trace audit (TR001-TR007) against the committed
 #        analysis/trace_manifest.json
@@ -21,6 +21,12 @@
 #        committed analysis/load_manifest.json (the real
 #        router/admission/planner serving seeded traffic vs simulated
 #        workers at virtual time; DTLOAD_BUDGET=1 in the gate)
+#   9.   kernel-plane Pallas audit (KN001-KN006) against the committed
+#        analysis/kern_manifest.json (VMEM budgets, index-map
+#        bounds/race proofs, NaN-canary padding oracles vs pure-XLA
+#        references in interpret mode, kernel pricing + census;
+#        DTKERN_BUDGET=1 in the gate, crank + DTKERN_SEED_BASE for the
+#        nightly fuzz sweep)
 #   scripts/lint.sh                      # lint dynamo_tpu/, human output
 #   scripts/lint.sh --format json        # stable JSON (one doc per pass)
 #   scripts/lint.sh --changed            # pre-commit mode: per-file rules
@@ -28,15 +34,15 @@
 #                                        # project/trace/wire/perf/shard
 #                                        # passes stay whole-program, proto
 #                                        # re-explores only the affected
-#                                        # scenarios and load skips when no
-#                                        # plane input changed
+#                                        # scenarios, and load/kern skip
+#                                        # when no plane input changed
 #   scripts/lint.sh --update-baseline    # rebuild analysis/baseline.json
-#                                        # AND all six manifests
+#                                        # AND all seven manifests
 #                                        # (justifications carried by key)
 #   scripts/lint.sh --select DT005       # one rule (project codes route
 #                                        # to the project registry; the
-#                                        # trace/wire/perf/shard/proto/load
-#                                        # passes ignore it)
+#                                        # trace/wire/perf/shard/proto/
+#                                        # load/kern passes ignore it)
 # Exit code 1 on any non-baselined finding from any pass.
 cd "$(dirname "$0")/.." || exit 2
 exec python -m dynamo_tpu lint --all "$@"

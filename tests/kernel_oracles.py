@@ -1,0 +1,48 @@
+"""Reusable NaN-canary oracle harness over the registry's audit matrix.
+
+A thin wrapper around the kernel plane's KN004 differential
+(dynamo_tpu/analysis/kerncheck.py): build a case's inputs, run the
+kernel clean and NaN-poisoned in interpret mode, and assert the canary
+contract — live lanes on-oracle within the case's atol, finite under
+poison, exact-zero claims exactly zero.  Kernel tests drive the SAME
+adversarial matrix `dynamo-tpu lint --kern` audits instead of
+hand-rolling a parallel (and inevitably narrower) set of geometries;
+adding a case to ops/pallas/registry.py grows both gates at once.
+"""
+
+from dynamo_tpu.analysis.kerncheck import _canary_failed, _canary_facts
+
+
+def interpret_cases():
+    """The registry's interpret-mode audit cases — the adversarial
+    geometry matrix (decode bf16/int8, unaligned multi-query, prefill
+    with cached prefix + padding tail, ragged bf16/int8 mixed rows,
+    int8 matmul).  Spec-mode cases shape-trace only and have no oracle
+    to differentiate against, so they are not runnable here."""
+    from dynamo_tpu.ops.pallas.registry import audit_cases
+
+    return [c for c in audit_cases() if c["mode"] == "interpret"]
+
+
+def run_canary(case):
+    """Run one audit case clean + NaN-poisoned; return its canary fact
+    dict ({atol, max_abs_err, poisoned_max_abs_err, nonfinite_live,
+    zero_rows_ok, live_lanes})."""
+    inp = case["build"]()
+    clean = case["run"](inp, poisoned=False)
+    return _canary_facts(case, inp, clean)
+
+
+def assert_canary_clean(case):
+    """Run the differential and fail with the full canary facts if any
+    leg of the contract trips.  Returns the facts for further asserts."""
+    canary = run_canary(case)
+    assert not _canary_failed(canary), (
+        f"{case['kernel']}[{case['name']}] canary tripped: "
+        f"clean err {canary['max_abs_err']} / poisoned err "
+        f"{canary['poisoned_max_abs_err']} vs atol {canary['atol']}; "
+        f"nonfinite live lanes {canary['nonfinite_live']}; "
+        f"zero_rows_ok={canary['zero_rows_ok']} "
+        f"({canary['live_lanes']} live lanes)"
+    )
+    return canary

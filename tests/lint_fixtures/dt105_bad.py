@@ -1,0 +1,21 @@
+"""DT105 bad: pallas_call geometry hardcoded at the call site — literal
+interpret=True, literal grid/BlockSpec tile sizes, and an int default on
+a *_per_* parameter (all three shapes)."""
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def run_hardcoded(x, blocks_per_chunk: int = 4):
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(8,),
+        in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((128, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=True,
+    )(x)

@@ -1,0 +1,27 @@
+"""DT105 good: the same kernel with its geometry routed through registry
+constants — the kernel-plane audit prices these exact shapes."""
+
+import jax
+from jax.experimental import pallas as pl
+
+from dynamo_tpu.ops.pallas.registry import PREFILL_ROWS_PER_CHUNK
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def run_registered(
+    x,
+    rows_per_chunk: int = PREFILL_ROWS_PER_CHUNK,
+    interpret: bool = False,
+):
+    rows, cols = x.shape
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(rows // rows_per_chunk,),
+        in_specs=[pl.BlockSpec((rows_per_chunk, cols), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((rows_per_chunk, cols), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x)

@@ -1,0 +1,348 @@
+"""Kernel-plane static analysis (dtkern) tests: THE ninth tier-1 gate
+(zero non-accepted findings over the Pallas audit facts against the
+committed kern manifest), the KN001-KN006 rules on the committed
+``tests/lint_fixtures/kn_*_facts.json`` fixture pair, the full
+adversarial canary matrix (every interpret case ran and passed), the
+ROADMAP-item-2 pin (stripping the accepted two-kernel-split entry
+re-trips the gate), registry/manifest coverage, replay tokens, and the
+manifest/CLI contract (``--update-baseline`` justification carry,
+stable JSON, run_lint routing, ``--changed`` skip).
+"""
+
+import argparse
+import io
+import json
+from pathlib import Path
+
+import pytest
+
+from dynamo_tpu.analysis import kerncheck as kc
+from dynamo_tpu.analysis.kerncheck import (
+    DEFAULT_MANIFEST_PATH,
+    _canary_failed,
+    check_kern_facts,
+    collect_kern_facts,
+    decode_token,
+    encode_token,
+    run_kern,
+)
+from dynamo_tpu.analysis.tracecheck import Manifest
+
+FIXTURES = Path(__file__).parent / "lint_fixtures"
+
+
+def _load_facts(name):
+    return json.loads((FIXTURES / name).read_text())
+
+
+# ------------------------------------------------------------- the gate ----
+
+
+@pytest.fixture(scope="module")
+def real_facts():
+    # pinned default matrix (no fuzz): exactly what the committed
+    # manifest snapshots; module scope amortizes the interpret runs
+    return collect_kern_facts()
+
+
+def test_kern_gate_zero_nonaccepted_findings(real_facts):
+    """THE tier-1 kernel-plane gate: VMEM budgets, index-map proofs,
+    NaN canaries, pricing and census are clean against the committed
+    kern manifest.  If this fails you either fix the kernel regression
+    (preferred) or, for an intended change, re-snapshot with
+    `dynamo-tpu lint --kern --update-baseline` and justify any new
+    intrinsic finding."""
+    manifest = Manifest.load(DEFAULT_MANIFEST_PATH)
+    assert manifest.entrypoints, "kern manifest missing or empty"
+    findings = check_kern_facts(real_facts, manifest)
+    fresh = manifest.filter(findings)
+    assert not fresh, (
+        "non-accepted kernel-plane findings:\n  "
+        + "\n  ".join(f.render() for f in fresh)
+        + "\nFix the kernel, or re-snapshot via `dynamo-tpu lint "
+        "--kern --update-baseline` and justify "
+        "(docs/static_analysis.md#kernel-plane)."
+    )
+
+
+def test_manifest_accepted_entries_justified_and_live(real_facts):
+    from manifest_hygiene import assert_manifest_hygiene
+
+    manifest = Manifest.load(DEFAULT_MANIFEST_PATH)
+    assert_manifest_hygiene(
+        manifest, check_kern_facts(real_facts, manifest))
+
+
+def test_manifest_header_records_budget_and_interpret_caveat():
+    """The committed header pins the v5e VMEM budget the KN001 gate
+    divides against and the interpret-mode caveat (canaries check
+    semantics on CPU; Mosaic lowering is probe_kernels.py's job on
+    hardware), so accepted entries carry their context."""
+    from dynamo_tpu.ops.pallas.registry import VMEM_BUDGET_BYTES
+
+    doc = json.loads(DEFAULT_MANIFEST_PATH.read_text())
+    h = doc["header"]
+    assert h["vmem_budget"]["budget_bytes"] == int(VMEM_BUDGET_BYTES)
+    assert h["vmem_budget"]["chip"] == "v5e"
+    assert "INTERPRET" in h["note"] and "2604.15464" in h["note"]
+
+
+def test_manifest_covers_every_registry_geometry(real_facts):
+    """Acceptance floor: every (kernel, geometry) case of the registry
+    audit matrix has a fact entry AND a committed manifest entry, and
+    every non-placeholder registered kernel appears in the matrix."""
+    from dynamo_tpu.ops.pallas.registry import KERNELS, audit_cases
+
+    manifest = Manifest.load(DEFAULT_MANIFEST_PATH)
+    names = {f"pallas.{c['kernel']}[{c['name']}]" for c in audit_cases()}
+    assert names <= set(real_facts)
+    assert names | {"(kern-census)"} == set(manifest.entrypoints)
+    audited = {c["kernel"] for c in audit_cases()}
+    for kname, meta in KERNELS.items():
+        if not meta["placeholder"]:
+            assert kname in audited, f"{kname} has no audit geometry"
+
+
+def test_full_adversarial_matrix_canaries_ran_and_clean(real_facts):
+    """KN004 executed on EVERY interpret-mode geometry (decode bf16 /
+    int8 / unaligned-mq, prefill, ragged bf16 / int8, int8 matmul) and
+    every canary is clean — spec-mode serving geometries are the only
+    entries allowed to skip it."""
+    ran = []
+    for name, f in real_facts.items():
+        if name == "(kern-census)":
+            continue
+        if f["mode"] == "interpret":
+            assert f["canary"]["ran"], name
+            assert not _canary_failed(f["canary"]), name
+            ran.append(name)
+        else:
+            assert f["mode"] == "spec", name
+    assert len(ran) >= 7, ran
+
+
+def test_two_kernel_split_pin_retrips_if_unaccepted(real_facts):
+    """ROADMAP item 2's tripwire: the two-kernel decode/ragged split is
+    a justified KN006 acceptance citing the unified Ragged Paged
+    Attention design (arxiv 2604.15464), and stripping it from the
+    manifest re-trips the gate — the premise cannot silently rot."""
+    manifest = Manifest.load(DEFAULT_MANIFEST_PATH)
+    pins = [e for e in manifest.accepted
+            if e["entrypoint"] == "(kern-census)"
+            and e["rule"] == "KN006" and e["key"] == "two-kernel-split"]
+    assert pins and "2604.15464" in pins[0]["justification"]
+
+    stripped = Manifest(
+        entrypoints=manifest.entrypoints, header=manifest.header,
+        accepted=[e for e in manifest.accepted if e not in pins],
+    )
+    fresh = stripped.filter(check_kern_facts(real_facts, stripped))
+    assert any(f.entrypoint == "(kern-census)" and f.rule == "KN006"
+               and f.key == "two-kernel-split" for f in fresh), \
+        "KN006 two-kernel-split pin did not re-trip"
+
+
+# ---------------------------------------------- drift rules (fixture pair) ----
+
+
+def test_fixture_baseline_is_clean():
+    """Good case: facts identical to the committed baseline produce
+    zero findings (VMEM under budget, index maps in-bounds and
+    race-free, canary on-oracle, census in sync with a real unified
+    kernel and full probe coverage)."""
+    base = _load_facts("kn_baseline_facts.json")
+    manifest = Manifest(entrypoints=base)
+    assert check_kern_facts(base, manifest) == []
+
+
+def test_fixture_regression_fires_every_rule():
+    """Bad case: the regressed fixture (VMEM blown past the budget, an
+    out-of-range index map, a non-consecutive output revisit, a NaN
+    canary on live lanes, pricing/VMEM/grid drift plus an added and a
+    removed geometry, and a census with a placeholder unified kernel,
+    desynced shard fallbacks and an unprobed kernel) demonstrably fails
+    every KN rule."""
+    base = _load_facts("kn_baseline_facts.json")
+    bad = _load_facts("kn_regressed_facts.json")
+    manifest = Manifest(entrypoints=base)
+    findings = check_kern_facts(bad, manifest)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert set(by_rule) == {"KN001", "KN002", "KN003", "KN004", "KN005",
+                            "KN006"}
+    assert by_rule["KN001"][0].key == "vmem-budget"
+    assert by_rule["KN002"][0].key == "in0@7"
+    assert by_rule["KN003"][0].key == "out0"
+    assert by_rule["KN004"][0].key == "padding-leak"
+    kn5 = {(f.entrypoint, f.key) for f in by_rule["KN005"]}
+    assert kn5 == {
+        ("pallas.fix_decode[new]", "added"),
+        ("pallas.fix_decode[old]", "removed"),
+        ("pallas.fix_decode[fix]", "pricing"),
+        ("pallas.fix_decode[fix]", "vmem"),
+        ("pallas.fix_decode[fix]", "grid"),
+    }
+    kn6 = {f.key for f in by_rule["KN006"]}
+    assert kn6 == {"two-kernel-split", "sh-fallback:probe.fix.decode[fix]",
+                   "probe:fix_decode"}
+
+
+def test_fuzz_entries_never_drift():
+    """Fuzz geometries are canary-only: a fuzz entry absent from the
+    manifest is NOT 'added' (KN005), so nightly sweeps never demand a
+    re-snapshot — only real canary failures surface."""
+    base = _load_facts("kn_baseline_facts.json")
+    grown = dict(base)
+    grown["pallas.fix_decode[fuzz[ragged-7]]"] = \
+        json.loads(json.dumps(base["pallas.fix_decode[fix]"]))
+    findings = check_kern_facts(grown, Manifest(entrypoints=base))
+    assert findings == []
+
+
+# --------------------------------------------------- update + CLI contract ----
+
+
+def _args(**kw):
+    base = dict(paths=None, fmt="text", select=None, baseline=None,
+                no_baseline=False, update_baseline=False, root=None,
+                project=False, trace=False, wire=False, perf=False,
+                shard=False, proto=False, load=False, kern=True,
+                manifest=None, replay=None, changed=False)
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+@pytest.fixture()
+def fixture_facts(monkeypatch):
+    """Route run_kern at the committed fixture facts so the CLI
+    contract tests don't pay the real interpret-mode collection, and
+    pin the pinned-run env (no fuzz budget/seed leaking in from CI)."""
+    monkeypatch.delenv("DTKERN_BUDGET", raising=False)
+    monkeypatch.delenv("DTKERN_SEED_BASE", raising=False)
+    base = _load_facts("kn_baseline_facts.json")
+    monkeypatch.setattr(
+        kc, "collect_kern_facts", lambda budget=1, seed_base=0: base)
+    return base
+
+
+def test_update_roundtrip_carries_justifications(
+        tmp_path, fixture_facts, monkeypatch):
+    """finding -> exit 1 -> --update accepts intrinsics (TODO) ->
+    justify -> second --update carries the justification by key -> gate
+    green; the header pins the VMEM budget."""
+    mpath = tmp_path / "manifest.json"
+    args = _args(manifest=str(mpath))
+    assert run_kern(args, out=io.StringIO()) == 1  # KN005 added x3
+
+    assert run_kern(_args(manifest=str(mpath), update_baseline=True),
+                    out=io.StringIO()) == 0
+    doc = json.loads(mpath.read_text())
+    assert doc["header"]["vmem_budget"]["chip"] == "v5e"
+    assert set(doc["entrypoints"]) == set(fixture_facts)
+    assert doc["accepted"] == []  # baseline fixture has no intrinsics
+    assert run_kern(args, out=io.StringIO()) == 0
+
+    # intrinsic findings flow through the justification carry
+    bad = _load_facts("kn_regressed_facts.json")
+    monkeypatch.setattr(
+        kc, "collect_kern_facts", lambda budget=1, seed_base=0: bad)
+    assert run_kern(_args(manifest=str(mpath), update_baseline=True),
+                    out=io.StringIO()) == 0
+    doc = json.loads(mpath.read_text())
+    intrinsic = [e for e in doc["accepted"]]
+    assert intrinsic and all(
+        e["justification"] == "TODO: justify" for e in intrinsic)
+    assert {e["rule"] for e in intrinsic} == \
+        {"KN001", "KN002", "KN003", "KN004", "KN006"}
+    doc["accepted"][0]["justification"] = "kept: fixture rig"
+    mpath.write_text(json.dumps(doc))
+    assert run_kern(_args(manifest=str(mpath), update_baseline=True),
+                    out=io.StringIO()) == 0
+    doc = json.loads(mpath.read_text())
+    assert "kept: fixture rig" in [
+        e["justification"] for e in doc["accepted"]]
+
+
+def test_update_refused_on_fuzz_run(tmp_path, fixture_facts, monkeypatch):
+    """A non-default budget/seed run may not re-snapshot the manifest:
+    fuzz geometries are transient and would poison the baseline."""
+    monkeypatch.setenv("DTKERN_BUDGET", "4")
+    rc = run_kern(_args(manifest=str(tmp_path / "m.json"),
+                        update_baseline=True), out=io.StringIO())
+    assert rc == 2
+
+
+def test_json_output_stable_sorted(tmp_path, fixture_facts):
+    mpath = tmp_path / "manifest.json"
+    outs = []
+    for _ in range(2):
+        out = io.StringIO()
+        rc = run_kern(_args(manifest=str(mpath), fmt="json"), out=out)
+        assert rc == 1
+        outs.append(out.getvalue())
+    assert outs[0] == outs[1], "kern JSON output must be stable"
+    doc = json.loads(outs[0])
+    keys = [(f["entrypoint"], f["rule"], f["key"]) for f in doc["findings"]]
+    assert keys == sorted(keys)
+    assert doc["total"] == len(doc["findings"]) + doc["accepted"]
+    assert doc["fuzz"] == {"budget": 1, "seed_base": 0,
+                           "replay_tokens": {}}
+
+
+def test_committed_manifest_is_save_stable():
+    """Manifest.load -> save must reproduce the committed file byte for
+    byte, so `--update-baseline` diffs stay reviewable."""
+    committed = DEFAULT_MANIFEST_PATH.read_text()
+    m = Manifest.load(DEFAULT_MANIFEST_PATH)
+    buf = io.StringIO()
+    json.dump(
+        {"version": 1, "header": m.header, "entrypoints": m.entrypoints,
+         "accepted": m.accepted},
+        buf, indent=2, sort_keys=True)
+    assert buf.getvalue() + "\n" == committed
+
+
+def test_replay_token_roundtrip_and_prefix_guard(fixture_facts):
+    tok = encode_token({"seed": 7})
+    assert tok.startswith("dtk1.")
+    assert decode_token(tok) == {"seed": 7}
+    out = io.StringIO()
+    assert run_kern(_args(replay="dtl1.notkern"), out=out) == 2
+    assert "not a dtkern replay token" in out.getvalue()
+
+
+def test_changed_skips_when_no_kernel_input_touched(
+        tmp_path, fixture_facts, monkeypatch):
+    """`lint --changed --kern` exits 0 without collecting when no
+    kernel-plane input changed, and still runs when one did."""
+    import dynamo_tpu.analysis.cli as cli
+
+    calls = []
+    monkeypatch.setattr(
+        kc, "collect_kern_facts",
+        lambda budget=1, seed_base=0: calls.append(1) or fixture_facts)
+    monkeypatch.setattr(
+        cli, "_git_changed_paths", lambda root: [Path("README.md")])
+    out = io.StringIO()
+    rc = run_kern(_args(manifest=str(tmp_path / "m.json"), changed=True),
+                  out=out)
+    assert rc == 0 and not calls
+    assert "unaffected" in out.getvalue()
+
+    monkeypatch.setattr(
+        cli, "_git_changed_paths",
+        lambda root: [Path("dynamo_tpu/ops/pallas/registry.py")])
+    rc = run_kern(_args(manifest=str(tmp_path / "m.json"), changed=True),
+                  out=io.StringIO())
+    assert rc == 1 and calls  # fresh manifest -> KN005 added
+
+
+def test_cli_routes_kern_flag(tmp_path, fixture_facts):
+    """`dynamo-tpu lint --kern` reaches the kernel-plane pass through
+    the shared lint CLI (run_lint routing)."""
+    from dynamo_tpu.analysis.cli import run_lint
+
+    out = io.StringIO()
+    rc = run_lint(_args(manifest=str(tmp_path / "m.json")), out=out)
+    assert rc == 1 and "KN00" in out.getvalue()

@@ -38,7 +38,7 @@ PACKAGE = ROOT / "dynamo_tpu"
 FIXTURES = Path(__file__).parent / "lint_fixtures"
 
 RULES = ["DT001", "DT002", "DT003", "DT004",
-         "DT101", "DT102", "DT103", "DT104"]
+         "DT101", "DT102", "DT103", "DT104", "DT105"]
 PROJECT_RULES = ["DT005", "DT006", "DT007", "DT008", "DT009"]
 
 

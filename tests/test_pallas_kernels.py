@@ -533,3 +533,35 @@ def test_ragged_prefill_kernel_quant_geometry():
     np.testing.assert_allclose(
         np.asarray(out)[0][real], np.asarray(ref)[0][real], atol=3e-5,
     )
+
+
+# ------------------------------------------------- registry audit matrix
+
+
+from kernel_oracles import assert_canary_clean, interpret_cases  # noqa: E402
+
+
+@pytest.mark.parametrize("case", interpret_cases(), ids=lambda c: c["name"])
+def test_audit_matrix_canary_clean(case):
+    """Every interpret-mode case in the registry's audit matrix passes
+    the NaN-canary differential: live lanes on-oracle within the case's
+    atol, finite when padding lanes and out-of-seq_len cache blocks are
+    poisoned with NaN, exact-zero claims exactly zero.  This is the SAME
+    matrix `dynamo-tpu lint --kern` audits (KN004) — the hand-written
+    oracle tests above pin specific shapes and options; this one pins
+    the shared adversarial geometries, so a kernel regression trips both
+    the lint gate and tier-1."""
+    canary = assert_canary_clean(case)
+    assert canary["live_lanes"] > 0, case["name"]
+
+
+def test_fuzz_case_deterministic_and_canary_clean():
+    """fuzz_case(seed) is the nightly kern-fuzz unit: same seed, same
+    geometry (the replay token IS the seed), and a healthy kernel passes
+    its canary.  One fixed seed keeps this in the tier-1 budget; the
+    nightly sweeps a date-derived window."""
+    from dynamo_tpu.ops.pallas.registry import fuzz_case
+
+    a, b = fuzz_case(1234), fuzz_case(1234)
+    assert a["name"] == b["name"] == "fuzz[ragged-1234]"
+    assert_canary_clean(a)
