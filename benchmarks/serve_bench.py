@@ -34,6 +34,10 @@ from aiohttp import ClientSession
 
 
 from benchmarks._common import percentile as _percentile
+from benchmarks.scrape import (
+    perf_model_stats_from_text,
+    prefill_dispatch_stats_from_text,
+)
 
 
 async def one_request(session, url, model, prompt, osl):
@@ -92,129 +96,30 @@ async def sweep_level(url, model, prompt, osl, concurrency, requests_per_conc):
     }
 
 
-async def prefill_dispatch_stats(url):
-    """Scrape the serving endpoint's prefill-batching counters
-    (dynamo_tpu_engine_prefill_* on /metrics): dispatch count and mean
-    tokens-per-dispatch — the direct readout of the token-budget ragged
-    prefill win.  Returns None when the server doesn't expose them
-    (non-dynamo endpoint) or saw no prefill work."""
+async def _fetch_metrics(url):
+    """One GET of the endpoint's /metrics body, or None when the
+    server doesn't expose it / is already gone (non-dynamo endpoint)."""
     try:
         async with ClientSession() as session:
             async with session.get(f"{url}/metrics") as resp:
                 if resp.status != 200:
                     return None
-                text = await resp.text()
-    except Exception:
+                return await resp.text()
+    except (OSError, aiohttp.ClientError):
         return None
-    vals = {}
-    for line in text.splitlines():
-        if line.startswith("#"):
-            continue
-        for key in ("prefill_dispatches_total", "prefill_tokens_total",
-                    "prefill_batch_occupancy", "prefill_budget_utilization",
-                    "unified_dispatches_total", "unified_decode_rows",
-                    "unified_prefill_tokens", "unified_budget_utilization",
-                    "lookahead_bursts_total", "lookahead_hits_total",
-                    "lookahead_mispredicts_total", "lookahead_commits_total",
-                    "lookahead_flushes_total", "lookahead_dispatch_depth",
-                    "persist_hits_total", "persist_misses_total",
-                    "persist_restored_tokens_total",
-                    "persist_spill_bytes_total", "persist_resident_bytes",
-                    "host_gap_ms_per_turn"):
-            if line.startswith(f"dynamo_tpu_engine_{key} "):
-                vals[key] = float(line.rsplit(" ", 1)[-1])
-        # measured DCN transfer bandwidth (EWMA) — keep the max over
-        # edges so one scalar summarizes the disagg KV hop
-        if line.startswith("dynamo_tpu_kv_transfer_mbps{") and 'path="dcn"' in line:
-            vals["transfer_mbps_dcn"] = max(
-                vals.get("transfer_mbps_dcn", 0.0),
-                float(line.rsplit(" ", 1)[-1]))
-        # streamed KV handoff counters (layer-wise disagg push)
-        for key in ("sessions_total", "layers_sent_total", "bytes_total",
-                    "fallbacks_total", "overlap_ratio"):
-            if line.startswith(f"dynamo_tpu_kv_stream_{key} "):
-                vals[f"stream_{key}"] = float(line.rsplit(" ", 1)[-1])
-    dispatches = vals.get("prefill_dispatches_total", 0)
-    if not dispatches:
+
+
+async def prefill_dispatch_stats(url):
+    """Scrape the serving endpoint's prefill-batching counters
+    (dynamo_tpu_engine_prefill_* on /metrics): dispatch count and mean
+    tokens-per-dispatch — the direct readout of the token-budget ragged
+    prefill win.  Returns None when the server doesn't expose them
+    (non-dynamo endpoint) or saw no prefill work.  Parsing lives in
+    benchmarks/scrape.py on the registry names."""
+    text = await _fetch_metrics(url)
+    if text is None:
         return None
-    out = {
-        "prefill_dispatches": int(dispatches),
-        "prefill_tokens_per_dispatch": round(
-            vals.get("prefill_tokens_total", 0) / dispatches, 1),
-        "prefill_batch_occupancy": vals.get("prefill_batch_occupancy", 0.0),
-        "prefill_budget_utilization": vals.get(
-            "prefill_budget_utilization", 0.0),
-    }
-    unified = vals.get("unified_dispatches_total", 0)
-    if unified:
-        # unified mixed dispatch engaged: the interleave win per run —
-        # each of these turns replaced a decode burst + prefill pair
-        out.update({
-            "unified_dispatches": int(unified),
-            "unified_decode_rows_per_dispatch": round(
-                vals.get("unified_decode_rows", 0) / unified, 1),
-            "unified_prefill_tokens_per_dispatch": round(
-                vals.get("unified_prefill_tokens", 0) / unified, 1),
-            "unified_budget_utilization": vals.get(
-                "unified_budget_utilization", 0.0),
-        })
-    bursts = vals.get("lookahead_bursts_total", 0)
-    if bursts:
-        # double-buffered dispatch engaged: fused device turns per
-        # readback, the per-row prediction hit rate, and how often the
-        # speculative next-turn prebuild survived to commit
-        rows = vals.get("lookahead_hits_total", 0) + vals.get(
-            "lookahead_mispredicts_total", 0)
-        plans = vals.get("lookahead_commits_total", 0) + vals.get(
-            "lookahead_flushes_total", 0)
-        out.update({
-            "lookahead_bursts": int(bursts),
-            "lookahead_dispatch_depth": int(
-                vals.get("lookahead_dispatch_depth", 0)),
-            "lookahead_hit_rate": round(
-                vals.get("lookahead_hits_total", 0) / rows, 4)
-            if rows else 0.0,
-            "lookahead_commit_rate": round(
-                vals.get("lookahead_commits_total", 0) / plans, 4)
-            if plans else 0.0,
-        })
-    phits = vals.get("persist_hits_total", 0)
-    pmiss = vals.get("persist_misses_total", 0)
-    if phits or pmiss or vals.get("persist_resident_bytes", 0):
-        # persistent prefix-cache tier engaged (--kv-persist-dir): how
-        # many probed block groups restored from disk instead of being
-        # re-prefilled, and the store's current footprint
-        out.update({
-            "persist_hits": int(phits),
-            "persist_hit_rate": round(phits / (phits + pmiss), 4)
-            if (phits + pmiss) else 0.0,
-            "persist_restored_tokens": int(
-                vals.get("persist_restored_tokens_total", 0)),
-            "persist_spill_bytes": int(
-                vals.get("persist_spill_bytes_total", 0)),
-            "persist_resident_bytes": int(
-                vals.get("persist_resident_bytes", 0)),
-        })
-    if "host_gap_ms_per_turn" in vals:
-        # the engine step timeline's headline: host wall per dispatching
-        # step outside dispatch+readback (ROADMAP item 3 before-number)
-        out["host_gap_ms_per_turn"] = round(vals["host_gap_ms_per_turn"], 3)
-    if "transfer_mbps_dcn" in vals:
-        out["transfer_mbps_dcn"] = round(vals["transfer_mbps_dcn"], 2)
-    if vals.get("stream_sessions_total", 0):
-        # layer-wise streamed handoff engaged (DYN_KV_STREAM=1): frames
-        # shipped under compute and the measured overlap win
-        out.update({
-            "kv_stream_sessions": int(vals["stream_sessions_total"]),
-            "kv_stream_layers_sent": int(
-                vals.get("stream_layers_sent_total", 0)),
-            "kv_stream_bytes": int(vals.get("stream_bytes_total", 0)),
-            "kv_stream_fallbacks": int(
-                vals.get("stream_fallbacks_total", 0)),
-            "kv_stream_overlap_ratio": round(
-                vals.get("stream_overlap_ratio", 0.0), 4),
-        })
-    return out
+    return prefill_dispatch_stats_from_text(text)
 
 
 async def perf_model_stats(url):
@@ -223,26 +128,10 @@ async def perf_model_stats(url):
     prediction, measured mean dispatch ms, and the model-error ratio
     (predicted/measured).  Returns None when the server doesn't expose
     them or no dispatch ran."""
-    try:
-        async with ClientSession() as session:
-            async with session.get(f"{url}/metrics") as resp:
-                if resp.status != 200:
-                    return None
-                text = await resp.text()
-    except (OSError, aiohttp.ClientError):
-        return None  # non-dynamo endpoint / server already gone
-    rows: dict[str, dict] = {}
-    for line in text.splitlines():
-        if not line.startswith("dynamo_tpu_perf_") or "{" not in line:
-            continue
-        name = line[len("dynamo_tpu_perf_"):line.index("{")]
-        if name == "predicted_step_ms":
-            continue  # static manifest rows, not runtime reconciliation
-        labels, val = line[line.index("{") + 1:].rsplit(" ", 1)
-        kind = labels.split('kind="', 1)[-1].split('"', 1)[0]
-        rows.setdefault(kind, {})[name] = float(val)
-    rows = {k: v for k, v in rows.items() if v.get("dispatches_total")}
-    return rows or None
+    text = await _fetch_metrics(url)
+    if text is None:
+        return None
+    return perf_model_stats_from_text(text)
 
 
 def print_perf_table(rows, out=sys.stderr):

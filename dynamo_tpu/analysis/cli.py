@@ -71,6 +71,12 @@ def configure_parser(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "bounds/race proofs, NaN-canary padding oracles, "
                         "kernel pricing + census) against the committed "
                         "kern manifest")
+    p.add_argument("--metrics", action="store_true",
+                   help="run the metrics-plane pass instead (MT001-MT005: "
+                        "static producer->renderer->scraper audit of the "
+                        "/metrics surface — dead telemetry, stale scrape "
+                        "keys, label cardinality, type misuse, census "
+                        "drift) against the committed metrics manifest")
     p.add_argument("--replay", default=None, metavar="TOKEN",
                    help="with --proto, --load or --kern: re-execute one "
                         "recorded run from a dtp1. interleaving token, "
@@ -78,10 +84,10 @@ def configure_parser(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "printed by a failing run or the nightly sweep) "
                         "instead of sweeping; exit 1 if it still violates")
     p.add_argument("--all", action="store_true",
-                   help="run all nine passes (per-file + project, trace, "
-                        "wire, perf, shard, proto, load, kern) in one "
-                        "process sharing the parse cache; exit 1 if any "
-                        "pass fails")
+                   help="run all ten passes (per-file + project, trace, "
+                        "wire, perf, shard, proto, load, kern, metrics) "
+                        "in one process sharing the parse cache; exit 1 "
+                        "if any pass fails")
     p.add_argument("--changed", action="store_true",
                    help="restrict the per-file pass to git-dirty files "
                         "(project/trace/wire passes stay whole-program); "
@@ -178,6 +184,13 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
         from dynamo_tpu.analysis.kerncheck import run_kern
 
         return run_kern(args, out)
+    if getattr(args, "metrics", False):
+        # metrics-plane pass: its unit is metric names (static census
+        # of the /metrics surface across producers, renderers and
+        # scrapers) — same manifest contract, its own committed file
+        from dynamo_tpu.analysis.metcheck import run_metrics
+
+        return run_metrics(args, out)
     paths = [Path(p) for p in (args.paths or [])]
     if args.root:
         root = Path(args.root)
@@ -261,16 +274,16 @@ def run_lint(args: argparse.Namespace, out=None) -> int:
 
 
 def run_all(args: argparse.Namespace, out=None) -> int:
-    """All nine passes in one process: per-file + project rules (one
+    """All ten passes in one process: per-file + project rules (one
     ``ast.parse`` per file via ``core.parse_module``'s cache, which the
     wire pass shares), then the compile-plane trace audit, then the
     wire-plane contract check, then the perf-plane roofline check
     (which shares tracecheck's entrypoint registry), then the
     sharding-plane placement audit, then the protocol-plane
     deterministic exploration, then the scale-simulation capacity
-    sweep, then the kernel-plane Pallas audit.  Exit 1 if any pass has
-    fresh findings; ``--update-baseline`` rewrites all the committed
-    baselines."""
+    sweep, then the kernel-plane Pallas audit, then the metrics-plane
+    /metrics-surface census.  Exit 1 if any pass has fresh findings;
+    ``--update-baseline`` rewrites all the committed baselines."""
     out = out if out is not None else sys.stdout
     # the shard probes need >= 4 devices, and the device count can only
     # be forced BEFORE any pass initializes the jax backend
@@ -279,6 +292,7 @@ def run_all(args: argparse.Namespace, out=None) -> int:
     ensure_audit_devices()
     from dynamo_tpu.analysis.kerncheck import run_kern
     from dynamo_tpu.analysis.loadcheck import run_load
+    from dynamo_tpu.analysis.metcheck import run_metrics
     from dynamo_tpu.analysis.perfcheck import run_perf
     from dynamo_tpu.analysis.protocheck import run_proto
     from dynamo_tpu.analysis.shardcheck import run_shard
@@ -297,8 +311,9 @@ def run_all(args: argparse.Namespace, out=None) -> int:
     rc_proto = run_proto(sub, out)
     rc_load = run_load(sub, out)
     rc_kern = run_kern(sub, out)
+    rc_metrics = run_metrics(sub, out)
     return max(rc_file, rc_trace, rc_wire, rc_perf, rc_shard, rc_proto,
-               rc_load, rc_kern)
+               rc_load, rc_kern, rc_metrics)
 
 
 def main(argv: Optional[list[str]] = None) -> int:

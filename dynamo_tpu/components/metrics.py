@@ -21,10 +21,9 @@ from aiohttp import ClientSession, web
 
 from dynamo_tpu.llm.kv_router.publisher import metrics_subject
 from dynamo_tpu.llm.kv_router.scheduler import WorkerMetrics
+from dynamo_tpu.obs.metric_names import RouterMetric as RM
 
 log = logging.getLogger("dynamo_tpu.metrics")
-
-PREFIX = "dynamo_tpu"
 
 __all__ = ["PrometheusMetricsCollector", "MetricsService"]
 
@@ -61,34 +60,34 @@ class PrometheusMetricsCollector:
         lines: list[str] = []
 
         def gauge(name: str, help_: str) -> None:
-            lines.append(f"# HELP {PREFIX}_{name} {help_}")
-            lines.append(f"# TYPE {PREFIX}_{name} gauge")
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} gauge")
 
-        gauge("kv_blocks_active", "active KV blocks per worker")
+        gauge(RM.KV_BLOCKS_ACTIVE, "active KV blocks per worker")
         for wid, m in sorted(self.workers.items()):
-            lines.append(f'{PREFIX}_kv_blocks_active{{worker="{wid}"}} {m.kv_active_blocks}')
-        gauge("kv_blocks_total", "total KV blocks per worker")
+            lines.append(f'{RM.KV_BLOCKS_ACTIVE}{{worker="{wid}"}} {m.kv_active_blocks}')
+        gauge(RM.KV_BLOCKS_TOTAL, "total KV blocks per worker")
         for wid, m in sorted(self.workers.items()):
-            lines.append(f'{PREFIX}_kv_blocks_total{{worker="{wid}"}} {m.kv_total_blocks}')
-        gauge("request_active_slots", "active request slots per worker")
+            lines.append(f'{RM.KV_BLOCKS_TOTAL}{{worker="{wid}"}} {m.kv_total_blocks}')
+        gauge(RM.REQUEST_ACTIVE_SLOTS, "active request slots per worker")
         for wid, m in sorted(self.workers.items()):
-            lines.append(f'{PREFIX}_request_active_slots{{worker="{wid}"}} {m.request_active_slots}')
-        gauge("requests_waiting", "queued requests per worker")
+            lines.append(f'{RM.REQUEST_ACTIVE_SLOTS}{{worker="{wid}"}} {m.request_active_slots}')
+        gauge(RM.REQUESTS_WAITING, "queued requests per worker")
         for wid, m in sorted(self.workers.items()):
-            lines.append(f'{PREFIX}_requests_waiting{{worker="{wid}"}} {m.num_requests_waiting}')
-        gauge("kv_cache_usage", "KV cache occupancy fraction per worker")
+            lines.append(f'{RM.REQUESTS_WAITING}{{worker="{wid}"}} {m.num_requests_waiting}')
+        gauge(RM.KV_CACHE_USAGE, "KV cache occupancy fraction per worker")
         for wid, m in sorted(self.workers.items()):
-            lines.append(f'{PREFIX}_kv_cache_usage{{worker="{wid}"}} {m.kv_usage:.6f}')
+            lines.append(f'{RM.KV_CACHE_USAGE}{{worker="{wid}"}} {m.kv_usage:.6f}')
 
-        lines.append(f"# HELP {PREFIX}_routing_decisions_total KV-router decisions")
-        lines.append(f"# TYPE {PREFIX}_routing_decisions_total counter")
+        lines.append(f"# HELP {RM.ROUTING_DECISIONS_TOTAL} KV-router decisions")
+        lines.append(f"# TYPE {RM.ROUTING_DECISIONS_TOTAL} counter")
         for wid, s in sorted(self.hits.items()):
-            lines.append(f'{PREFIX}_routing_decisions_total{{worker="{wid}"}} {s.decisions}')
-        lines.append(f"# HELP {PREFIX}_kv_hit_rate_percent cumulative prefix-hit rate")
-        lines.append(f"# TYPE {PREFIX}_kv_hit_rate_percent gauge")
+            lines.append(f'{RM.ROUTING_DECISIONS_TOTAL}{{worker="{wid}"}} {s.decisions}')
+        lines.append(f"# HELP {RM.KV_HIT_RATE_PERCENT} cumulative prefix-hit rate")
+        lines.append(f"# TYPE {RM.KV_HIT_RATE_PERCENT} gauge")
         for wid, s in sorted(self.hits.items()):
             rate = 100.0 * s.overlap_blocks / max(s.isl_blocks, 1)
-            lines.append(f'{PREFIX}_kv_hit_rate_percent{{worker="{wid}"}} {rate:.3f}')
+            lines.append(f'{RM.KV_HIT_RATE_PERCENT}{{worker="{wid}"}} {rate:.3f}')
         return "\n".join(lines) + "\n"
 
 

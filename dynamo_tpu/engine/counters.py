@@ -9,8 +9,8 @@ benchmarks read — no import cycles.  The HTTP metrics endpoint exposes:
     dynamo_tpu_engine_prefill_batch_occupancy      gauge (rows/dispatch)
     dynamo_tpu_engine_prefill_budget_utilization   gauge (used/offered)
     dynamo_tpu_engine_unified_dispatches_total     counter
-    dynamo_tpu_engine_unified_decode_rows          counter
-    dynamo_tpu_engine_unified_prefill_tokens       counter
+    dynamo_tpu_engine_unified_decode_rows_total    counter
+    dynamo_tpu_engine_unified_prefill_tokens_total counter
     dynamo_tpu_engine_unified_budget_utilization   gauge (used/offered)
     dynamo_tpu_engine_lookahead_bursts_total       counter
     dynamo_tpu_engine_lookahead_hits_total         counter
@@ -210,6 +210,8 @@ class KvShardCounters:
         dynamo_tpu_kv_shard_fanout_latency_ms     histogram (scatter issue
                                                   → last reply/deadline)
         dynamo_tpu_kv_shard_generation            gauge (current fence)
+        dynamo_tpu_kv_shard_last_fan_out          gauge (shards in the
+                                                  last scatter round)
         dynamo_tpu_kv_shard_index_blocks{shard=}  gauge (device blocks)
         dynamo_tpu_kv_shard_resident_keys{shard=} gauge (distinct keys,
                                                   both tiers)

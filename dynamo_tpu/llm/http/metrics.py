@@ -3,6 +3,13 @@
 Reference parity: lib/llm/src/http/service/metrics.rs:36-46 (request
 counters by model/endpoint/status, inflight gauge with RAII guard).
 No prometheus client dependency — the text format is trivial to emit.
+
+Every metric name comes from the committed registry
+(``obs/metric_names.py``); the dtmet lint plane
+(``analysis/metcheck.py``) statically extracts each ``# TYPE`` and
+sample line below and audits the producer -> renderer -> scraper
+chain, so a renamed or dropped series fails ``lint --metrics`` instead
+of silently zeroing a bench column.
 """
 
 from __future__ import annotations
@@ -17,16 +24,15 @@ from dynamo_tpu.engine.counters import (kv_shard_counters, kv_stream_counters,
                                         lookahead_counters, persist_counters)
 from dynamo_tpu.fault.counters import counters as fault_counters
 from dynamo_tpu.obs.costs import transfer_costs
+from dynamo_tpu.obs.metric_names import EngineMetric as EM
+from dynamo_tpu.obs.metric_names import FaultMetric as FM
+from dynamo_tpu.obs.metric_names import HttpMetric as HM
+from dynamo_tpu.obs.metric_names import KvShardMetric as SHM
+from dynamo_tpu.obs.metric_names import KvStreamMetric as STM
+from dynamo_tpu.obs.metric_names import KvTransferMetric as KM
+from dynamo_tpu.obs.metric_names import PerfMetric as PM
 from dynamo_tpu.obs.perfmodel import perf_model
 from dynamo_tpu.obs.timeline import PHASES, step_timeline
-
-PREFIX = "dynamo_tpu_http_service"
-FAULT_PREFIX = "dynamo_tpu_fault"
-ENGINE_PREFIX = "dynamo_tpu_engine"
-KV_PREFIX = "dynamo_tpu_kv_transfer"
-STREAM_PREFIX = "dynamo_tpu_kv_stream"
-SHARD_PREFIX = "dynamo_tpu_kv_shard"
-PERF_PREFIX = "dynamo_tpu_perf"
 
 # seconds; TTFT and whole-request durations share one ladder
 _BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
@@ -90,211 +96,209 @@ class Metrics:
         return InflightGuard(self, model, endpoint)
 
     def render(self) -> str:
-        lines = [
-            f"# TYPE {PREFIX}_requests_total counter",
-        ]
+        lines: list[str] = []
+        lines.append(f"# TYPE {HM.REQUESTS_TOTAL} counter")
         for (model, endpoint, status), n in sorted(self.requests.items()):
             lines.append(
-                f'{PREFIX}_requests_total{{model="{model}",endpoint="{endpoint}",status="{status}"}} {n}'
+                f'{HM.REQUESTS_TOTAL}{{model="{model}",endpoint="{endpoint}",status="{status}"}} {n}'
             )
-        lines.append(f"# TYPE {PREFIX}_inflight_requests gauge")
+        lines.append(f"# TYPE {HM.INFLIGHT_REQUESTS} gauge")
         for model, n in sorted(self.inflight.items()):
-            lines.append(f'{PREFIX}_inflight_requests{{model="{model}"}} {n}')
-        lines.append(f"# TYPE {PREFIX}_output_tokens_total counter")
+            lines.append(f'{HM.INFLIGHT_REQUESTS}{{model="{model}"}} {n}')
+        lines.append(f"# TYPE {HM.OUTPUT_TOKENS_TOTAL} counter")
         for model, n in sorted(self.tokens_out.items()):
-            lines.append(f'{PREFIX}_output_tokens_total{{model="{model}"}} {n}')
-        lines.append(f"# TYPE {PREFIX}_admission_shed_total counter")
+            lines.append(f'{HM.OUTPUT_TOKENS_TOTAL}{{model="{model}"}} {n}')
+        lines.append(f"# TYPE {HM.ADMISSION_SHED_TOTAL} counter")
         for (model, priority), n in sorted(self.shed.items()):
             lines.append(
-                f'{PREFIX}_admission_shed_total{{model="{model}",priority="{priority}"}} {n}'
+                f'{HM.ADMISSION_SHED_TOTAL}{{model="{model}",priority="{priority}"}} {n}'
             )
-        lines.append(f"# TYPE {PREFIX}_ttft_seconds histogram")
+        lines.append(f"# TYPE {HM.TTFT_SECONDS} histogram")
         for model, h in sorted(self.ttft.items()):
-            lines.extend(h.render(f"{PREFIX}_ttft_seconds",
-                                  f'model="{model}"'))
-        lines.append(f"# TYPE {PREFIX}_inter_token_seconds histogram")
+            lines.extend(h.render(HM.TTFT_SECONDS, f'model="{model}"'))
+        lines.append(f"# TYPE {HM.INTER_TOKEN_SECONDS} histogram")
         for model, h in sorted(self.itl.items()):
-            lines.extend(h.render(f"{PREFIX}_inter_token_seconds",
+            lines.extend(h.render(HM.INTER_TOKEN_SECONDS,
                                   f'model="{model}"'))
-        lines.append(f"# TYPE {PREFIX}_queue_wait_seconds histogram")
+        lines.append(f"# TYPE {HM.QUEUE_WAIT_SECONDS} histogram")
         for model, h in sorted(self.queue_wait.items()):
-            lines.extend(h.render(f"{PREFIX}_queue_wait_seconds",
+            lines.extend(h.render(HM.QUEUE_WAIT_SECONDS,
                                   f'model="{model}"'))
-        lines.append(f"# TYPE {PREFIX}_request_seconds histogram")
+        lines.append(f"# TYPE {HM.REQUEST_SECONDS} histogram")
         for (model, status), h in sorted(self.duration.items()):
             lines.extend(h.render(
-                f"{PREFIX}_request_seconds",
+                HM.REQUEST_SECONDS,
                 f'model="{model}",status="{status}"'))
         # fault plane (process-global): migrations performed, drains live,
         # instances currently suspect per the health probes
-        lines.append(f"# TYPE {FAULT_PREFIX}_migrations_total counter")
-        lines.append(f"{FAULT_PREFIX}_migrations_total "
+        lines.append(f"# TYPE {FM.MIGRATIONS_TOTAL} counter")
+        lines.append(f"{FM.MIGRATIONS_TOTAL} "
                      f"{fault_counters.migrations_total}")
-        lines.append(f"# TYPE {FAULT_PREFIX}_drains_in_progress gauge")
-        lines.append(f"{FAULT_PREFIX}_drains_in_progress "
+        lines.append(f"# TYPE {FM.DRAINS_IN_PROGRESS} gauge")
+        lines.append(f"{FM.DRAINS_IN_PROGRESS} "
                      f"{fault_counters.drains_in_progress}")
-        lines.append(f"# TYPE {FAULT_PREFIX}_suspect_instances gauge")
-        lines.append(f"{FAULT_PREFIX}_suspect_instances "
+        lines.append(f"# TYPE {FM.SUSPECT_INSTANCES} gauge")
+        lines.append(f"{FM.SUSPECT_INSTANCES} "
                      f"{fault_counters.suspect_instances()}")
         # prefill batching (process-global, like the fault plane): how
         # well the token-budget ragged prefill packs the device
-        lines.append(f"# TYPE {ENGINE_PREFIX}_prefill_dispatches_total counter")
-        lines.append(f"{ENGINE_PREFIX}_prefill_dispatches_total "
+        lines.append(f"# TYPE {EM.PREFILL_DISPATCHES_TOTAL} counter")
+        lines.append(f"{EM.PREFILL_DISPATCHES_TOTAL} "
                      f"{prefill_counters.dispatches_total}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_prefill_tokens_total counter")
-        lines.append(f"{ENGINE_PREFIX}_prefill_tokens_total "
+        lines.append(f"# TYPE {EM.PREFILL_TOKENS_TOTAL} counter")
+        lines.append(f"{EM.PREFILL_TOKENS_TOTAL} "
                      f"{prefill_counters.tokens_total}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_prefill_batch_occupancy gauge")
-        lines.append(f"{ENGINE_PREFIX}_prefill_batch_occupancy "
+        lines.append(f"# TYPE {EM.PREFILL_BATCH_OCCUPANCY} gauge")
+        lines.append(f"{EM.PREFILL_BATCH_OCCUPANCY} "
                      f"{round(prefill_counters.batch_occupancy, 6)}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_prefill_budget_utilization gauge")
-        lines.append(f"{ENGINE_PREFIX}_prefill_budget_utilization "
+        lines.append(f"# TYPE {EM.PREFILL_BUDGET_UTILIZATION} gauge")
+        lines.append(f"{EM.PREFILL_BUDGET_UTILIZATION} "
                      f"{round(prefill_counters.budget_utilization, 6)}")
         # unified mixed prefill+decode dispatch: how many turns collapsed
         # the two-dispatch interleave into one, and what shared the axis
-        lines.append(f"# TYPE {ENGINE_PREFIX}_unified_dispatches_total counter")
-        lines.append(f"{ENGINE_PREFIX}_unified_dispatches_total "
+        lines.append(f"# TYPE {EM.UNIFIED_DISPATCHES_TOTAL} counter")
+        lines.append(f"{EM.UNIFIED_DISPATCHES_TOTAL} "
                      f"{prefill_counters.unified_dispatches_total}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_unified_decode_rows counter")
-        lines.append(f"{ENGINE_PREFIX}_unified_decode_rows "
+        lines.append(f"# TYPE {EM.UNIFIED_DECODE_ROWS_TOTAL} counter")
+        lines.append(f"{EM.UNIFIED_DECODE_ROWS_TOTAL} "
                      f"{prefill_counters.unified_decode_rows_total}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_unified_prefill_tokens counter")
-        lines.append(f"{ENGINE_PREFIX}_unified_prefill_tokens "
+        lines.append(f"# TYPE {EM.UNIFIED_PREFILL_TOKENS_TOTAL} counter")
+        lines.append(f"{EM.UNIFIED_PREFILL_TOKENS_TOTAL} "
                      f"{prefill_counters.unified_prefill_tokens_total}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_unified_budget_utilization gauge")
-        lines.append(f"{ENGINE_PREFIX}_unified_budget_utilization "
+        lines.append(f"# TYPE {EM.UNIFIED_BUDGET_UTILIZATION} gauge")
+        lines.append(f"{EM.UNIFIED_BUDGET_UTILIZATION} "
                      f"{round(prefill_counters.unified_budget_utilization, 6)}")
         # double-buffered dispatch (lookahead scheduler): fused bursts,
         # per-row prediction hit/mispredict split, speculative next-turn
         # prebuild commits/flushes, and the depth of the last burst
         lc = lookahead_counters
-        lines.append(f"# TYPE {ENGINE_PREFIX}_lookahead_bursts_total counter")
-        lines.append(f"{ENGINE_PREFIX}_lookahead_bursts_total "
-                     f"{lc.bursts_total}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_lookahead_hits_total counter")
-        lines.append(f"{ENGINE_PREFIX}_lookahead_hits_total "
-                     f"{lc.hits_total}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_lookahead_mispredicts_total "
-                     f"counter")
-        lines.append(f"{ENGINE_PREFIX}_lookahead_mispredicts_total "
+        lines.append(f"# TYPE {EM.LOOKAHEAD_BURSTS_TOTAL} counter")
+        lines.append(f"{EM.LOOKAHEAD_BURSTS_TOTAL} {lc.bursts_total}")
+        lines.append(f"# TYPE {EM.LOOKAHEAD_HITS_TOTAL} counter")
+        lines.append(f"{EM.LOOKAHEAD_HITS_TOTAL} {lc.hits_total}")
+        lines.append(f"# TYPE {EM.LOOKAHEAD_MISPREDICTS_TOTAL} counter")
+        lines.append(f"{EM.LOOKAHEAD_MISPREDICTS_TOTAL} "
                      f"{lc.mispredicts_total}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_lookahead_commits_total counter")
-        lines.append(f"{ENGINE_PREFIX}_lookahead_commits_total "
-                     f"{lc.commits_total}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_lookahead_flushes_total counter")
-        lines.append(f"{ENGINE_PREFIX}_lookahead_flushes_total "
-                     f"{lc.flushes_total}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_lookahead_dispatch_depth gauge")
-        lines.append(f"{ENGINE_PREFIX}_lookahead_dispatch_depth "
-                     f"{lc.dispatch_depth}")
+        lines.append(f"# TYPE {EM.LOOKAHEAD_COMMITS_TOTAL} counter")
+        lines.append(f"{EM.LOOKAHEAD_COMMITS_TOTAL} {lc.commits_total}")
+        lines.append(f"# TYPE {EM.LOOKAHEAD_FLUSHES_TOTAL} counter")
+        lines.append(f"{EM.LOOKAHEAD_FLUSHES_TOTAL} {lc.flushes_total}")
+        lines.append(f"# TYPE {EM.LOOKAHEAD_DISPATCH_DEPTH} gauge")
+        lines.append(f"{EM.LOOKAHEAD_DISPATCH_DEPTH} {lc.dispatch_depth}")
         # persistent prefix-cache tier (llm/kv/persist.py): blocks/tokens
         # restored from disk instead of re-prefilled, spill volume, and
         # the store's current footprint
-        lines.append(f"# TYPE {ENGINE_PREFIX}_persist_hits_total counter")
-        lines.append(f"{ENGINE_PREFIX}_persist_hits_total "
+        lines.append(f"# TYPE {EM.PERSIST_HITS_TOTAL} counter")
+        lines.append(f"{EM.PERSIST_HITS_TOTAL} "
                      f"{persist_counters.hits_total}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_persist_misses_total counter")
-        lines.append(f"{ENGINE_PREFIX}_persist_misses_total "
+        lines.append(f"# TYPE {EM.PERSIST_MISSES_TOTAL} counter")
+        lines.append(f"{EM.PERSIST_MISSES_TOTAL} "
                      f"{persist_counters.misses_total}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_persist_restored_tokens_total counter")
-        lines.append(f"{ENGINE_PREFIX}_persist_restored_tokens_total "
+        lines.append(f"# TYPE {EM.PERSIST_RESTORED_TOKENS_TOTAL} counter")
+        lines.append(f"{EM.PERSIST_RESTORED_TOKENS_TOTAL} "
                      f"{persist_counters.restored_tokens_total}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_persist_spill_bytes_total counter")
-        lines.append(f"{ENGINE_PREFIX}_persist_spill_bytes_total "
+        lines.append(f"# TYPE {EM.PERSIST_SPILL_BYTES_TOTAL} counter")
+        lines.append(f"{EM.PERSIST_SPILL_BYTES_TOTAL} "
                      f"{persist_counters.spill_bytes_total}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_persist_resident_bytes gauge")
-        lines.append(f"{ENGINE_PREFIX}_persist_resident_bytes "
+        lines.append(f"# TYPE {EM.PERSIST_RESIDENT_BYTES} gauge")
+        lines.append(f"{EM.PERSIST_RESIDENT_BYTES} "
                      f"{persist_counters.resident_bytes}")
         # streamed KV handoff (llm/kv/stream.py): layer frames shipped
         # while prefill still computed, and how often the stream fell
         # back to the blocking whole-cache push
-        lines.append(f"# TYPE {STREAM_PREFIX}_sessions_total counter")
-        lines.append(f"{STREAM_PREFIX}_sessions_total "
+        lines.append(f"# TYPE {STM.SESSIONS_TOTAL} counter")
+        lines.append(f"{STM.SESSIONS_TOTAL} "
                      f"{kv_stream_counters.sessions_total}")
-        lines.append(f"# TYPE {STREAM_PREFIX}_layers_sent_total counter")
-        lines.append(f"{STREAM_PREFIX}_layers_sent_total "
+        lines.append(f"# TYPE {STM.LAYERS_SENT_TOTAL} counter")
+        lines.append(f"{STM.LAYERS_SENT_TOTAL} "
                      f"{kv_stream_counters.layers_sent_total}")
-        lines.append(f"# TYPE {STREAM_PREFIX}_bytes_total counter")
-        lines.append(f"{STREAM_PREFIX}_bytes_total "
+        lines.append(f"# TYPE {STM.BYTES_TOTAL} counter")
+        lines.append(f"{STM.BYTES_TOTAL} "
                      f"{kv_stream_counters.bytes_total}")
-        lines.append(f"# TYPE {STREAM_PREFIX}_fallbacks_total counter")
-        lines.append(f"{STREAM_PREFIX}_fallbacks_total "
+        lines.append(f"# TYPE {STM.FALLBACKS_TOTAL} counter")
+        lines.append(f"{STM.FALLBACKS_TOTAL} "
                      f"{kv_stream_counters.fallbacks_total}")
-        lines.append(f"# TYPE {STREAM_PREFIX}_overlap_ratio gauge")
-        lines.append(f"{STREAM_PREFIX}_overlap_ratio "
+        lines.append(f"# TYPE {STM.OVERLAP_RATIO} gauge")
+        lines.append(f"{STM.OVERLAP_RATIO} "
                      f"{round(kv_stream_counters.overlap_ratio, 6)}")
         # sharded control plane (llm/kv_router/shards/): scatter rounds,
         # partial gathers (a shard missed its deadline or answered behind
         # the generation fence), fan-out latency, per-shard index gauges
         sc = kv_shard_counters
-        lines.append(f"# TYPE {SHARD_PREFIX}_scatters_total counter")
-        lines.append(f"{SHARD_PREFIX}_scatters_total {sc.scatters_total}")
-        lines.append(f"# TYPE {SHARD_PREFIX}_gather_partial_total counter")
-        lines.append(f"{SHARD_PREFIX}_gather_partial_total "
+        lines.append(f"# TYPE {SHM.SCATTERS_TOTAL} counter")
+        lines.append(f"{SHM.SCATTERS_TOTAL} {sc.scatters_total}")
+        lines.append(f"# TYPE {SHM.GATHER_PARTIAL_TOTAL} counter")
+        lines.append(f"{SHM.GATHER_PARTIAL_TOTAL} "
                      f"{sc.gather_partial_total}")
-        lines.append(f"# TYPE {SHARD_PREFIX}_generation gauge")
-        lines.append(f"{SHARD_PREFIX}_generation {sc.generation}")
-        lines.append(f"# TYPE {SHARD_PREFIX}_fanout_latency_ms histogram")
+        lines.append(f"# TYPE {SHM.GENERATION} gauge")
+        lines.append(f"{SHM.GENERATION} {sc.generation}")
+        lines.append(f"# TYPE {SHM.LAST_FAN_OUT} gauge")
+        lines.append(f"{SHM.LAST_FAN_OUT} {sc.last_fan_out}")
+        lines.append(f"# TYPE {SHM.FANOUT_LATENCY_MS} histogram")
         for edge, count in zip(sc.FANOUT_BUCKETS_MS,
                                sc.fanout_bucket_counts):
             lines.append(
-                f'{SHARD_PREFIX}_fanout_latency_ms_bucket{{le="{edge}"}} '
-                f"{count}")
-        lines.append(f'{SHARD_PREFIX}_fanout_latency_ms_bucket{{le="+Inf"}} '
+                f'{SHM.FANOUT_LATENCY_MS}_bucket{{le="{edge}"}} {count}')
+        lines.append(f'{SHM.FANOUT_LATENCY_MS}_bucket{{le="+Inf"}} '
                      f"{sc.scatters_total}")
-        lines.append(f"{SHARD_PREFIX}_fanout_latency_ms_sum "
+        lines.append(f"{SHM.FANOUT_LATENCY_MS}_sum "
                      f"{round(sc.fanout_ms_sum, 6)}")
-        lines.append(f"{SHARD_PREFIX}_fanout_latency_ms_count "
+        lines.append(f"{SHM.FANOUT_LATENCY_MS}_count "
                      f"{sc.scatters_total}")
         if sc.index_blocks:
-            lines.append(f"# TYPE {SHARD_PREFIX}_index_blocks gauge")
+            lines.append(f"# TYPE {SHM.INDEX_BLOCKS} gauge")
             for shard_id, blocks in sorted(sc.index_blocks.items()):
                 lines.append(
-                    f'{SHARD_PREFIX}_index_blocks{{shard="{shard_id}"}} '
-                    f"{blocks}")
-            lines.append(f"# TYPE {SHARD_PREFIX}_resident_keys gauge")
+                    f'{SHM.INDEX_BLOCKS}{{shard="{shard_id}"}} {blocks}')
+            lines.append(f"# TYPE {SHM.RESIDENT_KEYS} gauge")
             for shard_id, keys in sorted(sc.resident_keys.items()):
                 lines.append(
-                    f'{SHARD_PREFIX}_resident_keys{{shard="{shard_id}"}} '
-                    f"{keys}")
+                    f'{SHM.RESIDENT_KEYS}{{shard="{shard_id}"}} {keys}')
         # dtspan engine step timeline: per-phase wall attribution plus the
         # headline host bubble (ROADMAP item 3's committed before-number)
         tl = step_timeline.snapshot()
-        lines.append(f"# TYPE {ENGINE_PREFIX}_steps_total counter")
-        lines.append(f"{ENGINE_PREFIX}_steps_total {tl['steps_total']}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_busy_steps_total counter")
-        lines.append(f"{ENGINE_PREFIX}_busy_steps_total "
-                     f"{tl['busy_steps_total']}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_step_wall_seconds_total counter")
-        lines.append(f"{ENGINE_PREFIX}_step_wall_seconds_total "
+        lines.append(f"# TYPE {EM.STEPS_TOTAL} counter")
+        lines.append(f"{EM.STEPS_TOTAL} {tl['steps_total']}")
+        lines.append(f"# TYPE {EM.BUSY_STEPS_TOTAL} counter")
+        lines.append(f"{EM.BUSY_STEPS_TOTAL} {tl['busy_steps_total']}")
+        lines.append(f"# TYPE {EM.STEP_WALL_SECONDS_TOTAL} counter")
+        lines.append(f"{EM.STEP_WALL_SECONDS_TOTAL} "
                      f"{round(tl['wall_seconds_total'], 6)}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_step_phase_seconds_total counter")
+        lines.append(f"# TYPE {EM.STEP_PHASE_SECONDS_TOTAL} counter")
         for p in PHASES:
             lines.append(
-                f'{ENGINE_PREFIX}_step_phase_seconds_total{{phase="{p}"}} '
+                f'{EM.STEP_PHASE_SECONDS_TOTAL}{{phase="{p}"}} '
                 f"{round(tl['phases'][p], 6)}")
-        lines.append(f"# TYPE {ENGINE_PREFIX}_host_gap_ms_per_turn gauge")
-        lines.append(f"{ENGINE_PREFIX}_host_gap_ms_per_turn "
+        lines.append(f"# TYPE {EM.HOST_GAP_MS_PER_TURN} gauge")
+        lines.append(f"{EM.HOST_GAP_MS_PER_TURN} "
                      f"{round(tl['host_gap_ms_per_turn'], 6)}")
+        # smoothed per-step companions to the lifetime means above — the
+        # signal a live dashboard watches while a run warms up
+        lines.append(f"# TYPE {EM.STEP_WALL_MS_EWMA} gauge")
+        lines.append(f"{EM.STEP_WALL_MS_EWMA} "
+                     f"{round(tl['ewma_wall_ms'], 6)}")
+        lines.append(f"# TYPE {EM.HOST_GAP_MS_EWMA} gauge")
+        lines.append(f"{EM.HOST_GAP_MS_EWMA} "
+                     f"{round(tl['ewma_host_gap_ms'], 6)}")
         # measured KV-transfer costs per (src, dst, path) edge
         costs = transfer_costs.snapshot()
         if costs:
-            for metric, typ in (("calls_total", "counter"),
-                                ("bytes_total", "counter"),
-                                ("seconds_total", "counter"),
-                                ("mbps", "gauge"),
-                                ("latency_ms", "gauge")):
-                lines.append(f"# TYPE {KV_PREFIX}_{metric} {typ}")
+            for name, typ in ((KM.CALLS_TOTAL, "counter"),
+                              (KM.BYTES_TOTAL, "counter"),
+                              (KM.SECONDS_TOTAL, "counter"),
+                              (KM.MBPS, "gauge"),
+                              (KM.LATENCY_MS, "gauge")):
+                lines.append(f"# TYPE {name} {typ}")
                 for (src, dst, path), e in sorted(costs.items()):
                     labels = f'src="{src}",dst="{dst}",path="{path}"'
                     val = {
-                        "calls_total": e["calls"],
-                        "bytes_total": e["bytes"],
-                        "seconds_total": round(e["seconds"], 6),
-                        "mbps": round(e["ewma_mbps"], 6),
-                        "latency_ms": round(e["ewma_latency_s"] * 1e3, 6),
-                    }[metric]
-                    lines.append(f"{KV_PREFIX}_{metric}{{{labels}}} {val}")
+                        KM.CALLS_TOTAL: e["calls"],
+                        KM.BYTES_TOTAL: e["bytes"],
+                        KM.SECONDS_TOTAL: round(e["seconds"], 6),
+                        KM.MBPS: round(e["ewma_mbps"], 6),
+                        KM.LATENCY_MS: round(e["ewma_latency_s"] * 1e3, 6),
+                    }[name]
+                    lines.append(f"{name}{{{labels}}} {val}")
         # dtperf plane: roofline-predicted step latency per (entrypoint,
         # config) from the committed perf manifest (JSON-only read — no
         # tracing happens here), plus the runtime predicted-vs-measured
@@ -306,30 +310,29 @@ class Metrics:
         except Exception:
             rows = []
         if rows:
-            lines.append(f"# TYPE {PERF_PREFIX}_predicted_step_ms gauge")
+            lines.append(f"# TYPE {PM.PREDICTED_STEP_MS} gauge")
             for r in rows:
                 labels = (f'entrypoint="{r["entrypoint"]}",'
                           f'config="{r["config"]}",'
                           f'signature="{r["signature"]}",'
                           f'bound="{r["bound"]}"')
                 lines.append(
-                    f"{PERF_PREFIX}_predicted_step_ms{{{labels}}} "
+                    f"{PM.PREDICTED_STEP_MS}{{{labels}}} "
                     f"{r['predicted_ms']}")
         recon = perf_model.reconcile()
         if recon:
-            for metric, field, typ in (
-                    ("predicted_dispatch_ms", "predicted_ms", "gauge"),
-                    ("measured_dispatch_ms", "measured_ms", "gauge"),
-                    ("dispatches_total", "dispatches", "counter"),
-                    ("model_error_ratio", "error_ratio", "gauge")):
+            for name, field, typ in (
+                    (PM.PREDICTED_DISPATCH_MS, "predicted_ms", "gauge"),
+                    (PM.MEASURED_DISPATCH_MS, "measured_ms", "gauge"),
+                    (PM.DISPATCHES_TOTAL, "dispatches", "counter"),
+                    (PM.MODEL_ERROR_RATIO, "error_ratio", "gauge")):
                 rendered = [r for r in recon if r.get(field) is not None]
                 if not rendered:
                     continue
-                lines.append(f"# TYPE {PERF_PREFIX}_{metric} {typ}")
+                lines.append(f"# TYPE {name} {typ}")
                 for r in rendered:
                     lines.append(
-                        f'{PERF_PREFIX}_{metric}{{kind="{r["kind"]}"}} '
-                        f"{r[field]}")
+                        f'{name}{{kind="{r["kind"]}"}} {r[field]}')
         return "\n".join(lines) + "\n"
 
 

@@ -1,6 +1,6 @@
 #!/bin/sh
 # Convenience wrapper for the static-analysis suite (docs/static_analysis.md).
-# One process, ALL NINE passes (dynamo-tpu lint --all), sharing one
+# One process, ALL TEN passes (dynamo-tpu lint --all), sharing one
 # ast.parse per file across the per-file, project and wire passes:
 #   1+2. per-file rules (DT001-DT105) + interprocedural project pass
 #        (DT005-DT009)
@@ -27,6 +27,10 @@
 #        references in interpret mode, kernel pricing + census;
 #        DTKERN_BUDGET=1 in the gate, crank + DTKERN_SEED_BASE for the
 #        nightly fuzz sweep)
+#   10.  metrics-plane contract audit (MT001-MT005) against the
+#        committed analysis/metrics_manifest.json (static
+#        producer->renderer->scraper census of the /metrics surface;
+#        also verifies the generated table in docs/observability.md)
 #   scripts/lint.sh                      # lint dynamo_tpu/, human output
 #   scripts/lint.sh --format json        # stable JSON (one doc per pass)
 #   scripts/lint.sh --changed            # pre-commit mode: per-file rules
@@ -34,15 +38,16 @@
 #                                        # project/trace/wire/perf/shard
 #                                        # passes stay whole-program, proto
 #                                        # re-explores only the affected
-#                                        # scenarios, and load/kern skip
-#                                        # when no plane input changed
+#                                        # scenarios, and load/kern/metrics
+#                                        # skip when no plane input changed
 #   scripts/lint.sh --update-baseline    # rebuild analysis/baseline.json
-#                                        # AND all seven manifests
+#                                        # AND all eight manifests
 #                                        # (justifications carried by key)
 #   scripts/lint.sh --select DT005       # one rule (project codes route
 #                                        # to the project registry; the
 #                                        # trace/wire/perf/shard/proto/
-#                                        # load/kern passes ignore it)
+#                                        # load/kern/metrics passes
+#                                        # ignore it)
 # Exit code 1 on any non-baselined finding from any pass.
 cd "$(dirname "$0")/.." || exit 2
 exec python -m dynamo_tpu lint --all "$@"

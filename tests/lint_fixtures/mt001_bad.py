@@ -1,0 +1,25 @@
+"""MT001 bad: reset() declares ``orphaned`` but nothing ever reads it."""
+
+
+class WidgetCounters:
+    def __init__(self):
+        self.reset()
+
+    def record(self, n):
+        self.dispatches += n
+        self.orphaned += 1
+
+    def reset(self):
+        self.dispatches = 0
+        self.orphaned = 0
+
+
+widget_counters = WidgetCounters()
+
+
+def render():
+    lines = []
+    lines.append("# TYPE dynamo_tpu_widget_dispatches_total counter")
+    lines.append(
+        f"dynamo_tpu_widget_dispatches_total {widget_counters.dispatches}")
+    return "\n".join(lines) + "\n"

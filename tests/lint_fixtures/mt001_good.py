@@ -1,0 +1,27 @@
+"""MT001 good: every reset()-declared counter field is rendered."""
+
+
+class WidgetCounters:
+    def __init__(self):
+        self.reset()
+
+    def record(self, n):
+        self.dispatches += n
+        self.orphaned += 1
+
+    def reset(self):
+        self.dispatches = 0
+        self.orphaned = 0
+
+
+widget_counters = WidgetCounters()
+
+
+def render():
+    lines = []
+    lines.append("# TYPE dynamo_tpu_widget_dispatches_total counter")
+    lines.append(
+        f"dynamo_tpu_widget_dispatches_total {widget_counters.dispatches}")
+    lines.append("# TYPE dynamo_tpu_widget_orphaned gauge")
+    lines.append(f"dynamo_tpu_widget_orphaned {widget_counters.orphaned}")
+    return "\n".join(lines) + "\n"

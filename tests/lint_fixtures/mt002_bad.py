@@ -1,0 +1,28 @@
+"""MT002 bad: the renderer grew a ``_total`` suffix; the scrape helper
+still reads the old name and will bank zero forever."""
+
+
+class WidgetCounters:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.dispatches = 0
+
+
+widget_counters = WidgetCounters()
+
+
+def render():
+    lines = []
+    lines.append("# TYPE dynamo_tpu_widget_dispatches_total counter")
+    lines.append(
+        f"dynamo_tpu_widget_dispatches_total {widget_counters.dispatches}")
+    return "\n".join(lines) + "\n"
+
+
+def scrape(text):
+    for line in text.splitlines():
+        if line.startswith("dynamo_tpu_widget_dispatches "):
+            return float(line.split()[1])
+    return 0.0

@@ -1,0 +1,27 @@
+"""MT002 good: the scrape helper reads the name the renderer emits."""
+
+
+class WidgetCounters:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.dispatches = 0
+
+
+widget_counters = WidgetCounters()
+
+
+def render():
+    lines = []
+    lines.append("# TYPE dynamo_tpu_widget_dispatches_total counter")
+    lines.append(
+        f"dynamo_tpu_widget_dispatches_total {widget_counters.dispatches}")
+    return "\n".join(lines) + "\n"
+
+
+def scrape(text):
+    for line in text.splitlines():
+        if line.startswith("dynamo_tpu_widget_dispatches_total "):
+            return float(line.split()[1])
+    return 0.0

@@ -1,0 +1,12 @@
+"""MT003 bad: a per-request session id flows into a label value — one
+series per session, unbounded cardinality."""
+
+
+def render(requests):
+    lines = []
+    lines.append("# TYPE dynamo_tpu_widget_inflight gauge")
+    for req in requests:
+        lines.append(
+            f'dynamo_tpu_widget_inflight{{session="{req.session_id}"}} '
+            f"{req.tokens}")
+    return "\n".join(lines) + "\n"

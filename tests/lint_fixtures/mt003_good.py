@@ -1,0 +1,12 @@
+"""MT003 good: the label value comes from a closed enum, not request
+identity."""
+
+
+def render(per_phase):
+    lines = []
+    lines.append("# TYPE dynamo_tpu_widget_inflight gauge")
+    for phase in ("prefill", "decode"):
+        lines.append(
+            f'dynamo_tpu_widget_inflight{{phase="{phase}"}} '
+            f"{per_phase.get(phase, 0)}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,28 @@
+"""MT004 bad: a counter without ``_total``, a histogram in ms, and a
+counter backing that is decremented (monotonicity broken)."""
+
+
+class WidgetCounters:
+    def __init__(self):
+        self.reset()
+
+    def record(self):
+        self.ops += 1
+
+    def undo(self):
+        self.ops -= 1
+
+    def reset(self):
+        self.ops = 0
+
+
+widget_counters = WidgetCounters()
+
+
+def render():
+    lines = []
+    lines.append("# TYPE dynamo_tpu_widget_ops counter")
+    lines.append(f"dynamo_tpu_widget_ops {widget_counters.ops}")
+    lines.append("# TYPE dynamo_tpu_widget_latency_ms histogram")
+    lines.append(f"dynamo_tpu_widget_latency_ms_sum {widget_counters.ops}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,26 @@
+"""MT004 good: ``_total`` counter, base-unit histogram, monotone
+backing."""
+
+
+class WidgetCounters:
+    def __init__(self):
+        self.reset()
+
+    def record(self):
+        self.ops += 1
+
+    def reset(self):
+        self.ops = 0
+
+
+widget_counters = WidgetCounters()
+
+
+def render():
+    lines = []
+    lines.append("# TYPE dynamo_tpu_widget_ops_total counter")
+    lines.append(f"dynamo_tpu_widget_ops_total {widget_counters.ops}")
+    lines.append("# TYPE dynamo_tpu_widget_latency_seconds histogram")
+    lines.append(
+        f"dynamo_tpu_widget_latency_seconds_sum {widget_counters.ops}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,10 @@
+"""MT005 base: the committed side of the census-drift fixture pair."""
+
+
+def render(v):
+    lines = []
+    lines.append("# TYPE dynamo_tpu_widget_ops_total counter")
+    lines.append(f'dynamo_tpu_widget_ops_total{{phase="decode"}} {v}')
+    lines.append("# TYPE dynamo_tpu_widget_old_total counter")
+    lines.append(f"dynamo_tpu_widget_old_total {v}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,12 @@
+"""MT005 drift: vs mt005_base — ops changed TYPE and label, old_total
+vanished, new_total appeared.  A manifest snapshotted from the base
+side must flag exactly those four drifts."""
+
+
+def render(v):
+    lines = []
+    lines.append("# TYPE dynamo_tpu_widget_ops_total gauge")
+    lines.append(f'dynamo_tpu_widget_ops_total{{kind="decode"}} {v}')
+    lines.append("# TYPE dynamo_tpu_widget_new_total counter")
+    lines.append(f"dynamo_tpu_widget_new_total {v}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,199 @@
+"""Regenerate the committed golden /metrics render fixtures.
+
+    python tests/metrics_golden/generate.py
+
+Two byte-level recordings of the repo's Prometheus text exposition —
+the HTTP service surface (llm/http/metrics.py, with every
+process-global counter family populated) and the standalone metrics
+component (components/metrics.py) — produced from a fixed,
+deterministic seeding of every producer.  tests/test_metrics_golden.py
+re-renders the same seeding with CURRENT code and compares
+byte-for-byte, then re-scrapes the committed text through
+benchmarks/scrape.py: a diff here means the exposition format changed,
+and every banked bench column and dashboard reading the old names sees
+that change.
+
+Everything is deterministic: fixed counts, a fake timeline clock, an
+injected perf-model prediction, and a patched perf-manifest row (the
+golden pins the FORMAT of the dtperf series, not the committed perf
+numbers, which re-baseline independently).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+OUT = Path(__file__).resolve().parent
+
+# fixed dtperf manifest rows — both generate.py and the golden test
+# patch analysis.perfcheck.manifest_predictions with this exact list
+PRED_ROWS = [
+    {"entrypoint": "decode_step", "config": "llama3b-v5e",
+     "signature": "b64", "bound": "hbm", "predicted_ms": 1.875},
+]
+
+
+class _Clock:
+    """Deterministic stand-in for the timeline's perf_counter."""
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def reset_producers() -> None:
+    """Reset every process-global producer the HTTP render reads (the
+    same singletons the tier-1 tests isolate against)."""
+    from dynamo_tpu.engine.counters import (counters, kv_shard_counters,
+                                            kv_stream_counters,
+                                            lookahead_counters,
+                                            persist_counters)
+    from dynamo_tpu.fault.counters import counters as fault_counters
+    from dynamo_tpu.obs.costs import transfer_costs
+    from dynamo_tpu.obs.perfmodel import perf_model
+    from dynamo_tpu.obs.timeline import step_timeline
+
+    for c in (counters, persist_counters, kv_stream_counters,
+              kv_shard_counters, lookahead_counters, fault_counters,
+              transfer_costs, perf_model):
+        c.reset()
+    step_timeline.reset()
+    step_timeline._clock = time.perf_counter
+
+
+def seed_http_metrics():
+    """Fixed recording across every producer family; returns the
+    seeded ``Metrics`` instance (render via ``render_http``)."""
+    from dynamo_tpu.engine.counters import (counters, kv_shard_counters,
+                                            kv_stream_counters,
+                                            lookahead_counters,
+                                            persist_counters)
+    from dynamo_tpu.fault.counters import counters as fault_counters
+    from dynamo_tpu.llm.http.metrics import Metrics
+    from dynamo_tpu.obs.costs import transfer_costs
+    from dynamo_tpu.obs.perfmodel import perf_model
+    from dynamo_tpu.obs.timeline import step_timeline
+
+    reset_producers()
+
+    m = Metrics()
+    m.requests[("m1", "completions", "success")] = 3
+    m.requests[("m1", "completions", "error")] = 1
+    m.inflight["m1"] = 2
+    m.tokens_out["m1"] = 64
+    m.shed[("m1", "interactive")] = 1
+    for v in (0.02, 0.08, 0.4):
+        m.ttft["m1"].observe(v)
+    for v in (0.004, 0.008, 0.02):
+        m.itl["m1"].observe(v)
+    m.queue_wait["m1"].observe(0.03)
+    m.duration[("m1", "success")].observe(1.2)
+    m.duration[("m1", "error")].observe(0.01)
+
+    fault_counters.migrations_total = 2
+    fault_counters.drains_in_progress = 1
+    fault_counters.register_suspect_source(lambda: (7,))
+
+    counters.record(4, 96, budget=128)
+    counters.record(2, 64, budget=128)
+    counters.record_unified(6, 90, 128)
+    lookahead_counters.record_burst(depth=4, hits=6, mispredicts=2)
+    lookahead_counters.record_commit()
+    lookahead_counters.record_commit()
+    lookahead_counters.record_flush()
+    persist_counters.record_restore(2, 32)
+    persist_counters.record_miss()
+    persist_counters.record_spill(4096)
+    persist_counters.set_resident(8192)
+    kv_stream_counters.record_session()
+    kv_stream_counters.record_layer(2048, 0.002, hidden=True)
+    kv_stream_counters.record_layer(2048, 0.002, hidden=False)
+    kv_shard_counters.record_scatter(0.3, fan_out=4)
+    kv_shard_counters.record_scatter(3.0, fan_out=4)
+    kv_shard_counters.record_partial_gather()
+    kv_shard_counters.set_generation(2)
+    kv_shard_counters.set_shard_size(0, 128, 32)
+    kv_shard_counters.set_shard_size(1, 120, 30)
+    transfer_costs.record("prefill-0", "decode-0", "dcn", 5_000_000, 0.02)
+    transfer_costs.record("prefill-0", "decode-0", "dcn", 5_000_000, 0.025)
+    transfer_costs.record("decode-0", "decode-0", "ici", 1_000_000, 0.001)
+
+    # two busy steps at virtual time: 10 ms dispatch, 2 ms host_build,
+    # 1 ms readback, 0.5 ms host_post each
+    clock = _Clock()
+    step_timeline._clock = clock
+    for _ in range(2):
+        step_timeline.begin()
+        clock.advance(0.002)
+        step_timeline.mark("host_build")
+        clock.advance(0.010)
+        step_timeline.mark("dispatch", kind="step")
+        clock.advance(0.001)
+        step_timeline.mark("readback")
+        clock.advance(0.0005)
+        step_timeline.end()
+
+    # one already-priced perf-model entry: reconcile() joins it with the
+    # timeline's measured "step" seconds without tracing anything
+    perf_model._entries["step"] = {
+        "fn": None, "args": (), "kw": {}, "statics": {},
+        "predicted": {"predicted": {"total_ms": 1.25}},
+    }
+    return m
+
+
+def render_http() -> str:
+    """Seed + render the HTTP surface with the perf-manifest rows
+    pinned to PRED_ROWS."""
+    from dynamo_tpu.analysis import perfcheck
+
+    m = seed_http_metrics()
+    orig = perfcheck.manifest_predictions
+    perfcheck.manifest_predictions = lambda: [dict(r) for r in PRED_ROWS]
+    try:
+        return m.render()
+    finally:
+        perfcheck.manifest_predictions = orig
+
+
+def render_components() -> str:
+    """Seed + render the standalone metrics component."""
+    from dynamo_tpu.components.metrics import PrometheusMetricsCollector
+    from dynamo_tpu.llm.kv_router.scheduler import WorkerMetrics
+
+    c = PrometheusMetricsCollector()
+    c.on_worker_metrics(WorkerMetrics(
+        worker_id=0, request_active_slots=3, request_total_slots=8,
+        kv_active_blocks=96, kv_total_blocks=256,
+        num_requests_waiting=1, updated_at=0.0))
+    c.on_worker_metrics(WorkerMetrics(
+        worker_id=1, request_active_slots=5, request_total_slots=8,
+        kv_active_blocks=192, kv_total_blocks=256,
+        num_requests_waiting=0, updated_at=0.0))
+    for _ in range(3):
+        c.on_hit_rate_event(0, 10, 7)
+    c.on_hit_rate_event(1, 8, 2)
+    return c.render()
+
+
+def main() -> None:
+    (OUT / "render_http.txt").write_text(render_http())
+    (OUT / "render_components.txt").write_text(render_components())
+    reset_producers()
+    for name in ("render_http.txt", "render_components.txt"):
+        print(f"wrote {OUT / name}")
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,7 @@ from aiohttp import ClientSession
 
 from dynamo_tpu.fault import FaultInjector, HealthMonitor, MigratingClient
 from dynamo_tpu.fault.counters import counters
+from dynamo_tpu.obs.metric_names import FaultMetric as FM
 from dynamo_tpu.fault.migration import MigrationExhausted
 from dynamo_tpu.llm.protocols import (
     BackendInput,
@@ -441,10 +442,10 @@ def test_fault_counters_scrape():
             async with ClientSession() as s:
                 r = await s.get(f"http://127.0.0.1:{svc.port}/metrics")
                 text = await r.text()
-            assert "dynamo_tpu_fault_migrations_total 7" in text
-            assert "dynamo_tpu_fault_drains_in_progress 2" in text
-            assert "dynamo_tpu_fault_suspect_instances 3" in text
-            assert "# TYPE dynamo_tpu_fault_migrations_total counter" in text
+            assert f"{FM.MIGRATIONS_TOTAL} 7" in text
+            assert f"{FM.DRAINS_IN_PROGRESS} 2" in text
+            assert f"{FM.SUSPECT_INSTANCES} 3" in text
+            assert f"# TYPE {FM.MIGRATIONS_TOTAL} counter" in text
         finally:
             await svc.stop()
 

@@ -265,6 +265,7 @@ def test_metrics_latency_histograms():
     """TTFT + request-duration histograms render in Prometheus format
     with coherent bucket/sum/count after served requests."""
     from dynamo_tpu.llm.http.metrics import Metrics
+    from dynamo_tpu.obs.metric_names import HttpMetric as HM
 
     m = Metrics()
     g = m.guard("m1", "completions")
@@ -273,8 +274,8 @@ def test_metrics_latency_histograms():
     g.ok()
     g.close()
     text = m.render()
-    assert 'dynamo_tpu_http_service_ttft_seconds_count{model="m1"} 1' in text
-    assert ('dynamo_tpu_http_service_request_seconds_count'
+    assert f'{HM.TTFT_SECONDS}_count{{model="m1"}} 1' in text
+    assert (f'{HM.REQUEST_SECONDS}_count'
             '{model="m1",status="success"} 1') in text
     assert 'le="+Inf"' in text
     # cumulative buckets are monotonically nondecreasing

@@ -234,10 +234,11 @@ def test_restart_warm_restores_prefix(setup, tmp_path):  # noqa: F811
     stats = core2.metrics()
     assert stats["persist_hits"] > 0
     from dynamo_tpu.llm.http.metrics import Metrics
+    from dynamo_tpu.obs.metric_names import EngineMetric as EM
     text = Metrics().render()
-    assert "dynamo_tpu_engine_persist_hits_total" in text
+    assert EM.PERSIST_HITS_TOTAL in text
     for line in text.splitlines():
-        if line.startswith("dynamo_tpu_engine_persist_hits_total "):
+        if line.startswith(f"{EM.PERSIST_HITS_TOTAL} "):
             assert float(line.split()[-1]) > 0
     core2.close()
 

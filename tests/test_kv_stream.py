@@ -300,17 +300,18 @@ def test_scheduler_transfer_cost_fold():
 
 def test_metrics_render_stream_counters():
     from dynamo_tpu.llm.http.metrics import Metrics
+    from dynamo_tpu.obs.metric_names import KvStreamMetric as STM
 
     kv_stream_counters.record_session()
     kv_stream_counters.record_layer(100, 0.01, hidden=True)
     kv_stream_counters.record_layer(100, 0.01, hidden=False)
     kv_stream_counters.record_fallback()
     text = Metrics().render()
-    assert "dynamo_tpu_kv_stream_sessions_total 1" in text
-    assert "dynamo_tpu_kv_stream_layers_sent_total 2" in text
-    assert "dynamo_tpu_kv_stream_bytes_total 200" in text
-    assert "dynamo_tpu_kv_stream_fallbacks_total 1" in text
-    assert "dynamo_tpu_kv_stream_overlap_ratio 0.5" in text
+    assert f"{STM.SESSIONS_TOTAL} 1" in text
+    assert f"{STM.LAYERS_SENT_TOTAL} 2" in text
+    assert f"{STM.BYTES_TOTAL} 200" in text
+    assert f"{STM.FALLBACKS_TOTAL} 1" in text
+    assert f"{STM.OVERLAP_RATIO} 0.5" in text
 
 
 # ------------------------------------------------- in-process disagg e2e ----

@@ -324,6 +324,7 @@ def test_lookahead_gauges_on_http_metrics(setup):
     """The lookahead counters ride /metrics next to the unified gauges."""
     from dynamo_tpu.engine.counters import lookahead_counters
     from dynamo_tpu.llm.http.metrics import Metrics
+    from dynamo_tpu.obs.metric_names import EngineMetric as EM
 
     model, params, _ = setup
     lookahead_counters.reset()
@@ -339,18 +340,18 @@ def test_lookahead_gauges_on_http_metrics(setup):
     run_staggered(core, specs, head=1, stagger=3)
     assert core.lookahead_bursts > 0
     text = Metrics().render()
-    assert (f"dynamo_tpu_engine_lookahead_bursts_total "
+    assert (f"{EM.LOOKAHEAD_BURSTS_TOTAL} "
             f"{core.lookahead_bursts}") in text
-    assert (f"dynamo_tpu_engine_lookahead_hits_total "
+    assert (f"{EM.LOOKAHEAD_HITS_TOTAL} "
             f"{core.lookahead_hits}") in text
-    assert (f"dynamo_tpu_engine_lookahead_mispredicts_total "
+    assert (f"{EM.LOOKAHEAD_MISPREDICTS_TOTAL} "
             f"{core.lookahead_mispredicts}") in text
-    assert (f"dynamo_tpu_engine_lookahead_commits_total "
+    assert (f"{EM.LOOKAHEAD_COMMITS_TOTAL} "
             f"{core.lookahead_commits}") in text
-    assert (f"dynamo_tpu_engine_lookahead_flushes_total "
+    assert (f"{EM.LOOKAHEAD_FLUSHES_TOTAL} "
             f"{core.lookahead_flushes}") in text
-    assert "dynamo_tpu_engine_lookahead_dispatch_depth " in text
-    assert "dynamo_tpu_engine_host_gap_ms_per_turn " in text
+    assert f"{EM.LOOKAHEAD_DISPATCH_DEPTH} " in text
+    assert f"{EM.HOST_GAP_MS_PER_TURN} " in text
 
 
 # --------------------------------------------------------------- census

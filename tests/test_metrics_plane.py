@@ -13,6 +13,7 @@ import asyncio
 from aiohttp import ClientSession
 
 from dynamo_tpu.components.metrics import MetricsService, PrometheusMetricsCollector
+from dynamo_tpu.obs.metric_names import RouterMetric as RM
 from dynamo_tpu.components.mock_worker import MockWorker
 from dynamo_tpu.llm.kv.events import KvStoredEvent
 from dynamo_tpu.llm.kv_router.metrics_aggregator import KvRouterSubscriber
@@ -110,9 +111,9 @@ async def _mock_workers_feed_metrics():
             r = await s.get(f"http://127.0.0.1:{svc.port}/metrics")
             assert r.status == 200
             text = await r.text()
-        assert 'dynamo_tpu_kv_blocks_active{worker="1"}' in text
-        assert "dynamo_tpu_routing_decisions_total" in text
-        assert "dynamo_tpu_kv_hit_rate_percent" in text
+        assert f'{RM.KV_BLOCKS_ACTIVE}{{worker="1"}}' in text
+        assert RM.ROUTING_DECISIONS_TOTAL in text
+        assert RM.KV_HIT_RATE_PERCENT in text
 
         await w1.stop()
         await w2.stop()
@@ -130,8 +131,8 @@ def test_prometheus_collector_render():
     c.on_hit_rate_event(3, isl_blocks=8, overlap_blocks=6)
     c.on_hit_rate_event(3, isl_blocks=8, overlap_blocks=2)
     out = c.render()
-    assert 'dynamo_tpu_kv_cache_usage{worker="3"} 0.250000' in out
-    assert 'dynamo_tpu_routing_decisions_total{worker="3"} 2' in out
-    assert 'dynamo_tpu_kv_hit_rate_percent{worker="3"} 50.000' in out
+    assert f'{RM.KV_CACHE_USAGE}{{worker="3"}} 0.250000' in out
+    assert f'{RM.ROUTING_DECISIONS_TOTAL}{{worker="3"}} 2' in out
+    assert f'{RM.KV_HIT_RATE_PERCENT}{{worker="3"}} 50.000' in out
     c.remove_worker(3)
     assert 'kv_cache_usage{worker="3"}' not in c.render()

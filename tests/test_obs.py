@@ -19,6 +19,7 @@ import pytest
 from dynamo_tpu.obs import tracing
 from dynamo_tpu.obs.costs import TransferCostTable, transfer_costs
 from dynamo_tpu.obs.export import chrome_trace, trace_for_request
+from dynamo_tpu.obs.metric_names import EngineMetric as EM, HttpMetric as HM
 from dynamo_tpu.obs.timeline import PHASES, StepTimeline, step_timeline
 from dynamo_tpu.runtime.transports.protocol import TRACE_FIELD
 
@@ -504,12 +505,12 @@ def test_http_request_id_echo_and_itl(card):
 
                 m = await s.get(f"{base}/metrics")
                 text = await m.text()
-                assert "dynamo_tpu_http_service_inter_token_seconds_bucket" in text
-                assert ('dynamo_tpu_http_service_inter_token_seconds_count'
+                assert f"{HM.INTER_TOKEN_SECONDS}_bucket" in text
+                assert (f'{HM.INTER_TOKEN_SECONDS}_count'
                         '{model="echo-model"}') in text
                 # step timeline block renders even with a non-EngineCore
                 # backend (zeros are fine — the names are the contract)
-                assert "dynamo_tpu_engine_host_gap_ms_per_turn" in text
+                assert EM.HOST_GAP_MS_PER_TURN in text
 
                 r = await s.get(f"{base}/debug/traces/cli-abc-1")
                 assert r.status == 404

@@ -506,6 +506,7 @@ def test_seeded_run_populates_predicted_vs_measured_gauge():
     from dynamo_tpu.obs.export import chrome_trace
     from dynamo_tpu.obs.perfmodel import perf_model
     from dynamo_tpu.obs.timeline import step_timeline
+    from dynamo_tpu.obs.metric_names import PerfMetric as PM
 
     was = tracing.enabled()
     tracing.enable(True)
@@ -570,6 +571,7 @@ def test_metrics_render_exports_perf_gauges():
     config) predicted_step_ms rows from the committed manifest and the
     runtime per-kind predicted/measured/error gauges."""
     from dynamo_tpu.llm.http.metrics import Metrics
+    from dynamo_tpu.obs.metric_names import PerfMetric as PM
     from dynamo_tpu.obs.perfmodel import perf_model
     from dynamo_tpu.obs.timeline import step_timeline
 
@@ -591,11 +593,11 @@ def test_metrics_render_exports_perf_gauges():
             if step_timeline.dispatch_kind_n.get("step"):
                 break
         text = Metrics().render()
-        assert 'dynamo_tpu_perf_predicted_step_ms{entrypoint="' in text
+        assert f'{PM.PREDICTED_STEP_MS}{{entrypoint="' in text
         assert 'config="llama3b-v5e"' in text
-        assert 'dynamo_tpu_perf_predicted_dispatch_ms{kind="step"}' in text
-        assert 'dynamo_tpu_perf_measured_dispatch_ms{kind="step"}' in text
-        assert 'dynamo_tpu_perf_model_error_ratio{kind="step"}' in text
+        assert f'{PM.PREDICTED_DISPATCH_MS}{{kind="step"}}' in text
+        assert f'{PM.MEASURED_DISPATCH_MS}{{kind="step"}}' in text
+        assert f'{PM.MODEL_ERROR_RATIO}{{kind="step"}}' in text
     finally:
         step_timeline.reset()
         perf_model.reset()

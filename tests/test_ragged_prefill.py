@@ -225,6 +225,7 @@ def test_prefill_gauges_on_http_metrics(setup):
     """The batching gauges ride /metrics next to the fault counters."""
     from dynamo_tpu.engine.counters import counters as prefill_counters
     from dynamo_tpu.llm.http.metrics import Metrics
+    from dynamo_tpu.obs.metric_names import EngineMetric as EM
 
     model, params, _ = setup
     prefill_counters.reset()
@@ -237,7 +238,7 @@ def test_prefill_gauges_on_http_metrics(setup):
     core = make_core(model, params, prefill_token_budget=128)
     run_requests(core, specs, sequential=False)
     text = Metrics().render()
-    assert "dynamo_tpu_engine_prefill_dispatches_total 1" in text
-    assert "dynamo_tpu_engine_prefill_tokens_total 48" in text
-    assert "dynamo_tpu_engine_prefill_batch_occupancy 3" in text
-    assert "dynamo_tpu_engine_prefill_budget_utilization 0.375" in text
+    assert f"{EM.PREFILL_DISPATCHES_TOTAL} 1" in text
+    assert f"{EM.PREFILL_TOKENS_TOTAL} 48" in text
+    assert f"{EM.PREFILL_BATCH_OCCUPANCY} 3" in text
+    assert f"{EM.PREFILL_BUDGET_UTILIZATION} 0.375" in text

@@ -372,6 +372,7 @@ def test_unified_gauges_on_http_metrics(setup):
     """The unified counters ride /metrics next to the prefill gauges."""
     from dynamo_tpu.engine.counters import counters as prefill_counters
     from dynamo_tpu.llm.http.metrics import Metrics
+    from dynamo_tpu.obs.metric_names import EngineMetric as EM
 
     model, params, _ = setup
     prefill_counters.reset()
@@ -387,10 +388,10 @@ def test_unified_gauges_on_http_metrics(setup):
     run_staggered(core, specs, head=1, stagger=3)
     assert core.unified_dispatches > 0
     text = Metrics().render()
-    assert (f"dynamo_tpu_engine_unified_dispatches_total "
+    assert (f"{EM.UNIFIED_DISPATCHES_TOTAL} "
             f"{core.unified_dispatches}") in text
-    assert (f"dynamo_tpu_engine_unified_decode_rows "
+    assert (f"{EM.UNIFIED_DECODE_ROWS_TOTAL} "
             f"{core.unified_decode_rows}") in text
-    assert (f"dynamo_tpu_engine_unified_prefill_tokens "
+    assert (f"{EM.UNIFIED_PREFILL_TOKENS_TOTAL} "
             f"{core.unified_prefill_tokens}") in text
-    assert "dynamo_tpu_engine_unified_budget_utilization " in text
+    assert f"{EM.UNIFIED_BUDGET_UTILIZATION} " in text
